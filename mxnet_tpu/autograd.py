@@ -1,0 +1,339 @@
+"""Autograd: imperative gradient tape.
+
+TPU-native equivalent of the reference's Imperative autograd
+(src/imperative/imperative.cc: RecordOp :193, Backward :280; Python front
+python/mxnet/autograd.py). The tape records every `invoke()` made inside a
+`record()` scope as (opdef, attrs, inputs@version, outputs@version). Backward
+walks the tape in reverse; each node's gradient is produced by a *cached,
+jitted* `jax.vjp` of the same pure op function that ran forward — one
+compiled backward kernel per (op, attrs), mirroring how the reference derives
+backward nodes from the forward op's FGradient attr (nnvm/gradient.cc:271).
+
+Versioned keys (NDArray._version) play the role of the reference's engine
+variable versioning: in-place buffer swaps create a new logical node, keeping
+the tape sound under mutation.
+
+For throughput-critical training, hybridize (CachedOp) captures whole graphs
+under one jit where XLA does AD-free fused codegen; this tape is the eager
+path, like the reference's per-op Imperative::Backward.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+
+import numpy as _np
+
+from . import ops as _ops
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "Function",
+           "set_recording", "set_training"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    st = _st()
+    prev = st.recording
+    st.recording = is_record
+    return prev
+
+
+def set_training(train_mode_):
+    st = _st()
+    prev = st.training
+    st.training = train_mode_
+    return prev
+
+
+@contextlib.contextmanager
+def _scope(recording=None, training=None):
+    st = _st()
+    prev_r, prev_t = st.recording, st.training
+    if recording is not None:
+        if recording and not prev_r:
+            st.tape = []  # fresh outermost record scope starts a new tape
+        st.recording = recording
+    if training is not None:
+        st.training = training
+    try:
+        yield
+    finally:
+        st.recording, st.training = prev_r, prev_t
+
+
+def record(train_mode=True):
+    """Scope: record ops for autograd (reference: autograd.py:122)."""
+    return _scope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    """Scope: stop recording (reference: autograd.py:141)."""
+    return _scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _scope(training=True)
+
+
+def predict_mode():
+    return _scope(training=False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference: autograd.py:197 -> imperative.cc:126)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+
+
+# --------------------------------------------------------------------------
+# tape
+# --------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("opdef", "attr_key", "rng", "inputs", "in_arrays", "out_keys",
+                 "out_shapes", "out_dtypes", "py_backward")
+
+    def __init__(self, opdef, attr_key, rng, inputs, in_arrays, out_keys,
+                 out_shapes, out_dtypes):
+        self.opdef = opdef
+        self.attr_key = attr_key
+        self.rng = rng
+        self.inputs = inputs        # list[(NDArray, version)]
+        self.in_arrays = in_arrays  # jax arrays at call time
+        self.out_keys = out_keys    # list[(id, version)] for ALL outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        self.py_backward = None
+
+
+def _record(opdef, attrs, rng, inputs, in_arrays, out_nd, all_results):
+    """Called from ndarray.invoke while recording (reference: RecordOp)."""
+    from .ndarray.ndarray import NDArray
+
+    st = _st()
+    nd_inputs = [(i, i._version) for i in inputs if isinstance(i, NDArray)]
+    attr_key = tuple(sorted((k, _ops._hashable(v)) for k, v in attrs.items()))
+    out_keys = [(id(o), o._version) for o in out_nd]
+    # aux outputs (written back into trailing inputs) count too: their
+    # cotangents are zero but the vjp needs seeds of the right shape
+    out_shapes = [r.shape for r in all_results]
+    out_dtypes = [r.dtype for r in all_results]
+    st.tape.append(_Node(opdef, attr_key, rng, nd_inputs, in_arrays, out_keys,
+                         out_shapes, out_dtypes))
+    # remember the arrays so backward can resolve ids
+    for o in out_nd:
+        _LIVE[id(o)] = o
+
+
+_LIVE = {}
+
+
+def _is_float(dt):
+    return _np.issubdtype(_np.dtype(dt), _np.floating) or str(dt) == "bfloat16"
+
+
+@functools.lru_cache(maxsize=8192)
+def _bwd_jitted(name, attr_key, has_rng):
+    """Jitted per-(op, attrs) backward: recompute forward + vjp in one fused
+    executable (the tape-recompute formulation; XLA DCEs what the pullback
+    doesn't need)."""
+    import jax
+
+    opdef = _ops.get(name)
+    kwargs = dict(attr_key)
+
+    def bwd(rng, in_arrays, float_cots):
+        def f(*args):
+            call = (rng,) + args if has_rng else args
+            out = opdef.fn(*call, **kwargs)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        primals, pull = jax.vjp(f, *in_arrays)
+        seeds = []
+        fi = 0
+        for p in primals:
+            if _is_float(p.dtype):
+                seeds.append(float_cots[fi])
+                fi += 1
+            else:
+                seeds.append(_np.zeros(p.shape, jax.dtypes.float0))
+        return pull(tuple(seeds))
+
+    return jax.jit(bwd)
+
+
+def _run_backward(heads, head_grads, retain_graph=False):
+    import jax.numpy as jnp
+
+    st = _st()
+    cot = {}
+    for h, hg in zip(heads, head_grads):
+        key = (id(h), h._version)
+        seed = hg if hg is not None else jnp.ones(h.shape, h.dtype)
+        if hasattr(seed, "_data"):
+            seed = seed._data
+        cot[key] = cot[key] + seed if key in cot else seed
+        _LIVE[id(h)] = h
+
+    touched = {}
+    for node in reversed(st.tape):
+        if not any(k in cot for k in node.out_keys):
+            continue
+        if not any(_is_float(a.dtype) for a in node.in_arrays):
+            continue
+        if node.py_backward is not None:
+            all_cots = []
+            for k, shp, dt in zip(node.out_keys, node.out_shapes, node.out_dtypes):
+                c = cot.get(k)
+                all_cots.append(c if c is not None else jnp.zeros(shp, dt))
+            grads = node.py_backward(all_cots)
+            grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+            in_cots = [g._data if hasattr(g, "_data") else g for g in grads]
+        else:
+            float_cots = []
+            for k, shp, dt in zip(node.out_keys + [None] * (len(node.out_shapes) - len(node.out_keys)),
+                                  node.out_shapes, node.out_dtypes):
+                if not _is_float(dt):
+                    continue
+                c = cot.get(k) if k is not None else None
+                float_cots.append(c if c is not None else jnp.zeros(shp, dt))
+            fn = _bwd_jitted(node.opdef.name, node.attr_key, node.opdef.needs_rng)
+            rng = node.rng
+            if rng is None:
+                import jax
+
+                rng = jax.random.PRNGKey(0)
+            in_cots = fn(rng, node.in_arrays, tuple(float_cots))
+        for (arr, ver), c in zip(node.inputs, in_cots):
+            if c is None or (hasattr(c, "dtype") and str(c.dtype) == "float0"):
+                continue
+            key = (id(arr), ver)
+            cot[key] = cot[key] + c if key in cot else c
+            touched[id(arr)] = arr
+
+    # write accumulated grads into attached buffers
+    for aid, arr in list(touched.items()) + [(id(h), h) for h in heads]:
+        if arr._grad is None or arr._grad_req == "null":
+            continue
+        total = None
+        for (kid, ver), c in cot.items():
+            if kid == aid:
+                total = c if total is None else total + c
+        if total is None:
+            continue
+        if arr._grad_req == "add":
+            arr._grad._set_data(arr._grad._data + total.astype(arr._grad.dtype))
+        else:
+            arr._grad._set_data(total.astype(arr._grad.dtype))
+        arr._fresh_grad = True
+    if not retain_graph:
+        st.tape = []
+        _LIVE.clear()
+    return cot
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads wrt all attached-grad variables
+    (reference: autograd.py:243 -> MXAutogradBackwardEx -> imperative.cc:280)."""
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    _run_backward(heads, head_grads, retain_graph)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return grads of heads wrt variables without touching .grad buffers
+    (reference: autograd.py:270). create_graph (higher-order) is not yet
+    supported on the tape; use hybridized blocks + jax.grad for that."""
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise MXNetError("create_graph=True not supported by the eager tape yet")
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    retain = True if retain_graph is None else retain_graph
+    cot = _run_backward(heads, head_grads, retain_graph=retain)
+    outs = []
+    for v in variables:
+        total = None
+        for (kid, ver), c in cot.items():
+            if kid == id(v):
+                total = c if total is None else total + c
+        if total is None:
+            import jax.numpy as jnp
+
+            total = jnp.zeros(v.shape, v.dtype)
+        outs.append(NDArray(total, ctx=v._ctx))
+    return outs
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol: use HybridBlock.export / Symbol API")
+
+
+class Function:
+    """Custom differentiable function (reference: autograd.py:365).
+
+    Subclass and implement forward(self, *inputs) and backward(self, *ograds)
+    operating on NDArrays; invoked with .__call__."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        st = _st()
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+        if st.recording:
+            fn_self = self
+            node_inputs = [(i, i._version) for i in inputs if isinstance(i, NDArray)]
+            node = _Node(None, (), None, node_inputs,
+                         tuple(i._data for i in inputs if isinstance(i, NDArray)),
+                         [(id(o), o._version) for o in outs],
+                         [o.shape for o in outs], [o.dtype for o in outs])
+            node.py_backward = lambda cots: fn_self.backward(
+                *[NDArray(c) for c in cots])
+            st.tape.append(node)
+            for o in outs:
+                _LIVE[id(o)] = o
+        return outputs

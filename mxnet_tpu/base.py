@@ -1,0 +1,82 @@
+"""Base utilities: errors, registries, dtype handling.
+
+TPU-native rebuild of the reference's `python/mxnet/base.py` role (ctypes
+plumbing, error translation — reference: python/mxnet/base.py). Here there is
+no C ABI to cross for the frontend: the "backend" is JAX/XLA, so this module
+only carries the shared error type, the string/dtype conversion helpers, and
+the small registry machinery the op/optimizer/metric/initializer registries use
+(reference: python/mxnet/registry.py).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: python/mxnet/base.py:49)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# dtype name <-> numpy dtype mapping (reference keeps int codes in
+# python/mxnet/base.py via _DTYPE_NP_TO_MX; we key on names since XLA is typed)
+_DTYPE_ALIASES = {
+    "float32": _np.float32,
+    "float64": _np.float64,
+    "float16": _np.float16,
+    "bfloat16": "bfloat16",  # resolved lazily via ml_dtypes through jax.numpy
+    "uint8": _np.uint8,
+    "int8": _np.int8,
+    "int32": _np.int32,
+    "int64": _np.int64,
+    "bool": _np.bool_,
+}
+
+
+def np_dtype(dtype):
+    """Normalize a user-provided dtype (string/np.dtype/jnp dtype) to numpy dtype."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return _np.dtype(_np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        return _np.dtype(jnp.bfloat16)
+    return _np.dtype(dtype)
+
+
+class _Registry:
+    """Simple name->object registry with alias support
+    (reference: python/mxnet/registry.py:30 `get_register_func`)."""
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._map = {}
+
+    def register(self, obj, name=None, aliases=()):
+        key = (name or getattr(obj, "__name__", str(obj))).lower()
+        self._map[key] = obj
+        for a in aliases:
+            self._map[a.lower()] = obj
+        return obj
+
+    def get(self, name):
+        key = name.lower()
+        if key not in self._map:
+            raise MXNetError(
+                "Cannot find %s '%s'. Valid: %s"
+                % (self.kind, name, sorted(self._map))
+            )
+        return self._map[key]
+
+    def create(self, name, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+    def __contains__(self, name):
+        return name.lower() in self._map
+
+    def keys(self):
+        return list(self._map)

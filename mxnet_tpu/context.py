@@ -1,0 +1,148 @@
+"""Device contexts.
+
+TPU-native equivalent of the reference's `python/mxnet/context.py` (Context
+class + ctx stack, context.py:23-309). Devices map onto JAX/PJRT devices:
+
+- ``cpu()``    -> host CPU PJRT device
+- ``tpu(i)``   -> i-th TPU chip
+- ``gpu(i)``   -> alias for the i-th *accelerator* device; kept so reference
+  scripts written against ``mx.gpu()`` run unmodified on TPU machines.
+- ``cpu_pinned()`` -> host CPU (XLA manages pinned staging buffers itself).
+
+Unlike the reference there is no device-id-indexed cuda runtime behind this;
+a Context is a thin, hashable handle resolving to a `jax.Device`.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context", "num_gpus", "num_tpus"]
+
+
+class Context:
+    """Device context (reference: python/mxnet/context.py:23).
+
+    Parameters
+    ----------
+    device_type : {'cpu', 'gpu', 'tpu', 'cpu_pinned', 'cpu_shared'}
+    device_id : int
+    """
+
+    _stack = threading.local()
+
+    devtype2id = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+    devid2type = {v: k for k, v in devtype2id.items()}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        if device_type not in self.devtype2id:
+            raise MXNetError("unknown device type %s" % device_type)
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def device_typeid(self):
+        return self.devtype2id[self.device_type]
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __str__ = __repr__
+
+    # -- JAX resolution ---------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete `jax.Device`."""
+        import jax
+
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = jax.devices("cpu")
+            return devs[min(self.device_id, len(devs) - 1)]
+        # 'gpu' and 'tpu' both mean "accelerator": prefer the default backend's
+        # devices (TPU when present), fall back to cpu so CPU-only test runs work.
+        devs = jax.devices()
+        if devs[0].platform == "cpu" and self.device_type in ("gpu", "tpu"):
+            return devs[min(self.device_id, len(devs) - 1)]
+        if self.device_id >= len(devs):
+            raise MXNetError(
+                "device %s out of range: %d accelerator device(s) visible"
+                % (self, len(devs))
+            )
+        return devs[self.device_id]
+
+    # -- stack ------------------------------------------------------------
+    def __enter__(self):
+        if not hasattr(Context._stack, "ctxs"):
+            Context._stack.ctxs = []
+        Context._stack.ctxs.append(self)
+        return self
+
+    def __exit__(self, *args):
+        Context._stack.ctxs.pop()
+
+    @classmethod
+    def default_ctx(cls):
+        stack = getattr(cls._stack, "ctxs", None)
+        if stack:
+            return stack[-1]
+        return _DEFAULT
+
+    def empty_cache(self):
+        """Release cached device memory (reference: context.py:292). XLA owns
+        the allocator; this is a best-effort no-op hook."""
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    """Accelerator context; on TPU machines this is the TPU chip (kept for
+    source compatibility with reference scripts using mx.gpu())."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def num_gpus():
+    """Number of accelerator devices (reference: context.py:258 num_gpus)."""
+    import jax
+
+    devs = jax.devices()
+    return 0 if devs[0].platform == "cpu" else len(devs)
+
+
+def num_tpus():
+    return num_gpus()
+
+
+_DEFAULT = Context("cpu", 0)
+
+
+def _set_default(ctx):
+    global _DEFAULT
+    _DEFAULT = ctx
+
+
+def current_context():
+    """The context on top of the with-stack (reference: context.py:301)."""
+    return Context.default_ctx()

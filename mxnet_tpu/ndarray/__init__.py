@@ -1,0 +1,30 @@
+"""mxnet_tpu.ndarray — imperative array API (reference: python/mxnet/ndarray)."""
+from __future__ import annotations
+
+from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty, arange,
+                      concat, save, load, waitall, from_jax)
+from . import register as _register
+
+_register.populate(globals())
+
+# convenience re-exports matching mxnet.nd surface
+from .ndarray import stack  # noqa: F401
+
+
+def zeros_like(data):
+    return invoke("zeros_like", (data,), {})
+
+
+def ones_like(data):
+    return invoke("ones_like", (data,), {})
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    out = invoke("_eye", (), {"N": N, "M": M, "k": k, "dtype": dtype})
+    return out.as_in_context(ctx) if ctx is not None else out
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    out = invoke("_linspace", (), {"start": start, "stop": stop, "num": num,
+                                   "endpoint": endpoint, "dtype": dtype})
+    return out.as_in_context(ctx) if ctx is not None else out

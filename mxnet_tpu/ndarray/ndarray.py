@@ -1,0 +1,644 @@
+"""NDArray: the imperative tensor.
+
+TPU-native equivalent of the reference's NDArray (include/mxnet/ndarray.h:82,
+src/ndarray/ndarray.cc — SURVEY §2.1 N3) and of the Python front
+(python/mxnet/ndarray/ndarray.py). Design mapping:
+
+- Storage/Chunk + engine var  →  an immutable `jax.Array` (PJRT buffer). XLA
+  owns allocation/pooling; async dispatch and dependency ordering come free
+  from PJRT's stream semantics (the reference needed the threaded engine N1
+  for this).
+- in-place mutation (`+=`, `x[:]=`, optimizer updates, BN aux states)  →
+  functional buffer *swap*: ops return new arrays and `_set_data` rebinds the
+  handle, bumping a version counter (used by the autograd tape the way the
+  reference uses engine var versioning).
+- `WaitToRead/WaitToWrite` (ndarray.h:359)  →  `wait_to_read` =
+  `block_until_ready`; async device errors surface here, matching the
+  reference's deferred-exception rethrow (threaded_engine.cc:418).
+
+Every operator call goes through `invoke()` — the equivalent of
+`Imperative::Invoke` (src/imperative/imperative.cc:89): resolve OpDef, inject
+train-mode / RNG key, run the per-(op, attrs) compiled executable, wrap
+outputs, write back aux outputs, and record the call on the autograd tape.
+"""
+from __future__ import annotations
+
+import inspect
+
+import numpy as _np
+
+from .. import ops as _ops
+from ..base import MXNetError, np_dtype, numeric_types
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
+           "arange", "concat", "save", "load", "waitall", "from_jax"]
+
+
+def _sig_params(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return [], False
+    names = []
+    var_pos = False
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            var_pos = True
+        elif p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                        inspect.Parameter.POSITIONAL_OR_KEYWORD):
+            names.append(p.name)
+    return names, var_pos
+
+
+class NDArray:
+    """Multi-dimensional array on a device (reference: ndarray.h:82)."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_version", "_fresh_grad")
+
+    def __init__(self, data, ctx=None):
+        self._data = data  # jax.Array
+        self._ctx = ctx if ctx is not None else current_context()
+        self._grad = None
+        self._grad_req = "null"
+        self._version = 0
+        self._fresh_grad = False
+
+    # -- core properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def handle(self):  # legacy compat: the jax array IS the handle
+        return self._data
+
+    def _set_data(self, new_data):
+        """Swap the underlying buffer (functional mutation)."""
+        self._data = new_data
+        self._version += 1
+
+    # -- sync / transfer (engine boundary) --------------------------------
+    def wait_to_read(self):
+        """Block until value ready; async errors raise here
+        (reference: NDArray::WaitToRead ndarray.h:359)."""
+        self._data.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        return self.shape[0]
+
+    def copyto(self, other):
+        import jax
+
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self._data, other._ctx.jax_device()))
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()), ctx=other)
+        raise TypeError("copyto: expected NDArray or Context")
+
+    def as_in_context(self, ctx):
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def copy(self):
+        return self.copyto(self._ctx)
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    def astype(self, dtype, copy=True):
+        return invoke("Cast", (self,), {"dtype": _np.dtype(np_dtype(dtype)).name})
+
+    def to_dlpack_for_read(self):
+        return self._data.__dlpack__()
+
+    # -- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer (reference: python ndarray.py attach_grad
+        -> MXAutogradMarkVariables c_api_ndarray.cc:257)."""
+        import jax.numpy as jnp
+
+        self._grad = NDArray(jnp.zeros(self.shape, self.dtype), ctx=self._ctx)
+        self._grad_req = grad_req
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape ops --------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return invoke("Reshape", (self,), {"shape": shape,
+                                           "reverse": kwargs.get("reverse", False)})
+
+    def reshape_like(self, other):
+        return invoke("Reshape", (self,), {"shape": other.shape})
+
+    def transpose(self, axes=None):
+        return invoke("transpose", (self,), {"axes": axes})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def flatten(self):
+        return invoke("Flatten", (self,), {})
+
+    def expand_dims(self, axis):
+        return invoke("expand_dims", (self,), {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return invoke("squeeze", (self,), {"axis": axis})
+
+    def swapaxes(self, dim1, dim2):
+        return invoke("SwapAxis", (self,), {"dim1": dim1, "dim2": dim2})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke("SliceChannel", (self,), {"num_outputs": num_outputs,
+                                                "axis": axis,
+                                                "squeeze_axis": squeeze_axis})
+
+    def slice(self, begin, end, step=None):
+        return invoke("slice", (self,), {"begin": begin, "end": end,
+                                         "step": step or ()})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke("slice_axis", (self,), {"axis": axis, "begin": begin, "end": end})
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", (self,), {"shape": shape})
+
+    def broadcast_like(self, other):
+        return invoke("broadcast_like", (self, other), {})
+
+    def tile(self, reps):
+        return invoke("tile", (self,), {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return invoke("repeat", (self,), {"repeats": repeats, "axis": axis})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke("take", (self, indices), {"axis": axis, "mode": mode})
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return invoke("batch_take", (self, index), {"axis": axis, "keepdims": keepdims})
+
+    def one_hot(self, depth, **kw):
+        return invoke("one_hot", (self,), dict(depth=depth, **kw))
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+
+        return _sp.cast_storage(self, stype)
+
+    # -- reductions -------------------------------------------------------
+    def sum(self, axis=None, keepdims=False, **kw):
+        return invoke("sum", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return invoke("mean", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return invoke("prod", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return invoke("max", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return invoke("min", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke("norm", (self,), {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke("argmax", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke("argmin", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke("argsort", (self,), {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return invoke("topk", (self,), {"axis": axis, "k": k, "ret_typ": ret_typ,
+                                        "is_ascend": is_ascend})
+
+    def clip(self, a_min, a_max):
+        return invoke("clip", (self,), {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return invoke("abs", (self,), {})
+
+    def sqrt(self):
+        return invoke("sqrt", (self,), {})
+
+    def square(self):
+        return invoke("square", (self,), {})
+
+    def exp(self):
+        return invoke("exp", (self,), {})
+
+    def log(self):
+        return invoke("log", (self,), {})
+
+    def sigmoid(self):
+        return invoke("sigmoid", (self,), {})
+
+    def tanh(self):
+        return invoke("tanh", (self,), {})
+
+    def relu(self):
+        return invoke("relu", (self,), {})
+
+    def softmax(self, axis=-1):
+        return invoke("softmax", (self,), {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return invoke("log_softmax", (self,), {"axis": axis})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke("dot", (self, other), {"transpose_a": transpose_a,
+                                             "transpose_b": transpose_b})
+
+    def zeros_like(self):
+        return invoke("zeros_like", (self,), {})
+
+    def ones_like(self):
+        return invoke("ones_like", (self,), {})
+
+    def flip(self, axis):
+        return invoke("reverse", (self,), {"axis": axis})
+
+    def pad(self, mode="constant", pad_width=(), constant_value=0.0):
+        return invoke("Pad", (self,), {"mode": mode, "pad_width": pad_width,
+                                       "constant_value": constant_value})
+
+    # -- arithmetic dunders ----------------------------------------------
+    def _binary(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            args = (other, self) if reverse else (self, other)
+            return invoke(op, args, {})
+        if isinstance(other, numeric_types):
+            name = scalar_op
+            if reverse and "_r" not in scalar_op:
+                rname = scalar_op.replace("_scalar", "").replace("_", "", 1)
+                name = "_r%s_scalar" % rname
+                if name not in _ops._REGISTRY:
+                    name = scalar_op  # commutative
+            return invoke(name, (self,), {"scalar": float(other)})
+        if isinstance(other, _np.ndarray):
+            return self._binary(array(other, ctx=self._ctx), op, scalar_op, reverse)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elemwise_sub", "_rminus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elemwise_div", "_rdiv_scalar", reverse=True)
+
+    def __mod__(self, o):
+        return self._binary(o, "elemwise_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._binary(o, "elemwise_mod", "_rmod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "elemwise_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binary(o, "elemwise_power", "_rpower_scalar", reverse=True)
+
+    def __matmul__(self, o):
+        return self.dot(o)
+
+    def __neg__(self):
+        return invoke("negative", (self,), {})
+
+    def __abs__(self):
+        return invoke("abs", (self,), {})
+
+    def __eq__(self, o):
+        if isinstance(o, (NDArray,) + numeric_types):
+            return self._binary(o, "elemwise_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (NDArray,) + numeric_types):
+            return self._binary(o, "elemwise_not_equal", "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, o):
+        return self._binary(o, "elemwise_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "elemwise_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "elemwise_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "elemwise_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: functional buffer swap
+    def __iadd__(self, o):
+        self._set_data((self + o)._data)
+        return self
+
+    def __isub__(self, o):
+        self._set_data((self - o)._data)
+        return self
+
+    def __imul__(self, o):
+        self._set_data((self * o)._data)
+        return self
+
+    def __itruediv__(self, o):
+        self._set_data((self / o)._data)
+        return self
+
+    # -- indexing ---------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data.astype("int32")
+        out = self._data[key]
+        return NDArray(out, ctx=self._ctx)
+
+    def __setitem__(self, key, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, _np.ndarray):
+            value = jnp.asarray(value, dtype=self.dtype)
+        if isinstance(key, NDArray):
+            key = key._data.astype("int32")
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            if not hasattr(value, "shape") or value.shape != self.shape:
+                value = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype), self.shape)
+            self._set_data(jnp.asarray(value, dtype=self.dtype))
+        else:
+            self._set_data(self._data.at[key].set(value))
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    def __repr__(self):
+        return "\n%s\n<NDArray %s @%s>" % (
+            self.asnumpy(), "x".join(str(s) for s in self.shape), self._ctx)
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+# --------------------------------------------------------------------------
+# op invocation — the Imperative::Invoke equivalent
+# --------------------------------------------------------------------------
+
+_IS_TRAIN_CACHE = {}
+
+
+def _takes_is_train(opdef):
+    v = _IS_TRAIN_CACHE.get(opdef.name)
+    if v is None:
+        names, _ = _sig_params(opdef.fn)
+        v = "is_train" in names
+        _IS_TRAIN_CACHE[opdef.name] = v
+    return v
+
+
+def invoke(op_name, inputs, attrs, out=None):
+    """Invoke a registered op on NDArrays (reference call path:
+    MXImperativeInvokeEx c_api_ndarray.cc:132 -> Imperative::Invoke
+    imperative.cc:89 -> PushFCompute; here: resolve -> compiled-exec cache ->
+    wrap -> tape record)."""
+    from .. import autograd, random as _random
+
+    opdef = _ops.get(op_name)
+    attrs = {k: v for k, v in attrs.items() if v is not None or k in ("axis",)}
+    attrs.pop("name", None)
+    attrs.pop("dtype_np", None)
+    if _takes_is_train(opdef):
+        attrs.setdefault("is_train", autograd.is_training())
+
+    in_arrays = tuple(i._data if isinstance(i, NDArray) else i for i in inputs)
+    rng = _random.next_key() if opdef.needs_rng else None
+    call_arrays = (rng,) + in_arrays if opdef.needs_rng else in_arrays
+
+    results = _ops.invoke_jax(op_name, call_arrays, attrs)
+    multi = isinstance(results, (tuple, list))
+    results = tuple(results) if multi else (results,)
+
+    ctx = None
+    for i in inputs:
+        if isinstance(i, NDArray):
+            ctx = i._ctx
+            break
+    ctx = ctx or current_context()
+    out_nd = [NDArray(r, ctx=ctx) for r in results]
+
+    # aux write-back: trailing (num_outputs - visible) outputs map onto the
+    # trailing inputs (BatchNorm moving stats, optimizer states)
+    n_aux = (opdef.num_outputs - opdef.visible_outputs) if opdef.num_outputs > 0 else 0
+    if n_aux > 0:
+        aux_inputs = [i for i in inputs if isinstance(i, NDArray)][-n_aux:]
+        for dst, src in zip(aux_inputs, results[-n_aux:]):
+            dst._set_data(src)
+        out_nd = out_nd[: opdef.visible_outputs]
+
+    if autograd.is_recording():
+        autograd._record(opdef, attrs, rng, inputs, in_arrays, out_nd, results)
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs, out_nd):
+            dst._set_data(src._data)
+        return out
+
+    if len(out_nd) == 1:
+        return out_nd[0]
+    return out_nd
+
+
+# --------------------------------------------------------------------------
+# creation / io functions (reference: python/mxnet/ndarray/ndarray.py + utils)
+# --------------------------------------------------------------------------
+
+def from_jax(arr, ctx=None):
+    return NDArray(arr, ctx=ctx)
+
+
+def array(source, ctx=None, dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    ctx = ctx or current_context()
+    if isinstance(source, NDArray):
+        if dtype is None:
+            dtype = source.dtype  # reference keeps NDArray dtype
+        source = source._data
+    if dtype is None:
+        # reference default: float32 for any non-NDArray source
+        # (python/mxnet/ndarray/ndarray.py `array`)
+        dtype = "float32"
+    npa = _np.asarray(source, dtype=np_dtype(dtype))
+    return NDArray(jax.device_put(jnp.asarray(npa), ctx.jax_device()), ctx=ctx)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jax.device_put(jnp.zeros(shape, np_dtype(dtype)), ctx.jax_device()), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jax.device_put(jnp.ones(shape, np_dtype(dtype)), ctx.jax_device()), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32", **kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jax.device_put(jnp.full(shape, val, np_dtype(dtype)), ctx.jax_device()), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = invoke("_arange", (), {"start": start, "stop": stop, "step": step,
+                                 "repeat": repeat, "dtype": dtype})
+    if ctx is not None:
+        return out.as_in_context(ctx)
+    return out
+
+
+def concat(*arrays, dim=1):
+    return invoke("Concat", tuple(arrays), {"dim": dim})
+
+
+def stack(*arrays, axis=0):
+    return invoke("stack", tuple(arrays), {"axis": axis})
+
+
+def waitall():
+    from .. import engine
+
+    engine.wait_all()
+
+
+def save(fname, data):
+    """Save NDArrays (reference format: prefix.params via NDArray::Save
+    src/ndarray/ndarray.cc; ours is an npz container — same keys/roundtrip,
+    different binary layout, documented divergence)."""
+    if isinstance(data, NDArray):
+        data = {"0": data}
+    if isinstance(data, (list, tuple)):
+        data = {str(i): v for i, v in enumerate(data)}
+    _np.savez(fname if fname.endswith(".npz") else fname, **{
+        k: v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v) for k, v in data.items()})
+    import os
+
+    # numpy appends .npz; keep the exact requested filename
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def load(fname):
+    with _np.load(fname, allow_pickle=False) as f:
+        out = {k: array(f[k]) for k in f.files}
+    keys = list(out)
+    if keys and all(k.isdigit() for k in keys):
+        return [out[k] for k in sorted(keys, key=int)]
+    return out

@@ -1,0 +1,103 @@
+"""Generate module-level op functions from the registry.
+
+Reference mechanism: python/mxnet/ndarray/register.py:170
+`_init_op_module('mxnet','ndarray',_make_ndarray_function)` builds one Python
+function per C++-registered op at import. We do the same against the jax op
+registry: each OpDef gets a wrapper that splits NDArray arguments from attrs
+by the op function's signature, then calls ndarray.invoke. Ops named
+`_contrib_*` / `_linalg_*` / `_random_*` land in `nd.contrib` / `nd.linalg` /
+`nd.random` namespaces like the reference."""
+from __future__ import annotations
+
+import inspect
+
+from .. import ops as _ops
+from .ndarray import NDArray, invoke
+
+
+def _make_function(opdef):
+    fn = opdef.fn
+    try:
+        sig = inspect.signature(fn)
+        params = list(sig.parameters.values())
+    except (TypeError, ValueError):
+        params = []
+    if opdef.needs_rng and params and params[0].name == "rng":
+        params = params[1:]
+    var_pos = any(p.kind == inspect.Parameter.VAR_POSITIONAL for p in params)
+    pos_names = [p.name for p in params
+                 if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                               inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+
+    def generated(*args, out=None, name=None, **kwargs):
+        inputs = []
+        attrs = {}
+        ctx = kwargs.pop("ctx", None)
+        if var_pos:
+            for a in args:
+                if isinstance(a, NDArray):
+                    inputs.append(a)
+                else:
+                    raise TypeError("%s: positional args must be NDArray" % opdef.name)
+            kwargs.pop("num_args", None)
+            attrs.update(kwargs)
+        else:
+            consumed = set()
+            for i, a in enumerate(args):
+                pname = pos_names[i] if i < len(pos_names) else None
+                if isinstance(a, NDArray):
+                    inputs.append(a)
+                    consumed.add(pname)
+                elif pname is not None:
+                    attrs[pname] = a
+                    consumed.add(pname)
+            # NDArray kwargs slot in by declared parameter order
+            for pname in pos_names:
+                if pname in consumed:
+                    continue
+                if pname in kwargs and isinstance(kwargs[pname], NDArray):
+                    inputs.append(kwargs.pop(pname))
+            attrs.update({k: v for k, v in kwargs.items()
+                          if not isinstance(v, NDArray)})
+        result = invoke(opdef.name, tuple(inputs), attrs, out=out)
+        if ctx is not None and out is None and isinstance(result, NDArray):
+            result = result.as_in_context(ctx)
+        return result
+
+    generated.__name__ = opdef.name
+    generated.__doc__ = (fn.__doc__ or "") + "\n\n(auto-generated from op '%s')" % opdef.name
+    return generated
+
+
+class _OpNamespace(object):
+    pass
+
+
+def populate(target_module_dict):
+    """Install generated functions into the nd module namespace."""
+    contrib = _OpNamespace()
+    linalg = _OpNamespace()
+    random_ns = _OpNamespace()
+    sparse_ns = _OpNamespace()
+    seen = set()
+    for name in _ops.list_ops():
+        opdef = _ops.get(name)
+        if id(opdef) in seen and name.startswith("_"):
+            pass
+        seen.add(id(opdef))
+        f = _make_function(opdef)
+        if name.startswith("_contrib_"):
+            setattr(contrib, name[len("_contrib_"):], f)
+        elif name.startswith("_linalg_"):
+            setattr(linalg, name[len("_linalg_"):], f)
+        elif name.startswith("_random_"):
+            setattr(random_ns, name[len("_random_"):], f)
+        elif name.startswith("_sample_"):
+            setattr(random_ns, name[1:], f)
+        if not name.startswith("_contrib_") and not name.startswith("_linalg_"):
+            target_module_dict.setdefault(name, f)
+    target_module_dict["contrib"] = contrib
+    target_module_dict["linalg"] = linalg
+    target_module_dict["random"] = random_ns
+    target_module_dict["sparse"] = sparse_ns
+    return contrib, linalg, random_ns, sparse_ns

@@ -1,0 +1,109 @@
+"""Operator registry.
+
+TPU-native replacement for the reference's NNVM op registry + kernel dispatch
+(src/operator/**, ~261 NNVM_REGISTER_OP — SURVEY §2.1 N8). Each op here is a
+single *pure JAX function* `fn(*arrays, **attrs) -> array | tuple`. That one
+definition serves every consumer the reference needed four kernel backends for:
+
+- eager NDArray calls (per-op `jax.jit` cache → MXU/VPU code via XLA),
+- the autograd tape (`jax.vjp` of the same fn gives the backward kernel),
+- Symbol/CachedOp graph tracing (fn is traced into the enclosing jit),
+- shape/type inference (`jax.eval_shape` replaces FInferShape/FInferType).
+
+Attrs are static (hashable) and participate in the jit cache key — the
+equivalent of dmlc::Parameter op schemas (SURVEY §5.6 tier 3).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import typing as _t
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get", "list_ops", "invoke_jax"]
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: _t.Callable
+    num_outputs: int = 1          # -1: variadic/tuple output
+    needs_rng: bool = False       # fn takes a PRNG key as first argument
+    num_visible_outputs: int = None  # outputs exposed to the user (rest are aux,
+                                     # e.g. batch_norm's batch stats)
+    aliases: tuple = ()
+
+    @property
+    def visible_outputs(self):
+        return self.num_visible_outputs if self.num_visible_outputs is not None else self.num_outputs
+
+
+_REGISTRY: dict = {}
+
+
+def register(name, num_outputs=1, needs_rng=False, num_visible_outputs=None, aliases=()):
+    """Decorator registering a pure-jax op function under `name`."""
+
+    def deco(fn):
+        op = OpDef(name, fn, num_outputs, needs_rng, num_visible_outputs, tuple(aliases))
+        _REGISTRY[name] = op
+        for a in aliases:
+            _REGISTRY[a] = op
+        return fn
+
+    return deco
+
+
+def get(name):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise MXNetError("operator '%s' is not registered" % name) from None
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+@functools.lru_cache(maxsize=8192)
+def _jitted(name, attr_key):
+    op = _REGISTRY[name]
+    kwargs = dict(attr_key)
+    import jax
+
+    def call(*arrays):
+        return op.fn(*arrays, **kwargs)
+
+    return jax.jit(call)
+
+
+def invoke_jax(name, arrays, attrs):
+    """Run op `name` on raw jax arrays. Uses a per-(op, attrs) compiled-
+    executable cache — the analogue of the reference's per-op kernel dispatch,
+    with XLA doing codegen + autotuning instead of mshadow/cuDNN."""
+    from .. import engine
+
+    op = _REGISTRY[name]
+    if engine.is_naive():
+        return op.fn(*arrays, **dict(attrs))
+    attr_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+    return _jitted(name, attr_key)(*arrays)
+
+
+# populate the registry
+from . import tensor as _tensor  # noqa: E402,F401
+from . import nn as _nn  # noqa: E402,F401
+from . import random_ops as _random_ops  # noqa: E402,F401
+from . import optimizer_ops as _optimizer_ops  # noqa: E402,F401
+from . import rnn as _rnn  # noqa: E402,F401
+from . import contrib as _contrib  # noqa: E402,F401
+from . import linalg as _linalg  # noqa: E402,F401

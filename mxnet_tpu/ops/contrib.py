@@ -1,0 +1,407 @@
+"""Contrib ops (reference: src/operator/contrib/**).
+
+Subset covering the reference's model configs: transformer helpers
+(transformer.cc:34 div_sqrt_dim), detection ops for SSD (multibox_prior/
+target/detection multibox_*.cc, box_nms bounding_box.cc), roi_align
+(roi_align.cc), resize ops (bilinear_resize-inl.h, adaptive_avg_pooling.cc),
+fft (fft-inl.h), the `quadratic` tutorial op (quadratic_op-inl.h), boolean
+mask and index ops. Dynamic-output-shape ops (box_nms, boolean_mask) keep
+static shapes by returning masked/padded results with -1 sentinels, the
+standard TPU formulation (SURVEY §7.8(b))."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(data):
+    """reference: src/operator/contrib/transformer.cc:34 — scale by 1/sqrt(d)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """reference: src/operator/contrib/quadratic_op-inl.h (the tutorial op)."""
+    return a * jnp.square(data) + b * data + c
+
+
+@register("_contrib_arange_like", aliases=("arange_like",))
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = data.size
+        out = start + step * jnp.arange(n, dtype=data.dtype)
+        return out.reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n, dtype=data.dtype)
+
+
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D", "bilinear_resize_2d"))
+def bilinear_resize_2d(data, height=1, width=1, scale_height=None, scale_width=None,
+                       mode="size", align_corners=True):
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    return jax.image.resize(data, (n, c, height, width), method="bilinear")
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=("AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling_2d(data, output_size=()):
+    n, c, h, w = data.shape
+    if not output_size:
+        oh = ow = 1
+    elif isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size if len(output_size) == 2 else (output_size[0],) * 2
+    if h % oh == 0 and w % ow == 0:
+        x = data.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    return jax.image.resize(data, (n, c, oh, ow), method="linear")
+
+
+@register("_contrib_boolean_mask", aliases=("boolean_mask",), num_outputs=1)
+def boolean_mask(data, index, axis=0):
+    """Static-shape variant: invalid rows are zeroed and compacted to the
+    front; the true count is data-dependent so TPU keeps the full size
+    (reference returns a dynamically-sized array, contrib/boolean_mask.cc)."""
+    mask = index.astype(bool)
+    order = jnp.argsort(~mask, stable=True)
+    gathered = jnp.take(data, order, axis=axis)
+    keep = jnp.sort(mask)[::-1]
+    bshape = (-1,) + (1,) * (data.ndim - 1 - axis)
+    return gathered * keep.reshape(bshape).astype(data.dtype)
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def index_copy(old, index, new):
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_index_array", aliases=("index_array",))
+def index_array(data, axes=None):
+    axes = tuple(axes) if axes else tuple(range(data.ndim))
+    grids = jnp.meshgrid(*[jnp.arange(data.shape[a]) for a in axes], indexing="ij")
+    return jnp.stack(grids, axis=-1).astype(jnp.int64)
+
+
+@register("_contrib_fft", aliases=("fft",))
+def fft(data, compute_size=128):
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(data.shape[:-1] + (2 * data.shape[-1],))
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    cplx = data.reshape(data.shape[:-1] + (n, 2))
+    out = jnp.fft.ifft(cplx[..., 0] + 1j * cplx[..., 1], axis=-1)
+    return out.real.astype(jnp.float32) * n
+
+
+# --------------------------------------------------------------------------
+# ROI ops (reference: roi_align.cc, ../roi_pooling.cc)
+# --------------------------------------------------------------------------
+
+def _bilinear_sample(feat, y, x):
+    """feat: (C,H,W); y,x scalars (traced)."""
+    h, w = feat.shape[1], feat.shape[2]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+
+    def g(yy, xx):
+        yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+        return feat[:, yi, xi]
+
+    return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x0 + 1) * (1 - wy) * wx
+            + g(y0 + 1, x0) * wy * (1 - wx) + g(y0 + 1, x0 + 1) * wy * wx)
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign", "roi_align"))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2,
+              position_sensitive=False, aligned=False):
+    ph, pw = pooled_size
+    sr = max(int(sample_ratio), 1)
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale - offset, roi[2] * spatial_scale - offset, \
+            roi[3] * spatial_scale - offset, roi[4] * spatial_scale - offset
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        feat = data[jnp.clip(bidx, 0, data.shape[0] - 1)]
+
+        iy = (jnp.arange(ph)[:, None, None, None] * bh + y1
+              + (jnp.arange(sr)[None, None, :, None] + 0.5) * bh / sr)
+        ix = (jnp.arange(pw)[None, :, None, None] * bw + x1
+              + (jnp.arange(sr)[None, None, None, :] + 0.5) * bw / sr)
+        ys = jnp.broadcast_to(iy, (ph, pw, sr, sr)).reshape(-1)
+        xs = jnp.broadcast_to(ix, (ph, pw, sr, sr)).reshape(-1)
+        samples = jax.vmap(lambda y, x: _bilinear_sample(feat, y, x))(ys, xs)
+        samples = samples.reshape(ph, pw, sr * sr, -1).mean(axis=2)
+        return jnp.moveaxis(samples, -1, 0)  # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("ROIPooling")
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    ph, pw = pooled_size
+
+    def one_roi(roi):
+        bidx = jnp.clip(roi[0].astype(jnp.int32), 0, data.shape[0] - 1)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        feat = data[bidx]
+        h, w = feat.shape[1], feat.shape[2]
+        gy = jnp.arange(h, dtype=jnp.float32)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        biny = jnp.clip(jnp.floor((gy - y1) * ph / rh), -1, ph - 1)
+        binx = jnp.clip(jnp.floor((gx - x1) * pw / rw), -1, pw - 1)
+        inside_y = (gy >= y1) & (gy <= y2)
+        inside_x = (gx >= x1) & (gx <= x2)
+        out = jnp.full((feat.shape[0], ph, pw), -jnp.inf, feat.dtype)
+        oh = jnp.where(inside_y, biny, ph).astype(jnp.int32)
+        ow = jnp.where(inside_x, binx, pw).astype(jnp.int32)
+        padded = jnp.full((feat.shape[0], ph + 1, pw + 1), -jnp.inf, feat.dtype)
+        padded = padded.at[:, oh[:, None], ow[None, :]].max(feat)
+        out = padded[:, :ph, :pw]
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# --------------------------------------------------------------------------
+# SSD / detection ops (reference: multibox_prior.cc, multibox_target.cc,
+# multibox_detection.cc, bounding_box.cc)
+# --------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                   offsets=(0.5, 0.5)):
+    import numpy as np
+
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (np.arange(h) + offsets[0]) * step_y
+    cx = (np.arange(w) + offsets[1]) * step_x
+    cy, cx = np.meshgrid(cy, cx, indexing="ij")
+    boxes = []
+    num = len(sizes) + len(ratios) - 1
+    for i in range(num):
+        if i < len(sizes):
+            s = sizes[i]
+            bw = bh = s / 2.0
+            bw *= np.sqrt(ratios[0])
+            bh /= np.sqrt(ratios[0])
+        else:
+            r = ratios[i - len(sizes) + 1]
+            bw = sizes[0] / 2.0 * np.sqrt(r)
+            bh = sizes[0] / 2.0 / np.sqrt(r)
+        boxes.append(np.stack([cx - bw, cy - bh, cx + bw, cy + bh], axis=-1))
+    out = np.stack(boxes, axis=2).reshape(1, -1, 4).astype(np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return jnp.asarray(out)
+
+
+def _box_iou_corner(a, b):
+    """a: (..., 4), b: (..., 4) corner format; broadcast IoU."""
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum((a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1]), 0.0)
+    area_b = jnp.maximum((b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1]), 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    return _box_iou_corner(lhs[..., :, None, :], rhs[..., None, :, :])
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",), num_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor matching + target encoding for SSD training
+    (reference: src/operator/contrib/multibox_target.cc)."""
+    anchors = anchor.reshape(-1, 4)  # (A,4)
+    A = anchors.shape[0]
+
+    def per_sample(lab):
+        # lab: (M, 5+) [cls, x1, y1, x2, y2]
+        valid = lab[:, 0] >= 0
+        ious = _box_iou_corner(anchors[:, None, :], lab[None, :, 1:5])  # (A,M)
+        ious = jnp.where(valid[None, :], ious, 0.0)
+        best_gt = jnp.argmax(ious, axis=1)
+        best_iou = jnp.max(ious, axis=1)
+        matched = best_iou > overlap_threshold
+        # force-match the best anchor for each gt
+        best_anchor = jnp.argmax(ious, axis=0)  # (M,)
+        forced = jnp.zeros(A, bool).at[best_anchor].set(valid)
+        matched = matched | forced
+        gt = lab[best_gt]
+        cls_target = jnp.where(matched, gt[:, 0] + 1.0, 0.0)
+        # encode regression targets (center form, variances)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-12)
+        gh = jnp.maximum(gt[:, 4] - gt[:, 2], 1e-12)
+        gcx = (gt[:, 1] + gt[:, 3]) / 2
+        gcy = (gt[:, 2] + gt[:, 4]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-12) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-12) / variances[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-12)) / variances[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-12)) / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0)
+        loc_mask = jnp.where(matched[:, None], 1.0, 0.0)
+        loc_mask = jnp.broadcast_to(loc_mask, (A, 4))
+        return loc_t.reshape(-1), loc_mask.reshape(-1), cls_target
+
+    loc_target, loc_mask, cls_target = jax.vmap(per_sample)(label)
+    return loc_target, loc_mask, cls_target
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + per-class NMS, static shapes (invalid -> id=-1).
+    reference: src/operator/contrib/multibox_detection.cc"""
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def per_sample(cls_p, loc_p):
+        # cls_p: (C, A); loc_p: (A*4,)
+        loc = loc_p.reshape(A, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw / 2
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        scores = cls_p[1:] if background_id == 0 else cls_p  # (C-1, A)
+        cls_id = jnp.argmax(scores, axis=0).astype(jnp.float32)
+        score = jnp.max(scores, axis=0)
+        keep_score = score > threshold
+        # greedy NMS over all anchors (class-aware unless force_suppress)
+        order = jnp.argsort(-score)
+        boxes_o = boxes[order]
+        ids_o = cls_id[order]
+        score_o = score[order]
+        keep_o = keep_score[order]
+        ious = _box_iou_corner(boxes_o[:, None, :], boxes_o[None, :, :])
+        same = jnp.ones((A, A), bool) if force_suppress else (ids_o[:, None] == ids_o[None, :])
+        sup_mat = (ious > nms_threshold) & same
+
+        def body(i, alive):
+            cur = alive[i]
+            kill = sup_mat[i] & (jnp.arange(A) > i) & cur
+            return alive & ~kill
+
+        alive = lax.fori_loop(0, A, body, keep_o)
+        out_id = jnp.where(alive & keep_o, ids_o, -1.0)
+        return jnp.concatenate([out_id[:, None], score_o[:, None], boxes_o], axis=-1)
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+@register("_contrib_box_nms", aliases=("box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Static-shape NMS: suppressed entries get score column set to -1
+    (reference: src/operator/contrib/bounding_box.cc)."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+
+    def per_batch(d):
+        n = d.shape[0]
+        score = d[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(d, coord_start, 4, axis=1)
+        if in_format == "center":
+            cx, cy, w, h = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+            boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        valid = score > valid_thresh
+        order = jnp.argsort(-score)
+        d_o = d[order]
+        b_o = boxes[order]
+        v_o = valid[order]
+        if id_index >= 0 and not force_suppress:
+            ids = d_o[:, id_index]
+            same = ids[:, None] == ids[None, :]
+        else:
+            same = jnp.ones((n, n), bool)
+        ious = _box_iou_corner(b_o[:, None, :], b_o[None, :, :])
+        sup = (ious > overlap_thresh) & same
+
+        def body(i, alive):
+            cur = alive[i]
+            kill = sup[i] & (jnp.arange(n) > i) & cur
+            return alive & ~kill
+
+        alive = lax.fori_loop(0, n, body, v_o)
+        out = d_o.at[:, score_index].set(jnp.where(alive, d_o[:, score_index], -1.0))
+        return out
+
+    out = jax.vmap(per_batch)(flat)
+    return out.reshape(shape)
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    idx = h.astype(jnp.int32).reshape(-1)
+    sign = s.reshape(-1)
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return out.at[..., idx].add(data * sign)
+
+
+@register("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0, use_linear=False):
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        depth = d.shape[-1]
+        onehot = jax.nn.one_hot(l.astype(jnp.int32), depth, dtype=d.dtype)
+        score_gt = jnp.sum(d * onehot, axis=-1, keepdims=True)
+        if use_linear:
+            viol = ((margin - (score_gt - d)) > 0).astype(d.dtype) * (1 - onehot)
+            grad = viol - onehot * jnp.sum(viol, axis=-1, keepdims=True)
+        else:
+            m = jnp.maximum(margin - (score_gt - d), 0.0) * (1 - onehot)
+            grad = 2 * m - 2 * onehot * jnp.sum(m, axis=-1, keepdims=True)
+        return grad * regularization_coefficient, jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)

@@ -1,0 +1,106 @@
+"""Linear-algebra ops (reference: src/operator/tensor/la_op.cc — potrf, potri,
+gemm, gemm2, trmm, trsm, sumlogdiag, syrk, gelqf, syevd). Batched via leading
+dims; XLA lowers these to its native decomposition/triangular-solve HLOs."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+def _t(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, axis=-2):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def linalg_potri(A):
+    ident = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = jax.scipy.linalg.solve_triangular(A, ident, lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    if rightside:
+        # solve X M = alpha B via M^T X^T = alpha B^T (transpose flips triangularity)
+        M = _t(A, transpose)
+        lower_eff = lower != transpose
+        Xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(M, -1, -2), jnp.swapaxes(alpha * B, -1, -2), lower=not lower_eff)
+        return jnp.swapaxes(Xt, -1, -2)
+    return jax.scipy.linalg.solve_triangular(A, alpha * B, lower=lower,
+                                             trans=1 if transpose else 0)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    At = _t(A, transpose)
+    return alpha * (jnp.matmul(B, At) if rightside else jnp.matmul(At, B))
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(A):
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    At = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def linalg_gelqf(A):
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def linalg_syevd(A):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_makediag", aliases=("linalg_makediag",))
+def linalg_makediag(A, offset=0):
+    return jax.vmap(jnp.diag, in_axes=0)(A.reshape((-1, A.shape[-1]))).reshape(
+        A.shape[:-1] + (A.shape[-1], A.shape[-1]))
+
+
+@register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse",))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_det", aliases=("linalg_det",))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", aliases=("linalg_slogdet",), num_outputs=2)
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet

@@ -1,0 +1,492 @@
+"""Neural-net ops.
+
+TPU-native equivalents of the reference's `src/operator/nn/` family
+(fully_connected.cc, convolution.cc, deconvolution.cc, pooling.cc,
+batch_norm.cc, layer_norm.cc, activation.cc, softmax.cc, dropout.cc, lrn.cc,
+upsampling.cc, softmax_output.cc, l2_normalization.cc — SURVEY §2.1 N8).
+
+Design notes (TPU-first):
+- Convs/matmuls lower to `lax.conv_general_dilated` / `jnp.dot` → MXU. Layout
+  stays NCHW at the API (reference layout); XLA relayouts internally for TPU.
+- There are no cuDNN-vs-native variants: one jax definition; XLA fuses the
+  elementwise pre/post ops (bias, activation, BN-inference) into the conv.
+- Stateful bits (BatchNorm moving stats) are functional: the op *returns* the
+  updated stats as aux outputs and the dispatch layer writes them back
+  (OpDef.num_visible_outputs; see ndarray/ndarray.py) — mutation become
+  functional outputs, the jit-friendly form of the reference's aux states.
+- Ops whose behavior depends on train/predict mode (`BatchNorm`, `Dropout`)
+  take an `is_train` attr injected by the dispatch layer from the autograd
+  mode (reference: Imperative::is_training / OpContext.is_train).
+"""
+from __future__ import annotations
+
+import builtins
+import math
+
+import numpy as _np
+
+from . import register
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# --------------------------------------------------------------------------
+# FullyConnected (reference: src/operator/nn/fully_connected.cc)
+# --------------------------------------------------------------------------
+
+@register("FullyConnected")
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
+    if flatten:
+        x = data.reshape((data.shape[0], -1))
+    else:
+        x = data
+    out = jnp.dot(x, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# --------------------------------------------------------------------------
+# Convolution / Deconvolution (reference: convolution.cc, deconvolution.cc)
+# --------------------------------------------------------------------------
+
+def _conv_dnums(ndim):
+    # NC + spatial; kernel OI + spatial
+    spatial = "DHW"[3 - (ndim - 2):]
+    return lax.conv_dimension_numbers(
+        (1,) * ndim, (1,) * ndim,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+
+
+def _tup(v, n):
+    if v is None or v == ():
+        return (1,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+@register("Convolution")
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                num_filter=0, num_group=1, no_bias=False, cudnn_tune=None,
+                cudnn_off=False, workspace=1024, layout=None):
+    nsp = data.ndim - 2
+    stride = _tup(stride, nsp)
+    dilate = _tup(dilate, nsp)
+    pad = _tup(pad if pad != () else 0, nsp)
+    dn = _conv_dnums(data.ndim)
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    )
+    out = out.astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+@register("Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                  adj=(), target_shape=(), num_filter=0, num_group=1, no_bias=True,
+                  cudnn_tune=None, cudnn_off=False, workspace=1024, layout=None):
+    """Transposed conv. weight layout (in_c, out_c/g, *k) — same as the
+    reference (deconvolution-inl.h); implemented as a fractionally-strided
+    conv (lhs_dilation) so XLA lowers it onto the MXU like a regular conv."""
+    nsp = data.ndim - 2
+    stride = _tup(stride, nsp)
+    dilate = _tup(dilate, nsp)
+    pad = _tup(pad if pad != () else 0, nsp)
+    adj = _tup(adj if adj != () else 0, nsp)
+    if target_shape:
+        k = weight.shape[2:]
+        adj = tuple(
+            target_shape[i] - ((data.shape[2 + i] - 1) * stride[i] - 2 * pad[i]
+                               + (dilate[i] * (k[i] - 1) + 1))
+            for i in range(nsp))
+    in_c = weight.shape[0]
+    g = num_group
+    # (in_c, oc_g, *k) -> (g, in_c/g, oc_g, *k) -> (g, oc_g, in_c/g, *k) -> (out_c, in_c/g, *k)
+    w = weight.reshape((g, in_c // g) + weight.shape[1:])
+    w = jnp.swapaxes(w, 1, 2)
+    w = w.reshape((g * weight.shape[1], in_c // g) + weight.shape[2:])
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nsp)))
+    k_eff = [dilate[i] * (weight.shape[2 + i] - 1) + 1 for i in range(nsp)]
+    padding = [(k_eff[i] - 1 - pad[i], k_eff[i] - 1 - pad[i] + adj[i]) for i in range(nsp)]
+    dn = _conv_dnums(data.ndim)
+    out = lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * nsp,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=g,
+    ).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Pooling (reference: src/operator/nn/pooling.cc)
+# --------------------------------------------------------------------------
+
+@register("Pooling")
+def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=(),
+            pooling_convention="valid", count_include_pad=True, p_value=2,
+            cudnn_off=False, layout=None):
+    nsp = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nsp
+        pad = (0,) * nsp
+    kernel = _tup(kernel, nsp)
+    stride = _tup(stride if stride != () else 1, nsp)
+    pad = _tup(pad if pad != () else 0, nsp)
+    pads = []
+    for i in range(nsp):
+        lo = hi = pad[i]
+        if pooling_convention == "full" and not global_pool:
+            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            out_d = int(math.ceil(size / stride[i])) + 1
+            need = (out_d - 1) * stride[i] + kernel[i] - (data.shape[2 + i] + 2 * pad[i])
+            hi += builtins.max(need, 0)
+        pads.append((lo, hi))
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = [(0, 0), (0, 0)] + pads
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                 window, strides, padding)
+    if pool_type == "lp":
+        powed = jnp.power(jnp.abs(data), p_value)
+        s = lax.reduce_window(powed, jnp.asarray(0, data.dtype), lax.add, window, strides, padding)
+        return jnp.power(s, 1.0 / p_value)
+    s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add, window, strides, padding)
+    if pool_type == "sum":
+        return s
+    # avg
+    if count_include_pad:
+        denom = float(_np.prod(kernel))
+        return s / jnp.asarray(denom, data.dtype)
+    ones = jnp.ones(data.shape, data.dtype)
+    cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add, window, strides, padding)
+    return s / cnt
+
+
+# --------------------------------------------------------------------------
+# Normalization (batch_norm.cc, layer_norm.cc, instance_norm.cc, l2_norm...)
+# --------------------------------------------------------------------------
+
+@register("BatchNorm", num_outputs=3, num_visible_outputs=1)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+               fix_gamma=True, use_global_stats=False, output_mean_var=False,
+               axis=1, cudnn_off=False, is_train=False):
+    """Returns (out, new_moving_mean, new_moving_var); the dispatch layer
+    writes outputs 1..2 back into the aux-state arrays (reference mutates aux
+    in place, src/operator/nn/batch_norm.cc)."""
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if is_train and not use_global_stats:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
+        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
+        new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
+    else:
+        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
+        new_mm, new_mv = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
+    out = out * g.astype(jnp.float32).reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
+    return out.astype(data.dtype), new_mm, new_mv
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    ax = axis % data.ndim
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=ax, keepdims=True)
+    var = jnp.var(x32, axis=ax, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + eps)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    out = out * gamma.astype(jnp.float32).reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
+    return out.astype(data.dtype)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / n
+
+
+@register("LRN")
+def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    sq = jnp.square(data)
+    half = nsize // 2
+    window = (1, nsize, 1, 1)
+    s = lax.reduce_window(sq, jnp.asarray(0, data.dtype), lax.add, window,
+                          (1, 1, 1, 1), [(0, 0), (half, half), (0, 0), (0, 0)])
+    return data / jnp.power(knorm + (alpha / nsize) * s, beta)
+
+
+# --------------------------------------------------------------------------
+# Activations (activation.cc, leaky_relu.cc)
+# --------------------------------------------------------------------------
+
+@register("Activation")
+def activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %s" % act_type)
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        bshape = (1, -1) + (1,) * (data.ndim - 2) if data.ndim > 1 else (-1,)
+        return jnp.where(data >= 0, data, gamma.reshape(bshape) * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, mid * data)
+    raise ValueError("unknown act_type %s" % act_type)
+
+
+# --------------------------------------------------------------------------
+# Softmax family (softmax.cc, softmax_output.cc, loss_binary_op.cc)
+# --------------------------------------------------------------------------
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, length=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    if length is not None:
+        steps = jnp.arange(data.shape[axis])
+        bshape = [1] * data.ndim
+        bshape[axis] = data.shape[axis]
+        mask = steps.reshape(bshape) < length.reshape((-1,) + (1,) * (data.ndim - 1))
+        x = jnp.where(mask, x, -jnp.inf)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output=False,
+                   use_ignore=False, preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0):
+    """Fused softmax + cross-entropy gradient: forward is softmax, backward is
+    (p - onehot(label)) — the reference computes this in SoftmaxOutput's
+    backward (src/operator/softmax_output-inl.h)."""
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def f(d, l):
+        return jax.nn.softmax(d, axis=axis)
+
+    def fwd(d, l):
+        out = jax.nn.softmax(d, axis=axis)
+        return out, (out, l)
+
+    def bwd(res, g):
+        out, lab = res
+        depth = out.shape[axis]
+        li = lab.astype(jnp.int32)
+        onehot = jax.nn.one_hot(li, depth, axis=axis, dtype=out.dtype)
+        if smooth_alpha:
+            onehot = onehot * (1 - smooth_alpha) + smooth_alpha / (depth - 1) * (1 - onehot)
+        grad = out - onehot
+        valid = None
+        if use_ignore:
+            keep = (li != int(ignore_label)).astype(out.dtype)
+            grad = grad * jnp.expand_dims(keep, axis if axis != -1 else li.ndim)
+            valid = jnp.sum(keep)
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid":
+            grad = grad / (jnp.maximum(valid, 1.0) if valid is not None else out.shape[0])
+        return grad * grad_scale, jnp.zeros_like(lab)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return (d - l.reshape(d.shape)) * grad_scale, jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def f(d, l):
+        return jax.nn.sigmoid(d)
+
+    def fwd(d, l):
+        out = jax.nn.sigmoid(d)
+        return out, (out, l)
+
+    def bwd(res, g):
+        out, l = res
+        return ((out - l.reshape(out.shape)) * grad_scale, jnp.zeros_like(l))
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return (jnp.sign(d - l.reshape(d.shape)) * grad_scale, jnp.zeros_like(l))
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    @jax.custom_vjp
+    def f(d):
+        return d
+
+    def fwd(d):
+        return d, d.shape
+
+    def bwd(shape, g):
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / shape[0]
+        return (jnp.full(shape, scale, dtype=jnp.float32),)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+# --------------------------------------------------------------------------
+# Dropout (dropout.cc) — rng-consuming op
+# --------------------------------------------------------------------------
+
+@register("Dropout", needs_rng=True)
+def dropout(rng, data, p=0.5, mode="training", axes=(), cudnn_off=False, is_train=False):
+    if (not is_train and mode != "always") or p <= 0.0:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# --------------------------------------------------------------------------
+# UpSampling / resize (upsampling.cc, bilinear via jax.image)
+# --------------------------------------------------------------------------
+
+@register("UpSampling")
+def upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+               multi_input_mode="concat", workspace=512):
+    data = args[0]
+    if sample_type == "nearest":
+        outs = []
+        for d in args:
+            s = scale if outs == [] else data.shape[2] * scale // d.shape[2]
+            outs.append(jnp.repeat(jnp.repeat(d, s, axis=2), s, axis=3))
+        if len(outs) == 1:
+            return outs[0]
+        if multi_input_mode == "sum":
+            return sum(outs)
+        return jnp.concatenate(outs, axis=1)
+    # bilinear: args = (data, weight) in reference; we resize directly
+    n, c, h, w = data.shape
+    return jax.image.resize(data, (n, c, h * scale, w * scale), method="bilinear")
+
+
+# --------------------------------------------------------------------------
+# misc
+# --------------------------------------------------------------------------
+
+@register("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    raise NotImplementedError("Correlation op: not yet ported to TPU build")
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001, momentum=0.9):
+    return data

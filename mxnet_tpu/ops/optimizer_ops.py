@@ -1,0 +1,209 @@
+"""Fused optimizer update ops.
+
+Reference: src/operator/optimizer_op.cc (+contrib/adamw.cc) — SGD/Adam/etc as
+single fused kernels mutating the weight in place. TPU-native: each update is
+a pure function returning (new_weight, *new_states); the dispatch layer swaps
+the weight NDArray's buffer (functional "donation" — XLA aliases the input
+buffer when the update runs inside a jit with donated args). All updates are
+single fused XLA kernels: grad rescale, clip, wd, momentum and the write are
+one HBM pass."""
+from __future__ import annotations
+
+from . import register
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _prep(grad, rescale_grad, clip_gradient, wd, weight):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight.astype(jnp.float32)
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+               lazy_update=False):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update", num_outputs=2)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=False):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return (weight.astype(jnp.float32) + new_mom).astype(weight.dtype), new_mom
+
+
+@register("mp_sgd_update", num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=False):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight32)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight32)
+    new_mom = momentum * mom - lr * g
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("nag_mom_update", num_outputs=2)
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom + g
+    w = weight.astype(jnp.float32) - lr * (g + momentum * new_mom)
+    return w.astype(weight.dtype), new_mom
+
+
+@register("adam_update", num_outputs=3)
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight.astype(jnp.float32) - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return w.astype(weight.dtype), new_mean, new_var
+
+
+@register("adamw_update", num_outputs=3, aliases=("_contrib_adamw_update",))
+def adamw_update(weight, grad, mean, var, rescale_grad=1.0, lr=0.001, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0, clip_gradient=-1.0):
+    """reference: src/operator/contrib/adamw.cc — decoupled weight decay."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight.astype(jnp.float32) - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+                                            + wd * weight.astype(jnp.float32))
+    return w.astype(weight.dtype), new_mean, new_var
+
+
+@register("rmsprop_update", num_outputs=2)
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight.astype(jnp.float32) - lr * g / (jnp.sqrt(new_n) + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w.astype(weight.dtype), new_n
+
+
+@register("rmspropalex_update", num_outputs=4)
+def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_g = gamma1 * g_state + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    w = weight.astype(jnp.float32) + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w.astype(weight.dtype), new_n, new_g, new_delta
+
+
+@register("ftrl_update", num_outputs=3)
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w32 = weight.astype(jnp.float32)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * w32
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(w32),
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+    )
+    return w.astype(weight.dtype), new_z, new_n
+
+
+@register("ftml_update", num_outputs=4)
+def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999, epsilon=1e-8,
+                wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
+    g = _prep(grad, rescale_grad, clip_grad, wd, weight)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight.astype(jnp.float32)
+    w = -new_z / d_t
+    return w.astype(weight.dtype), d_t, new_v, new_z
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient, 0.0, weight)
+    w = weight.astype(jnp.float32) * (1 - lr * wd) - lr * jnp.sign(g)
+    return w.astype(weight.dtype)
+
+
+@register("signum_update", num_outputs=2)
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.9, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - (1 - momentum) * g
+    w = weight.astype(jnp.float32) * (1 - lr * wd_lh) + lr * jnp.sign(new_mom)
+    return w.astype(weight.dtype), new_mom
+
+
+@register("adagrad_update", num_outputs=2, aliases=("_sparse_adagrad_update",))
+def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_hist = history + jnp.square(g)
+    w = weight.astype(jnp.float32) - lr * g / (jnp.sqrt(new_hist) + epsilon)
+    return w.astype(weight.dtype), new_hist
+
+
+@register("adadelta_update", num_outputs=3)
+def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    w = weight.astype(jnp.float32) - delta
+    return w.astype(weight.dtype), new_acc_g, new_acc_delta
+
+
+@register("multi_sgd_update", num_outputs=-1)
+def multi_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0, clip_gradient=-1.0,
+                     num_weights=1):
+    """Aggregated update (reference: optimizer_op.cc multi_sgd) — one fused
+    launch updating many weights; XLA compiles the whole batch into one
+    executable, amortizing dispatch like the reference's aggregated kernels."""
+    weights = args[:num_weights]
+    grads = args[num_weights:2 * num_weights]
+    outs = []
+    for i in range(num_weights):
+        g = _prep(grads[i], rescale_grad, clip_gradient, wds[i], weights[i])
+        outs.append((weights[i].astype(jnp.float32) - lrs[i] * g).astype(weights[i].dtype))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", num_outputs=-1)
+def multi_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1):
+    weights = args[:num_weights]
+    grads = args[num_weights:2 * num_weights]
+    moms = args[2 * num_weights:3 * num_weights]
+    outs = []
+    new_moms = []
+    for i in range(num_weights):
+        g = _prep(grads[i], rescale_grad, clip_gradient, wds[i], weights[i])
+        nm = momentum * moms[i] - lrs[i] * g
+        new_moms.append(nm)
+        outs.append((weights[i].astype(jnp.float32) + nm).astype(weights[i].dtype))
+    return tuple(outs) + tuple(new_moms)

@@ -1,0 +1,142 @@
+"""Random sampling ops.
+
+Reference: src/operator/random/sample_op.cc (uniform/normal/gamma/exponential/
+poisson/negative_binomial/randint), multisample_op.cc, shuffle_op.cc,
+sample_multinomial_op.cc. TPU-native: every op consumes one threefry subkey
+from the global chain (mxnet_tpu/random.py) — stateless, reproducible, and
+traceable (the key is a runtime input under jit, SURVEY §7.8(e))."""
+from __future__ import annotations
+
+from . import register
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+
+
+@register("_random_uniform", needs_rng=True, aliases=("uniform", "random_uniform"))
+def random_uniform(rng, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(rng, shape, np_dtype(dtype), low, high)
+
+
+@register("_random_normal", needs_rng=True, aliases=("normal", "random_normal"))
+def random_normal(rng, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return jax.random.normal(rng, shape, np_dtype(dtype)) * scale + loc
+
+
+@register("_random_gamma", needs_rng=True, aliases=("gamma_sample",))
+def random_gamma(rng, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return jax.random.gamma(rng, alpha, shape, np_dtype(dtype)) * beta
+
+
+@register("_random_exponential", needs_rng=True)
+def random_exponential(rng, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(rng, shape, np_dtype(dtype)) / lam
+
+
+@register("_random_poisson", needs_rng=True)
+def random_poisson(rng, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(rng, lam, shape).astype(np_dtype(dtype))
+
+
+@register("_random_negative_binomial", needs_rng=True)
+def random_negative_binomial(rng, k=1, p=1.0, shape=(), dtype="float32"):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(np_dtype(dtype))
+
+
+@register("_random_generalized_negative_binomial", needs_rng=True)
+def random_gen_negative_binomial(rng, mu=1.0, alpha=1.0, shape=(), dtype="float32"):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, 1.0 / alpha, shape) * (alpha * mu)
+    return jax.random.poisson(k2, lam, shape).astype(np_dtype(dtype))
+
+
+@register("_random_randint", needs_rng=True, aliases=("randint",))
+def random_randint(rng, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(rng, shape, low, high, np_dtype(dtype))
+
+
+@register("_sample_unique_zipfian", needs_rng=True)
+def sample_unique_zipfian(rng, range_max=1, shape=()):
+    u = jax.random.uniform(rng, shape)
+    out = jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0
+    return out.astype(jnp.int64)
+
+
+@register("_sample_multinomial", needs_rng=True, aliases=("sample_multinomial", "multinomial"))
+def sample_multinomial(rng, data, shape=(), get_prob=False, dtype="int32"):
+    """data: (..., k) probabilities; draws `shape` samples per distribution
+    (reference: sample_multinomial_op.cc)."""
+    n = 1
+    for s in shape if isinstance(shape, tuple) else (shape,):
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    samp_shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if data.ndim == 1:
+        out = jax.random.categorical(rng, logits, shape=samp_shape or None)
+    else:
+        out = jax.random.categorical(rng, logits[..., None, :].repeat(max(n, 1), axis=-2), axis=-1)
+        out = out.reshape(data.shape[:-1] + samp_shape) if samp_shape else out.reshape(data.shape[:-1])
+    return out.astype(np_dtype(dtype))
+
+
+@register("_shuffle", needs_rng=True, aliases=("shuffle",))
+def shuffle(rng, data):
+    return jax.random.permutation(rng, data, axis=0)
+
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=()):
+    h, w = target_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    if transform_type == "affine":
+        base = jnp.stack([gx.ravel(), gy.ravel(), jnp.ones(h * w)], axis=0)
+        theta = data.reshape((-1, 2, 3))
+        out = jnp.einsum("bij,jk->bik", theta, base)
+        return out.reshape((-1, 2, h, w))
+    # warp: data is (b, 2, h, w) flow
+    grid = jnp.stack([gx, gy], axis=0)[None]
+    norm = jnp.asarray([(w - 1) / 2.0, (h - 1) / 2.0]).reshape((1, 2, 1, 1))
+    return grid + data / norm
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=False):
+    """reference: src/operator/bilinear_sampler.cc — sample `data` (NCHW) at
+    normalized grid coords (N,2,H',W') in [-1,1]."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+
+    def sample_one(img, x, y):
+        # img: (C,H,W); x,y: (H',W')
+        x0 = jnp.floor(x)
+        y0 = jnp.floor(y)
+        wx = x - x0
+        wy = y - y0
+
+        def gather(yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+            valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)).astype(img.dtype)
+            return img[:, yi, xi] * valid[None]
+
+        out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[None]
+               + gather(y0, x0 + 1) * (wx * (1 - wy))[None]
+               + gather(y0 + 1, x0) * ((1 - wx) * wy)[None]
+               + gather(y0 + 1, x0 + 1) * (wx * wy)[None])
+        return out
+
+    return jax.vmap(sample_one)(data, gx, gy)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(), transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=False):
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)

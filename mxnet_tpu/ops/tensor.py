@@ -1,0 +1,730 @@
+"""Tensor ops: elementwise, broadcast, reduction, shape, indexing, init.
+
+TPU-native equivalents of the reference's `src/operator/tensor/` family
+(elemwise_unary_op.cc, elemwise_binary_{op,broadcast_op}.cc, matrix_op.cc,
+broadcast_reduce_op_value.cc, indexing_op.cc, init_op.cc, ordering_op.cc,
+control_flow_op.cc — SURVEY §2.1 N8). Everything is expressed as jnp/lax so
+XLA fuses chains of these into single kernels; no hand-written elementwise
+kernels needed on TPU.
+
+MXNet semantics preserved where they differ from numpy: `reshape` magic codes
+(0/-1/-2/-3/-4, reference: src/operator/tensor/matrix_op-inl.h InferReshapeShape),
+`dot` (last-axis • first-axis, src/operator/tensor/dot-inl.h), reductions with
+`exclude`, `norm(ord=2)`, topk modes, etc.
+"""
+from __future__ import annotations
+
+import builtins
+import functools
+
+import numpy as _np
+
+from . import register
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+def _reduce_axes(ndim, axis, exclude=False):
+    if axis is None:
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def mx_reshape_shape(ishape, spec, reverse=False):
+    """MXNet reshape shape inference with magic codes
+    (reference: src/operator/tensor/matrix_op-inl.h:InferReshapeShape)."""
+    ishape = tuple(ishape)
+    spec = tuple(int(s) for s in spec)
+    if reverse:
+        rs = mx_reshape_shape(ishape[::-1], spec[::-1], reverse=False)
+        return tuple(rs[::-1])
+    out = []
+    i = 0
+    j = 0
+    while j < len(spec):
+        k = spec[j]
+        if k > 0:
+            out.append(k)
+            i += 1
+        elif k == 0:
+            out.append(ishape[i])
+            i += 1
+        elif k == -1:
+            out.append(-1)
+            i += 1
+        elif k == -2:
+            out.extend(ishape[i:])
+            i = len(ishape)
+        elif k == -3:
+            out.append(ishape[i] * ishape[i + 1])
+            i += 2
+        elif k == -4:
+            a, b = spec[j + 1], spec[j + 2]
+            j += 2
+            d = ishape[i]
+            i += 1
+            if a == -1 and b == -1:
+                raise ValueError("reshape -4 cannot infer both factors")
+            if a == -1:
+                a = d // b
+            if b == -1:
+                b = d // a
+            out.extend([a, b])
+        else:
+            raise ValueError("invalid reshape code %d" % k)
+        j += 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in ishape:
+            total *= d
+        out[out.index(-1)] = total // builtins.max(known, 1)
+    return tuple(out)
+
+
+def _binary(name, fn):
+    register(name)(lambda lhs, rhs: fn(lhs, rhs))
+    register("broadcast_" + name.lstrip("_"))(lambda lhs, rhs: fn(lhs, rhs))
+
+
+# --------------------------------------------------------------------------
+# elementwise binary (+ broadcast_ and _scalar variants)
+# reference: src/operator/tensor/elemwise_binary_broadcast_op_basic.cc
+# --------------------------------------------------------------------------
+
+_BINARY_FNS = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": lambda a, b: jnp.equal(a, b).astype(jnp.result_type(a, b)),
+    "not_equal": lambda a, b: jnp.not_equal(a, b).astype(jnp.result_type(a, b)),
+    "greater": lambda a, b: jnp.greater(a, b).astype(jnp.result_type(a, b)),
+    "greater_equal": lambda a, b: jnp.greater_equal(a, b).astype(jnp.result_type(a, b)),
+    "lesser": lambda a, b: jnp.less(a, b).astype(jnp.result_type(a, b)),
+    "lesser_equal": lambda a, b: jnp.less_equal(a, b).astype(jnp.result_type(a, b)),
+    "logical_and": lambda a, b: jnp.logical_and(a, b).astype(jnp.result_type(a, b)),
+    "logical_or": lambda a, b: jnp.logical_or(a, b).astype(jnp.result_type(a, b)),
+    "logical_xor": lambda a, b: jnp.logical_xor(a, b).astype(jnp.result_type(a, b)),
+}
+
+for _n, _f in _BINARY_FNS.items():
+    register("elemwise_" + _n, aliases=("_" + _n, "broadcast_" + _n))(
+        (lambda f: lambda lhs, rhs: f(lhs, rhs))(_f)
+    )
+
+# scalar variants (reference: elemwise_binary_scalar_op_basic.cc)
+for _n, _f in _BINARY_FNS.items():
+    register("_%s_scalar" % _n)(
+        (lambda f: lambda data, scalar=0.0: f(data, jnp.asarray(scalar, dtype=data.dtype)))(_f)
+    )
+
+register("_plus_scalar")(lambda data, scalar=0.0: data + jnp.asarray(scalar, data.dtype))
+register("_minus_scalar")(lambda data, scalar=0.0: data - jnp.asarray(scalar, data.dtype))
+register("_rminus_scalar")(lambda data, scalar=0.0: jnp.asarray(scalar, data.dtype) - data)
+register("_mul_scalar")(lambda data, scalar=1.0: data * jnp.asarray(scalar, data.dtype))
+register("_div_scalar")(lambda data, scalar=1.0: data / jnp.asarray(scalar, data.dtype))
+register("_rdiv_scalar")(lambda data, scalar=1.0: jnp.asarray(scalar, data.dtype) / data)
+register("_power_scalar")(lambda data, scalar=1.0: jnp.power(data, jnp.asarray(scalar, data.dtype)))
+register("_rpower_scalar")(lambda data, scalar=1.0: jnp.power(jnp.asarray(scalar, data.dtype), data))
+register("_mod_scalar")(lambda data, scalar=1.0: jnp.mod(data, jnp.asarray(scalar, data.dtype)))
+register("_rmod_scalar")(lambda data, scalar=1.0: jnp.mod(jnp.asarray(scalar, data.dtype), data))
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# --------------------------------------------------------------------------
+# elementwise unary (reference: elemwise_unary_op_basic.cc, _trig.cc, _pow.cc)
+# --------------------------------------------------------------------------
+
+_UNARY_FNS = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "reciprocal": jnp.reciprocal,
+    "negative": jnp.negative,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+}
+
+for _n, _f in _UNARY_FNS.items():
+    register(_n)((lambda f: lambda data: f(data))(_f))
+
+register("identity", aliases=("_copy",))(lambda data: data)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(data):
+    return lax.stop_gradient(data)
+
+
+@register("Cast", aliases=("cast",))
+def cast(data, dtype="float32"):
+    from ..base import np_dtype
+
+    return data.astype(np_dtype(dtype))
+
+
+@register("clip")
+def clip(data, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+# --------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc)
+# --------------------------------------------------------------------------
+
+def _make_reduce(jfn, name):
+    @register(name)
+    def _red(data, axis=None, keepdims=False, exclude=False):
+        axes = _reduce_axes(data.ndim, axis, exclude)
+        if data.ndim == 0:
+            return data
+        return jfn(data, axis=axes, keepdims=keepdims)
+
+    return _red
+
+
+_make_reduce(jnp.sum, "sum")
+_make_reduce(jnp.mean, "mean")
+_make_reduce(jnp.prod, "prod")
+_make_reduce(jnp.max, "max")
+_make_reduce(jnp.min, "min")
+_make_reduce(jnp.nansum, "nansum")
+_make_reduce(jnp.nanprod, "nanprod")
+register("sum_axis", aliases=("sum_axis",))(lambda data, axis=None, keepdims=False, exclude=False:
+                                            jnp.sum(data, axis=_reduce_axes(data.ndim, axis, exclude),
+                                                    keepdims=keepdims))
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    axes = None if axis is None else _reduce_axes(data.ndim, axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axes, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=keepdims))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# dot products (reference: src/operator/tensor/dot-inl.h)
+# --------------------------------------------------------------------------
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """MXNet dot: contract last axis of lhs with first axis of rhs; full-axis
+    transposes applied first. Lowers to a single MXU matmul via reshape."""
+    if transpose_a:
+        lhs = jnp.transpose(lhs)
+    if transpose_b:
+        rhs = jnp.transpose(rhs)
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+# --------------------------------------------------------------------------
+# shape manipulation (reference: matrix_op.cc)
+# --------------------------------------------------------------------------
+
+@register("Reshape", aliases=("reshape",))
+def reshape(data, shape=(), reverse=False):
+    tgt = mx_reshape_shape(data.shape, shape, reverse)
+    return jnp.reshape(data, tgt)
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten_op(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    if axes is None or len(axes) == 0:
+        return jnp.transpose(data)
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("reverse", aliases=("flip",))
+def reverse(data, axis=0):
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=ax)
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("Pad", aliases=("pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(data, pw, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+@register("Concat", aliases=("concat",))
+def concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=-1)
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=(), end=(), step=()):
+    slices = []
+    for i in range(data.ndim):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        s = step[i] if i < len(step) and step[i] is not None and step[i] != 0 else None
+        slices.append(builtins.slice(b, e, s))
+    return data[tuple(slices)]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [builtins.slice(None)] * data.ndim
+    idx[axis] = builtins.slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    axes = tuple(axes) if axes else tuple(range(shape_like.ndim))
+    idx = [builtins.slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = builtins.slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(x, (n, c // (b * b), h * b, w * b))
+
+
+# --------------------------------------------------------------------------
+# broadcasting (reference: broadcast_reduce_op_value.cc)
+# --------------------------------------------------------------------------
+
+@register("broadcast_to")
+def broadcast_to(data, shape=()):
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def broadcast_like(data, like):
+    return jnp.broadcast_to(data, like.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+# --------------------------------------------------------------------------
+# indexing (reference: indexing_op.cc)
+# --------------------------------------------------------------------------
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+        mode = "clip"
+    return jnp.take(a, idx, axis=axis, mode="clip")
+
+
+@register("batch_take", aliases=("pick",))
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis % data.ndim), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
+    """reference: src/operator/tensor/indexing_op.cc (Embedding). Gather rows
+    of `weight`; grad of weight is a scatter-add which XLA emits natively."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
+
+
+@register("one_hot")
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import np_dtype
+
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (oh * (on_value - off_value) + off_value).astype(np_dtype(dtype))
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    out = jnp.zeros(shape, dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd")
+def scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("where")
+def where(condition, x, y):
+    if condition.shape != x.shape and condition.ndim == 1:
+        cond = condition.reshape((-1,) + (1,) * (x.ndim - 1)).astype(bool)
+    else:
+        cond = condition.astype(bool)
+    return jnp.where(cond, x, y)
+
+
+# --------------------------------------------------------------------------
+# ordering (reference: ordering_op.cc)
+# --------------------------------------------------------------------------
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import np_dtype
+
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(np_dtype(dtype))
+
+
+@register("topk", num_outputs=-1)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import np_dtype
+
+    ax = axis % data.ndim
+    moved = jnp.moveaxis(data, ax, -1)
+    vals, idxs = lax.top_k(jnp.negative(moved) if is_ascend else moved, k)
+    if is_ascend:
+        vals = jnp.negative(vals)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax).astype(np_dtype(dtype))
+    if ret_typ == "value":
+        return (vals,)
+    if ret_typ == "both":
+        return (vals, idxs)
+    if ret_typ == "mask":
+        mask = jnp.zeros(moved.shape, data.dtype)
+        mask = jax.vmap(lambda m, i: m.at[i].set(1), in_axes=(0, 0))(
+            mask.reshape((-1, moved.shape[-1])),
+            jnp.moveaxis(data, ax, -1).reshape((-1, moved.shape[-1])).argsort(-1)[:, -k:]
+            if not is_ascend
+            else jnp.moveaxis(data, ax, -1).reshape((-1, moved.shape[-1])).argsort(-1)[:, :k],
+        ).reshape(moved.shape)
+        return (jnp.moveaxis(mask, -1, ax),)
+    return (idxs,)
+
+
+# --------------------------------------------------------------------------
+# init / creation ops (reference: init_op.cc)
+# --------------------------------------------------------------------------
+
+@register("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register("size_array")
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("_zeros")
+def _zeros(shape=(), dtype="float32"):
+    from ..base import np_dtype
+
+    return jnp.zeros(shape, np_dtype(dtype))
+
+
+@register("_ones")
+def _ones(shape=(), dtype="float32"):
+    from ..base import np_dtype
+
+    return jnp.ones(shape, np_dtype(dtype))
+
+
+@register("_full")
+def _full(shape=(), value=0.0, dtype="float32"):
+    from ..base import np_dtype
+
+    return jnp.full(shape, value, np_dtype(dtype))
+
+
+@register("_arange")
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    from ..base import np_dtype
+
+    out = jnp.arange(start, stop, step, np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace")
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    from ..base import np_dtype
+
+    return jnp.linspace(start, stop, num, endpoint=endpoint, dtype=np_dtype(dtype))
+
+
+@register("_eye")
+def _eye(N=0, M=0, k=0, dtype="float32"):
+    from ..base import np_dtype
+
+    return jnp.eye(N, M if M else None, k, np_dtype(dtype))
+
+
+@register("diag")
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+# --------------------------------------------------------------------------
+# sequence ops (reference: src/operator/sequence_{mask,last,reverse}.cc)
+# layout: (seq_len, batch, ...) when use_sequence_length
+# --------------------------------------------------------------------------
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    seq_axis = axis
+    batch_axis = 1 - axis
+    steps = jnp.arange(data.shape[seq_axis])
+    mask = steps[:, None] < sequence_length[None, :].astype(steps.dtype)
+    if seq_axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [builtins.slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)
+    return jax.vmap(lambda x, i: x[i], in_axes=(1, 0))(moved, last)
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    T = moved.shape[0]
+
+    def rev_one(x, L):
+        idx = jnp.where(jnp.arange(T) < L, L - 1 - jnp.arange(T), jnp.arange(T))
+        return x[idx]
+
+    out = jax.vmap(rev_one, in_axes=(1, 0), out_axes=1)(moved, sequence_length.astype(jnp.int32))
+    return jnp.moveaxis(out, 0, axis)
+
+
+# --------------------------------------------------------------------------
+# misc
+# --------------------------------------------------------------------------
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2, 0.5 * s2 * jnp.square(data),
+                     jnp.abs(data) - 0.5 / s2)
+
+
+@register("khatri_rao")
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape((-1,) + out.shape[1:])
+    return out
+
+
+@register("histogram", num_outputs=2)
+def histogram(data, bin_cnt=10, range=None):
+    flat = data.reshape(-1)
+    if range is not None:
+        lo = jnp.asarray(range[0], flat.dtype)
+        hi = jnp.asarray(range[1], flat.dtype)
+    else:
+        lo, hi = flat.min(), flat.max()
+    edges = lo + (hi - lo) * jnp.arange(bin_cnt + 1, dtype=flat.dtype) / bin_cnt
+    scaled = (flat - lo) / jnp.maximum(hi - lo, jnp.asarray(1e-12, flat.dtype)) * bin_cnt
+    idx = jnp.clip(scaled.astype(jnp.int32), 0, bin_cnt - 1)
+    cnt = jnp.zeros((bin_cnt,), jnp.int64).at[idx].add(1)
+    return cnt, edges

@@ -1,0 +1,59 @@
+"""Global RNG state.
+
+The reference keeps per-device cuRAND/mt19937 resource states handed to ops via
+ResourceManager (reference: src/resource.cc, include/mxnet/resource.h:38-46).
+TPU-native design: a single stateless threefry key chain — every random op
+consumes one fresh subkey split off the global chain, so eager ops are
+reproducible under `seed()` while traced graphs receive the key as a runtime
+input (keeping compiled executables deterministic functions of their inputs).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        import jax
+
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+        _state.seed_val = _DEFAULT_SEED
+    return _state
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global RNG (reference: python/mxnet/random.py:38 mx.random.seed).
+
+    `ctx` accepted for API parity; with a single stateless chain the seed is
+    global (per-device streams are derived by folding device ids in sharded
+    code paths)."""
+    import jax
+
+    st = _get()
+    st.key = jax.random.PRNGKey(int(seed_state))
+    st.seed_val = int(seed_state)
+
+
+def current_seed():
+    return _get().seed_val
+
+
+def next_key():
+    """Split one subkey off the global chain (consumed by a single random op)."""
+    import jax
+
+    st = _get()
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+def np_random():
+    """numpy Generator used by host-side shufflers (data pipeline)."""
+    return _np.random.default_rng(_get().seed_val)

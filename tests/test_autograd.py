@@ -1,0 +1,165 @@
+"""Autograd tape tests (mirrors reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x + 2 * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_chain():
+    x = mx.nd.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.relu(x)
+        z = (y * 3).sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [3.0, 0.0])
+
+
+def test_grad_accumulate_add():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * 2 * x.asnumpy())
+
+
+def test_grad_write_overwrites():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_multi_input():
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), b.asnumpy())
+    assert np.allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_head_grad():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [20.0, 200.0])
+
+
+def test_pause():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = x * 100  # not recorded
+        w = (y + z.detach()).sum()
+    w.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_dropout_respects_mode():
+    x = mx.nd.ones((100,))
+    with autograd.record(train_mode=False):
+        y = mx.nd.Dropout(x, p=0.5)
+    assert np.allclose(y.asnumpy(), 1.0)
+    with autograd.record(train_mode=True):
+        y = mx.nd.Dropout(x, p=0.5)
+    assert not np.allclose(y.asnumpy(), 1.0)
+
+
+def test_grad_function():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.exp(x).sum()
+    grads = autograd.grad([y], [x])
+    assert np.allclose(grads[0].asnumpy(), np.exp(x.asnumpy()), atol=1e-5)
+
+
+def test_mark_variables():
+    x = mx.nd.array([2.0])
+    g = mx.nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x ** 2).sum()
+    y.backward()
+    assert np.allclose(g.asnumpy(), [4.0])
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_softmax_output_grad():
+    data = mx.nd.array(np.random.randn(4, 3).astype(np.float32))
+    label = mx.nd.array([0, 1, 2, 1])
+    data.attach_grad()
+    with autograd.record():
+        out = mx.nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = out.asnumpy()
+    onehot = np.eye(3)[label.asnumpy().astype(int)]
+    assert np.allclose(data.grad.asnumpy(), p - onehot, atol=1e-5)
+
+
+def test_batchnorm_updates_running_stats():
+    x = mx.nd.array(np.random.randn(8, 4).astype(np.float32) * 3 + 1)
+    gamma = mx.nd.ones((4,))
+    beta = mx.nd.zeros((4,))
+    mm = mx.nd.zeros((4,))
+    mv = mx.nd.ones((4,))
+    with autograd.record():
+        out = mx.nd.BatchNorm(x, gamma, beta, mm, mv, fix_gamma=False, momentum=0.9,
+                              axis=1)
+    # moving stats must have been updated in place
+    assert not np.allclose(mm.asnumpy(), 0.0)
+    # normalized output: near zero mean, unit var per channel
+    o = out.asnumpy()
+    assert np.allclose(o.mean(axis=0), 0, atol=1e-4)
+    assert np.allclose(o.var(axis=0), 1, atol=1e-2)

@@ -1,0 +1,147 @@
+"""NDArray basics (mirrors reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = mx.nd.ones((2, 3), dtype="int32")
+    assert b.dtype == np.int32
+    c = mx.nd.array([[1, 2], [3, 4]])
+    assert c.dtype == np.float32  # reference default
+    assert np.allclose(c.asnumpy(), [[1, 2], [3, 4]])
+    d = mx.nd.full((2,), 7.0)
+    assert np.allclose(d.asnumpy(), [7, 7])
+    e = mx.nd.arange(0, 10, 2)
+    assert np.allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([4.0, 5.0, 6.0])
+    assert np.allclose((a + b).asnumpy(), [5, 7, 9])
+    assert np.allclose((a - b).asnumpy(), [-3, -3, -3])
+    assert np.allclose((a * b).asnumpy(), [4, 10, 18])
+    assert np.allclose((b / a).asnumpy(), [4, 2.5, 2])
+    assert np.allclose((a + 1).asnumpy(), [2, 3, 4])
+    assert np.allclose((1 + a).asnumpy(), [2, 3, 4])
+    assert np.allclose((10 - a).asnumpy(), [9, 8, 7])
+    assert np.allclose((a ** 2).asnumpy(), [1, 4, 9])
+    assert np.allclose((2 / a).asnumpy(), [2, 1, 2 / 3])
+    assert np.allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace():
+    a = mx.nd.ones((3,))
+    a += 2
+    assert np.allclose(a.asnumpy(), [3, 3, 3])
+    a *= 2
+    assert np.allclose(a.asnumpy(), [6, 6, 6])
+    a[:] = 0
+    assert np.allclose(a.asnumpy(), [0, 0, 0])
+    a[1] = 5
+    assert np.allclose(a.asnumpy(), [0, 5, 0])
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(12).reshape(3, 4))
+    assert np.allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    assert np.allclose(a[1:3, 0].asnumpy(), [4, 8])
+    assert a[1, 2].asscalar() == 6.0
+
+
+def test_reshape_magic():
+    a = mx.nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    assert a.reshape((0, 0, -1)).shape == (2, 3, 4)
+
+
+def test_dot_semantics():
+    a = mx.nd.array(np.random.rand(2, 3, 4).astype(np.float32))
+    b = mx.nd.array(np.random.rand(4, 5).astype(np.float32))
+    out = mx.nd.dot(a, b)
+    assert out.shape == (2, 3, 5)
+    ref = np.tensordot(a.asnumpy(), b.asnumpy(), axes=([2], [0]))
+    assert np.allclose(out.asnumpy(), ref, atol=1e-5)
+    # batch_dot
+    x = mx.nd.array(np.random.rand(5, 2, 3).astype(np.float32))
+    y = mx.nd.array(np.random.rand(5, 3, 4).astype(np.float32))
+    out = mx.nd.batch_dot(x, y)
+    assert out.shape == (5, 2, 4)
+
+
+def test_reduce():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.sum().asscalar() == 276
+    assert a.sum(axis=1).shape == (2, 4)
+    assert a.sum(axis=(0, 2)).shape == (3,)
+    assert a.mean(axis=0, keepdims=True).shape == (1, 3, 4)
+    assert mx.nd.sum(a, axis=1, exclude=True).shape == (3,)
+    assert a.max().asscalar() == 23
+    assert a.argmax(axis=2).shape == (2, 3)
+
+
+def test_concat_split_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=1)
+    assert c.shape == (2, 6)
+    parts = mx.nd.split(c, num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = mx.nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_onehot_where():
+    w = mx.nd.array(np.arange(12).reshape(4, 3))
+    idx = mx.nd.array([0, 2], dtype="int32")
+    out = mx.nd.take(w, idx)
+    assert np.allclose(out.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    oh = mx.nd.one_hot(idx, depth=4)
+    assert oh.shape == (2, 4)
+    cond = mx.nd.array([1, 0, 1])
+    x = mx.nd.array([1, 2, 3])
+    y = mx.nd.array([10, 20, 30])
+    assert np.allclose(mx.nd.where(cond, x, y).asnumpy(), [1, 20, 3])
+
+
+def test_transfer_and_sync():
+    a = mx.nd.ones((4,), ctx=mx.cpu())
+    b = a.as_in_context(mx.cpu(0))
+    assert np.allclose(b.asnumpy(), 1)
+    a.wait_to_read()
+    mx.nd.waitall()
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    d = {"w": mx.nd.ones((2, 2)), "b": mx.nd.zeros((3,))}
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert np.allclose(loaded["w"].asnumpy(), 1)
+
+
+def test_astype_cast():
+    a = mx.nd.ones((2,))
+    assert a.astype("int32").dtype == np.int32
+    assert a.astype(np.float16).dtype == np.float16
+
+
+def test_topk_sort():
+    a = mx.nd.array([[3, 1, 2], [0, 5, 4]])
+    idx = mx.nd.topk(a, k=2)
+    assert idx.shape == (2, 2)
+    v = mx.nd.topk(a, k=1, ret_typ="value")
+    assert np.allclose(v.asnumpy(), [[3], [5]])
+    s = mx.nd.sort(a, is_ascend=False)
+    assert np.allclose(s.asnumpy(), [[3, 2, 1], [5, 4, 0]])
