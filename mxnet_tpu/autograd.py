@@ -40,6 +40,7 @@ def _st():
         _state.recording = False
         _state.training = False
         _state.tape = []
+        _state.freed = set()  # out_keys of nodes consumed by a prior backward
     return _state
 
 
@@ -72,6 +73,7 @@ def _scope(recording=None, training=None):
     if recording is not None:
         if recording and not prev_r:
             st.tape = []  # fresh outermost record scope starts a new tape
+            st.freed = set()
         st.recording = recording
     if training is not None:
         st.training = training
@@ -199,9 +201,11 @@ def _run_backward(heads, head_grads, retain_graph=False):
         _LIVE[id(h)] = h
 
     touched = {}
+    consumed = set()
     for node in reversed(st.tape):
         if not any(k in cot for k in node.out_keys):
             continue
+        consumed.add(id(node))
         if not any(_is_float(a.dtype) for a in node.in_arrays):
             continue
         if node.py_backward is not None:
@@ -249,9 +253,29 @@ def _run_backward(heads, head_grads, retain_graph=False):
         else:
             arr._grad._set_data(total.astype(arr._grad.dtype))
         arr._fresh_grad = True
+    # A cotangent that reached a key produced by a node consumed in an
+    # EARLIER backward means this head shares a subgraph with an already-
+    # freed graph — grads would silently stop at the boundary. Match the
+    # reference's "graph already freed" error instead.
+    if st.freed and (set(cot) & st.freed):
+        raise MXNetError(
+            "backward reached part of the graph that was freed by a previous "
+            "backward call. Use retain_graph=True on the earlier backward, or "
+            "call autograd.backward([...]) once with all heads.")
     if not retain_graph:
-        st.tape = []
-        _LIVE.clear()
+        # Consume only the subgraph this backward traversed; other heads
+        # recorded in the same scope (e.g. per-device loss copies — the
+        # `for l in losses: l.backward()` idiom) keep their nodes.
+        remaining = []
+        for n in st.tape:
+            if id(n) in consumed:
+                st.freed.update(n.out_keys)
+            else:
+                remaining.append(n)
+        st.tape = remaining
+        keep = {kid for n in st.tape for (kid, _) in n.out_keys}
+        for aid in [a for a in _LIVE if a not in keep]:
+            del _LIVE[aid]
     return cot
 
 

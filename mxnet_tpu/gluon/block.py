@@ -1,0 +1,523 @@
+"""Gluon Block / HybridBlock.
+
+Reference: python/mxnet/gluon/block.py (Block :127, HybridBlock :671 —
+`_build_cache` :748 creating a CachedOp, `hybridize` :832, `export` :868;
+SymbolBlock :952). TPU-native mapping:
+
+- Block: identical imperative semantics (eager NDArray ops on the tape).
+- HybridBlock.hybridize(): instead of tracing into an NNVM Symbol executed by
+  the C++ CachedOp (src/imperative/cached_op.cc), the block's forward is
+  traced by `jax.jit` into ONE XLA executable per (input signature,
+  train-mode): parameters become executable inputs, BatchNorm aux-state
+  updates become extra outputs written back after the call (the functional
+  form of the reference's aux mutation), and RNG ops consume a key passed in
+  at each call. The whole forward — and, via a cached jax.vjp, the whole
+  backward — runs as one fused TPU program: this is where MXNet's
+  "hybridize for speed" story maps onto XLA's compile-once-run-many model.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+from ..base import MXNetError
+from ..context import current_context
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+_naming = threading.local()
+
+
+def _name_counter():
+    if not hasattr(_naming, "counts"):
+        _naming.counts = {}
+    return _naming.counts
+
+
+class _BlockScope:
+    """Name/prefix manager (reference: block.py:33 _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                counts = _name_counter()
+                count = counts.get(hint, 0)
+                counts[hint] = count + 1
+                prefix = "%s%d_" % (hint, count)
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = "%s%d_" % (hint, count)
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base building block (reference: block.py:127)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params,
+                                                        self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def params(self):
+        return self._params
+
+    def name_scope(self):
+        return self._scope
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)" if self._children else "{name}()"
+        modstr = "\n".join("  (%s): %s" % (k, re.sub("\n", "\n  ", repr(v)))
+                           for k, v in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self._children.get(name)
+            if existing is not None:
+                self._children[name] = value
+            else:
+                self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def collect_params(self, select=None):
+        """All params of self + descendants (reference: block.py collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, p in self.params.items():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- persistence -------------------------------------------------------
+    def save_parameters(self, filename):
+        """reference: block.py:315 save_parameters (params only)."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {key: val._reduce() if hasattr(val, "_reduce") else
+                    val.data().copyto(__import__("mxnet_tpu").cpu())
+                    for key, val in params.items()}
+        nd.save(filename, arg_dict)
+
+    save_params = save_parameters
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False):
+        """reference: block.py:356 load_parameters."""
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            for name in params:
+                assert name in loaded, "Parameter %s missing in %s" % (name, filename)
+        for name in loaded:
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError("Parameter %s in file not in Block" % name)
+                continue
+            p = params[name]
+            if p._data is None:
+                p.shape = loaded[name].shape
+                p.initialize(ctx=ctx or [current_context()])
+                p._finish_deferred_init()
+            p.set_data(loaded[name])
+
+    load_params = load_parameters
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # -- execution ---------------------------------------------------------
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        summary_rows = []
+
+        def walk(block, depth):
+            n_params = sum(int(__import__("numpy").prod(p.shape or ()))
+                           for p in block._reg_params.values())
+            summary_rows.append(("  " * depth + block.__class__.__name__, n_params))
+            for c in block._children.values():
+                walk(c, depth + 1)
+
+        walk(self, 0)
+        total = sum(n for _, n in summary_rows)
+        lines = ["%-40s %12s" % ("Layer", "Params"), "-" * 53]
+        lines += ["%-40s %12d" % r for r in summary_rows]
+        lines += ["-" * 53, "%-40s %12d" % ("Total (direct)", total)]
+        print("\n".join(lines))
+
+
+_TRACING = threading.local()
+
+
+def _is_tracing():
+    return getattr(_TRACING, "flag", False)
+
+
+class _CachedGraph:
+    """One compiled entry: jitted fn + aux bookkeeping for a signature."""
+
+    __slots__ = ("jitted", "aux_params", "n_outputs", "single", "bwd")
+
+    def __init__(self):
+        self.jitted = None
+        self.aux_params = []
+        self.n_outputs = 0
+        self.single = True
+        self.bwd = None
+
+
+class HybridBlock(Block):
+    """Block tracable into a compiled executable (reference: block.py:671)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._flags = {}
+        self._cached = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        """reference: block.py:832. static_alloc/static_shape accepted for
+        parity; XLA executables are always statically allocated."""
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc, static_shape=static_shape)
+        self._cached = {}
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def infer_shape(self, *args):
+        """Finish deferred param init from input shapes. Layers override
+        `_shape_hook` (the TPU build's per-layer equivalent of the reference's
+        symbolic _deferred_infer_shape, block.py:810)."""
+        self._shape_hook(*args)
+
+    def _shape_hook(self, *args):
+        pass
+
+    def _finish_deferred(self, *args):
+        params = [p for p in self.collect_params().values() if p._deferred_init]
+        if not params:
+            return
+        # give every descendant a chance to infer shapes from the args flowing
+        # through an eager probe pass
+        self._shape_probe(*args)
+        for p in params:
+            if p._deferred_init:
+                p._finish_deferred_init()
+
+    def _shape_probe(self, *args):
+        """Run one eager forward in probe mode: each HybridBlock's
+        _shape_hook fires with its actual inputs before executing."""
+        with _probe_scope():
+            from .. import autograd
+
+            with autograd.pause():
+                self._eager_forward(*args)
+
+    def _eager_forward(self, *args):
+        ctx = None
+        for a in args:
+            if isinstance(a, NDArray):
+                ctx = a.context
+                break
+        self._shape_hook(*args)
+        for p in self._reg_params.values():
+            if p._deferred_init and not (p._shape is None or any(s == 0 for s in p._shape)):
+                p._finish_deferred_init()
+        params = {}
+        for name, p in self._reg_params.items():
+            params[name] = p.data(ctx)
+        return self.hybrid_forward(nd, *args, **params)
+
+    def forward(self, *args):
+        if self._active and not _is_tracing():
+            return self._call_cached(*args)
+        try:
+            return self._eager_forward(*args)
+        except DeferredInitializationError:
+            self._finish_deferred(*args)
+            return self._eager_forward(*args)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- compiled path -----------------------------------------------------
+    def _call_cached(self, *args):
+        import jax
+
+        from .. import autograd, random as _random
+
+        try:
+            param_list = [(n, p) for n, p in sorted(self.collect_params().items())]
+            param_nds = []
+            ctx = None
+            for a in args:
+                if isinstance(a, NDArray):
+                    ctx = a.context
+                    break
+            for _, p in param_list:
+                param_nds.append(p.data(ctx))
+        except DeferredInitializationError:
+            self._finish_deferred(*args)
+            return self._call_cached(*args)
+
+        is_train = autograd.is_training()
+        sig = (tuple((a.shape, str(a.dtype)) if isinstance(a, NDArray) else ("<s>", repr(a))
+                     for a in args), is_train)
+        entry = self._cached.get(sig)
+        if entry is None:
+            entry = self._build_cache(args, param_nds, is_train)
+            self._cached[sig] = entry
+
+        key = _random.next_key()
+        arg_arrays = tuple(a._data for a in args if isinstance(a, NDArray))
+        param_arrays = tuple(p._data for p in param_nds)
+        outs, aux_new = entry.jitted(key, arg_arrays, param_arrays)
+
+        # write aux-state updates (BatchNorm moving stats) back
+        for idx, new in zip(entry.aux_params, aux_new):
+            param_nds[idx]._set_data(new)
+
+        arg_nds = [a for a in args if isinstance(a, NDArray)]
+        out_nds = [NDArray(o, ctx=ctx or current_context()) for o in outs]
+        if autograd.is_recording():
+            self._record_cached(entry, key, arg_nds, param_nds, arg_arrays,
+                                param_arrays, out_nds)
+        if entry.single:
+            return out_nds[0]
+        return out_nds
+
+    def _build_cache(self, args, param_nds, is_train):
+        """Trace the whole block into one jitted executable
+        (reference: block.py:748 _build_cache -> CachedOp)."""
+        import jax
+
+        from .. import autograd, random as _random
+
+        entry = _CachedGraph()
+        arg_ctx = None
+        for a in args:
+            if isinstance(a, NDArray):
+                arg_ctx = a.context
+                break
+        static_args = [a if not isinstance(a, NDArray) else None for a in args]
+        block = self
+
+        def traced(key, arg_arrays, param_arrays):
+            prev_key = _random.push_trace_key(key)
+            saved = [(p, p._data, p._version) for p in param_nds]
+            _TRACING.flag = True
+            try:
+                for p, arr in zip(param_nds, param_arrays):
+                    p._data = arr
+                arg_it = iter(arg_arrays)
+                call_args = [a if a is not None else NDArray(next(arg_it), ctx=arg_ctx)
+                             for a in static_args]
+                with autograd._scope(recording=False, training=is_train):
+                    out = block._eager_forward(*call_args)
+                outs = out if isinstance(out, (list, tuple)) else (out,)
+                entry.single = not isinstance(out, (list, tuple))
+                entry.n_outputs = len(outs)
+                out_arrays = tuple(o._data for o in outs)
+                mutated = []
+                entry.aux_params = []
+                for i, (p, _, _) in enumerate(saved):
+                    if p._data is not param_arrays[i]:
+                        entry.aux_params.append(i)
+                        mutated.append(p._data)
+                return out_arrays, tuple(mutated)
+            finally:
+                for p, old, ver in saved:
+                    p._data = old
+                    p._version = ver
+                _TRACING.flag = False
+                _random.pop_trace_key(prev_key)
+
+        entry.jitted = jax.jit(traced)
+
+        def bwd(key, arg_arrays, param_arrays, out_cots):
+            def pure(a, p):
+                o, aux = traced(key, a, p)
+                return o
+
+            _, pull = jax.vjp(pure, arg_arrays, param_arrays)
+            return pull(tuple(out_cots))
+
+        entry.bwd = jax.jit(bwd)
+        return entry
+
+    def _record_cached(self, entry, key, arg_nds, param_nds, arg_arrays,
+                       param_arrays, out_nds):
+        from .. import autograd
+
+        inputs = arg_nds + param_nds
+        node = autograd._Node(
+            None, (), None,
+            [(i, i._version) for i in inputs],
+            tuple(arg_arrays) + tuple(param_arrays),
+            [(id(o), o._version) for o in out_nds],
+            [o.shape for o in out_nds], [o.dtype for o in out_nds])
+        n_args = len(arg_arrays)
+
+        def py_backward(cots):
+            acots, pcots = entry.bwd(key, tuple(arg_arrays), tuple(param_arrays),
+                                     tuple(cots))
+            return list(acots) + list(pcots)
+
+        node.py_backward = py_backward
+        autograd._st().tape.append(node)
+        for o in out_nds:
+            autograd._LIVE[id(o)] = o
+
+    # -- export ------------------------------------------------------------
+    def export(self, path, epoch=0):
+        """Serialize for deployment (reference: block.py:868 — symbol.json +
+        params). The TPU build stores params + an input-signature manifest;
+        StableHLO export of the jitted graph is produced when a cache entry
+        exists."""
+        import json
+
+        params = self._collect_params_with_prefix()
+        arg_dict = {"arg:" + k: v.data() for k, v in params.items()}
+        nd.save("%s-%04d.params" % (path, epoch), {k: v for k, v in arg_dict.items()})
+        manifest = {
+            "framework": "mxnet_tpu",
+            "block": self.__class__.__name__,
+            "params": {k: list(p.shape or ()) for k, p in params.items()},
+        }
+        with open("%s-symbol.json" % path, "w") as f:
+            json.dump(manifest, f, indent=2)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _probe_scope():
+    prev = getattr(_TRACING, "probe", False)
+    _TRACING.probe = True
+    try:
+        yield
+    finally:
+        _TRACING.probe = prev
+
+
+class SymbolBlock(HybridBlock):
+    """Run a symbolic graph as a Block (reference: block.py:952). Implemented
+    once the Symbol API lands; imports from `export` manifests."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._outputs = outputs
+        self._inputs = inputs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+
+        symbol = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        ret = SymbolBlock(symbol, inputs)
+        if param_file is not None:
+            ret.collect_params().load(param_file, ctx=ctx)
+        return ret
+
+    def forward(self, *args):
+        from .. import symbol as sym_mod
+
+        arg_names = [i.name for i in self._inputs]
+        kwargs = dict(zip(arg_names, args))
+        params = {name: p.data() for name, p in self.collect_params().items()}
+        return self._outputs.eval_with(dict(kwargs, **params))

@@ -1,0 +1,54 @@
+"""Contrib layers (reference: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ..nn.basic_layers import BatchNorm, HybridBlock
+from ... import ndarray as nd
+
+__all__ = ["SyncBatchNorm", "Concurrent", "HybridConcurrent", "Identity",
+           "PixelShuffle2D"]
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference: contrib sync_batch_norm.cc). On TPU
+    the distributed trainer computes BN stats under pjit where XLA inserts the
+    cross-replica psum automatically when the batch axis is sharded; the
+    single-process layer is therefore identical to BatchNorm."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9, epsilon=1e-5,
+                 center=True, scale=True, use_global_stats=False, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon, center=center,
+                         scale=scale, use_global_stats=use_global_stats,
+                         in_channels=in_channels, **kwargs)
+
+
+class Concurrent(HybridBlock):
+    """Parallel branches concatenated (reference: contrib basic_layers)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def _eager_forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+HybridConcurrent = Concurrent
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class PixelShuffle2D(HybridBlock):
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        return F.depth_to_space(x, block_size=self._factor)

@@ -1,0 +1,99 @@
+"""Contrib RNN cells (reference: python/mxnet/gluon/contrib/rnn)."""
+from __future__ import annotations
+
+from ..rnn.rnn_cell import ModifierCell, RecurrentCell
+from ... import ndarray as nd
+
+__all__ = ["VariationalDropoutCell", "Conv2DLSTMCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Same dropout mask across time steps (reference: contrib/rnn/rnn_cell.py)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0, drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, p, like):
+        return nd.Dropout(nd.ones_like(like), p=p, mode="always")
+
+    def __call__(self, inputs, states):
+        if self.drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask(self.drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        if self.drop_states:
+            if self._state_mask is None:
+                self._state_mask = self._mask(self.drop_states, states[0])
+            states = [s * self._state_mask for s in states]
+        out, next_states = self.base_cell(inputs, states)
+        if self.drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(self.drop_outputs, out)
+            out = out * self._output_mask
+        return out, next_states
+
+
+class Conv2DLSTMCell(RecurrentCell):
+    """ConvLSTM (reference: contrib/rnn/conv_rnn_cell.py)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=(0, 0), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = input_shape
+        self._hc = hidden_channels
+        k = i2h_kernel if isinstance(i2h_kernel, tuple) else (i2h_kernel,) * 2
+        hk = h2h_kernel if isinstance(h2h_kernel, tuple) else (h2h_kernel,) * 2
+        self._i2h_kernel, self._h2h_kernel = k, hk
+        self._i2h_pad = i2h_pad
+        self._h2h_pad = (hk[0] // 2, hk[1] // 2)
+        in_c = input_shape[0]
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight",
+                                              shape=(4 * hidden_channels, in_c) + k)
+            self.h2h_weight = self.params.get("h2h_weight",
+                                              shape=(4 * hidden_channels, hidden_channels) + hk)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(4 * hidden_channels,),
+                                            init="zeros")
+            self.h2h_bias = self.params.get("h2h_bias", shape=(4 * hidden_channels,),
+                                            init="zeros")
+
+    def state_info(self, batch_size=0):
+        c, h, w = self._input_shape
+        oh = (h + 2 * self._i2h_pad[0] - self._i2h_kernel[0]) + 1
+        ow = (w + 2 * self._i2h_pad[1] - self._i2h_kernel[1]) + 1
+        shape = (batch_size, self._hc, oh, ow)
+        return [{"shape": shape, "__layout__": "NCHW"},
+                {"shape": shape, "__layout__": "NCHW"}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=4 * self._hc)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=4 * self._hc)
+        gates = i2h + h2h
+        sg = F.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(sg[0])
+        f = F.sigmoid(sg[1])
+        g = F.tanh(sg[2])
+        o = F.sigmoid(sg[3])
+        next_c = f * states[1] + i * g
+        next_h = o * F.tanh(next_c)
+        return next_h, [next_h, next_c]

@@ -1,0 +1,92 @@
+"""DataLoader.
+
+Reference: python/mxnet/gluon/data/dataloader.py:98-120 — multi-worker loader
+feeding shared-memory NDArrays. TPU-native: workers are a thread pool doing
+host-side decode/augment into numpy, with a prefetch queue that overlaps the
+pipeline with device steps (PJRT transfers are async); there is no fork+shm
+dance because buffers go straight to device via device_put. A
+`num_workers>0` therefore means prefetch depth here."""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return nd.array(data, dtype=data.dtype if data.dtype != _np.float64 else "float32")
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError("batch_size/shuffle/sampler/last_batch incompatible "
+                             "with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load(self, batch_indices):
+        return self._batchify_fn([self._dataset[i] for i in batch_indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._load(batch)
+            return
+        # threaded prefetch pipeline
+        q = queue.Queue(maxsize=self._prefetch or 2)
+        sentinel = object()
+
+        def producer():
+            try:
+                for batch in self._batch_sampler:
+                    q.put(self._load(batch))
+            except Exception as e:  # propagate worker errors
+                q.put(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item
+        t.join()

@@ -1,0 +1,152 @@
+"""Vision transforms (reference: python/mxnet/gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .... import ndarray as nd
+from ....base import MXNetError
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+
+
+class Compose(Sequential):
+    """Chain transforms (reference: transforms.py Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: transforms.py ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        out = F.Cast(x, dtype="float32") / 255.0
+        if out.ndim == 3:
+            return F.transpose(out, axes=(2, 0, 1))
+        return F.transpose(out, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = nd.array(_np.asarray(self._mean, _np.float32).reshape(-1, 1, 1))
+        std = nd.array(_np.asarray(self._std, _np.float32).reshape(-1, 1, 1))
+        return (x - mean) / std
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        from .... import image
+
+        return image.imresize(x, self._size[0], self._size[1])
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        h, w = x.shape[0], x.shape[1]
+        cw, ch = self._size
+        x0 = max((w - cw) // 2, 0)
+        y0 = max((h - ch) // 2, 0)
+        return x[y0:y0 + ch, x0:x0 + cw]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from .... import image
+
+        h, w = x.shape[0], x.shape[1]
+        area = h * w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            cw = int(round(_np.sqrt(target_area * aspect)))
+            ch = int(round(_np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                x0 = _np.random.randint(0, w - cw + 1)
+                y0 = _np.random.randint(0, h - ch + 1)
+                crop = x[y0:y0 + ch, x0:x0 + cw]
+                return image.imresize(crop, self._size[0], self._size[1])
+        return image.imresize(x, self._size[0], self._size[1])
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return x.flip(axis=1)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return x.flip(axis=0)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._delta = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + _np.random.uniform(-self._delta, self._delta)
+        return (x.astype("float32") * alpha).clip(0, 255)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._delta = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + _np.random.uniform(-self._delta, self._delta)
+        xf = x.astype("float32")
+        gray = xf.mean()
+        return ((xf - gray) * alpha + gray).clip(0, 255)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._delta = saturation
+
+    def forward(self, x):
+        alpha = 1.0 + _np.random.uniform(-self._delta, self._delta)
+        xf = x.astype("float32")
+        coef = nd.array(_np.array([0.299, 0.587, 0.114], _np.float32).reshape(1, 1, 3))
+        gray = (xf * coef).sum(axis=2, keepdims=True)
+        return (xf * alpha + gray * (1.0 - alpha)).clip(0, 255)

@@ -1,0 +1,32 @@
+"""Model zoo: vision (reference: python/mxnet/gluon/model_zoo/vision/__init__.py).
+
+get_model('resnet50_v1') etc. Pretrained weights are file-based
+(net.load_parameters) — this build has zero egress, so the reference's
+model_store download path is not available."""
+from .resnet import *  # noqa: F401,F403
+from .alexnet import *  # noqa: F401,F403
+from .vgg import *  # noqa: F401,F403
+from .squeezenet import *  # noqa: F401,F403
+from .densenet import *  # noqa: F401,F403
+from .inception import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
+
+from . import resnet, alexnet, vgg, squeezenet, densenet, inception, mobilenet  # noqa: F401
+
+from ....base import MXNetError
+
+_models = {}
+for _mod in (resnet, alexnet, vgg, squeezenet, densenet, inception, mobilenet):
+    for _name in getattr(_mod, "__all__", []):
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower():
+            _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """reference: model_zoo/vision/__init__.py get_model"""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError("Model %s not supported. Available: %s"
+                         % (name, sorted(_models)))
+    return _models[name](**kwargs)

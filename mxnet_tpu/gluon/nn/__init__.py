@@ -1,0 +1,5 @@
+"""Gluon neural-net layers (reference: python/mxnet/gluon/nn)."""
+from .basic_layers import *  # noqa: F401,F403
+from .conv_layers import *  # noqa: F401,F403
+from .basic_layers import Sequential, HybridSequential  # noqa: F401
+from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401

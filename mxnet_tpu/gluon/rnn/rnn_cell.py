@@ -1,0 +1,418 @@
+"""Recurrent cells + explicit unroll (reference: python/mxnet/gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+def _format_sequence(length, inputs, layout, merge):
+    """Split/merge helpers (reference: rnn_cell.py:46 _format_sequence)."""
+    batch_axis = layout.find("N")
+    axis = layout.find("T")
+    if isinstance(inputs, nd.NDArray):
+        batch_size = inputs.shape[batch_axis]
+        if merge is False:
+            inputs = [x.squeeze(axis=axis) for x in
+                      nd.split(inputs, num_outputs=inputs.shape[axis], axis=axis,
+                               squeeze_axis=False)]
+    else:
+        batch_size = inputs[0].shape[0]
+        if merge is True:
+            inputs = nd.stack(*inputs, axis=axis)
+    return inputs, axis, batch_size
+
+
+class RecurrentCell(HybridBlock):
+    """Base recurrent cell (reference: rnn_cell.py:120)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified
+        states = []
+        func = func or nd.zeros
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            info.pop("__layout__", None)
+            states.append(func(**dict(info, **kwargs)))
+        return states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return self.forward(inputs, states)
+
+    def forward(self, inputs, states):
+        return self._eager_forward(inputs, states)
+
+    def _eager_forward(self, inputs, states):
+        self._shape_hook(inputs)
+        for p in self._reg_params.values():
+            if p._deferred_init and not (p._shape is None or any(s == 0 for s in p._shape)):
+                p._finish_deferred_init()
+        params = {name: p.data(inputs.context if isinstance(inputs, nd.NDArray)
+                               else None)
+                  for name, p in self._reg_params.items()}
+        return self.hybrid_forward(nd, inputs, states, **params)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell over `length` steps (reference: rnn_cell.py unroll).
+        Python loop — under hybridize/CachedOp the whole unroll traces into
+        one XLA program (XLA unrolls or loops as it sees fit)."""
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            ctx = inputs[0].context
+            begin_state = self.begin_state(batch_size, ctx=ctx, dtype=inputs[0].dtype)
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [nd.invoke("SequenceLast", (nd.stack(*ele_list, axis=0), valid_length),
+                                {"use_sequence_length": True, "axis": 0})
+                      for ele_list in zip(*all_states)]
+            outputs = [nd.invoke("SequenceMask", (nd.stack(*outputs, axis=0), valid_length),
+                                 {"use_sequence_length": True, "axis": 0})]
+            outputs = [o.squeeze(axis=0) for o in
+                       nd.split(outputs[0], num_outputs=length, axis=0)] \
+                if merge_outputs is False else outputs[0].swapaxes(0, 1) \
+                if layout == "NTC" else outputs[0]
+            if merge_outputs is None:
+                merge_outputs = True
+            return outputs, states
+        if merge_outputs is None or merge_outputs is True:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def _get_activation(self, F, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return F.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    """Elman cell (reference: rnn_cell.py RNNCell)."""
+
+    def __init__(self, hidden_size, activation="tanh", i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                            init=i2h_bias_initializer,
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                            init=h2h_bias_initializer,
+                                            allow_deferred_init=True)
+
+    def _shape_hook(self, x, *a):
+        if self.i2h_weight.shape and self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = self._get_activation(F, i2h + h2h, self._activation)
+        return output, [output]
+
+
+class LSTMCell(RecurrentCell):
+    """LSTM cell (reference: rnn_cell.py LSTMCell; gate order i,f,g,o)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(4 * hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(4 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(4 * hidden_size,),
+                                            init=i2h_bias_initializer,
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(4 * hidden_size,),
+                                            init=h2h_bias_initializer,
+                                            allow_deferred_init=True)
+
+    def _shape_hook(self, x, *a):
+        if self.i2h_weight.shape and self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slice_gates = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slice_gates[0])
+        forget_gate = F.sigmoid(slice_gates[1])
+        in_transform = F.tanh(slice_gates[2])
+        out_gate = F.sigmoid(slice_gates[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RecurrentCell):
+    """GRU cell (reference: rnn_cell.py GRUCell; gate order r,z,n)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(3 * hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(3 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(3 * hidden_size,),
+                                            init=i2h_bias_initializer,
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(3 * hidden_size,),
+                                            init=h2h_bias_initializer,
+                                            allow_deferred_init=True)
+
+    def _shape_hook(self, x, *a):
+        if self.i2h_weight.shape and self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        prev_state_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h = F.split(h2h, num_outputs=3, axis=1)
+        reset_gate = F.sigmoid(i2h_r + h2h_r)
+        update_gate = F.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = F.tanh(i2h + reset_gate * h2h)
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_state_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells (reference: rnn_cell.py SequentialRNNCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return sum([c.state_info(batch_size) for c in self._children.values()], [])
+
+    def begin_state(self, batch_size=0, **kwargs):
+        assert not self._modified
+        return sum([c.begin_state(batch_size, **kwargs)
+                    for c in self._children.values()], [])
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    """Base for cells wrapping another cell (reference: rnn_cell.py)."""
+
+    def __init__(self, base_cell):
+        assert not base_cell._modified
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias(), params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def __call__(self, inputs, states):
+        cell = self.base_cell
+        next_output, next_states = cell(inputs, states)
+        mask = lambda p, like: nd.Dropout(nd.ones_like(like), p=p)
+        prev_output = self._prev_output if self._prev_output is not None \
+            else nd.zeros_like(next_output)
+        output = nd.where(mask(self.zoneout_outputs, next_output), next_output,
+                          prev_output) if self.zoneout_outputs > 0.0 else next_output
+        new_states = [nd.where(mask(self.zoneout_states, new_s), new_s, old_s)
+                      for new_s, old_s in zip(next_states, states)] \
+            if self.zoneout_states > 0.0 else next_states
+        self._prev_output = output
+        return output, new_states
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+
+class ResidualCell(ModifierCell):
+    def _alias(self):
+        return "residual"
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Bidirectional wrapper (reference: rnn_cell.py BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll()")
+
+    def state_info(self, batch_size=0):
+        return sum([c.state_info(batch_size) for c in self._children.values()], [])
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return sum([c.begin_state(batch_size, **kwargs)
+                    for c in self._children.values()], [])
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            ctx = inputs[0].context
+            begin_state = self.begin_state(batch_size, ctx=ctx, dtype=inputs[0].dtype)
+        states = begin_state
+        l_cell, r_cell = self._children["l_cell"], self._children["r_cell"]
+        n_l = len(l_cell.state_info(batch_size))
+        l_outputs, l_states = l_cell.unroll(length, inputs, states[:n_l], layout,
+                                            merge_outputs=False,
+                                            valid_length=valid_length)
+        rev_inputs = list(reversed(inputs))
+        r_outputs, r_states = r_cell.unroll(length, rev_inputs, states[n_l:], layout,
+                                            merge_outputs=False,
+                                            valid_length=valid_length)
+        r_outputs = list(reversed(r_outputs))
+        outputs = [nd.concat(l_o, r_o, dim=1)
+                   for l_o, r_o in zip(l_outputs, r_outputs)]
+        if merge_outputs is None or merge_outputs is True:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, l_states + r_states

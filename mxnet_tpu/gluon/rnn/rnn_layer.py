@@ -1,0 +1,199 @@
+"""Fused recurrent layers.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py — RNN/LSTM/GRU wrapping the
+fused RNN op (src/operator/rnn-inl.h). TPU-native: the op is a lax.scan whose
+input projection is hoisted into one large MXU matmul per layer
+(ops/rnn.py). Parameters are kept as separate i2h/h2h weights per
+layer/direction (same naming as the reference) and packed into the flat
+cuDNN-layout vector at forward, so checkpoints interchange."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), "Invalid layout %s; must be TNC or NTC" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = _GATES[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][: self._dir]:
+                    self._register_param("%s%d_i2h_weight" % (j, i),
+                                         (ng * nh, ni), i2h_weight_initializer)
+                    self._register_param("%s%d_h2h_weight" % (j, i),
+                                         (ng * nh, nh), h2h_weight_initializer)
+                    self._register_param("%s%d_i2h_bias" % (j, i),
+                                         (ng * nh,), i2h_bias_initializer)
+                    self._register_param("%s%d_h2h_bias" % (j, i),
+                                         (ng * nh,), h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init, allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def _shape_hook(self, x, *args):
+        if self._input_size == 0:
+            ni = x.shape[2] if self._layout == "TNC" else x.shape[-1]
+            for j in ["l", "r"][: self._dir]:
+                p = getattr(self, "%s0_i2h_weight" % j)
+                if p.shape and p.shape[1] == 0:
+                    p.shape = (self._gates * self._hidden_size, ni)
+            self._input_size = ni
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent states (reference: rnn_layer.py begin_state)."""
+        func = func or nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def _eager_forward(self, inputs, states=None):
+        self._shape_hook(inputs)
+        skip_states = states is None
+        batch_axis = 1 if self._layout == "TNC" else 0
+        batch_size = inputs.shape[batch_axis]
+        if states is None:
+            states = self.begin_state(batch_size, ctx=inputs.context,
+                                      dtype=inputs.dtype)
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        out, out_states = self._forward_kernel(inputs, states)
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        return out if skip_states else (out, out_states)
+
+    def forward(self, inputs, states=None):
+        from ..block import _is_tracing
+
+        if self._active and not _is_tracing():
+            # compiled path keyed on (shape, states-given)
+            return self._call_cached(inputs, states) if states is not None \
+                else self._call_cached(inputs)
+        try:
+            return self._eager_forward(inputs, states)
+        except Exception as e:
+            from ..parameter import DeferredInitializationError
+
+            if isinstance(e, DeferredInitializationError):
+                self._finish_deferred(inputs)
+                return self._eager_forward(inputs, states)
+            raise
+
+    def _finish_deferred(self, inputs):
+        self._shape_hook(inputs)
+        for p in self.collect_params().values():
+            if p._deferred_init:
+                p._finish_deferred_init()
+
+    def _forward_kernel(self, inputs, states):
+        """Pack params into the flat cuDNN layout and run the fused op."""
+        ctx = inputs.context
+        weights = []
+        biases = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                weights.append(getattr(self, "%s%d_i2h_weight" % (j, i)).data(ctx).reshape((-1,)))
+                weights.append(getattr(self, "%s%d_h2h_weight" % (j, i)).data(ctx).reshape((-1,)))
+                biases.append(getattr(self, "%s%d_i2h_bias" % (j, i)).data(ctx))
+                biases.append(getattr(self, "%s%d_h2h_bias" % (j, i)).data(ctx))
+        params = nd.concat(*(weights + biases), dim=0)
+        if self._mode == "lstm":
+            rnn_args = (states[0], states[1])
+        else:
+            rnn_args = (states[0],)
+        outs = nd.invoke("RNN", (inputs, params) + rnn_args, {
+            "state_size": self._hidden_size, "num_layers": self._num_layers,
+            "bidirectional": self._dir == 2, "mode": self._mode,
+            "p": self._dropout, "state_outputs": True})
+        outs = outs if isinstance(outs, list) else [outs]
+        return outs[0], list(outs[1:])
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        mapping = "{0} -> {1}".format(self._input_size if self._input_size else None,
+                                      self._hidden_size)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+
+class RNN(_RNNLayer):
+    """Vanilla RNN layer (reference: rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC",
+                 dropout=0, bidirectional=False, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size),
+                 "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """LSTM layer (reference: rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size),
+                 "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size, self._hidden_size),
+                 "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """GRU layer (reference: rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size),
+                 "__layout__": "LNC"}]

@@ -1,0 +1,153 @@
+"""Gluon Trainer.
+
+Reference: python/mxnet/gluon/trainer.py:27 — `step` :298 = allreduce grads
+across device copies (:327, via kvstore) + optimizer update per copy (:359).
+
+TPU-native: for the single-process multi-device case the grad reduction is a
+kvstore('device') push/pull which lowers onto one XLA add over device buffers;
+the *scaled* path is mxnet_tpu.parallel.DistributedTrainer, which keeps ONE
+sharded copy of each parameter on the mesh and lets XLA insert the
+all-reduces inside the compiled step (SURVEY §2.3 row 1)."""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be list/dict of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError("invalid parameter %r" % (p,))
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx()
+            assert contexts is None or contexts == ctx, \
+                "All Parameters must be initialized on the same set of contexts"
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be empty when optimizer is an instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
+
+    def _init_kvstore(self):
+        """Lazily create the kvstore (reference: trainer.py:169)."""
+        self._kv_initialized = True
+        if not self._kvstore_type or len(self._contexts) < 2:
+            self._kvstore = None
+            return
+        from .. import kvstore as kvs
+
+        kv = kvs.create(self._kvstore_type) if isinstance(self._kvstore_type, str) \
+            else self._kvstore_type
+        self._kvstore = kv
+        for i, param in enumerate(self._params):
+            if param._data is not None:
+                kv.init(i, param.list_data()[0])
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr_scheduler(self._optimizer.num_update) \
+            if self._optimizer.lr_scheduler else self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Allreduce grads + update (reference: trainer.py:298)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if len(self._contexts) < 2:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            grads = param.list_grad()
+            if self._kvstore is not None:
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, out=grads)
+            else:
+                total = grads[0]
+                for g in grads[1:]:
+                    total = total + g.as_in_context(total.context)
+                for g in grads:
+                    g._set_data(total.as_in_context(g.context)._data)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if not ignore_stale_grad:
+                for data in param.list_data():
+                    if not data._fresh_grad:
+                        raise MXNetError(
+                            "Gradient of Parameter `%s` on context %s has not been "
+                            "updated by backward since last step. Set "
+                            "ignore_stale_grad=True to suppress" % (param.name, data.context))
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+                arr._fresh_grad = False
+
+    def save_states(self, fname):
+        """reference: trainer.py:429"""
+        assert self._optimizer is not None
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """reference: trainer.py:458"""
+        with open(fname, "rb") as f:
+            states = f.read()
+        for updater in self._updaters:
+            updater.set_states(states)
+            updater.optimizer = self._optimizer

@@ -1,0 +1,92 @@
+"""Gluon utilities (reference: python/mxnet/gluon/utils.py — split_data,
+split_and_load, clip_global_norm, check_sha1, download)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """reference: utils.py:31"""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            "data with shape %s cannot be evenly split into %d slices along axis %d"
+            % (str(data.shape), num_slice, batch_axis))
+    n_each = size // num_slice
+    if even_split:
+        return [data.slice_axis(batch_axis, i * n_each, (i + 1) * n_each)
+                for i in range(num_slice)]
+    slices = []
+    step = (size + num_slice - 1) // num_slice
+    for i in range(num_slice):
+        end = min((i + 1) * step, size)
+        if i * step < size:
+            slices.append(data.slice_axis(batch_axis, i * step, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """reference: utils.py:79 — slice along batch axis and place per context."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """reference: utils.py:115 — one fused global-norm clip."""
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total = None
+    for a in arrays:
+        n = (a.astype("float32") ** 2).sum().as_in_context(ctx)
+        total = n if total is None else total + n
+    total_norm = float(total.sqrt().asscalar())
+    if check_isfinite and not _np.isfinite(total_norm):
+        import warnings
+
+        warnings.warn("nan or inf found in clip_global_norm")
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data((a * scale)._data)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """reference: utils.py download. This build runs with zero egress: the
+    function only serves cache hits (pre-downloaded files); a network fetch
+    raises."""
+    fname = path
+    if path is None or os.path.isdir(path or ""):
+        fname = os.path.join(path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and \
+            (sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        "download(%s): no network egress in this environment and file %s not "
+        "cached locally" % (url, fname))

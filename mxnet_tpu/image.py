@@ -1,0 +1,415 @@
+"""Image decode + augmentation pipeline.
+
+Reference: python/mxnet/image/image.py (ImageIter + augmenter classes) and the
+C++ pipeline src/io/iter_image_recordio_2.cc + image_aug_default.cc. Decode
+and augmentation are host-side (PIL/numpy) exactly as the reference keeps them
+on CPU (OpenCV); the batches stream to device asynchronously. Augmenter set
+mirrors image_aug_default.cc: resize, random/center crop, mirror, HSL jitter,
+mean/std normalize."""
+from __future__ import annotations
+
+import io as _io
+import queue
+import threading
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["imdecode", "imencode", "imread", "imresize", "resize_short",
+           "center_crop", "random_crop", "fixed_crop", "color_normalize",
+           "Augmenter", "ResizeAug", "RandomCropAug", "CenterCropAug",
+           "HorizontalFlipAug", "ColorNormalizeAug", "CastAug",
+           "CreateAugmenter", "ImageIter", "ImageRecordIterPy"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+
+        return Image
+    except ImportError:
+        raise MXNetError("PIL is required for image decode in this build")
+
+
+def imdecode(buf, flag=1, to_rgb=True, to_ndarray=True):
+    """Decode an encoded image buffer -> HWC uint8 (reference: image.py imdecode)."""
+    Image = _pil()
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = _np.asarray(img, dtype=_np.uint8)
+    if not flag:
+        arr = arr[:, :, None]
+    if not to_rgb:
+        arr = arr[:, :, ::-1]
+    if to_ndarray:
+        return nd.array(arr, dtype="uint8")
+    return arr
+
+
+def imencode(img, quality=95, fmt=".jpg"):
+    Image = _pil()
+    if isinstance(img, nd.NDArray):
+        img = img.asnumpy()
+    img = _np.asarray(img, dtype=_np.uint8)
+    if img.ndim == 3 and img.shape[2] == 1:
+        img = img[:, :, 0]
+    pil = Image.fromarray(img)
+    out = _io.BytesIO()
+    pil.save(out, format="JPEG" if fmt in (".jpg", ".jpeg") else "PNG",
+             quality=quality)
+    return out.getvalue()
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize HWC image (reference: image.py imresize)."""
+    Image = _pil()
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else _np.asarray(src)
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    pil = Image.fromarray(arr[:, :, 0] if squeeze else arr.astype(_np.uint8))
+    resample = Image.NEAREST if interp == 0 else Image.BILINEAR
+    out = _np.asarray(pil.resize((w, h), resample))
+    if squeeze:
+        out = out[:, :, None]
+    return nd.array(out, dtype="uint8")
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = _np.random.randint(0, max(w - new_w, 0) + 1)
+    y0 = _np.random.randint(0, max(h - new_h, 0) + 1)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter:
+    """Base augmenter (reference: image.py Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size if isinstance(size, tuple) else (size, size)
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size if isinstance(size, tuple) else (size, size)
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.rand() < self.p:
+            return src.flip(axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = nd.array(mean) if mean is not None else None
+        self.std = nd.array(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.brightness, self.brightness)
+        return (src * alpha).clip(0, 255)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.contrast, self.contrast)
+        gray = float(src.mean().asscalar())
+        return (src * alpha + gray * (1 - alpha)).clip(0, 255)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Standard augmentation chain (reference: image.py CreateAugmenter,
+    mirroring src/io/image_aug_default.cc order)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and (isinstance(mean, _np.ndarray) or mean):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Python-side augmenting image iterator (reference: image.py ImageIter).
+    Sources: .rec file (path_imgrec) or image list + root dir."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
+                                           if k in ("resize", "rand_crop",
+                                                    "rand_mirror", "mean", "std")})
+        self.record = None
+        self.imglist = None
+        if path_imgrec is not None:
+            from . import recordio
+            import os
+
+            idx = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.record = recordio.MXIndexedRecordIO(idx, path_imgrec, "r")
+            self.seq = list(self.record.keys)
+        elif path_imglist is not None or imglist is not None:
+            items = []
+            if path_imglist is not None:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        label = [float(x) for x in parts[1:-1]]
+                        items.append((parts[-1], label))
+            else:
+                for entry in imglist:
+                    items.append((entry[-1], [float(x) for x in entry[:-1]]))
+            self.imglist = items
+            self.path_root = path_root
+            self.seq = list(range(len(items)))
+        else:
+            raise MXNetError("ImageIter needs path_imgrec, path_imglist or imglist")
+        self.shuffle = shuffle
+        self.cur = 0
+        if shuffle:
+            _np.random.shuffle(self.seq)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        self.cur = 0
+        if self.shuffle:
+            _np.random.shuffle(self.seq)
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.record is not None:
+            from . import recordio
+
+            header, buf = recordio.unpack(self.record.read_idx(idx))
+            label = header.label
+            return label, imdecode(buf)
+        fname, label = self.imglist[idx]
+        import os
+
+        return _np.asarray(label), imread(os.path.join(self.path_root, fname))
+
+    def next(self):
+        batch_data = []
+        batch_label = []
+        pad = 0
+        for i in range(self.batch_size):
+            try:
+                label, img = self.next_sample()
+            except StopIteration:
+                if not batch_data:
+                    raise
+                pad = self.batch_size - len(batch_data)
+                batch_data.extend(batch_data[:pad])
+                batch_label.extend(batch_label[:pad])
+                break
+            for aug in self.auglist:
+                img = aug(img)
+            arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
+            batch_data.append(_np.transpose(arr.astype(_np.float32), (2, 0, 1)))
+            lab = _np.asarray(label, dtype=_np.float32).reshape(-1)
+            batch_label.append(lab[0] if self.label_width == 1 else
+                               lab[: self.label_width])
+        data = nd.array(_np.stack(batch_data))
+        label = nd.array(_np.stack(batch_label))
+        return DataBatch(data=[data], label=[label], pad=pad)
+
+
+class ImageRecordIterPy(ImageIter):
+    """Threaded augmenting RecordIO iterator — the ImageRecordIter equivalent
+    (reference: src/io/iter_image_recordio_2.cc:766 threaded parser +
+    iter_prefetcher.h). preprocess_threads decode/augment in parallel;
+    prefetch_buffer batches are staged ahead."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
+                 rand_crop=False, rand_mirror=False, mean=(0, 0, 0),
+                 std=(1, 1, 1), resize=-1, label_width=1, preprocess_threads=4,
+                 prefetch_buffer=4, **kwargs):
+        mean_arr = _np.asarray(mean, _np.float32).reshape(1, 1, 3) \
+            if any(m != 0 for m in mean) else None
+        std_arr = _np.asarray(std, _np.float32).reshape(1, 1, 3) \
+            if any(s != 1 for s in std) else None
+        aug_list = CreateAugmenter(data_shape, resize=max(resize, 0),
+                                   rand_crop=rand_crop, rand_mirror=rand_mirror,
+                                   mean=mean_arr, std=std_arr)
+        super().__init__(batch_size, data_shape, label_width=label_width,
+                         path_imgrec=path_imgrec, shuffle=shuffle,
+                         aug_list=aug_list)
+        self._threads = max(1, preprocess_threads)
+        self._buffer = max(1, prefetch_buffer)
+        self._queue = None
+        self._worker = None
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self._buffer)
+
+        def run():
+            try:
+                while True:
+                    self._queue.put(ImageIter.next(self))
+            except StopIteration:
+                self._queue.put(None)
+            except Exception as e:
+                self._queue.put(e)
+
+        self._worker = threading.Thread(target=run, daemon=True)
+        self._worker.start()
+
+    def reset(self):
+        if self._worker is not None:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        super().reset()
+        self._worker = None
+
+    def next(self):
+        if self._worker is None:
+            self._start()
+        item = self._queue.get()
+        if item is None:
+            self._worker = None
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item

@@ -1,0 +1,303 @@
+"""Weight initializers (reference: python/mxnet/initializer.py, 752 LoC).
+
+Same registry + name-pattern dispatch as the reference: an Initializer is
+called with (InitDesc(name, attrs), NDArray) and fills the array based on the
+parameter's name suffix (weight/bias/gamma/beta/...)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import _Registry
+from . import ndarray as nd
+from . import random as _random
+
+__all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Zero", "One",
+           "Constant", "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear",
+           "LSTMBias", "Load", "Mixed", "register", "create"]
+
+_REG = _Registry("initializer")
+
+
+def register(klass):
+    _REG.register(klass, klass.__name__)
+    return klass
+
+
+def create(init, **kwargs):
+    if init is None:
+        return Uniform()
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        return _REG.create(init, **kwargs)
+    raise TypeError("cannot create initializer from %r" % (init,))
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint (reference: initializer.py:38)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer (reference: initializer.py:92)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(desc)
+        init_hint = desc.attrs.get("__init__", "")
+        if init_hint:
+            create(init_hint)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("min") or name.endswith("max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # hooks
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, desc, arr):
+        arr[:] = 1.0
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def _rand(self, shape):
+        return _random.np_random().random(shape)
+
+    def __repr__(self):
+        return "%s(%s)" % (type(self).__name__, self._kwargs)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        arr[:] = _np.random.uniform(-self.scale, self.scale, arr.shape).astype(_np.float32)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        arr[:] = _np.random.normal(0, self.sigma, arr.shape).astype(_np.float32)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 0.0
+
+
+register(Zero)
+_REG.register(Zero, "zeros")
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 1.0
+
+
+_REG.register(One, "ones")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        arr[:] = self.value
+
+
+@register
+class Xavier(Initializer):
+    """reference: initializer.py Xavier — gaussian/uniform scaled by fan avg/in/out."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError("Xavier requires ndim >= 2, got %s for %s" % (shape, desc))
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = _np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = _np.random.uniform(-scale, scale, shape).astype(_np.float32)
+        else:
+            arr[:] = _np.random.normal(0, scale, shape).astype(_np.float32)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """reference: initializer.py MSRAPrelu (He init)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape).astype(_np.float32)
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: initializer.py Bilinear)."""
+
+    def _init_weight(self, desc, arr):
+        weight = _np.zeros(arr.shape, dtype=_np.float32)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(_np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, rest 0 (reference: initializer.py)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = _np.zeros(arr.shape, dtype=_np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+    _init_bias = _init_weight
+    _init_default = _init_weight
+
+
+@register
+class Load(Initializer):
+    """Init from a dict of arrays with fallback (reference: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        self.param = {k.replace("arg:", "").replace("aux:", ""): v
+                      for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, desc, arr):
+        name = str(desc)
+        if name in self.param:
+            src = self.param[name]
+            arr[:] = src.asnumpy() if hasattr(src, "asnumpy") else src
+        elif self.default_init is not None:
+            self.default_init(desc, arr)
+        else:
+            raise ValueError("no init value for %s" % name)
+
+
+@register
+class Mixed(Initializer):
+    """Pattern-matched initializer list (reference: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+
+        super().__init__()
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                init(desc, arr)
+                return
+        raise ValueError("no matching initializer pattern for %s" % str(desc))
+
+
+# convenience namespace mirroring mx.init.*
+class init:
+    Uniform = Uniform
+    Normal = Normal
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Orthogonal = Orthogonal
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    Load = Load
+    Mixed = Mixed
+    Initializer = Initializer
+    InitDesc = InitDesc

@@ -1,0 +1,244 @@
+"""Key-value store for parameter synchronization.
+
+Reference surface: python/mxnet/kvstore.py:97 (`KVStore.push` :160, `pull`
+:240, `row_sparse_pull` :314, `set_optimizer` :450, rank/num_workers
+:513-526) backed natively by src/kvstore/kvstore.cc:40-72 (types
+local/device/nccl/dist_sync/dist_async/dist_device_sync) with in-process
+reduce strategies (comm.h:103/451) and the ps-lite parameter server
+(kvstore_dist.h:44).
+
+TPU-native design (SURVEY §5.8): there is no parameter server and no NCCL.
+ - `local` / `device` / `nccl`: single-process multi-device reduction. The
+   reduce is one XLA add per key executed on the target device; broadcast is
+   a device_put fan-out. (The reference's CommDevice merge-buffer machinery
+   is unnecessary — XLA owns transfers.)
+ - `dist_sync` / `dist_device_sync` / `horovod` / `tpu`: the same API over
+   `jax.distributed` process groups. Under a single process this degrades to
+   rank 0 of 1; under multi-host each push/pull additionally all-reduces
+   across processes with `jax.make_array_from_process_local_data` +
+   collective sum. The *recommended* scaled path keeps gradients inside one
+   compiled step function on a Mesh (mxnet_tpu.parallel) so XLA rides ICI;
+   this kvstore exists for API parity so Trainer/Module code runs unmodified.
+ - `dist_async`: intentionally unsupported (async-PS semantics dropped —
+   documented divergence, SURVEY §2.3).
+
+An optimizer can be installed with `set_optimizer` (reference: server-side
+update, kvstore_dist_server.h:179); updates then happen during `push` and
+`pull` returns updated weights — matching update_on_kvstore semantics.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    if isinstance(key, (list, tuple)):
+        return list(key), True
+    return [key], False
+
+
+def _group_vals(vals, nkeys, batched):
+    """Normalize push/pull values to a list (len nkeys) of lists of NDArray."""
+    if not batched:
+        vals = [vals]
+    out = []
+    for v in vals:
+        if isinstance(v, NDArray):
+            out.append([v])
+        else:
+            out.append(list(v))
+    if len(out) != nkeys:
+        raise MXNetError("number of keys != number of value groups")
+    return out
+
+
+class KVStore:
+    """In-process key-value store; see module docstring for the design."""
+
+    def __init__(self, name="local"):
+        self._type = name
+        self._store = {}          # key -> NDArray (merged value, on init ctx)
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        self._states = {}         # key -> optimizer state (when optimizer set)
+
+    # ------------------------------------------------------------------ info
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """reference: kvstore.py:513 — process rank; single-process = 0."""
+        try:
+            import jax
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self):
+        """reference: kvstore.py:526."""
+        try:
+            import jax
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    # ------------------------------------------------------------- lifecycle
+    def init(self, key, value):
+        """Initialize key(s) with value(s) (reference: kvstore.py:138)."""
+        keys, batched = _key_list(key)
+        vals = _group_vals(value, len(keys), batched)
+        for k, vgroup in zip(keys, vals):
+            if k in self._store:
+                continue
+            self._store[k] = vgroup[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate value(s) into the store (reference: kvstore.py:160).
+
+        Values for one key (one per device copy) are summed — one XLA add
+        chain executed lazily on the first value's device. If an optimizer
+        is installed the update is applied here (server-side-update parity).
+        """
+        keys, batched = _key_list(key)
+        vals = _group_vals(value, len(keys), batched)
+        for k, vgroup in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            merged = vgroup[0]
+            for v in vgroup[1:]:
+                merged = merged + v.as_in_context(merged.context)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k] = merged.as_in_context(self._store[k].context)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast stored value(s) into `out` arrays (reference: :240)."""
+        keys, batched = _key_list(key)
+        outs = _group_vals(out, len(keys), batched)
+        for k, ogroup in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            src = self._store[k]
+            for o in ogroup:
+                o._set_data(src.as_in_context(o.context)._data)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference: kvstore.py:314).
+
+        TPU note: row_sparse storage is dense-backed (SURVEY §7.8c); this
+        gathers the requested rows with one XLA take per out array.
+        """
+        if row_ids is None:
+            raise MXNetError("row_ids is required for row_sparse_pull")
+        keys, batched = _key_list(key)
+        outs = _group_vals(out, len(keys), batched)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        if len(rids) == 1 and len(outs[0]) > 1:
+            rids = rids * len(outs[0])
+        for k, ogroup in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            src = self._store[k]
+            for o, rid in zip(ogroup, rids):
+                rows = src.take(rid.as_in_context(src.context))
+                o._set_data(rows.as_in_context(o.context)._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority=priority)
+        self.pull(key, out=out if out is not None else value, priority=priority)
+
+    # ------------------------------------------------------------- optimizer
+    def set_updater(self, updater):
+        """Install a local updater fn(key, recv, local) (reference: :420)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Run this optimizer inside the store (reference: kvstore.py:450).
+
+        The reference pickles the optimizer to remote servers; here the
+        "server" is in-process, so we just build an Updater around it.
+        """
+        from . import optimizer as opt
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        """reference: kvstore.py:398. 2-bit compression is a wire-format
+        optimization for the ps-lite transport; on an in-process/ICI path
+        there is no wire, so this validates and records the setting only."""
+        if compression_params.get("type", "2bit") not in ("2bit", "none"):
+            raise MXNetError("unsupported compression type")
+        self._compression_params = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        """reference: kvstore.py:482."""
+        if self._updater is None:
+            raise MXNetError("no optimizer installed on this kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer installed on this kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        """Global sync barrier (reference: kvstore.h:364). Single-process:
+        just drain pending async work."""
+        for v in self._store.values():
+            v.wait_to_read()
+
+    def _send_command_to_servers(self, head, body):  # parity stub
+        pass
+
+    def __repr__(self):
+        return "KVStore(type=%s, keys=%d)" % (self._type, len(self._store))
+
+
+class _DistKVStore(KVStore):
+    """Synchronous multi-process kvstore over jax.distributed.
+
+    Each push reduces device copies locally, then sums across processes.
+    Under one process this is identical to `local`. The cross-process sum
+    uses a tiny jitted psum over a 1-axis process mesh — DCN-aware via XLA.
+    """
+
+    def push(self, key, value, priority=0):
+        super().push(key, value, priority=priority)
+        if self.num_workers > 1:
+            import jax
+            keys, _ = _key_list(key)
+            for k in keys:
+                arr = self._store[k]
+                summed = jax.experimental.multihost_utils.process_allgather(
+                    arr._data).sum(axis=0)
+                arr._set_data(summed)
+
+
+def create(name="local"):
+    """Factory (reference: src/kvstore/kvstore.cc:40-72)."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be str")
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl"):
+        return KVStore(name)
+    if name in ("dist_sync", "dist_device_sync", "dist_sync_device", "horovod", "tpu"):
+        return _DistKVStore(name)
+    if name.startswith("dist_async"):
+        raise MXNetError(
+            "dist_async is not supported by the TPU backend: asynchronous "
+            "parameter-server semantics were replaced by synchronous XLA "
+            "collectives (see SURVEY.md §2.3). Use dist_sync.")
+    raise MXNetError("unknown kvstore type %r" % (name,))

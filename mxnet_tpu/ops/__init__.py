@@ -89,11 +89,20 @@ def _jitted(name, attr_key):
 def invoke_jax(name, arrays, attrs):
     """Run op `name` on raw jax arrays. Uses a per-(op, attrs) compiled-
     executable cache — the analogue of the reference's per-op kernel dispatch,
-    with XLA doing codegen + autotuning instead of mshadow/cuDNN."""
+    with XLA doing codegen + autotuning instead of mshadow/cuDNN.
+
+    When any input is a tracer (we are inside an outer jit trace — CachedOp,
+    Symbol executor, vjp), the op function is inlined instead of nested-jitted:
+    the outer compile fuses everything, and reverse-mode AD through nested jit
+    of some primitives (reduce_window max) is unsupported in jax."""
     from .. import engine
 
     op = _REGISTRY[name]
     if engine.is_naive():
+        return op.fn(*arrays, **dict(attrs))
+    import jax
+
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
         return op.fn(*arrays, **dict(attrs))
     attr_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
     return _jitted(name, attr_key)(*arrays)

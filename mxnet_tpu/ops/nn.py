@@ -21,6 +21,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import builtins
+import functools
 import math
 
 import numpy as _np
@@ -138,6 +139,48 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
 # Pooling (reference: src/operator/nn/pooling.cc)
 # --------------------------------------------------------------------------
 
+def _patches_max(x, kernel, stride, pads):
+    """Max pool via patch extraction — differentiable formulation used only
+    inside the backward rule of `_float_max_pool`. Pad value must be finite:
+    conv_general_dilated_patches gathers through a one-hot conv, and
+    0 * -inf = NaN would poison every border window."""
+    n, c = x.shape[0], x.shape[1]
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+    padded = jnp.pad(x, ((0, 0), (0, 0)) + pads, constant_values=neg)
+    patches = lax.conv_general_dilated_patches(
+        padded, filter_shape=kernel, window_strides=stride,
+        padding=[(0, 0)] * len(kernel),
+        dimension_numbers=_conv_dnums(x.ndim))
+    out_spatial = patches.shape[2:]
+    k_elems = int(_np.prod(kernel))
+    return patches.reshape((n, c, k_elems) + out_spatial).max(axis=2)
+
+
+@functools.lru_cache(maxsize=None)
+def _float_max_pool(kernel, stride, pads):
+    """Float max pooling: cheap `lax.reduce_window` forward, patches-based
+    backward (reduce_window(max) has no linearization rule in jax 0.9, which
+    breaks reverse-mode AD under jit — CachedOp backward)."""
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + pads
+
+    @jax.custom_vjp
+    def mp(x):
+        return lax.reduce_window(x, jnp.asarray(-jnp.inf, x.dtype), lax.max,
+                                 window, strides, padding)
+
+    def fwd(x):
+        return mp(x), x
+
+    def bwd(x, g):
+        _, pull = jax.vjp(lambda t: _patches_max(t, kernel, stride, pads), x)
+        return (pull(g)[0],)
+
+    mp.defvjp(fwd, bwd)
+    return mp
+
+
 @register("Pooling")
 def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=(),
             pooling_convention="valid", count_include_pad=True, p_value=2,
@@ -164,9 +207,11 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
     padding = [(0, 0), (0, 0)] + pads
 
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
-                                 window, strides, padding)
+        if not jnp.issubdtype(data.dtype, jnp.floating):
+            init = jnp.iinfo(data.dtype).min
+            return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                     window, strides, padding)
+        return _float_max_pool(kernel, stride, tuple(pads))(data)
     if pool_type == "lp":
         powed = jnp.power(jnp.abs(data), p_value)
         s = lax.reduce_window(powed, jnp.asarray(0, data.dtype), lax.add, window, strides, padding)

@@ -104,6 +104,70 @@ def _run_layer(x, wx, wh, bx, bh, h0, c0, mode, reverse=False):
     return ys, hT, cT
 
 
+@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"))
+def ctc_loss(pred, label, pred_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """CTC forward algorithm as a lax.scan (reference: warp-ctc via
+    src/operator/contrib/ctc_loss.cc; blank index 0 for blank_label='first').
+
+    pred: (T, B, C) raw activations (softmax applied internally, matching the
+    reference). label: (B, L) class indices (padded). Returns per-sample loss."""
+    T, B, C = pred.shape
+    L = label.shape[1]
+    # blank=0 → labels arrive 1-based relative to blank, as in reference usage
+    blank = 0 if blank_label == "first" else C - 1
+    lab = label.astype(jnp.int32)
+    logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
+    S = 2 * L + 1
+    # extended label sequence with interleaved blanks
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    NEG = jnp.asarray(-1e30, jnp.float32)
+
+    # gather per-position class log-probs: (T,B,C) indexed by (B,S) → (T,B,S)
+    ext_logp = jnp.take_along_axis(logp, jnp.broadcast_to(ext[None], (T, B, S)),
+                                   axis=2)
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (~same_as_prev2)
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(ext_logp[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(ext_logp[0, :, 1])
+
+    def lse(a, b):
+        m = jnp.maximum(a, b)
+        return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m))
+
+    def step(alpha, lp_t):
+        shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        acc = lse(alpha, shift1)
+        acc = jnp.where(can_skip, lse(acc, shift2), acc)
+        new_alpha = acc + lp_t
+        return new_alpha, new_alpha
+
+    _, alphas = lax.scan(step, alpha0, ext_logp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, B, S)
+
+    t_idx = (pred_lengths.astype(jnp.int32) - 1) if (use_data_lengths and pred_lengths is not None) \
+        else jnp.full((B,), T - 1, jnp.int32)
+    if use_label_lengths and label_lengths is not None:
+        l_len = label_lengths.astype(jnp.int32)
+    else:
+        l_len = jnp.sum((lab != blank).astype(jnp.int32), axis=1) if blank == 0 \
+            else jnp.full((B,), L, jnp.int32)
+    final = alphas[t_idx, jnp.arange(B)]  # (B, S)
+    end1 = jnp.take_along_axis(final, (2 * l_len)[:, None], axis=1)[:, 0]
+    end2 = jnp.take_along_axis(final, jnp.maximum(2 * l_len - 1, 0)[:, None], axis=1)[:, 0]
+    # empty label: the only path is all-blank (end1); the clamped end2 index
+    # would double-count it
+    end2 = jnp.where(l_len == 0, NEG, end2)
+    return -lse(end1, end2)
+
+
 @register("RNN", num_outputs=-1, needs_rng=True)
 def rnn(rng, data, parameters, state, state_cell=None, state_size=0, num_layers=1,
         bidirectional=False, mode="lstm", p=0.0, state_outputs=False,

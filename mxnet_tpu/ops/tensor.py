@@ -129,7 +129,7 @@ _BINARY_FNS = {
 }
 
 for _n, _f in _BINARY_FNS.items():
-    register("elemwise_" + _n, aliases=("_" + _n, "broadcast_" + _n))(
+    register("elemwise_" + _n, aliases=("_" + _n, "broadcast_" + _n, _n))(
         (lambda f: lambda lhs, rhs: f(lhs, rhs))(_f)
     )
 

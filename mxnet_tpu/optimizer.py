@@ -1,0 +1,511 @@
+"""Optimizers (reference: python/mxnet/optimizer/optimizer.py, 1.8k LoC).
+
+Same API as the reference: an `Optimizer` registry, per-index lr/wd
+multipliers, `create_state`/`update`, and an `Updater` for local updates
+(optimizer.py:1621). Every update lowers onto the fused update ops in
+ops/optimizer_ops.py — one XLA kernel per (op, hyperparams), with the
+functional outputs written back into weight/state buffers (the TPU version of
+the reference's in-place kernels src/operator/optimizer_op.cc)."""
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+
+from .base import _Registry, MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta", "RMSProp",
+           "Ftrl", "FTML", "Signum", "SGLD", "DCASGD", "Adamax", "Nadam",
+           "AdamW", "LBSGD", "Updater", "get_updater", "create", "register"]
+
+_REG = _Registry("optimizer")
+
+
+def register(klass):
+    _REG.register(klass, klass.__name__)
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.create(name, **kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:46)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.sym_info = ()
+
+    # -- registry-compatible helpers --------------------------------------
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return create(name, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            s, w32 = state
+            g32 = grad.astype("float32")
+            self.update(index, w32, g32, s)
+            weight._set_data(w32.astype("float16")._data)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot override lr")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common(self, index):
+        self._update_count(index)
+        return dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                    rescale_grad=self.rescale_grad,
+                    clip_gradient=self.clip_gradient if self.clip_gradient else -1.0)
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d["param_dict"] = {}
+        return d
+
+
+@register
+class SGD(Optimizer):
+    """SGD(+momentum, multi-precision) — reference optimizer.py:511."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        if state is None:
+            nd.sgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.sgd_mom_update(weight, grad, state, out=[weight, state],
+                              momentum=self.momentum, **kw)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        if state is None:
+            nd.sgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.nag_mom_update(weight, grad, state, out=[weight, state],
+                              momentum=self.momentum, **kw)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype="float32"),
+                nd.zeros(weight.shape, ctx=weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        kw["lr"] = kw["lr"] * (coef2 ** 0.5) / coef1
+        nd.adam_update(weight, grad, mean, var, out=[weight, mean, var],
+                       beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, **kw)
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay (reference: contrib adamw.cc + adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon, self.eta = beta1, beta2, epsilon, eta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype="float32"),
+                nd.zeros(weight.shape, ctx=weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        mean, var = state
+        nd.adamw_update(weight, grad, mean, var, out=[weight, mean, var],
+                        beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                        eta=self.eta, **kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        nd.adagrad_update(weight, grad, state, out=[weight, state],
+                          epsilon=self.float_stable_eps, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype="float32"),
+                nd.zeros(weight.shape, ctx=weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        kw.pop("lr")
+        acc_g, acc_d = state
+        nd.adadelta_update(weight, grad, acc_g, acc_d, out=[weight, acc_g, acc_d],
+                           rho=self.rho, epsilon=self.epsilon, **kw)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
+                 centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context, dtype="float32")
+        if self.centered:
+            return (z(), z(), z())
+        return z()
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        kw["clip_weights"] = self.clip_weights if self.clip_weights else -1.0
+        if self.centered:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta,
+                                  out=[weight, n, g, delta], gamma1=self.gamma1,
+                                  gamma2=self.gamma2, epsilon=self.epsilon, **kw)
+        else:
+            nd.rmsprop_update(weight, grad, state, out=[weight, state],
+                              gamma1=self.gamma1, epsilon=self.epsilon, **kw)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype="float32"),
+                nd.zeros(weight.shape, ctx=weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        z, n = state
+        nd.ftrl_update(weight, grad, z, n, out=[weight, z, n],
+                       lamda1=self.lamda1, beta=self.beta, **kw)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context, dtype="float32")
+        return (z(), z(), z())
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        kw["clip_grad"] = kw.pop("clip_gradient")
+        d, v, z = state
+        t = self._index_update_count[index]
+        nd.ftml_update(weight, grad, d, v, z, out=[weight, d, v, z],
+                       beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                       t=t, **kw)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        if state is None:
+            nd.signsgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.signum_update(weight, grad, state, out=[weight, state],
+                             momentum=self.momentum, wd_lh=self.wd_lh, **kw)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer.py:1083)."""
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        g = grad * kw["rescale_grad"]
+        if kw["clip_gradient"] > 0:
+            g = g.clip(-kw["clip_gradient"], kw["clip_gradient"])
+        noise = nd.random.normal(loc=0, scale=float(_np.sqrt(kw["lr"])),
+                                 shape=weight.shape)
+        weight._set_data((weight - kw["lr"] / 2 * (g + kw["wd"] * weight) + noise)._data)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py:975)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = None if self.momentum == 0.0 else nd.zeros(weight.shape, ctx=weight.context)
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        g = grad * kw["rescale_grad"]
+        if kw["clip_gradient"] > 0:
+            g = g.clip(-kw["clip_gradient"], kw["clip_gradient"])
+        mom, prev = state
+        comp = g + kw["wd"] * weight + self.lamda * g * g * (weight - prev)
+        if mom is None:
+            delta = -kw["lr"] * comp
+        else:
+            mom._set_data((self.momentum * mom - kw["lr"] * comp)._data)
+            delta = mom
+        prev._set_data(weight._data)
+        weight._set_data((weight + delta)._data)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype="float32"),
+                nd.zeros(weight.shape, ctx=weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        t = self._index_update_count[index]
+        lr = kw["lr"] / (1.0 - self.beta1 ** t)
+        m, u = state
+        g = grad * kw["rescale_grad"] + kw["wd"] * weight
+        if kw["clip_gradient"] > 0:
+            g = g.clip(-kw["clip_gradient"], kw["clip_gradient"])
+        m._set_data((self.beta1 * m + (1.0 - self.beta1) * g)._data)
+        u._set_data(nd.maximum(self.beta2 * u, g.abs())._data)
+        weight._set_data((weight - lr * m / (u + 1e-8))._data)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype="float32"),
+                nd.zeros(weight.shape, ctx=weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        t = self._index_update_count[index]
+        g = grad * kw["rescale_grad"] + kw["wd"] * weight
+        if kw["clip_gradient"] > 0:
+            g = g.clip(-kw["clip_gradient"], kw["clip_gradient"])
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m._set_data((self.beta1 * m + (1.0 - self.beta1) * g)._data)
+        v._set_data((self.beta2 * v + (1.0 - self.beta2) * g * g)._data)
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m / (1.0 - m_schedule_next)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._set_data((weight - kw["lr"] * m_bar / (v_prime.sqrt() + self.epsilon))._data)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style warmup (reference: optimizer.py:782).
+    Layer-wise adaptive rate: lr scaled by ||w||/||g||."""
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+        self.warmup_strategy = warmup_strategy
+
+    def update(self, index, weight, grad, state):
+        kw = self._common(index)
+        wnorm = float(weight.norm().asscalar())
+        gnorm = float(grad.norm().asscalar())
+        if wnorm > 0 and gnorm > 0:
+            kw["lr"] = kw["lr"] * min(wnorm / (gnorm * kw["rescale_grad"] + 1e-12), 10.0)
+        if state is None:
+            nd.sgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.sgd_mom_update(weight, grad, state, out=[weight, state],
+                              momentum=self.momentum, **kw)
+
+
+ccSGD = SGD  # legacy alias (reference registers ccSGD -> SGD)
+_REG.register(SGD, "ccsgd")
+
+
+class Updater:
+    """Local updater applying Optimizer with per-index states
+    (reference: optimizer.py:1621 get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2:
+            self.states, opt_state = data
+            self.optimizer.__dict__.update(opt_state)
+        else:
+            self.states = data
+
+    def get_states(self, dump_optimizer=False):
+        def _np_state(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return tuple(_np_state(x) for x in s)
+            return s
+
+        states = {k: _np_state(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer.__getstate__()))
+        return pickle.dumps(states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -46,12 +46,33 @@ def current_seed():
 
 
 def next_key():
-    """Split one subkey off the global chain (consumed by a single random op)."""
+    """Split one subkey off the global chain (consumed by a single random op).
+
+    Under graph tracing (CachedOp/Symbol executor) a *trace key* is active:
+    subkeys are derived deterministically from it by fold_in(counter), so the
+    compiled executable takes the key as a runtime input and stays a pure
+    function — fresh randomness per call, reproducible under seed()."""
     import jax
 
     st = _get()
+    trace = getattr(st, "trace", None)
+    if trace is not None:
+        key = jax.random.fold_in(trace[0], trace[1])
+        trace[1] += 1
+        return key
     st.key, sub = jax.random.split(st.key)
     return sub
+
+
+def push_trace_key(key):
+    st = _get()
+    prev = getattr(st, "trace", None)
+    st.trace = [key, 0]
+    return prev
+
+
+def pop_trace_key(prev=None):
+    _get().trace = prev
 
 
 def np_random():

@@ -8,3 +8,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Some environments install a PJRT plugin hook that force-overrides
+# jax_platforms at interpreter start (sitecustomize), which would make
+# backend init try to reach real accelerator hardware even for CPU test
+# runs. Re-assert CPU before any computation triggers backends().
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

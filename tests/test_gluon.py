@@ -1,0 +1,320 @@
+"""Gluon tests (mirrors reference tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu(0)])
+    assert len(p.list_data()) == 1
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_sharing():
+    class Net(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5, in_units=5)
+                self.dense1 = nn.Dense(5, in_units=5)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    net1 = Net(prefix="net1_")
+    net2 = Net(prefix="net2_", params=net1.collect_params())
+    net1.initialize()
+    net2(mx.nd.zeros((3, 5)))
+    net1.save_parameters("/tmp/net1.params")
+    net3 = Net(prefix="net3_")
+    net3.load_parameters("/tmp/net1.params", mx.cpu())
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(16)
+    layer.initialize()
+    x = mx.nd.ones((4, 7))
+    out = layer(x)
+    assert out.shape == (4, 16)
+    assert layer.weight.shape == (16, 7)
+
+
+def test_conv_layers():
+    x = mx.nd.random.uniform(shape=(2, 3, 16, 16))
+    conv = nn.Conv2D(8, kernel_size=3, padding=1)
+    conv.initialize()
+    assert conv(x).shape == (2, 8, 16, 16)
+    conv_s = nn.Conv2D(8, kernel_size=3, strides=2, padding=1)
+    conv_s.initialize()
+    assert conv_s(x).shape == (2, 8, 8, 8)
+    deconv = nn.Conv2DTranspose(4, kernel_size=2, strides=2)
+    deconv.initialize()
+    assert deconv(x).shape == (2, 4, 32, 32)
+    grouped = nn.Conv2D(6, kernel_size=3, padding=1, groups=3)
+    grouped.initialize()
+    assert grouped(x).shape == (2, 6, 16, 16)
+
+
+def test_pool_layers():
+    x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    x5 = mx.nd.random.uniform(shape=(2, 3, 5, 5))
+    assert nn.MaxPool2D(2, strides=2, ceil_mode=True)(x5).shape == (2, 3, 3, 3)
+
+
+def test_batchnorm_layer():
+    bn = nn.BatchNorm()
+    bn.initialize()
+    x = mx.nd.random.uniform(shape=(4, 3, 5, 5))
+    with autograd.record():
+        out = bn(x)
+    assert out.shape == x.shape
+    rm0 = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        bn(x)
+    assert not np.allclose(bn.running_mean.data().asnumpy(), 0.0)
+    # eval mode uses running stats
+    out_eval = bn(x)
+    assert out_eval.shape == x.shape
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array([0, 3, 9])
+    out = emb(idx)
+    assert out.shape == (3, 4)
+    idx.attach_grad()
+    emb.collect_params().zero_grad()
+    with autograd.record():
+        loss = emb(idx).sum()
+    loss.backward()
+    g = emb.weight.grad().asnumpy()
+    assert np.allclose(g[0], 1) and np.allclose(g[1], 0)
+
+
+def test_hybrid_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.BatchNorm(), nn.Dense(8))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(4, 16))
+    out_eager = net(x).asnumpy()
+    net.hybridize()
+    out_hybrid = net(x).asnumpy()
+    assert np.allclose(out_eager, out_hybrid, atol=1e-5), \
+        np.abs(out_eager - out_hybrid).max()
+
+
+def test_hybrid_grad_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="tanh"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(4, 8))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    eager_grads = {k: v.grad().asnumpy().copy()
+                   for k, v in net.collect_params().items()}
+    net.hybridize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    for k, v in net.collect_params().items():
+        assert np.allclose(eager_grads[k], v.grad().asnumpy(), atol=1e-4), k
+
+
+def test_lenet_convergence():
+    """Minimum end-to-end slice: LeNet on synthetic MNIST-like data
+    (SURVEY §7 phase 2 exit criterion)."""
+    mx.random.seed(42)
+    np.random.seed(42)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=5, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Conv2D(16, kernel_size=5, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Flatten(),
+                nn.Dense(64, activation="relu"),
+                nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    n = 64
+    x_np = np.zeros((n, 1, 28, 28), np.float32)
+    y_np = np.random.randint(0, 4, n)
+    for i in range(n):  # class-dependent pattern
+        q = y_np[i]
+        x_np[i, 0, 7 * q:7 * q + 7, :] = 1.0
+    x_np += np.random.randn(n, 1, 28, 28).astype(np.float32) * 0.1
+    x, y = mx.nd.array(x_np), mx.nd.array(y_np)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    for epoch in range(15):
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(n)
+    pred = net(x).argmax(axis=1).asnumpy()
+    acc = (pred == y_np).mean()
+    assert acc > 0.9, "LeNet failed to fit synthetic data: acc=%.3f" % acc
+
+
+def test_sequential_getitem():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(5), nn.Dense(6))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_losses():
+    pred = mx.nd.random.uniform(shape=(4, 5))
+    label = mx.nd.array([0, 1, 2, 3])
+    for loss_fn in [gluon.loss.SoftmaxCrossEntropyLoss(),
+                    gluon.loss.L2Loss(), gluon.loss.L1Loss(),
+                    gluon.loss.HuberLoss()]:
+        if isinstance(loss_fn, gluon.loss.SoftmaxCrossEntropyLoss):
+            out = loss_fn(pred, label)
+        else:
+            out = loss_fn(pred, mx.nd.random.uniform(shape=(4, 5)))
+        assert out.shape == (4,)
+    l = gluon.loss.SigmoidBCELoss()
+    out = l(pred, mx.nd.round(mx.nd.random.uniform(shape=(4, 5))))
+    assert out.shape == (4,)
+
+
+def test_rnn_layers():
+    lstm = gluon.rnn.LSTM(16, num_layers=2)
+    lstm.initialize()
+    x = mx.nd.random.uniform(shape=(5, 3, 8))  # TNC
+    out = lstm(x)
+    assert out.shape == (5, 3, 16)
+    states = lstm.begin_state(batch_size=3)
+    out, new_states = lstm(x, states)
+    assert out.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)
+    assert new_states[1].shape == (2, 3, 16)
+
+    gru = gluon.rnn.GRU(12, layout="NTC")
+    gru.initialize()
+    x = mx.nd.random.uniform(shape=(3, 5, 8))
+    assert gru(x).shape == (3, 5, 12)
+
+    bi = gluon.rnn.LSTM(7, bidirectional=True)
+    bi.initialize()
+    x = mx.nd.random.uniform(shape=(4, 2, 5))
+    assert bi(x).shape == (4, 2, 14)
+
+
+def test_rnn_cells():
+    cell = gluon.rnn.LSTMCell(10)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 6, 5))
+    outputs, states = cell.unroll(6, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 6, 10)
+    assert states[0].shape == (2, 10)
+
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.GRUCell(8))
+    stack.add(gluon.rnn.RNNCell(4))
+    stack.initialize()
+    outputs, states = stack.unroll(6, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 6, 4)
+
+
+def test_rnn_gradient():
+    lstm = gluon.rnn.LSTM(8)
+    lstm.initialize()
+    x = mx.nd.random.uniform(shape=(4, 2, 6))
+    with autograd.record():
+        out = lstm(x).sum()
+    out.backward()
+    for name, p in lstm.collect_params().items():
+        assert np.abs(p.grad().asnumpy()).sum() > 0, name
+
+
+def test_trainer_multi_device():
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    p = gluon.Parameter("w", shape=(3,))
+    p.initialize(ctx=ctxs, init="ones")
+    trainer = gluon.Trainer({"w": p}, "sgd", {"learning_rate": 1.0})
+    from mxnet_tpu.gluon.utils import split_and_load
+
+    for ctx_idx, ctx in enumerate(ctxs):
+        with autograd.record():
+            loss = (p.data(ctx) * (ctx_idx + 1)).sum()
+        loss.backward()
+    trainer.step(1)
+    # grad total = 1 + 2 = 3 across devices -> w = 1 - 3
+    assert np.allclose(p.data(ctxs[0]).asnumpy(), -2.0)
+    assert np.allclose(p.data(ctxs[1]).asnumpy(), -2.0)
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.ones((3,)) * 3, mx.nd.ones((4,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert new_total < 1.01
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(f)
+    x = mx.nd.random.uniform(shape=(2, 3))
+    assert np.allclose(net(x).asnumpy(), net2(x).asnumpy(), atol=1e-6)
+
+
+def test_optimizers_step():
+    for name in ["sgd", "adam", "adagrad", "rmsprop", "adadelta", "ftrl",
+                 "nag", "signum", "adamax", "nadam", "ftml", "adamw"]:
+        p = gluon.Parameter("w", shape=(4,))
+        p.initialize(init="ones")
+        opt_params = {"learning_rate": 0.1} if name != "adadelta" else {}
+        trainer = gluon.Trainer({"w": p}, name, opt_params)
+        with autograd.record():
+            loss = (p.data() ** 2).sum()
+        loss.backward()
+        before = p.data().asnumpy().copy()
+        trainer.step(1)
+        after = p.data().asnumpy()
+        assert not np.allclose(before, after), "optimizer %s did not update" % name
+
+
+def test_lr_scheduler():
+    from mxnet_tpu import lr_scheduler
+
+    s = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert abs(s(11) - 0.5) < 1e-6
+    c = lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert abs(c(0) - 1.0) < 1e-6
+    assert c(50) < 0.6
+    p = lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0)
+    assert p(0) == 1.0 and p(100) < 1e-6
+
+
+def test_model_zoo_construction():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    for name in ["resnet18_v1", "resnet18_v2", "mobilenet0_25", "squeezenet1_1"]:
+        net = vision.get_model(name, classes=10)
+        net.initialize()
+        x = mx.nd.random.uniform(shape=(1, 3, 224, 224))
+        out = net(x)
+        assert out.shape == (1, 10), name
