@@ -1,0 +1,31 @@
+"""mxnet_tpu.parallel — scaling subsystem (SURVEY §2.3, §5.8).
+
+Replaces the reference's kvstore transports + executor-group batch slicing
+with mesh-sharded compiled steps:
+
+  mesh        — named-axis device meshes (dp/fsdp/tp/pp/sp/ep)
+  sharding    — parameter/data PartitionSpec rules
+  collectives — XLA collectives (psum/all_gather/reduce_scatter/ppermute)
+                + multi-host bootstrap (jax.distributed rendezvous)
+  trainer     — DistributedTrainer: fwd+loss+bwd+optimizer as ONE compiled
+                sharded step with donated buffers
+  ring_attention — exact sequence-parallel attention over the sp axis
+"""
+from .mesh import (make_mesh, default_mesh, current_mesh, use_mesh,
+                   local_devices, DP, FSDP, TP, PP, SP, EP)
+from .sharding import (ShardingRules, named_sharding, shard_array, batch_spec,
+                       param_spec, constraint)
+from . import collectives
+from .collectives import (init_process_group, rank, num_workers, barrier,
+                          all_reduce_arrays)
+from .trainer import DistributedTrainer
+from .ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "make_mesh", "default_mesh", "current_mesh", "use_mesh", "local_devices",
+    "DP", "FSDP", "TP", "PP", "SP", "EP",
+    "ShardingRules", "named_sharding", "shard_array", "batch_spec",
+    "param_spec", "constraint", "collectives", "init_process_group", "rank",
+    "num_workers", "barrier", "all_reduce_arrays", "DistributedTrainer",
+    "ring_attention", "ring_attention_sharded",
+]

@@ -1,0 +1,161 @@
+"""Collective communication layer — XLA collectives over the mesh.
+
+Replaces the reference's four transports (SURVEY §5.8): ps-lite/ZMQ
+parameter server (kvstore_dist.h:44), NCCL (kvstore_nccl.h:285-482),
+CommDevice P2P reduce (comm.h:451-728) and CommCPU (comm.h:272-407).
+Inside a compiled step these are `lax.psum`/`all_gather`/`ppermute` which
+XLA lowers onto ICI rings (and DCN across pod slices); at the host level
+`jax.distributed` replaces the ps-lite scheduler rendezvous.
+
+Two call modes:
+  * inside `shard_map`/`pmap` — the `axis_name` forms are used directly;
+  * outside jit — `all_reduce_arrays` provides an eager, engine-style
+    reduce across per-device NDArray copies (what kvstore('device') uses).
+"""
+from __future__ import annotations
+
+__all__ = [
+    "psum", "pmean", "pmax", "pmin", "all_gather", "reduce_scatter",
+    "ppermute", "axis_index", "axis_size", "all_to_all",
+    "all_reduce_arrays", "broadcast_arrays", "init_process_group", "barrier",
+    "rank", "num_workers",
+]
+
+
+# ---- in-graph collectives (use inside shard_map-ped / pmapped fns) --------
+
+def psum(x, axis_name):
+    import jax
+
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    import jax
+
+    return jax.lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name):
+    import jax
+
+    return jax.lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name):
+    import jax
+
+    return jax.lax.pmin(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    import jax
+
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    import jax
+
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    import jax
+
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    import jax
+
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis,
+                              tiled=tiled)
+
+
+def axis_index(axis_name):
+    import jax
+
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    import jax
+
+    return jax.lax.psum(1, axis_name)
+
+
+# ---- eager cross-device reduce (kvstore('device') backend) ----------------
+
+def all_reduce_arrays(arrays):
+    """Sum a list of same-shaped jax arrays living on different devices and
+    return the sum materialized on each array's device — the eager
+    equivalent of CommDevice::Reduce+Broadcast (comm.h:451-728). XLA runs
+    the adds on-device; transfers ride ICI when available."""
+    import jax
+
+    if not arrays:
+        return []
+    if len(arrays) == 1:
+        return [jax.device_put(arrays[0], list(arrays[0].devices())[0])]
+    total = arrays[0]
+    for a in arrays[1:]:
+        total = total + jax.device_put(a, list(total.devices())[0])
+    return [jax.device_put(total, list(a.devices())[0]) for a in arrays]
+
+
+def broadcast_arrays(src, devices):
+    import jax
+
+    return [jax.device_put(src, d) for d in devices]
+
+
+# ---- multi-host bootstrap (ps-lite scheduler replacement) -----------------
+
+def init_process_group(coordinator_address=None, num_processes=None,
+                       process_id=None):
+    """Multi-host rendezvous via jax.distributed — replaces the DMLC_PS_ROOT
+    scheduler env protocol (SURVEY §3.4). No-op when single-process or when
+    the envs are absent."""
+    import os
+
+    import jax
+
+    if num_processes is None:
+        num_processes = int(os.environ.get("MXNET_TPU_NUM_WORKERS",
+                                           os.environ.get("DMLC_NUM_WORKER", "1")))
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def rank():
+    import jax
+
+    return jax.process_index()
+
+
+def num_workers():
+    import jax
+
+    return jax.process_count()
+
+
+def barrier():
+    """Host-level barrier (reference: KVStore::Barrier kvstore.h:364).
+    Implemented as a tiny all-device reduction that every participant must
+    reach before any can proceed."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .mesh import default_mesh
+
+    mesh = default_mesh()
+    x = jnp.zeros((jax.device_count(),))
+    y = jax.device_put(x, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
+    jax.block_until_ready(jax.jit(lambda v: v.sum())(y))

@@ -1,0 +1,99 @@
+"""Device mesh management — the TPU-native replacement for the reference's
+device-list/ps-topology plumbing (kvstore.cc:40-72 transport selection,
+tools/launch.py rendezvous).
+
+Instead of a list of `mx.gpu(i)` contexts plus a kvstore transport, the unit
+of scale is a `jax.sharding.Mesh` with named axes. Conventional axis names:
+
+    dp — data parallel (batch dimension)
+    fsdp — fully-sharded data parallel (params sharded over the data axis)
+    tp — tensor/model parallel (hidden dimension)
+    pp — pipeline parallel (layer stages)
+    sp — sequence/context parallel (ring attention)
+    ep — expert parallel (MoE)
+
+Collectives ride ICI when the mesh axes follow the physical torus; XLA
+handles DCN hierarchy across pod slices (SURVEY §5.8 TPU-equivalent note).
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+
+import numpy as np
+
+__all__ = [
+    "make_mesh", "default_mesh", "current_mesh", "use_mesh", "local_devices",
+    "DP", "FSDP", "TP", "PP", "SP", "EP",
+]
+
+DP, FSDP, TP, PP, SP, EP = "dp", "fsdp", "tp", "pp", "sp", "ep"
+
+_state = threading.local()
+
+
+def local_devices(platform=None):
+    """Devices addressable by THIS process (host-local, for data placement)."""
+    import jax
+
+    return [d for d in jax.local_devices()
+            if platform is None or d.platform == platform]
+
+
+def make_mesh(axes=None, devices=None):
+    """Create a `jax.sharding.Mesh`.
+
+    `axes` is an ordered dict / list of (name, size) pairs; a size of -1
+    means "whatever is left" (at most one). With no axes, the mesh is 1-D
+    data-parallel over every visible device — the moral equivalent of the
+    reference's default `ctx=[mx.gpu(i) for i in ...]` + kvstore('device').
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = [(DP, n)]
+    if isinstance(axes, dict):
+        axes = list(axes.items())
+    names = [a for a, _ in axes]
+    sizes = [s for _, s in axes]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs "
+                         f"{math.prod(sizes)} devices, have {n}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def default_mesh():
+    """The process-wide default mesh (1-D data parallel over all devices)."""
+    m = getattr(_state, "default", None)
+    if m is None:
+        m = make_mesh()
+        _state.default = m
+    return m
+
+
+def current_mesh():
+    return getattr(_state, "current", None) or default_mesh()
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    """Scope a mesh as the current one (analogous to the reference's
+    Context stack, context.py:87)."""
+    prev = getattr(_state, "current", None)
+    _state.current = mesh
+    try:
+        yield mesh
+    finally:
+        _state.current = prev

@@ -1,0 +1,118 @@
+"""Parameter/data sharding rules.
+
+The reference distributes by *copying*: one parameter NDArray per device
+context (gluon/parameter.py `_init_impl` per-ctx copies) plus kvstore
+reduce/broadcast. The TPU-native model keeps ONE logical array per
+parameter, laid out over the mesh by a `PartitionSpec`; XLA inserts the
+collectives (SURVEY §2.3). This module decides the PartitionSpec for each
+parameter from name/shape rules.
+
+Rule resolution order:
+  1. explicit per-parameter spec (``rules[name]`` exact or regex match)
+  2. tensor-parallel heuristics when the mesh has a ``tp`` axis
+     (Dense/Conv weight matrices sharded on the output or input dim,
+     alternating column-/row-parallel is the caller's job via rules)
+  3. fsdp: shard the largest divisible dim over the ``fsdp`` axis
+  4. replicate
+"""
+from __future__ import annotations
+
+import re
+
+from .mesh import DP, FSDP, TP
+
+__all__ = ["ShardingRules", "named_sharding", "shard_array", "batch_spec",
+           "param_spec", "constraint"]
+
+
+def _P(*parts):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*parts)
+
+
+def named_sharding(mesh, spec):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, spec)
+
+
+class ShardingRules:
+    """Maps parameter name → PartitionSpec over a given mesh.
+
+    ``rules`` — ordered {pattern: spec-template} where pattern is a regex
+    fullmatched against the parameter name and spec-template is a tuple of
+    axis names / None / tuples, or the string "auto".
+    """
+
+    def __init__(self, rules=None, fsdp_min_size=2 ** 10):
+        self.rules = dict(rules or {})
+        self.fsdp_min_size = fsdp_min_size
+
+    def spec_for(self, name, shape, mesh):
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        for pat, spec in self.rules.items():
+            if pat == name or re.fullmatch(pat, name):
+                if spec == "auto":
+                    break
+                return _P(*spec)
+        # -- heuristics --
+        shape = tuple(shape or ())
+        if not shape:
+            return _P()
+        parts = [None] * len(shape)
+        if TP in axis_sizes and axis_sizes[TP] > 1:
+            # column-parallel by default: shard dim 0 (out-features for Dense
+            # [out,in]; out-channels for Conv OIHW-style weights)
+            if shape[0] % axis_sizes[TP] == 0 and shape[0] >= axis_sizes[TP]:
+                parts[0] = TP
+        if FSDP in axis_sizes and axis_sizes[FSDP] > 1:
+            size = 1
+            for s in shape:
+                size *= s
+            if size >= self.fsdp_min_size:
+                # shard the largest not-yet-sharded divisible dim
+                order = sorted(range(len(shape)), key=lambda i: -shape[i])
+                for i in order:
+                    if parts[i] is None and shape[i] % axis_sizes[FSDP] == 0:
+                        parts[i] = FSDP
+                        break
+        while parts and parts[-1] is None:
+            parts.pop()
+        return _P(*parts)
+
+    def sharding_for(self, name, shape, mesh):
+        return named_sharding(mesh, self.spec_for(name, shape, mesh))
+
+
+def param_spec(name, shape, mesh, rules=None):
+    return (rules or ShardingRules()).spec_for(name, shape, mesh)
+
+
+def batch_spec(mesh, ndim=None, axes=(DP, FSDP)):
+    """PartitionSpec for a batch-leading data array: batch dim sharded over
+    the data axes present in the mesh (dp and fsdp both carry batch)."""
+    present = [a for a in axes if a in mesh.axis_names
+               and dict(zip(mesh.axis_names, mesh.devices.shape))[a] > 1]
+    first = tuple(present) if len(present) > 1 else (present[0] if present else None)
+    if ndim is None:
+        return _P(first)
+    return _P(*([first] + [None] * (ndim - 1)))
+
+
+def shard_array(x, mesh, spec):
+    import jax
+
+    return jax.device_put(x, named_sharding(mesh, spec))
+
+
+def constraint(x, spec, mesh=None):
+    """with_sharding_constraint usable inside jit — the in-graph annotation
+    that replaces the reference's group2ctx device placement attrs
+    (graph_executor.cc PlaceDevice)."""
+    import jax
+
+    from .mesh import current_mesh
+
+    mesh = mesh or current_mesh()
+    return jax.lax.with_sharding_constraint(x, named_sharding(mesh, spec))

@@ -1,0 +1,368 @@
+"""DistributedTrainer — the scaled training path.
+
+The reference scales by copying parameters per device and reducing grads
+through a kvstore (gluon/trainer.py:27 + kvstore_dist.h / kvstore_nccl.h).
+The TPU-native model compiles ONE training step over the whole mesh:
+
+  * each parameter is a single logical jax.Array laid out by a
+    PartitionSpec (sharding.ShardingRules);
+  * the batch is sharded over the data axes;
+  * forward + loss + backward + optimizer update are ONE jit-compiled
+    function with donated param/state buffers — XLA inserts the grad
+    all-reduces (psum over dp), the fsdp all-gathers/reduce-scatters and
+    the tp collectives, and they ride ICI;
+  * any registered mxnet_tpu.optimizer.Optimizer works: its `update()` is
+    traced into the step (the fused optimizer ops are pure functions, see
+    ops/optimizer_ops.py), with the update count `t` and scheduled `lr`
+    fed in as device scalars so one executable serves every step.
+
+This subsumes the reference's dist_sync kvstore semantics (synchronous
+data parallelism); dist_async is intentionally not reproduced (SURVEY
+§2.3 divergence note).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from .mesh import current_mesh
+from .sharding import ShardingRules, batch_spec, named_sharding
+
+__all__ = ["DistributedTrainer"]
+
+
+def _tree_map(fn, *trees):
+    """tree_map over optimizer-state pytrees. NDArray is not a registered
+    pytree node, so mark it (and any non-container) as a leaf explicitly."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        fn, *trees,
+        is_leaf=lambda x: x is not None and not isinstance(x, (list, tuple, dict)))
+
+
+class DistributedTrainer:
+    """Compiled sharded training over a mesh.
+
+    Parameters
+    ----------
+    block : gluon.Block — initialized (single context); its parameters are
+        moved onto the mesh and updated functionally. Call `sync_params()`
+        to copy trained values back into the block for save/export.
+    optimizer : str or Optimizer
+    loss : gluon loss Block / callable(pred, label) -> per-sample loss.
+    mesh : jax.sharding.Mesh (default: parallel.current_mesh())
+    rules : ShardingRules for parameter layout (default heuristics).
+    """
+
+    def __init__(self, block, optimizer, optimizer_params=None, loss=None,
+                 mesh=None, rules=None):
+        import jax
+
+        self._block = block
+        self._mesh = mesh or current_mesh()
+        self._rules = rules or ShardingRules()
+        self._loss = loss
+
+        param_items = sorted(block.collect_params().items())
+        if not param_items:
+            raise MXNetError("block has no parameters; initialize() it first")
+        self._param_names = [n for n, _ in param_items]
+        self._params = [p for _, p in param_items]
+        # NDArray views (one per param, on the block's context) — these are
+        # the objects whose buffers get swapped during tracing
+        ctx = self._params[0].list_ctx()[0]
+        self._param_nds = [p.data(ctx) for p in self._params]
+        self._trainable = [i for i, p in enumerate(self._params)
+                           if p.grad_req != "null"]
+        self._aux = [i for i, p in enumerate(self._params) if p.grad_req == "null"]
+
+        optimizer_params = optimizer_params or {}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = {i: self._params[i] for i in self._trainable}
+
+        # -- move parameters onto the mesh ---------------------------------
+        self._shardings = []
+        self._arrays = []
+        for name, p, nd_ in zip(self._param_names, self._params, self._param_nds):
+            sh = self._rules.sharding_for(name, nd_.shape, self._mesh)
+            self._shardings.append(sh)
+            self._arrays.append(jax.device_put(nd_._data, sh))
+
+        # -- optimizer state pytree (sharded like its weight) --------------
+        self._states = []
+        self._state_shardings = []
+        for i in self._trainable:
+            st = self._optimizer.create_state(i, self._param_nds[i])
+            sh = self._shardings[i]
+            self._states.append(_tree_map(
+                lambda s: jax.device_put(s._data, named_sharding(
+                    self._mesh, sh.spec)), st))
+            self._state_shardings.append(_tree_map(lambda s: sh, st))
+
+        self._step_count = 0
+        self._compiled = {}
+        self._fwd_compiled = {}
+
+    # ------------------------------------------------------------------
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def learning_rate(self):
+        return self._host_lr()
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _host_lr(self):
+        o = self._optimizer
+        return float(o.lr_scheduler(max(o.num_update, 1))) if o.lr_scheduler \
+            else o.lr
+
+    # ------------------------------------------------------------------
+    def _trace_forward(self, batch_arrays, param_arrays, key, is_train):
+        """Run the block's eager forward with traced buffers swapped in.
+        Same mechanism as HybridBlock._build_cache (gluon/block.py)."""
+        from .. import autograd, random as _random
+        from ..ndarray import NDArray
+        from ..gluon import block as block_mod
+
+        ctx = self._params[0].list_ctx()[0]
+        prev_key = _random.push_trace_key(key)
+        saved = [(nd_, nd_._data, nd_._version) for nd_ in self._param_nds]
+        block_mod._TRACING.flag = True
+        try:
+            for nd_, arr in zip(self._param_nds, param_arrays):
+                nd_._data = arr
+            call_args = [NDArray(a, ctx=ctx) for a in batch_arrays]
+            with autograd._scope(recording=False, training=is_train):
+                out = self._block(*call_args)
+            aux_updates = {}
+            for i in self._aux:
+                if self._param_nds[i]._data is not param_arrays[i]:
+                    aux_updates[i] = self._param_nds[i]._data
+            return out, aux_updates
+        finally:
+            for nd_, old, ver in saved:
+                nd_._data = old
+                nd_._version = ver
+            block_mod._TRACING.flag = False
+            _random.pop_trace_key(prev_key)
+
+    def _traced_update(self, weights, grads, states, t, lr):
+        """Trace optimizer.update() for every trainable param with the update
+        count and learning rate fed as device scalars (one executable serves
+        all steps — no per-step recompile from Adam's bias correction)."""
+        from ..ndarray import NDArray
+
+        o = self._optimizer
+        ctx = self._params[0].list_ctx()[0]
+        saved = (o._index_update_count.copy(), o.num_update, o.lr,
+                 o.lr_scheduler, o._update_count)
+        try:
+            o._index_update_count = {i: t for i in self._trainable}
+            o._update_count = lambda index: None
+            o.lr_scheduler = None
+            o.lr = lr
+            new_w, new_s = [], []
+            for k, i in enumerate(self._trainable):
+                w = NDArray(weights[k], ctx=ctx)
+                g = NDArray(grads[k], ctx=ctx)
+                s = _tree_map(lambda a: NDArray(a, ctx=ctx), states[k])
+                o.update(i, w, g, s)
+                new_w.append(w._data)
+                new_s.append(_tree_map(lambda nd_: nd_._data, s))
+            return new_w, new_s
+        finally:
+            (o._index_update_count, o.num_update, o.lr, o.lr_scheduler,
+             o._update_count) = saved
+
+    def _build_step(self, batch_shapes, batch_dtypes):
+        import jax
+        import jax.numpy as jnp
+
+        trainable, aux = self._trainable, self._aux
+        loss_blk = self._loss
+
+        def step(key, t, lr, arrays, states, *batch):
+            train_arrays = [arrays[i] for i in trainable]
+            other = list(arrays)
+
+            def loss_fn(train_arrs):
+                full = list(other)
+                for k, i in enumerate(trainable):
+                    full[i] = train_arrs[k]
+                fwd_in = batch[:-1] if loss_blk is not None else batch
+                out, aux_up = self._trace_forward(fwd_in, full, key, True)
+                pred = out[0] if isinstance(out, (list, tuple)) else out
+                if loss_blk is not None:
+                    label_nd = pred.__class__(batch[-1],
+                                              ctx=self._params[0].list_ctx()[0])
+                    l = loss_blk(pred, label_nd)
+                    lval = jnp.mean(l._data)
+                else:
+                    lval = jnp.mean(pred._data)
+                return lval, aux_up
+
+            (loss_val, aux_up), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(train_arrays)
+            new_w, new_s = self._traced_update(train_arrays, list(grads),
+                                               states, t, lr)
+            new_arrays = list(arrays)
+            for k, i in enumerate(trainable):
+                new_arrays[i] = new_w[k]
+            for i in aux:
+                if i in aux_up:
+                    new_arrays[i] = aux_up[i]
+            return loss_val, new_arrays, new_s
+
+        from jax.sharding import PartitionSpec
+
+        data_sh = [named_sharding(self._mesh, batch_spec(self._mesh, len(s)))
+                   for s in batch_shapes]
+        repl = named_sharding(self._mesh, PartitionSpec())
+        out_shardings = (repl, list(self._shardings), list(self._state_shardings))
+        jitted = jax.jit(
+            step,
+            in_shardings=(repl, repl, repl, list(self._shardings),
+                          list(self._state_shardings), *data_sh),
+            out_shardings=out_shardings,
+            donate_argnums=(3, 4),
+        )
+        return jitted
+
+    # ------------------------------------------------------------------
+    def step(self, data, label=None, batch_size=None):
+        """One synchronous sharded training step; returns the (replicated)
+        scalar loss as an NDArray. Reference semantics: trainer.py:298
+        step = allreduce + update, here fused into one executable."""
+        import jax.numpy as jnp
+
+        from .. import random as _random
+        from ..ndarray import NDArray
+
+        if self._loss is not None and label is None:
+            raise MXNetError("this trainer was built with a loss that takes "
+                             "(pred, label); step() needs a label argument")
+        batch = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                 for a in ([data] if label is None else [data, label])]
+        # the step's loss is jnp.mean over the (global) batch, so gradients
+        # are already batch-means — unlike gluon.Trainer.step, which divides
+        # summed grads by batch_size via rescale_grad. Leave rescale at the
+        # optimizer's own value.
+
+        sig = tuple((tuple(b.shape), str(b.dtype)) for b in batch)
+        fn = self._compiled.get(sig)
+        if fn is None:
+            fn = self._build_step([b.shape for b in batch],
+                                  [b.dtype for b in batch])
+            self._compiled[sig] = fn
+
+        # host-side schedule: the real step count advances here; the traced
+        # update consumes it (and the scheduled lr) as device scalars
+        self._step_count += 1
+        o = self._optimizer
+        o.num_update = max(self._step_count + o.begin_num_update, o.num_update)
+        lr = self._host_lr()
+
+        batch = [self._shard_batch(b) for b in batch]
+        key = _random.next_key()
+        t = jnp.asarray(self._step_count, dtype=jnp.float32)
+        loss_val, self._arrays, self._states = fn(
+            key, t, jnp.asarray(lr, dtype=jnp.float32),
+            self._arrays, self._states, *batch)
+        ctx = self._params[0].list_ctx()[0]
+        return NDArray(loss_val, ctx=ctx)
+
+    def _shard_batch(self, arr):
+        import jax
+
+        return jax.device_put(arr, named_sharding(
+            self._mesh, batch_spec(self._mesh, arr.ndim)))
+
+    def forward(self, data, is_train=False):
+        """Compiled sharded inference over the mesh."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import random as _random
+        from ..ndarray import NDArray
+
+        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        sig = (tuple(x.shape), str(x.dtype), is_train)
+        entry = self._fwd_compiled.get(sig)
+        if entry is None:
+            aux_order = []   # aux indices whose updates the trace emits
+                             # (filled at trace time; stable thereafter)
+
+            def fwd(key, arrays, batch):
+                out, aux_up = self._trace_forward((batch,), arrays, key,
+                                                  is_train)
+                pred = out[0] if isinstance(out, (list, tuple)) else out
+                aux_order.clear()
+                aux_order.extend(sorted(aux_up))
+                return pred._data, [aux_up[i] for i in aux_order]
+
+            from jax.sharding import PartitionSpec
+
+            fn = jax.jit(fwd, in_shardings=(
+                named_sharding(self._mesh, PartitionSpec()),
+                list(self._shardings),
+                named_sharding(self._mesh, batch_spec(self._mesh, x.ndim))))
+            entry = (fn, aux_order)
+            self._fwd_compiled[sig] = entry
+        fn, aux_order = entry
+        key = _random.next_key()
+        out, aux_new = fn(key, self._arrays, self._shard_batch(x))
+        # train-mode forward advances BatchNorm running stats (gluon
+        # semantics); write the updates back into the mesh param set
+        for i, arr in zip(aux_order, aux_new):
+            self._arrays[i] = jax.device_put(arr, self._shardings[i])
+        ctx = self._params[0].list_ctx()[0]
+        return NDArray(out, ctx=ctx)
+
+    # ------------------------------------------------------------------
+    def sync_params(self):
+        """Copy trained values back into the block's Parameters (for
+        save_parameters/export — reference checkpoint flow §5.4)."""
+        import jax
+
+        for p, nd_, arr in zip(self._params, self._param_nds, self._arrays):
+            host = np.asarray(jax.device_get(arr))
+            p.set_data(nd_.__class__(host, ctx=p.list_ctx()[0]))
+            nd_._data = p.data(p.list_ctx()[0])._data
+
+    def save_states(self, fname):
+        import pickle
+
+        import jax
+
+        states = _tree_map(lambda a: np.asarray(jax.device_get(a)),
+                           self._states)
+        with open(fname, "wb") as f:
+            pickle.dump({"states": states, "step": self._step_count,
+                         "num_update": self._optimizer.num_update}, f)
+
+    def load_states(self, fname):
+        import pickle
+
+        import jax
+
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._step_count = blob["step"]
+        self._optimizer.num_update = blob["num_update"]
+        loaded = blob["states"]
+        self._states = [
+            _tree_map(lambda a, sh: jax.device_put(a, sh), st, shs)
+            for st, shs in zip(loaded, self._state_shardings)]

@@ -1,0 +1,190 @@
+"""Parallel subsystem tests on the 8-virtual-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8 — SURVEY §4 test-strategy note)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.gluon import nn, loss as gloss
+
+
+def test_make_mesh_shapes():
+    m = par.make_mesh()
+    assert m.devices.size == 8 and m.axis_names == ("dp",)
+    m2 = par.make_mesh([("dp", 2), ("tp", -1)])
+    assert m2.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        par.make_mesh([("dp", 3)])
+
+
+def test_sharding_rules():
+    m = par.make_mesh([("dp", 2), ("tp", 4)])
+    rules = par.ShardingRules()
+    spec = rules.spec_for("dense0_weight", (16, 8), m)
+    assert spec[0] == "tp"
+    # explicit rule wins
+    rules2 = par.ShardingRules({r".*_bias": (None,)})
+    assert tuple(rules2.spec_for("dense0_bias", (16,), m)) == (None,)
+    # scalar replicated
+    assert tuple(rules.spec_for("gamma", (), m)) == ()
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize()
+    return net
+
+
+def test_distributed_trainer_dp_matches_local():
+    np.random.seed(0)
+    x = np.random.randn(16, 20).astype("float32")
+    y = np.random.randint(0, 10, (16,)).astype("float32")
+
+    # local single-device reference run
+    mx.random.seed(42)
+    net_a = _mlp()
+    net_a(mx.nd.array(x))  # materialize deferred shapes
+    mx.random.seed(7)
+    net_b = _mlp()
+    net_b(mx.nd.array(x))
+    # copy A's weights into B so both start identical
+    pa = sorted(net_a.collect_params().items())
+    pb = sorted(net_b.collect_params().items())
+    for (_, a), (_, b) in zip(pa, pb):
+        b.set_data(a.data())
+
+    l2 = gloss.SoftmaxCrossEntropyLoss()
+    trainer_local = mx.gluon.Trainer(net_a.collect_params(), "sgd",
+                                     {"learning_rate": 0.1})
+    from mxnet_tpu import autograd
+    for _ in range(3):
+        with autograd.record():
+            l = l2(net_a(mx.nd.array(x)), mx.nd.array(y))
+        l.backward()
+        trainer_local.step(16)
+
+    mesh = par.make_mesh([("dp", 8)])
+    dt = par.DistributedTrainer(net_b, "sgd", {"learning_rate": 0.1},
+                                loss=l2, mesh=mesh)
+    for _ in range(3):
+        dt.step(x, y)
+    dt.sync_params()
+
+    for (_, a), (_, b) in zip(pa, pb):
+        np.testing.assert_allclose(a.data().asnumpy(), b.data().asnumpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_distributed_trainer_loss_decreases_tp():
+    np.random.seed(1)
+    x = np.random.randn(16, 20).astype("float32")
+    y = np.random.randint(0, 10, (16,)).astype("float32")
+    net = _mlp()
+    net(mx.nd.array(x))
+    mesh = par.make_mesh([("dp", 2), ("tp", 4)])
+    dt = par.DistributedTrainer(net, "adam", {"learning_rate": 0.01},
+                                loss=gloss.SoftmaxCrossEntropyLoss(),
+                                mesh=mesh)
+    losses = [float(dt.step(x, y).asscalar()) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_distributed_trainer_fsdp_runs():
+    np.random.seed(2)
+    x = np.random.randn(8, 16).astype("float32")
+    y = np.random.randint(0, 4, (8,)).astype("float32")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    net(mx.nd.array(x))
+    mesh = par.make_mesh([("fsdp", 8)])
+    dt = par.DistributedTrainer(net, "sgd", {"learning_rate": 0.05,
+                                             "momentum": 0.9},
+                                loss=gloss.SoftmaxCrossEntropyLoss(),
+                                mesh=mesh, rules=par.ShardingRules(fsdp_min_size=8))
+    l0 = float(dt.step(x, y).asscalar())
+    l1 = float(dt.step(x, y).asscalar())
+    assert np.isfinite(l0) and np.isfinite(l1)
+    # fsdp params must actually be sharded
+    sharded = [s for s in dt._shardings if not s.is_fully_replicated]
+    assert sharded
+
+
+def test_batchnorm_aux_state_updates():
+    np.random.seed(3)
+    x = np.random.randn(32, 8).astype("float32")
+    y = np.random.randint(0, 3, (32,)).astype("float32")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16))
+    net.add(nn.BatchNorm())
+    net.add(nn.Dense(3))
+    net.initialize()
+    net(mx.nd.array(x))
+    mesh = par.make_mesh([("dp", 8)])
+    dt = par.DistributedTrainer(net, "sgd", {"learning_rate": 0.1},
+                                loss=gloss.SoftmaxCrossEntropyLoss(), mesh=mesh)
+    aux_i = [i for i, p in enumerate(dt._params) if "running_mean" in
+             dt._param_names[i]]
+    assert aux_i
+    before = np.asarray(dt._arrays[aux_i[0]])
+    dt.step(x, y)
+    after = np.asarray(dt._arrays[aux_i[0]])
+    assert not np.allclose(before, after)
+
+
+def test_collectives_eager_allreduce():
+    import jax
+
+    devs = jax.devices()[:4]
+    arrs = [jax.device_put(np.full((3,), float(i + 1), np.float32), d)
+            for i, d in enumerate(devs)]
+    out = par.all_reduce_arrays(arrs)
+    for i, o in enumerate(out):
+        np.testing.assert_allclose(np.asarray(o), np.full((3,), 10.0))
+        assert list(o.devices())[0] == devs[i]
+
+
+def _ref_attention(q, k, v, causal):
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    if causal:
+        L = q.shape[1]
+        mask = np.tril(np.ones((L, L), bool))
+        s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_exact(causal):
+    np.random.seed(4)
+    B, L, H, D = 2, 32, 2, 8
+    q = np.random.randn(B, L, H, D).astype(np.float32)
+    k = np.random.randn(B, L, H, D).astype(np.float32)
+    v = np.random.randn(B, L, H, D).astype(np.float32)
+    mesh = par.make_mesh([("dp", 2), ("sp", 4)])
+    out = np.asarray(par.ring_attention_sharded(q, k, v, mesh=mesh,
+                                                causal=causal))
+    ref = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_save_load_states(tmp_path):
+    np.random.seed(5)
+    x = np.random.randn(8, 8).astype("float32")
+    y = np.random.randint(0, 2, (8,)).astype("float32")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2))
+    net.initialize()
+    net(mx.nd.array(x))
+    mesh = par.make_mesh([("dp", 8)])
+    dt = par.DistributedTrainer(net, "adam", {"learning_rate": 0.01},
+                                loss=gloss.SoftmaxCrossEntropyLoss(), mesh=mesh)
+    dt.step(x, y)
+    f = str(tmp_path / "states.bin")
+    dt.save_states(f)
+    dt.load_states(f)
+    dt.step(x, y)
