@@ -1,0 +1,72 @@
+"""Benchmark: ResNet-50 training throughput (the reference's headline
+number — docs/faq/perf.md:234, `train_imagenet.py` imgs/sec).
+
+Runs the full compiled training step (fwd + CE loss + bwd + SGD-momentum
+update as ONE donated-buffer XLA executable, via parallel.DistributedTrainer
+on a 1-chip mesh) at batch 32 on synthetic ImageNet-shaped data and prints
+one JSON line. `vs_baseline` is measured imgs/sec over the reference's
+298.51 imgs/sec (ResNet-50 training, bs=32, V100, MXNet 1.2 + cuDNN 7).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import os
+
+BASELINE_IMGS_PER_SEC = 298.51  # reference docs/faq/perf.md:234 (V100, bs=32)
+BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", 32))
+WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", 3))
+ITERS = int(os.environ.get("MXTPU_BENCH_ITERS", 10))
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import DistributedTrainer, make_mesh
+
+    ctx = mx.tpu()  # resolves to the accelerator; falls back to cpu devices
+    with ctx:
+        net = vision.resnet50_v1()
+        net.initialize(ctx=ctx)
+
+        rng = np.random.RandomState(0)
+        # data lives on-device: a real input pipeline double-buffers batches to
+        # HBM; the timed loop must not pay host->device transfer per step
+        x = mx.nd.array(rng.uniform(-1, 1, (BATCH, 3, 224, 224)).astype(np.float32),
+                        ctx=ctx)
+        label = mx.nd.array(rng.randint(0, 1000, (BATCH,)).astype(np.float32),
+                            ctx=ctx)
+        net(x)  # finish deferred init
+
+    mesh = make_mesh([("dp", 1)], devices=jax.devices()[:1])
+    trainer = DistributedTrainer(
+        net, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh)
+
+    for _ in range(WARMUP):
+        loss = trainer.step(x, label)
+    loss.asnumpy()  # drain async dispatch before the timed region
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = trainer.step(x, label)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_bs32_imgs_per_sec",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

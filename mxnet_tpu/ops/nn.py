@@ -167,7 +167,7 @@ def _float_max_pool(kernel, stride, pads):
 
     @jax.custom_vjp
     def mp(x):
-        return lax.reduce_window(x, jnp.asarray(-jnp.inf, x.dtype), lax.max,
+        return lax.reduce_window(x, _np.asarray(-_np.inf, x.dtype), lax.max,
                                  window, strides, padding)
 
     def fwd(x):
@@ -209,14 +209,14 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
     if pool_type == "max":
         if not jnp.issubdtype(data.dtype, jnp.floating):
             init = jnp.iinfo(data.dtype).min
-            return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+            return lax.reduce_window(data, _np.asarray(init, data.dtype), lax.max,
                                      window, strides, padding)
         return _float_max_pool(kernel, stride, tuple(pads))(data)
     if pool_type == "lp":
         powed = jnp.power(jnp.abs(data), p_value)
-        s = lax.reduce_window(powed, jnp.asarray(0, data.dtype), lax.add, window, strides, padding)
+        s = lax.reduce_window(powed, _np.zeros((), data.dtype), lax.add, window, strides, padding)
         return jnp.power(s, 1.0 / p_value)
-    s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add, window, strides, padding)
+    s = lax.reduce_window(data, _np.zeros((), data.dtype), lax.add, window, strides, padding)
     if pool_type == "sum":
         return s
     # avg
@@ -224,7 +224,7 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
         denom = float(_np.prod(kernel))
         return s / jnp.asarray(denom, data.dtype)
     ones = jnp.ones(data.shape, data.dtype)
-    cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add, window, strides, padding)
+    cnt = lax.reduce_window(ones, _np.zeros((), data.dtype), lax.add, window, strides, padding)
     return s / cnt
 
 
@@ -298,7 +298,7 @@ def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
     sq = jnp.square(data)
     half = nsize // 2
     window = (1, nsize, 1, 1)
-    s = lax.reduce_window(sq, jnp.asarray(0, data.dtype), lax.add, window,
+    s = lax.reduce_window(sq, _np.zeros((), data.dtype), lax.add, window,
                           (1, 1, 1, 1), [(0, 0), (half, half), (0, 0), (0, 0)])
     return data / jnp.power(knorm + (alpha / nsize) * s, beta)
 

@@ -100,10 +100,33 @@ def all_reduce_arrays(arrays):
         return []
     if len(arrays) == 1:
         return [jax.device_put(arrays[0], list(arrays[0].devices())[0])]
-    total = arrays[0]
-    for a in arrays[1:]:
-        total = total + jax.device_put(a, list(total.devices())[0])
+    # pairwise tree reduce: log2(n) rounds of concurrent adds instead of a
+    # serial hub chain (the comm.h:451-728 CommDevice analogue)
+    level = list(arrays)
+    while len(level) > 1:
+        nxt = []
+        for i in range(0, len(level) - 1, 2):
+            a, b = level[i], level[i + 1]
+            nxt.append(a + jax.device_put(b, list(a.devices())[0]))
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    total = level[0]
     return [jax.device_put(total, list(a.devices())[0]) for a in arrays]
+
+
+def _barrier_sum(v):
+    # module-level jitted reduction: jax.jit caches by function identity, so
+    # a per-call lambda would retrace + recompile on every barrier()
+    import jax
+
+    global _BARRIER_JIT
+    if _BARRIER_JIT is None:
+        _BARRIER_JIT = jax.jit(lambda v: v.sum())
+    return _BARRIER_JIT(v)
+
+
+_BARRIER_JIT = None
 
 
 def broadcast_arrays(src, devices):
@@ -158,4 +181,4 @@ def barrier():
     mesh = default_mesh()
     x = jnp.zeros((jax.device_count(),))
     y = jax.device_put(x, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
-    jax.block_until_ready(jax.jit(lambda v: v.sum())(y))
+    jax.block_until_ready(_barrier_sum(y))

@@ -97,11 +97,11 @@ class DistributedTrainer:
         self._states = []
         self._state_shardings = []
         for i in self._trainable:
-            st = self._optimizer.create_state(i, self._param_nds[i])
+            st = self._optimizer.create_state_multi_precision(
+                i, self._param_nds[i])
             sh = self._shardings[i]
             self._states.append(_tree_map(
-                lambda s: jax.device_put(s._data, named_sharding(
-                    self._mesh, sh.spec)), st))
+                lambda s: jax.device_put(s._data, sh), st))
             self._state_shardings.append(_tree_map(lambda s: sh, st))
 
         self._step_count = 0
@@ -179,7 +179,7 @@ class DistributedTrainer:
                 w = NDArray(weights[k], ctx=ctx)
                 g = NDArray(grads[k], ctx=ctx)
                 s = _tree_map(lambda a: NDArray(a, ctx=ctx), states[k])
-                o.update(i, w, g, s)
+                o.update_multi_precision(i, w, g, s)
                 new_w.append(w._data)
                 new_s.append(_tree_map(lambda nd_: nd_._data, s))
             return new_w, new_s
@@ -187,7 +187,7 @@ class DistributedTrainer:
             (o._index_update_count, o.num_update, o.lr, o.lr_scheduler,
              o._update_count) = saved
 
-    def _build_step(self, batch_shapes, batch_dtypes):
+    def _build_step(self, batch_shapes):
         import jax
         import jax.numpy as jnp
 
@@ -264,18 +264,18 @@ class DistributedTrainer:
         sig = tuple((tuple(b.shape), str(b.dtype)) for b in batch)
         fn = self._compiled.get(sig)
         if fn is None:
-            fn = self._build_step([b.shape for b in batch],
-                                  [b.dtype for b in batch])
+            fn = self._build_step([b.shape for b in batch])
             self._compiled[sig] = fn
 
-        # host-side schedule: the real step count advances here; the traced
-        # update consumes it (and the scheduled lr) as device scalars
+        batch = [self._shard_batch(b) for b in batch]
+        # host-side schedule: the real step count advances here (only after
+        # the batch sharded successfully, so a failed step doesn't skew the
+        # update schedule); the traced update consumes it (and the scheduled
+        # lr) as device scalars
         self._step_count += 1
         o = self._optimizer
         o.num_update = max(self._step_count + o.begin_num_update, o.num_update)
         lr = self._host_lr()
-
-        batch = [self._shard_batch(b) for b in batch]
         key = _random.next_key()
         t = jnp.asarray(self._step_count, dtype=jnp.float32)
         loss_val, self._arrays, self._states = fn(
