@@ -1,0 +1,297 @@
+"""Executor — a bound, compiled symbolic graph.
+
+Reference: include/mxnet/executor.h + src/executor/graph_executor.cc
+(Bind :1726 / SimpleBind :1694, Forward :65, Backward :78) and the Python
+wrapper python/mxnet/executor.py.
+
+TPU-native design: binding does NOT run a pass pipeline — `forward` jits the
+whole-graph interpreter (one XLA executable per (shape, is_train) signature;
+XLA performs memory planning/fusion/placement, SURVEY §3.5), and `backward`
+jits the jax.vjp of the same interpreted graph (recomputing the forward
+inside the backward executable — XLA's rematerialization model — instead of
+the reference's retained fwd+bwd graph). Aux states (BatchNorm moving
+stats) come back as extra functional outputs and are written into
+`aux_arrays` after the call, mirroring the reference's in-place mutation.
+
+An optional `mesh` shards the leading (batch) dim of data arguments over
+the mesh's data axes — the Module multi-context path (the reference's
+DataParallelExecutorGroup batch slicing, executor_group.py:281) expressed
+as GSPMD sharding.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import current_context
+from .ndarray import NDArray
+from . import ndarray as nd
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, mesh=None, data_arg_names=None):
+        self._symbol = symbol
+        self._ctx = ctx if not isinstance(ctx, (list, tuple)) else ctx[0]
+        self._mesh = mesh
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._data_arg_names = set(data_arg_names or ())
+
+        self.arg_arrays = self._as_list(args, self._arg_names, "args")
+        self.aux_arrays = self._as_list(aux_states or [], self._aux_names,
+                                        "aux_states", allow_missing=True)
+        self.grad_req = self._req_dict(grad_req)
+        self.grad_arrays = self._grad_list(args_grad)
+
+        self.outputs = []
+        self._monitor_callback = None
+        self._fwd_cache = {}
+        self._bwd_cache = {}
+        self._last = None
+
+    # -- construction helpers ---------------------------------------------
+    def _as_list(self, arrays, names, what, allow_missing=False):
+        if isinstance(arrays, dict):
+            missing = [n for n in names if n not in arrays]
+            if missing and not allow_missing:
+                raise MXNetError("%s missing arrays for %s" % (what, missing))
+            return [arrays.get(n) for n in names]
+        arrays = list(arrays)
+        if len(arrays) != len(names):
+            if allow_missing and not arrays:
+                return [None] * len(names)
+            raise MXNetError("%s: expected %d arrays (%s), got %d"
+                             % (what, len(names), names, len(arrays)))
+        return arrays
+
+    def _req_dict(self, grad_req):
+        if isinstance(grad_req, str):
+            return {n: grad_req for n in self._arg_names}
+        if isinstance(grad_req, (list, tuple)):
+            return dict(zip(self._arg_names, grad_req))
+        out = {n: "null" for n in self._arg_names}
+        out.update(grad_req or {})
+        return out
+
+    def _grad_list(self, args_grad):
+        if args_grad is None:
+            return [None] * len(self._arg_names)
+        if isinstance(args_grad, dict):
+            return [args_grad.get(n) for n in self._arg_names]
+        grads = list(args_grad)
+        if len(grads) != len(self._arg_names):
+            raise MXNetError("args_grad length mismatch")
+        return grads
+
+    # -- dict views --------------------------------------------------------
+    @property
+    def arg_dict(self):
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    # -- sharding ----------------------------------------------------------
+    def _shardings(self):
+        from jax.sharding import PartitionSpec
+
+        from .parallel.sharding import batch_spec, named_sharding
+
+        repl = named_sharding(self._mesh, PartitionSpec())
+        arg_sh = []
+        for n, a in zip(self._arg_names, self.arg_arrays):
+            if n in self._data_arg_names and a is not None and a.ndim > 0:
+                arg_sh.append(named_sharding(
+                    self._mesh, batch_spec(self._mesh, a.ndim)))
+            else:
+                arg_sh.append(repl)
+        return repl, arg_sh
+
+    def _place_inputs(self):
+        """device_put data args onto their mesh sharding (no-op when already
+        placed, e.g. when the input pipeline produced sharded batches)."""
+        import jax
+
+        if self._mesh is None:
+            return
+        _, arg_sh = self._shardings()
+        for i, (a, sh) in enumerate(zip(self.arg_arrays, arg_sh)):
+            if a is not None:
+                self.arg_arrays[i]._data = jax.device_put(a._data, sh)
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """reference: executor.py forward / GraphExecutor::Forward."""
+        import jax
+
+        from . import random as _random
+
+        for name, val in kwargs.items():
+            if name not in self._arg_names:
+                raise MXNetError("unknown argument '%s'" % name)
+            i = self._arg_names.index(name)
+            if isinstance(val, NDArray):
+                self.arg_arrays[i] = val
+            else:
+                self.arg_arrays[i] = nd.array(val, ctx=self._ctx)
+        self._place_inputs()
+
+        sig = (tuple(tuple(a.shape) + (str(a.dtype),) for a in self.arg_arrays),
+               bool(is_train))
+        fn = self._fwd_cache.get(sig)
+        if fn is None:
+            fn = self._build_forward(bool(is_train))
+            self._fwd_cache[sig] = fn
+        key = _random.next_key()
+        arg_arrays = tuple(a._data for a in self.arg_arrays)
+        aux_arrays = tuple(a._data for a in self.aux_arrays)
+        outs, new_aux = fn(key, arg_arrays, aux_arrays)
+        for dst, src in zip(self.aux_arrays, new_aux):
+            dst._set_data(src)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        self._last = (sig, key, arg_arrays, aux_arrays)
+        if self._monitor_callback is not None:
+            for name, o in zip(self._output_names, self.outputs):
+                self._monitor_callback(name, o)
+        return self.outputs
+
+    def _build_forward(self, is_train):
+        import jax
+
+        arg_names, aux_names = self._arg_names, self._aux_names
+        symbol = self._symbol
+
+        def run(key, arg_arrays, aux_arrays):
+            values = dict(zip(arg_names, arg_arrays))
+            values.update(zip(aux_names, aux_arrays))
+            outs, aux_up = symbol._interpret(values, is_train=is_train,
+                                             rng_key=key)
+            new_aux = tuple(aux_up.get(n, values[n]) for n in aux_names)
+            return tuple(outs), new_aux
+
+        if self._mesh is None:
+            return jax.jit(run)
+        repl, arg_sh = self._shardings()
+        return jax.jit(run, in_shardings=(repl, tuple(arg_sh),
+                                          tuple(repl for _ in aux_names)))
+
+    def backward(self, out_grads=None, is_train=True):
+        """Gradients via jax.vjp of the graph (reference:
+        GraphExecutor::Backward graph_executor.cc:78; loss-head ops carry
+        their own cotangent-independent custom_vjp, so no out_grads means
+        ones — identical to the reference's head-gradient convention)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._last is None:
+            raise MXNetError("backward called before forward")
+        sig, key, arg_arrays, aux_arrays = self._last
+        wrt = [i for i, n in enumerate(self._arg_names)
+               if self.grad_req.get(n, "null") != "null"]
+        if not wrt:
+            return
+        fn = self._bwd_cache.get(sig)
+        if fn is None:
+            fn = self._build_backward(sig[1], wrt)
+            self._bwd_cache[sig] = fn
+
+        if out_grads is None:
+            cots = tuple(jnp.ones(tuple(o.shape), o.dtype) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                         for g in out_grads)
+        grads = fn(key, arg_arrays, aux_arrays, cots)
+        for k, i in enumerate(wrt):
+            name = self._arg_names[i]
+            req = self.grad_req.get(name, "null")
+            dst = self.grad_arrays[i]
+            if dst is None:
+                dst = NDArray(grads[k], ctx=self._ctx)
+                self.grad_arrays[i] = dst
+            elif req == "add":
+                dst._set_data(dst._data + grads[k])
+            else:
+                dst._set_data(grads[k])
+
+    def _build_backward(self, is_train, wrt):
+        import jax
+
+        arg_names, aux_names = self._arg_names, self._aux_names
+        symbol = self._symbol
+
+        def bwd(key, arg_arrays, aux_arrays, cots):
+            def pure(wrt_arrays):
+                full = list(arg_arrays)
+                for k, i in enumerate(wrt):
+                    full[i] = wrt_arrays[k]
+                values = dict(zip(arg_names, full))
+                values.update(zip(aux_names, aux_arrays))
+                outs, _ = symbol._interpret(values, is_train=is_train,
+                                            rng_key=key)
+                return tuple(outs)
+
+            _, pull = jax.vjp(pure, tuple(arg_arrays[i] for i in wrt))
+            return pull(tuple(cots))[0]
+
+        return jax.jit(bwd)
+
+    # -- misc API parity ---------------------------------------------------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """reference: executor.py copy_params_from."""
+        for name, arr in (arg_params or {}).items():
+            if name in self._arg_names:
+                self.arg_arrays[self._arg_names.index(name)]._set_data(
+                    arr._data if isinstance(arr, NDArray)
+                    else nd.array(arr, ctx=self._ctx)._data)
+            elif not allow_extra_params:
+                raise MXNetError("unknown parameter '%s'" % name)
+        for name, arr in (aux_params or {}).items():
+            if name in self._aux_names:
+                self.aux_arrays[self._aux_names.index(name)]._set_data(
+                    arr._data if isinstance(arr, NDArray)
+                    else nd.array(arr, ctx=self._ctx)._data)
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux state '%s'" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes (executable cache handles the rest —
+        the reference rebuilt memory plans; XLA just compiles per shape)."""
+        new_args = {}
+        for n, a in zip(self._arg_names, self.arg_arrays):
+            if n in kwargs:
+                new_args[n] = nd.zeros(kwargs[n], ctx=self._ctx)
+            else:
+                new_args[n] = a
+        ex = Executor(self._symbol, self._ctx, new_args,
+                      {n: g for n, g in zip(self._arg_names, self.grad_arrays)
+                       if g is not None} or None,
+                      dict(self.grad_req),
+                      list(self.aux_arrays), mesh=self._mesh,
+                      data_arg_names=self._data_arg_names)
+        return ex
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    def debug_str(self):
+        return self._symbol.debug_str()

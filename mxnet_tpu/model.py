@@ -1,0 +1,119 @@
+"""Checkpoint format + legacy FeedForward estimator.
+
+Reference: python/mxnet/model.py — save_checkpoint :394 writes
+`prefix-symbol.json` (graph JSON) + `prefix-%04d.params` (binary NDArray
+dict with arg:/aux: prefixes); load_checkpoint :424; FeedForward :462 is
+the pre-Module estimator API, kept as a thin veneer over Module.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym_mod
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params",
+           "FeedForward", "BatchEndParam"]
+
+from .module.base_module import BatchEndParam  # noqa: E402 (re-export)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """reference: model.py:394."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    nd.save("%s-%04d.params" % (prefix, epoch), save_dict)
+    logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, epoch)
+
+
+def load_params(fname):
+    """Split an arg:/aux: prefixed params file (reference: model.py:424)."""
+    loaded = nd.load(fname)
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """reference: model.py:424 — (symbol, arg_params, aux_params)."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params("%s-%04d.params" % (prefix, epoch))
+    return symbol, arg_params, aux_params
+
+
+class FeedForward(object):
+    """Legacy estimator (reference: model.py:462). Deprecated in the
+    reference in favor of Module; provided as a Module veneer."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .initializer import Uniform
+
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = kwargs
+        self._module = None
+
+    def _mod(self, data, label_names=("softmax_label",)):
+        from .module import Module
+
+        if self._module is None:
+            self._module = Module(self.symbol, context=self.ctx,
+                                  label_names=list(label_names))
+        return self._module
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        mod = self._mod(X)
+        mod.fit(X, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer,
+                optimizer_params=dict(self.kwargs.get("optimizer_params",
+                                                      {"learning_rate": 0.01})),
+                initializer=self.initializer, arg_params=self.arg_params,
+                aux_params=self.aux_params, begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        mod = self._mod(X)
+        if not mod.binded:
+            mod.bind(X.provide_data, X.provide_label, for_training=False)
+            mod.init_params(self.initializer, self.arg_params, self.aux_params,
+                            allow_missing=False)
+        out = mod.predict(X, num_batch=num_batch, reset=reset)
+        return out.asnumpy() if hasattr(out, "asnumpy") else out
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch if epoch is not None else self.num_epoch,
+                        self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)

@@ -1,0 +1,12 @@
+"""mxnet_tpu.module — symbolic training loop (reference: python/mxnet/module).
+
+Module binds a Symbol to contexts; multi-context = mesh sharding (GSPMD)
+instead of per-context executor copies. See module.py docstring.
+"""
+from .base_module import BaseModule, BatchEndParam
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+
+__all__ = ["BaseModule", "BatchEndParam", "Module", "BucketingModule",
+           "SequentialModule"]

@@ -1,0 +1,166 @@
+"""BucketingModule — variable-length (e.g. seq-len) training via per-bucket
+executors.
+
+Reference: python/mxnet/module/bucketing_module.py + the shared-memory
+co-binding machinery (graph_executor shared pool :654, docs/faq/bucketing.md).
+
+TPU-native: each bucket is a Module whose Executor jit-compiles per shape —
+exactly the XLA executable-cache model (SURVEY §5.7: bucketing is how the
+reference handled long sequences; here it is nearly free). Parameters are
+shared across buckets by pointing every bucket's executor at the SAME
+NDArray objects — no copy, no memory-pool gymnastics.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._opt_config = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    @property
+    def data_shapes(self):
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._curr_module.output_shapes
+
+    def _gen_module(self, bucket_key):
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(sym, data_names=data_names, label_names=label_names,
+                      logger=self.logger, context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                    force_rebind=False, shared_module=None, grad_req=grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self._buckets[self._default_bucket_key] = module
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """reference: bucketing_module.py switch_bucket."""
+        assert self.binded
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes, self.for_training,
+                        self.inputs_need_grad, force_rebind=False,
+                        shared_module=self._buckets[self._default_bucket_key],
+                        grad_req=self._buckets[self._default_bucket_key]._grad_req)
+            # share parameter STORAGE with the default bucket: same NDArray
+            # objects, so updates through any bucket are visible to all
+            default = self._buckets[self._default_bucket_key]._exec
+            ex = module._exec
+            for name in module._param_names:
+                if name in default.arg_dict:
+                    ex.arg_arrays[ex._arg_names.index(name)] = \
+                        default.arg_dict[name]
+                    gi = ex._arg_names.index(name)
+                    di = default._arg_names.index(name)
+                    if default.grad_arrays[di] is not None:
+                        ex.grad_arrays[gi] = default.grad_arrays[di]
+            for name in module._aux_names:
+                if name in default.aux_dict:
+                    ex.aux_arrays[ex._aux_names.index(name)] = \
+                        default.aux_dict[name]
+            module.params_initialized = self.params_initialized
+            if self._opt_config is not None:
+                module._optimizer = self._buckets[
+                    self._default_bucket_key]._optimizer
+                module._updater = self._buckets[
+                    self._default_bucket_key]._updater
+                module.optimizer_initialized = True
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        self._buckets[self._default_bucket_key].init_params(
+            initializer, arg_params, aux_params, allow_missing, force_init,
+            allow_extra)
+        self.params_initialized = True
+        for mod in self._buckets.values():
+            mod.params_initialized = True
+
+    def get_params(self):
+        return self._buckets[self._default_bucket_key].get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self._buckets[self._default_bucket_key].init_optimizer(
+            kvstore, optimizer, optimizer_params, force_init)
+        self._opt_config = (kvstore, optimizer, optimizer_params)
+        for key, mod in self._buckets.items():
+            if key != self._default_bucket_key:
+                mod._optimizer = self._buckets[self._default_bucket_key]._optimizer
+                mod._updater = self._buckets[self._default_bucket_key]._updater
+                mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        bucket_key = getattr(data_batch, "bucket_key", self._default_bucket_key)
+        self.switch_bucket(bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)

@@ -1,0 +1,329 @@
+"""Module — bind a Symbol to contexts and train it.
+
+Reference: python/mxnet/module/module.py (Module :40 — bind :364,
+init_params :259, init_optimizer :474, forward :575, backward :629,
+update :646) + executor_group.py DataParallelExecutorGroup :143.
+
+TPU-native mapping: ONE Executor regardless of context count. A multi-
+context list becomes a 1-D `dp` mesh over those devices and the executor's
+data arguments are sharded on the batch dimension (GSPMD replaces the
+reference's per-context executor copies + manual batch slicing + kvstore
+gradient reduce: the gradients arrive already summed because the graph is
+compiled globally over the mesh).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..executor import Executor
+from ..initializer import InitDesc
+from ..io import DataDesc
+from ..ndarray import NDArray
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+def _normalize_shapes(shapes, default_names):
+    """Accept [('name', shape)] / [DataDesc] / [shape]."""
+    out = []
+    for i, s in enumerate(shapes or []):
+        if isinstance(s, DataDesc):
+            out.append((s.name, tuple(s.shape)))
+        elif isinstance(s, (list, tuple)) and len(s) == 2 and isinstance(s[0], str):
+            out.append((s[0], tuple(s[1])))
+        else:
+            name = default_names[i] if i < len(default_names) else "data%d" % i
+            out.append((name, tuple(s)))
+    return out
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        context = context if context is not None else ctx_mod.current_context()
+        if isinstance(context, ctx_mod.Context):
+            context = [context]
+        self._context = list(context)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = set(fixed_param_names or [])
+
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names + self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        self._exec = None
+        self._updater = None
+        self._optimizer = None
+        self._kvstore = None
+        self._mesh = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = "write"
+
+    # -- properties --------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return [DataDesc(n, s) for n, s in self._data_shapes or []]
+
+    @property
+    def label_shapes(self):
+        return [DataDesc(n, s) for n, s in self._label_shapes or []]
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return list(zip(self._output_names,
+                        [tuple(o.shape) for o in self._exec.outputs]))
+
+    # -- bind --------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """reference: module.py:364. Allocates args via simple_bind; multi-
+        context => dp mesh sharding (see module docstring)."""
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+
+        data_shapes = _normalize_shapes(data_shapes, self._data_names)
+        label_shapes = _normalize_shapes(label_shapes, self._label_names) \
+            if label_shapes else []
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+
+        shape_kwargs = dict(data_shapes + label_shapes)
+        # drop label args absent from the graph (predict-time binding)
+        shape_kwargs = {k: v for k, v in shape_kwargs.items()
+                        if k in self._symbol.list_arguments()}
+
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._fixed_param_names:
+                req[n] = "null"
+            elif n in dict(data_shapes):
+                req[n] = grad_req if (for_training and inputs_need_grad) else "null"
+            elif n in dict(label_shapes):
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+
+        mesh = None
+        if len(self._context) > 1:
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh([("dp", len(self._context))],
+                             devices=[c.jax_device() for c in self._context])
+        self._mesh = mesh
+
+        ex = self._symbol.simple_bind(ctx=self._context[0], grad_req=req,
+                                      **shape_kwargs)
+        ex._mesh = mesh
+        ex._data_arg_names = set(dict(data_shapes + label_shapes))
+        if shared_module is not None and shared_module._exec is not None:
+            ex.copy_params_from(shared_module._exec.arg_dict,
+                                shared_module._exec.aux_dict,
+                                allow_extra_params=True)
+        self._exec = ex
+        self.binded = True
+
+    # -- params ------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """reference: module.py:259."""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        # Module.load stashes checkpoint params; use them unless overridden
+        if arg_params is None:
+            arg_params = getattr(self, "_arg_params_cache", None)
+        if aux_params is None:
+            aux_params = getattr(self, "_aux_params_cache", None)
+        from ..initializer import Uniform
+
+        attr_dict = self._symbol.attr_dict()
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                src = arg_params[name]
+                arr._set_data(src._data if isinstance(src, NDArray)
+                              else nd.array(src)._data)
+            elif initializer is not None:
+                desc = InitDesc(name, attrs=attr_dict.get(name, {}))
+                initializer(desc, arr)
+            elif not allow_missing:
+                raise MXNetError("no initializer and no value for '%s'" % name)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                src = aux_params[name]
+                arr._set_data(src._data if isinstance(src, NDArray)
+                              else nd.array(src)._data)
+            elif initializer is not None:
+                desc = InitDesc(name, attrs=attr_dict.get(name, {}))
+                initializer(desc, arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        """reference: module.py get_params — host copies of params."""
+        assert self.binded and self.params_initialized
+        arg_params = {n: self._exec.arg_dict[n].copyto(ctx_mod.cpu())
+                      for n in self._param_names}
+        aux_params = {n: self._exec.aux_dict[n].copyto(ctx_mod.cpu())
+                      for n in self._aux_names}
+        return arg_params, aux_params
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """reference: module.py:474. The kvstore argument is accepted for
+        API parity; gradient aggregation is compiled into the graph (mesh
+        psum), so every kvstore type behaves like the synchronous 'device'
+        kvstore (SURVEY §2.3 divergence: dist_async not reproduced)."""
+        if self.optimizer_initialized and not force_init:
+            return
+        assert self.binded and self.params_initialized
+        if isinstance(optimizer, opt_mod.Optimizer):
+            opt = optimizer
+        else:
+            opt_params = dict(optimizer_params or {})
+            opt_params.setdefault("param_idx2name",
+                                  {i: n for i, n in enumerate(self._param_names)})
+            # reference module.py:474: default rescale_grad = 1/batch_size
+            # (loss-head ops emit sum-over-batch gradients)
+            if self._data_shapes:
+                batch_size = self._data_shapes[0][1][0]
+                opt_params.setdefault("rescale_grad", 1.0 / batch_size)
+            opt = opt_mod.create(optimizer, **opt_params)
+        self._optimizer = opt
+        self._updater = opt_mod.get_updater(opt)
+        self._kvstore = kvstore
+        self.optimizer_initialized = True
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """reference: module.py:575."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        data = data_batch.data if hasattr(data_batch, "data") else data_batch
+        for name_shape, arr in zip(self._data_shapes, data):
+            feeds[name_shape[0]] = arr
+        labels = getattr(data_batch, "label", None) or []
+        for name_shape, arr in zip(self._label_shapes, labels):
+            if name_shape[0] in self._exec._arg_names:
+                feeds[name_shape[0]] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        """reference: module.py:629."""
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply optimizer to each parameter using its gradient (reference:
+        module.py:646; the kvstore push/pull pair collapses into the
+        in-graph gradient sum)."""
+        assert self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            if self._exec.grad_req.get(name, "null") == "null":
+                continue
+            grad = self._exec.grad_dict[name]
+            if grad is None:
+                continue
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n, _ in self._data_shapes]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+    # -- checkpointing -----------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """reference: module.py save_checkpoint → model.py:394 format
+        (prefix-symbol.json + prefix-%04d.params)."""
+        from ..model import save_checkpoint
+
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self.symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded_params = (arg_params, aux_params)
+        mod._arg_params_cache = arg_params
+        mod._aux_params_cache = aux_params
+        return mod
+
+    def load_params(self, fname):
+        from ..model import load_params as _load
+
+        arg_params, aux_params = _load(fname)
+        self.set_params(arg_params, aux_params)
+
+    def save_params(self, fname):
+        arg_params, aux_params = self.get_params()
+        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+        nd.save(fname, save_dict)
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """reference: module.py reshape — on TPU just a re-bind; executable
+        cache keyed on shape does the heavy lifting."""
+        self.bind(data_shapes, label_shapes, for_training=self.for_training,
+                  inputs_need_grad=self.inputs_need_grad, force_rebind=True,
+                  grad_req=self._grad_req)

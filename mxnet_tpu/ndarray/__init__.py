@@ -11,6 +11,20 @@ _register.populate(globals())
 from .ndarray import stack  # noqa: F401
 
 
+def concatenate(arrays, axis=0, always_copy=True):
+    """reference: ndarray.py concatenate (list -> one array along axis)."""
+    # a bare NDArray is iterable row-wise, so list() would silently flatten
+    # it; the reference asserts list-of-NDArray (ndarray.py:3724)
+    if isinstance(arrays, NDArray):
+        raise TypeError("concatenate expects a list of NDArrays, got NDArray")
+    arrays = list(arrays)
+    if not arrays:
+        raise ValueError("concatenate expects a non-empty list")
+    if len(arrays) == 1 and not always_copy:
+        return arrays[0]
+    return concat(*arrays, dim=axis)
+
+
 def zeros_like(data):
     return invoke("zeros_like", (data,), {})
 

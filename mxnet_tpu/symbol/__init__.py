@@ -1,0 +1,31 @@
+"""mxnet_tpu.symbol — declarative graph API (reference: python/mxnet/symbol).
+
+The graph is a Python DAG over the shared op registry; binding compiles the
+whole graph with jax.jit (XLA = the pass pipeline). See symbol.py docstring.
+"""
+from .symbol import (Symbol, var, Variable, Group, load, load_json, pow,
+                     maximum, minimum, ones_like, zeros_like)
+from . import register as _register
+
+_functions = _register.populate(globals())
+
+from ..ndarray import register as _nd_register  # noqa: E402
+
+
+def zeros(shape, dtype=None, **kwargs):
+    from . import _functions
+
+    return _functions["_zeros"](shape=shape, dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype=None, **kwargs):
+    from . import _functions
+
+    return _functions["_ones"](shape=shape, dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, name=None):
+    from . import _functions
+
+    return _functions["_arange"](start=start, stop=stop, step=step,
+                                 repeat=repeat, dtype=dtype, name=name)

@@ -1,0 +1,326 @@
+"""Symbol op-function codegen + arg-shape rules.
+
+Reference mechanism: python/mxnet/symbol/register.py (same codegen as
+ndarray — one function per registered op, composing graph nodes instead of
+executing). Auto-creation of weight/bias variables when omitted matches the
+reference's nnvm composition behavior (sym.Convolution(data=d, ...) creates
+convN_weight/convN_bias vars), driven by the per-op input-slot tables below.
+"""
+from __future__ import annotations
+
+import inspect
+
+from .. import ops as _ops
+from ..base import MXNetError
+from .symbol import Symbol, _Node, _auto_name, var
+
+# op -> ordered array-input slot names; entries after `|` are aux states
+# (BatchNorm moving stats — hidden-output write-back targets).
+_INPUT_SLOTS = {
+    "FullyConnected": (["data", "weight", "bias"], []),
+    "Convolution": (["data", "weight", "bias"], []),
+    "Deconvolution": (["data", "weight", "bias"], []),
+    "BatchNorm": (["data", "gamma", "beta"], ["moving_mean", "moving_var"]),
+    "LayerNorm": (["data", "gamma", "beta"], []),
+    "InstanceNorm": (["data", "gamma", "beta"], []),
+    "Embedding": (["data", "weight"], []),
+    "LeakyReLU": (["data", "gamma"], []),
+    "RNN": (["data", "parameters", "state", "state_cell"], []),
+    "SoftmaxOutput": (["data", "label"], []),
+    "LinearRegressionOutput": (["data", "label"], []),
+    "LogisticRegressionOutput": (["data", "label"], []),
+    "MAERegressionOutput": (["data", "label"], []),
+}
+
+# ops whose optional trailing array inputs are dropped by a flag
+_OPTIONAL_DROP = {
+    "FullyConnected": ("no_bias", ["bias"]),
+    "Convolution": ("no_bias", ["bias"]),
+    "Deconvolution": ("no_bias", ["bias"]),
+}
+
+
+def _slot_names(opname, attrs):
+    entry = _INPUT_SLOTS.get(opname)
+    if entry is None:
+        return None, ()
+    slots, aux = entry
+    drop = _OPTIONAL_DROP.get(opname)
+    if drop is not None:
+        flag, names = drop
+        if attrs.get(flag):
+            slots = [s for s in slots if s not in names]
+    if opname == "LeakyReLU" and attrs.get("act_type", "leaky") != "prelu":
+        slots = ["data"]
+    if opname == "RNN":
+        if str(attrs.get("mode", "lstm")) != "lstm":
+            slots = [s for s in slots if s != "state_cell"]
+    return list(slots), tuple(aux)
+
+
+def _make_symbol_function(opdef):
+    fn = opdef.fn
+    try:
+        sig = inspect.signature(fn)
+        params = list(sig.parameters.values())
+    except (TypeError, ValueError):
+        params = []
+    if opdef.needs_rng and params and params[0].name == "rng":
+        params = params[1:]
+    var_pos = any(p.kind == inspect.Parameter.VAR_POSITIONAL for p in params)
+    pos_names = [p.name for p in params
+                 if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                               inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+
+    def generated(*args, name=None, attr=None, **kwargs):
+        inputs = []          # [(slot_name_or_None, Symbol)]
+        attrs = {}
+        if var_pos:
+            for a in args:
+                if not isinstance(a, Symbol):
+                    raise TypeError("%s: positional args must be Symbol" % opdef.name)
+                inputs.append((None, a))
+            kwargs.pop("num_args", None)
+            for k, v in kwargs.items():
+                if isinstance(v, Symbol):
+                    inputs.append((k, v))
+                else:
+                    attrs[k] = v
+        else:
+            consumed = set()
+            for i, a in enumerate(args):
+                pname = pos_names[i] if i < len(pos_names) else None
+                if isinstance(a, Symbol):
+                    inputs.append((pname, a))
+                    consumed.add(pname)
+                elif pname is not None:
+                    attrs[pname] = a
+                    consumed.add(pname)
+            for pname in pos_names:
+                if pname in consumed or pname not in kwargs:
+                    continue
+                if isinstance(kwargs[pname], Symbol):
+                    inputs.append((pname, kwargs.pop(pname)))
+            attrs.update({k: v for k, v in kwargs.items()
+                          if not isinstance(v, Symbol)})
+        attrs = {k: v for k, v in attrs.items() if v is not None}
+        attrs.pop("is_train", None)
+
+        node_name = name or _auto_name(opdef.name.lstrip("_").lower())
+        slots, aux_names = _slot_names(opdef.name, attrs)
+        if slots is None:
+            # no table entry: inputs are whatever Symbols were passed
+            edges = [s._outputs[0] for _, s in inputs]
+            aux_slots = ()
+            n_hidden = (opdef.num_outputs - opdef.visible_outputs
+                        if opdef.num_outputs > 0 else 0)
+            if n_hidden > 0:
+                aux_slots = tuple(range(len(edges) - n_hidden, len(edges)))
+        else:
+            by_slot = {}
+            unnamed = [s for nm, s in inputs if nm is None]
+            for nm, s in inputs:
+                if nm is not None:
+                    by_slot[nm] = s
+            edges = []
+            full = slots + list(aux_names)
+            for slot in full:
+                if slot in by_slot:
+                    edges.append(by_slot[slot]._outputs[0])
+                elif unnamed:
+                    edges.append(unnamed.pop(0)._outputs[0])
+                else:
+                    # auto-create the variable (reference nnvm behavior)
+                    edges.append(var("%s_%s" % (node_name, slot))._outputs[0])
+            aux_slots = tuple(range(len(slots), len(full)))
+        if attr:
+            attrs = dict(attrs, **attr)
+        node = _Node(opdef.name, node_name, attrs, edges, aux_slots)
+        nvis = opdef.visible_outputs if opdef.num_outputs > 0 else 1
+        return Symbol([(node, i) for i in range(max(1, nvis))])
+
+    generated.__name__ = opdef.name
+    generated.__doc__ = (fn.__doc__ or "") + \
+        "\n\n(symbol function auto-generated from op '%s')" % opdef.name
+    return generated
+
+
+class _OpNamespace(object):
+    pass
+
+
+def populate(target_module_dict):
+    contrib = _OpNamespace()
+    linalg = _OpNamespace()
+    random_ns = _OpNamespace()
+    sparse_ns = _OpNamespace()
+    functions = {}
+    for name in _ops.list_ops():
+        opdef = _ops.get(name)
+        f = _make_symbol_function(opdef)
+        functions[name] = f
+        if name.startswith("_contrib_"):
+            setattr(contrib, name[len("_contrib_"):], f)
+        elif name.startswith("_linalg_"):
+            setattr(linalg, name[len("_linalg_"):], f)
+        elif name.startswith("_random_"):
+            setattr(random_ns, name[len("_random_"):], f)
+        elif name.startswith("_sample_"):
+            setattr(random_ns, name[1:], f)
+        if not name.startswith("_contrib_") and not name.startswith("_linalg_"):
+            target_module_dict.setdefault(name, f)
+    target_module_dict["contrib"] = contrib
+    target_module_dict["linalg"] = linalg
+    target_module_dict["random"] = random_ns
+    target_module_dict["sparse"] = sparse_ns
+    return functions
+
+
+# --------------------------------------------------------------------------
+# arg-shape rules: fill unknown variable shapes from op attrs + data shape
+# (the forward half of the reference's bidirectional InferShape pass,
+# src/executor/infer_graph_attr_pass.cc — enough for simple_bind flows)
+# --------------------------------------------------------------------------
+
+def _prod(xs):
+    r = 1
+    for x in xs:
+        r *= x
+    return r
+
+
+def _fc_rule(attrs, in_shapes):
+    data = in_shapes[0]
+    nh = int(attrs.get("num_hidden", 0))
+    flat = attrs.get("flatten", True)
+    in_dim = _prod(data[1:]) if flat else data[-1]
+    return {"weight": (nh, in_dim), "bias": (nh,)}
+
+
+def _conv_rule(attrs, in_shapes):
+    data = in_shapes[0]
+    nf = int(attrs.get("num_filter", 0))
+    kernel = tuple(attrs.get("kernel", ()))
+    ng = int(attrs.get("num_group", 1))
+    return {"weight": (nf, data[1] // ng) + kernel, "bias": (nf,)}
+
+
+def _deconv_rule(attrs, in_shapes):
+    data = in_shapes[0]
+    nf = int(attrs.get("num_filter", 0))
+    kernel = tuple(attrs.get("kernel", ()))
+    ng = int(attrs.get("num_group", 1))
+    return {"weight": (data[1], nf // ng) + kernel, "bias": (nf,)}
+
+
+def _bn_rule(attrs, in_shapes):
+    ax = int(attrs.get("axis", 1)) % len(in_shapes[0])
+    c = in_shapes[0][ax]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,), "moving_var": (c,)}
+
+
+def _ln_rule(attrs, in_shapes):
+    ax = int(attrs.get("axis", -1)) % len(in_shapes[0])
+    c = in_shapes[0][ax]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _embed_rule(attrs, in_shapes):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+def _prelu_rule(attrs, in_shapes):
+    if attrs.get("act_type") == "prelu":
+        return {"gamma": (in_shapes[0][1],)}
+    return {}
+
+
+def _rnn_rule(attrs, in_shapes):
+    # data [T, N, C]; parameters = flat fused buffer (ops/rnn.py layout)
+    from ..ops.rnn import rnn_param_size
+
+    data = in_shapes[0]
+    sh = int(attrs["state_size"])
+    nl = int(attrs.get("num_layers", 1))
+    bi = bool(attrs.get("bidirectional", False))
+    mode = str(attrs.get("mode", "lstm"))
+    d = 2 if bi else 1
+    n_states = 2 if mode == "lstm" else 1
+    out = {"parameters": (rnn_param_size(nl, data[2], sh, bi, mode),),
+           "state": (nl * d, data[1], sh)}
+    if n_states == 2:
+        out["state_cell"] = (nl * d, data[1], sh)
+    return out
+
+
+_ARG_SHAPE_RULES = {
+    "FullyConnected": _fc_rule,
+    "Convolution": _conv_rule,
+    "Deconvolution": _deconv_rule,
+    "BatchNorm": _bn_rule,
+    "LayerNorm": _ln_rule,
+    "InstanceNorm": _ln_rule,
+    "Embedding": _embed_rule,
+    "LeakyReLU": _prelu_rule,
+    "RNN": _rnn_rule,
+}
+
+
+def infer_var_shapes(sym, known):
+    """Walk the graph forward, filling variable shapes: known data shapes
+    propagate through jax.eval_shape; parameter vars attached to table ops
+    get their shapes from the op's attr rule."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ndarray.ndarray import _takes_is_train
+
+    shapes = dict(known)
+    out_shapes = {}   # id(node) -> tuple of output shapes
+
+    for node in sym._topo():
+        if node.is_var:
+            if node.name not in shapes and node._shape is not None and \
+                    not any(s == 0 for s in node._shape):
+                shapes[node.name] = tuple(node._shape)
+            if node.name in shapes:
+                out_shapes[id(node)] = (shapes[node.name],)
+            continue
+        in_nodes = [src for src, _ in node.inputs]
+        rule = _ARG_SHAPE_RULES.get(node.op)
+        if rule is not None:
+            first_src, first_idx = node.inputs[0]
+            if id(first_src) in out_shapes:
+                data_shape = out_shapes[id(first_src)][first_idx]
+                try:
+                    slot_shapes = rule(node.attrs, [data_shape])
+                except (KeyError, MXNetError):
+                    slot_shapes = {}
+                slots, aux = _slot_names(node.op, node.attrs)
+                full = (slots or []) + list(aux)
+                for slot, (src, _) in zip(full, node.inputs):
+                    if src.is_var and src.name not in shapes and slot in slot_shapes:
+                        shapes[src.name] = tuple(slot_shapes[slot])
+                        out_shapes[id(src)] = (shapes[src.name],)
+        # forward eval if every input known
+        ready = all(id(src) in out_shapes and
+                    len(out_shapes[id(src)]) > idx
+                    for src, idx in node.inputs)
+        if not ready:
+            continue
+        opdef = _ops.get(node.op)
+        attrs = dict(node.attrs)
+        if _takes_is_train(opdef):
+            attrs.setdefault("is_train", True)
+        in_structs = [jax.ShapeDtypeStruct(out_shapes[id(src)][idx], jnp.float32)
+                      for src, idx in node.inputs]
+        if opdef.needs_rng:
+            in_structs = [jax.ShapeDtypeStruct((2,), jnp.uint32)] + in_structs
+
+        try:
+            res = jax.eval_shape(lambda *a: opdef.fn(*a, **attrs), *in_structs)
+        except Exception:
+            continue
+        res = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+        out_shapes[id(node)] = tuple(tuple(r.shape) for r in res)
+    return shapes

@@ -1,0 +1,605 @@
+"""Symbol — the declarative graph-building API.
+
+Reference: python/mxnet/symbol/symbol.py (~3k LoC ctypes wrapper over the
+nnvm graph C API: compose :?, infer_shape, bind/simple_bind, tojson/load).
+
+TPU-native design: the graph is a tiny Python DAG of `_Node`s over the SAME
+op registry the imperative path uses (mxnet_tpu/ops). There is no separate
+symbolic kernel path and no NNVM pass pipeline — binding a Symbol hands the
+whole graph to `jax.jit`, where XLA performs what the reference's
+GraphExecutor::Init did by hand (shape inference, memory planning, fusion,
+placement — graph_executor.cc:321, SURVEY §3.5). Gradients come from
+`jax.vjp` of the interpreted graph instead of the nnvm MXGradient pass
+(src/nnvm/gradient.cc:271).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ops as _ops
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "pow", "maximum", "minimum", "ones_like", "zeros_like"]
+
+_counter = threading.local()
+
+
+def _auto_name(hint):
+    if not hasattr(_counter, "counts"):
+        _counter.counts = {}
+    c = _counter.counts.get(hint, 0)
+    _counter.counts[hint] = c + 1
+    return "%s%d" % (hint, c)
+
+
+class _Node:
+    """One graph node: a variable (op is None) or an op application."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "aux_slots", "_shape", "_dtype")
+
+    def __init__(self, op, name, attrs=None, inputs=None, aux_slots=()):
+        self.op = op                     # op name in the registry, or None
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs or [])  # [(Node, out_index)]
+        self.aux_slots = tuple(aux_slots)  # indices into `inputs` that are aux
+        self._shape = None                # declared shape, for variables
+        self._dtype = None
+
+    @property
+    def is_var(self):
+        return self.op is None
+
+    def num_outputs(self):
+        if self.is_var:
+            return 1
+        return max(1, _ops.get(self.op).num_outputs)
+
+    def visible_outputs(self):
+        if self.is_var:
+            return 1
+        return max(1, _ops.get(self.op).visible_outputs)
+
+
+class Symbol:
+    """A handle on one or more graph outputs (reference: symbol.py Symbol)."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)    # [(Node, out_index)]
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        if len(self._outputs) == 1:
+            return "<Symbol %s>" % self._outputs[0][0].name
+        return "<Symbol group [%s]>" % ", ".join(n.name for n, _ in self._outputs)
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __len__(self):
+        return len(self.list_outputs())
+
+    def __getitem__(self, index):
+        outs = self.list_outputs()
+        if isinstance(index, str):
+            if index not in outs:
+                raise MXNetError("output '%s' not found in %s" % (index, outs))
+            index = outs.index(index)
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        # graph nodes are immutable once composed; sharing them is safe
+        return Symbol(list(self._outputs))
+
+    # -- graph walking -----------------------------------------------------
+    def _topo(self):
+        # DFS post-order visiting inputs left-to-right: variables appear in
+        # the order the graph consumes them (data before weights before the
+        # next layer's weights), matching the reference's nnvm IndexedGraph
+        # argument ordering
+        order, seen = [], set()
+        stack = [(n, False) for n, _ in reversed(self._outputs)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for m, _ in reversed(node.inputs):
+                if id(m) not in seen:
+                    stack.append((m, False))
+        return order
+
+    def list_arguments(self):
+        """Variable names feeding the graph, minus aux states
+        (reference: symbol.py list_arguments)."""
+        aux = set(self._aux_nodes())
+        return [n.name for n in self._topo() if n.is_var and id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_nodes()
+        order = [id(n) for n in self._topo()]
+        return [n.name for n in sorted(
+            {i: n for i, n in aux.items()}.values(),
+            key=lambda n: order.index(id(n)))]
+
+    def _aux_nodes(self):
+        """Vars wired into aux input slots (BatchNorm moving stats...)."""
+        aux = {}
+        for node in self._topo():
+            for slot in node.aux_slots:
+                src, _ = node.inputs[slot]
+                if src.is_var:
+                    aux[id(src)] = src
+        return aux
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._outputs:
+            if node.is_var:
+                names.append(node.name)
+            elif node.visible_outputs() == 1:
+                names.append(node.name + "_output")
+            else:
+                names.append("%s_output%d" % (node.name, idx))
+        return names
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.is_var]
+
+    def get_internals(self):
+        """Every node output as a group (reference: symbol.py get_internals)."""
+        outs = []
+        for node in self._topo():
+            for i in range(node.visible_outputs()):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        kids = []
+        for node, _ in self._outputs:
+            kids.extend(node.inputs)
+        return Symbol(kids) if kids else None
+
+    @property
+    def attrs(self):
+        if len(self._outputs) == 1:
+            return dict(self._outputs[0][0].attrs)
+        return {}
+
+    def attr(self, key):
+        return self._outputs[0][0].attrs.get(key)
+
+    def attr_dict(self):
+        return {n.name: {k: str(v) for k, v in n.attrs.items()}
+                for n in self._topo() if n.attrs}
+
+    # -- composition helpers ----------------------------------------------
+    def _binop(self, other, opname, reverse=False):
+        from . import _functions
+
+        f = _functions[opname]
+        if isinstance(other, Symbol):
+            return f(other, self) if reverse else f(self, other)
+        scalar_ops = {"broadcast_add": "_plus_scalar",
+                      "broadcast_sub": "_rminus_scalar" if reverse else "_minus_scalar",
+                      "broadcast_mul": "_mul_scalar",
+                      "broadcast_div": "_rdiv_scalar" if reverse else "_div_scalar",
+                      "broadcast_power": "_rpower_scalar" if reverse else "_power_scalar",
+                      "broadcast_mod": "_rmod_scalar" if reverse else "_mod_scalar",
+                      "broadcast_greater": "_lesser_scalar" if reverse else "_greater_scalar",
+                      "broadcast_lesser": "_greater_scalar" if reverse else "_lesser_scalar",
+                      "broadcast_greater_equal": "_lesser_equal_scalar" if reverse else "_greater_equal_scalar",
+                      "broadcast_lesser_equal": "_greater_equal_scalar" if reverse else "_lesser_equal_scalar",
+                      "broadcast_equal": "_equal_scalar",
+                      "broadcast_not_equal": "_not_equal_scalar"}
+        sop = scalar_ops.get(opname)
+        if sop is None:
+            raise MXNetError("unsupported scalar operand for %s" % opname)
+        return _functions[sop](self, scalar=float(other))
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_div", reverse=True)
+
+    def __mod__(self, other):
+        return self._binop(other, "broadcast_mod")
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power")
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __eq__(self, other):  # noqa: comparison builds graph, like reference
+        return self._binop(other, "broadcast_equal")
+
+    def __ne__(self, other):
+        return self._binop(other, "broadcast_not_equal")
+
+    def __gt__(self, other):
+        return self._binop(other, "broadcast_greater")
+
+    def __ge__(self, other):
+        return self._binop(other, "broadcast_greater_equal")
+
+    def __lt__(self, other):
+        return self._binop(other, "broadcast_lesser")
+
+    def __le__(self, other):
+        return self._binop(other, "broadcast_lesser_equal")
+
+    def __hash__(self):
+        return id(self)
+
+    def __getattr__(self, name):
+        # sym.reshape(...)-style method calls on single-output symbols
+        from . import _functions
+
+        if name.startswith("_"):
+            raise AttributeError(name)
+        f = _functions.get(name)
+        if f is None:
+            raise AttributeError("Symbol has no attribute/op '%s'" % name)
+
+        def call(*args, **kwargs):
+            return f(self, *args, **kwargs)
+
+        return call
+
+    # -- interpretation ----------------------------------------------------
+    def _interpret(self, values, is_train=False, rng_key=None):
+        """Evaluate the graph on raw jax arrays.
+
+        values: {var_name: array}. Returns (outputs, aux_updates) where
+        aux_updates maps aux var name -> new array (BatchNorm moving stats:
+        the functional form of the reference's in-place aux mutation).
+        """
+        import jax
+
+        computed = {}
+        aux_updates = {}
+        key_iter = [rng_key]
+
+        def next_subkey():
+            if key_iter[0] is None:
+                from .. import random as _random
+
+                key_iter[0] = _random.next_key()
+            key, sub = jax.random.split(key_iter[0])
+            key_iter[0] = key
+            return sub
+
+        for node in self._topo():
+            if node.is_var:
+                if node.name not in values:
+                    raise MXNetError("missing value for variable '%s'" % node.name)
+                computed[id(node)] = (values[node.name],)
+                continue
+            opdef = _ops.get(node.op)
+            in_arrays = tuple(computed[id(src)][idx] for src, idx in node.inputs)
+            attrs = dict(node.attrs)
+            from ..ndarray.ndarray import _takes_is_train
+
+            if _takes_is_train(opdef):
+                attrs.setdefault("is_train", is_train)
+            if opdef.needs_rng:
+                in_arrays = (next_subkey(),) + in_arrays
+            out = opdef.fn(*in_arrays, **attrs)
+            out = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+            computed[id(node)] = out
+            # hidden trailing outputs update the trailing aux inputs
+            n_aux = len(out) - node.visible_outputs()
+            if n_aux > 0:
+                aux_srcs = [node.inputs[s][0] for s in node.aux_slots]
+                for src, new in zip(aux_srcs[-n_aux:], out[-n_aux:]):
+                    if src.is_var:
+                        aux_updates[src.name] = new
+        outputs = [computed[id(node)][idx] for node, idx in self._outputs]
+        return outputs, aux_updates
+
+    # -- evaluation convenience -------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        """Evaluate with NDArray kwargs (reference: symbol.py eval)."""
+        from .. import context as ctx_mod
+        from ..ndarray import NDArray
+
+        ctx = ctx or ctx_mod.current_context()
+        values = {k: (v._data if isinstance(v, NDArray) else v)
+                  for k, v in kwargs.items()}
+        outs, _ = self._interpret(values)
+        return [NDArray(o, ctx=ctx) for o in outs]
+
+    def eval_with(self, values):
+        from ..ndarray import NDArray
+
+        ctx = None
+        raw = {}
+        for k, v in values.items():
+            if isinstance(v, NDArray):
+                ctx = ctx or v.context
+                raw[k] = v._data
+            else:
+                raw[k] = v
+        outs, _ = self._interpret(raw)
+        res = [NDArray(o, ctx=ctx) for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """(arg_shapes, out_shapes, aux_shapes) — reference symbol.py
+        infer_shape. Unknown weight shapes are filled from per-op rules
+        (see register._ARG_SHAPE_RULES), then shapes propagate forward via
+        jax.eval_shape (XLA abstract evaluation replaces the nnvm
+        InferShape pass, src/executor/infer_graph_attr_pass.cc)."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        from .register import infer_var_shapes
+
+        known = {}
+        if args:
+            arg_names = self.list_arguments()
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+
+        shapes = infer_var_shapes(self, known)   # fills weights from op rules
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        missing = [n for n in arg_names + aux_names if n not in shapes]
+        if missing and not partial:
+            raise MXNetError("infer_shape: cannot infer shapes for %s" % missing)
+
+        # forward-propagate to outputs with abstract eval
+        try:
+            structs = {n: jax.ShapeDtypeStruct(shapes[n], jnp.float32)
+                       for n in shapes}
+            out_struct = jax.eval_shape(
+                lambda vals: self._interpret(vals, is_train=True)[0], structs)
+            out_shapes = [tuple(o.shape) for o in out_struct]
+        except Exception:
+            if partial:
+                out_shapes = [None] * len(self._outputs)
+            else:
+                raise
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dtype = _np.float32
+        for a in list(args) + list(kwargs.values()):
+            if a is not None:
+                dtype = a
+                break
+        return ([dtype] * len(arg_names),
+                [dtype] * len(self._outputs),
+                [dtype] * len(self.list_auxiliary_states()))
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate argument/gradient/aux arrays from inferred shapes and
+        bind (reference: graph_executor.cc:1694 SimpleBind)."""
+        from .. import context as ctx_mod
+        from ..executor import Executor
+        from ..ndarray import zeros
+
+        ctx = ctx or ctx_mod.current_context()
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        if any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError("simple_bind: cannot infer shape for %s" % missing)
+
+        shared = {}
+        if shared_exec is not None:
+            shared = dict(zip(shared_exec._arg_names, shared_exec.arg_arrays))
+        if shared_buffer is not None:
+            shared.update(shared_buffer)
+        args = []
+        for n, s in zip(arg_names, arg_shapes):
+            if n in shared and tuple(shared[n].shape) == tuple(s):
+                args.append(shared[n])
+            else:
+                args.append(zeros(s, ctx=ctx))
+                if shared_buffer is not None:
+                    shared_buffer[n] = args[-1]
+        req = grad_req if isinstance(grad_req, (str, dict)) else "write"
+        args_grad = {}
+        for n, s in zip(arg_names, arg_shapes):
+            r = req if isinstance(req, str) else req.get(n, "write")
+            if r != "null":
+                args_grad[n] = zeros(s, ctx=ctx)
+        aux_states = [zeros(s, ctx=ctx) for s in aux_shapes]
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """reference: graph_executor.cc:1726 Bind."""
+        from ..executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    # -- gradient ----------------------------------------------------------
+    def gradient(self, wrt):
+        raise MXNetError("symbolic gradient graphs are not materialized; "
+                         "Executor.backward computes gradients via jax.vjp "
+                         "(TPU-native divergence from nnvm/gradient.cc)")
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self):
+        """nnvm-style JSON (reference: symbol.py tojson; legacy_json_util.cc)."""
+        nodes = self._topo()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        out = {
+            "nodes": [
+                {
+                    "op": n.op or "null",
+                    "name": n.name,
+                    "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
+                              for k, v in n.attrs.items()},
+                    "inputs": [[node_ids[id(src)], idx, 0] for src, idx in n.inputs],
+                    "aux_slots": list(n.aux_slots),
+                }
+                for n in nodes
+            ],
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_var],
+            "heads": [[node_ids[id(node)], idx, 0] for node, idx in self._outputs],
+            "mxnet_tpu_version": 1,
+        }
+        return json.dumps(out, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # debugging
+    def debug_str(self):
+        lines = []
+        for n in self._topo():
+            if n.is_var:
+                lines.append("Variable:%s" % n.name)
+            else:
+                ins = ", ".join("%s[%d]" % (s.name, i) for s, i in n.inputs)
+                lines.append("Op:%s, Name=%s, Inputs=[%s]" % (n.op, n.name, ins))
+        return "\n".join(lines)
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference: symbol.py var/Variable)."""
+    node = _Node(None, name)
+    node._shape = tuple(shape) if shape is not None else None
+    node._dtype = dtype
+    if attr:
+        node.attrs.update(attr)
+    if lr_mult is not None:
+        node.attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        node.attrs["__wd_mult__"] = wd_mult
+    if init is not None:
+        node.attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    node.attrs.update(kwargs)
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for nd_ in data["nodes"]:
+        op = None if nd_["op"] == "null" else nd_["op"]
+        attrs = {}
+        for k, v in nd_.get("attrs", {}).items():
+            try:
+                attrs[k] = json.loads(v)
+            except (json.JSONDecodeError, TypeError):
+                attrs[k] = v
+        node = _Node(op, nd_["name"], attrs,
+                     [(nodes[i], oi) for i, oi, _ in nd_.get("inputs", [])],
+                     tuple(nd_.get("aux_slots", [])))
+        nodes.append(node)
+    return Symbol([(nodes[i], oi) for i, oi, _ in data["heads"]])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# a few free functions the reference exposes at mxnet.symbol level
+def pow(base, exp):
+    return base ** exp
+
+
+def maximum(lhs, rhs):
+    from . import _functions
+
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _functions["broadcast_maximum"](lhs, rhs)
+    s, other = (lhs, rhs) if isinstance(lhs, Symbol) else (rhs, lhs)
+    return _functions["_maximum_scalar"](s, scalar=float(other))
+
+
+def minimum(lhs, rhs):
+    from . import _functions
+
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _functions["broadcast_minimum"](lhs, rhs)
+    s, other = (lhs, rhs) if isinstance(lhs, Symbol) else (rhs, lhs)
+    return _functions["_minimum_scalar"](s, scalar=float(other))
+
+
+def ones_like(data):
+    from . import _functions
+
+    return _functions["ones_like"](data)
+
+
+def zeros_like(data):
+    from . import _functions
+
+    return _functions["zeros_like"](data)

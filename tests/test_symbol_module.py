@@ -1,0 +1,218 @@
+"""Symbol / Executor / Module tests (reference strategy: tests/python/
+unittest/test_symbol.py, test_module.py, tests/python/train/test_mlp.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp_symbol():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+class TestSymbol:
+    def test_compose_and_listing(self):
+        out = _mlp_symbol()
+        assert out.list_arguments() == [
+            "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+            "softmax_label"]
+        assert out.list_outputs() == ["softmax_output"]
+        assert out.list_auxiliary_states() == []
+
+    def test_infer_shape(self):
+        out = _mlp_symbol()
+        arg_shapes, out_shapes, _ = out.infer_shape(data=(16, 8),
+                                                    softmax_label=(16,))
+        shapes = dict(zip(out.list_arguments(), arg_shapes))
+        assert shapes["fc1_weight"] == (32, 8)
+        assert shapes["fc2_weight"] == (4, 32)
+        assert out_shapes == [(16, 4)]
+
+    def test_batchnorm_aux(self):
+        d = mx.sym.var("d")
+        bn = mx.sym.BatchNorm(mx.sym.FullyConnected(d, num_hidden=6, name="f"),
+                              name="bn")
+        assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+        assert "bn_moving_mean" not in bn.list_arguments()
+        arg_shapes, _, aux_shapes = bn.infer_shape(d=(4, 3))
+        assert aux_shapes == [(6,), (6,)]
+
+    def test_json_roundtrip(self):
+        out = _mlp_symbol()
+        out2 = mx.sym.load_json(out.tojson())
+        assert out2.list_arguments() == out.list_arguments()
+        assert out2.list_outputs() == out.list_outputs()
+        x = np.random.RandomState(0).uniform(-1, 1, (4, 8)).astype(np.float32)
+        ex = out.simple_bind(ctx=mx.cpu(), data=(4, 8), softmax_label=(4,))
+        ex2 = out2.simple_bind(ctx=mx.cpu(), data=(4, 8), softmax_label=(4,))
+        ex2.copy_params_from(ex.arg_dict)
+        a = ex.forward(data=x, softmax_label=np.zeros(4, np.float32))
+        b = ex2.forward(data=x, softmax_label=np.zeros(4, np.float32))
+        np.testing.assert_allclose(a[0].asnumpy(), b[0].asnumpy(), rtol=1e-6)
+
+    def test_arithmetic_composition(self):
+        a = mx.sym.var("a")
+        b = mx.sym.var("b")
+        c = (a + b * 2.0) / 2.0 - a
+        ex = c.eval(a=mx.nd.array([2.0]), b=mx.nd.array([4.0]))
+        np.testing.assert_allclose(ex[0].asnumpy(), [3.0])
+
+    def test_get_internals(self):
+        out = _mlp_symbol()
+        internals = out.get_internals()
+        assert "fc1_output" in internals.list_outputs()
+        fc1 = internals["fc1_output"]
+        assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+    def test_grouping(self):
+        a = mx.sym.var("a")
+        s1 = mx.sym.sin(a)
+        s2 = mx.sym.cos(a)
+        g = mx.sym.Group([s1, s2])
+        assert len(g.list_outputs()) == 2
+        outs = g.eval(a=mx.nd.array([0.0]))
+        np.testing.assert_allclose(outs[0].asnumpy(), [0.0], atol=1e-6)
+        np.testing.assert_allclose(outs[1].asnumpy(), [1.0], atol=1e-6)
+
+
+class TestExecutor:
+    def test_forward_backward_grad(self):
+        # d(sum(relu(x*w)))/dx numeric check
+        x = mx.sym.var("x")
+        w = mx.sym.var("w")
+        y = mx.sym.broadcast_mul(x, w)
+        rng = np.random.RandomState(0)
+        xv = rng.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+        wv = rng.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+        ex = y.bind(mx.cpu(), {"x": mx.nd.array(xv), "w": mx.nd.array(wv)},
+                    args_grad={"x": mx.nd.zeros((3, 4)),
+                               "w": mx.nd.zeros((3, 4))})
+        ex.forward(is_train=True)
+        ex.backward(out_grads=mx.nd.ones((3, 4)))
+        np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), wv, rtol=1e-5)
+        np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), xv, rtol=1e-5)
+
+    def test_grad_req_add(self):
+        x = mx.sym.var("x")
+        y = x * 2.0
+        ex = y.bind(mx.cpu(), {"x": mx.nd.ones((2,))},
+                    args_grad={"x": mx.nd.zeros((2,))}, grad_req="add")
+        for _ in range(3):
+            ex.forward(is_train=True)
+            ex.backward(out_grads=mx.nd.ones((2,)))
+        np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [6.0, 6.0])
+
+    def test_softmax_output_implicit_grad(self):
+        data = mx.sym.var("data")
+        out = mx.sym.SoftmaxOutput(data, name="softmax")
+        dv = np.array([[1.0, 2.0, 3.0]], np.float32)
+        lv = np.array([2.0], np.float32)
+        ex = out.bind(mx.cpu(), {"data": mx.nd.array(dv),
+                                 "softmax_label": mx.nd.array(lv)},
+                      args_grad={"data": mx.nd.zeros((1, 3))},
+                      grad_req={"data": "write", "softmax_label": "null"})
+        ex.forward(is_train=True)
+        p = ex.outputs[0].asnumpy()
+        ex.backward()
+        expected = p.copy()
+        expected[0, 2] -= 1.0
+        np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), expected,
+                                   rtol=1e-5)
+
+
+def _make_data(n=512, d=16, classes=2, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-1, 1, (n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, (d,)).astype(np.float32)
+    Y = (X @ w > 0).astype(np.float32)
+    return X, Y
+
+
+class TestModule:
+    def test_fit_convergence(self):
+        X, Y = _make_data()
+        train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True,
+                                  label_name="softmax_label")
+        val = mx.io.NDArrayIter(X, Y, batch_size=64,
+                                label_name="softmax_label")
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.fit(train, num_epoch=8, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5})
+        score = mod.score(val, "acc")
+        assert score[0][1] > 0.93, score
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        X, Y = _make_data()
+        train = mx.io.NDArrayIter(X, Y, batch_size=64,
+                                  label_name="softmax_label")
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.fit(train, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5})
+        prefix = str(tmp_path / "mlp")
+        mod.save_checkpoint(prefix, 2)
+        val = mx.io.NDArrayIter(X, Y, batch_size=64,
+                                label_name="softmax_label")
+        ref = mod.score(val, "acc")[0][1]
+        mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+        mod2.bind(val.provide_data, val.provide_label, for_training=False)
+        mod2.init_params()
+        got = mod2.score(val, "acc")[0][1]
+        assert abs(ref - got) < 1e-6
+
+    def test_multi_context_dp(self):
+        X, Y = _make_data()
+        train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True,
+                                  label_name="softmax_label")
+        val = mx.io.NDArrayIter(X, Y, batch_size=64,
+                                label_name="softmax_label")
+        mod = mx.mod.Module(_mlp_symbol(),
+                            context=[mx.cpu(0), mx.cpu(1)])
+        mod.fit(train, num_epoch=8, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5})
+        score = mod.score(val, "acc")
+        assert score[0][1] > 0.93, score
+
+    def test_predict(self):
+        X, Y = _make_data(n=96)
+        it = mx.io.NDArrayIter(X, Y, batch_size=32,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.bind(it.provide_data, it.provide_label, for_training=False)
+        mod.init_params(mx.initializer.Uniform(0.1))
+        out = mod.predict(it)
+        assert out.shape == (96, 4)
+
+    def test_bucketing_module(self):
+        def sym_gen(seq_len):
+            data = mx.sym.var("data")
+            net = mx.sym.FullyConnected(data, num_hidden=8, name="fc_shared")
+            net = mx.sym.SoftmaxOutput(net, name="softmax")
+            return net, ("data",), ("softmax_label",)
+
+        mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                     context=mx.cpu())
+        mod.bind([("data", (4, 16))], [("softmax_label", (4,))])
+        mod.init_params(mx.initializer.Uniform(0.1))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        from mxnet_tpu.io import DataBatch
+
+        b1 = DataBatch(data=[mx.nd.ones((4, 16))],
+                       label=[mx.nd.zeros((4,))], bucket_key=16,
+                       provide_data=[("data", (4, 16))],
+                       provide_label=[("softmax_label", (4,))])
+        mod.forward(b1, is_train=True)
+        mod.backward()
+        mod.update()
+        out16 = mod.get_outputs()[0].shape
+        # same params, different bucket shape
+        b2 = DataBatch(data=[mx.nd.ones((4, 16)) * 0.5],
+                       label=[mx.nd.zeros((4,))], bucket_key=161,
+                       provide_data=[("data", (4, 16))],
+                       provide_label=[("softmax_label", (4,))])
+        mod.forward(b2, is_train=False)
+        assert out16 == mod.get_outputs()[0].shape
