@@ -1,0 +1,64 @@
+"""Scoped symbol attributes.
+
+TPU-native equivalent of the reference's `python/mxnet/attribute.py`
+(`AttrScope`: a with-scope whose attributes are stamped onto every symbol
+created inside it — used for ctx groups, lr_mult, and the model-parallel
+`group2ctx` annotation path, reference attribute.py:25).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import string_types
+
+__all__ = ["AttrScope", "current"]
+
+_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = [AttrScope()]
+    return _state.stack
+
+
+class AttrScope:
+    """Attribute manager for symbol scoping (reference: attribute.py:25).
+
+    with AttrScope(ctx_group='dev1', lr_mult='0.5'):
+        w = mx.sym.var('w')   # w carries both attributes
+    """
+
+    def __init__(self, **kwargs):
+        for value in kwargs.values():
+            if not isinstance(value, string_types):
+                raise ValueError("attributes must be strings")
+        self._attr = kwargs
+
+    def get(self, attr=None):
+        """Merge scope attributes into `attr` (user-provided wins —
+        reference: attribute.py:49)."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        stack = _stack()
+        merged = dict(stack[-1]._attr)
+        merged.update(self._attr)
+        scope = AttrScope()
+        scope._attr = merged
+        stack.append(scope)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def current():
+    """The innermost active AttrScope."""
+    return _stack()[-1]

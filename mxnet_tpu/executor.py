@@ -158,7 +158,10 @@ class Executor:
         key = _random.next_key()
         arg_arrays = tuple(a._data for a in self.arg_arrays)
         aux_arrays = tuple(a._data for a in self.aux_arrays)
-        outs, new_aux = fn(key, arg_arrays, aux_arrays)
+        from . import profiler as _profiler
+
+        outs, new_aux = _profiler.timed_call(
+            "ExecutorForward", fn, (key, arg_arrays, aux_arrays), cat="symbolic")
         for dst, src in zip(self.aux_arrays, new_aux):
             dst._set_data(src)
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
@@ -215,7 +218,11 @@ class Executor:
                 out_grads = [out_grads]
             cots = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
                          for g in out_grads)
-        grads = fn(key, arg_arrays, aux_arrays, cots)
+        from . import profiler as _profiler
+
+        grads = _profiler.timed_call(
+            "ExecutorBackward", fn, (key, arg_arrays, aux_arrays, cots),
+            cat="symbolic")
         for k, i in enumerate(wrt):
             name = self._arg_names[i]
             req = self.grad_req.get(name, "null")

@@ -1,0 +1,11 @@
+"""Native (C++) runtime components.
+
+The reference's runtime around the compute path is C++ (engine, storage, IO —
+SURVEY §2.1 N1/N2/N13). Here the TPU compute path is XLA, but the host-side
+runtime pieces that remain hot — RecordIO parsing, the threaded prefetching
+data pipeline, pinned host staging buffers — are likewise native C++
+(`mxnet_tpu/lib/native/`), lazily compiled with g++ on first use and loaded
+via ctypes. Everything has a pure-Python fallback so the framework still
+works where no toolchain exists.
+"""
+from . import native  # noqa: F401

@@ -1,0 +1,63 @@
+"""Loader for the native C++ runtime library (libmxtpu).
+
+Compiles `mxnet_tpu/lib/src/*.cc` into a shared object with g++ on first use
+(cached next to the sources; rebuilt when any source is newer) and exposes it
+through ctypes. The reference ships its runtime as a prebuilt libmxnet.so
+behind a C ABI (include/mxnet/c_api.h); here the surface is the small host
+runtime that stays native in a TPU build: RecordIO, the threaded data
+pipeline, and host staging buffers.
+"""
+from __future__ import annotations
+
+import ctypes
+import glob
+import os
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_SO_PATH = os.path.join(os.path.dirname(__file__), "libmxtpu.so")
+
+
+def _build():
+    sources = sorted(glob.glob(os.path.join(_SRC_DIR, "*.cc")))
+    if not sources:
+        return None
+    if os.path.exists(_SO_PATH):
+        so_mtime = os.path.getmtime(_SO_PATH)
+        if all(os.path.getmtime(s) <= so_mtime for s in sources):
+            return _SO_PATH
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", _SO_PATH] + sources
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return None
+    return _SO_PATH
+
+
+def get():
+    """The loaded CDLL, or None if unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is None and not _TRIED:
+            _TRIED = True
+            if os.environ.get("MXTPU_NO_NATIVE"):
+                return None
+            path = _build()
+            if path is not None:
+                try:
+                    _LIB = ctypes.CDLL(path)
+                except OSError:
+                    _LIB = None
+    return _LIB
+
+
+def available():
+    return get() is not None

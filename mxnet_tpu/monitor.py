@@ -1,0 +1,89 @@
+"""Monitor: per-op output statistics during training.
+
+TPU-native equivalent of the reference's `python/mxnet/monitor.py` (class
+Monitor: installs an executor monitor callback, collects a stat per output
+NDArray each batch between `tic()`/`toc()`, prints sorted rows — reference
+monitor.py:34; executor hook graph_executor.cc:1319-1341). Works with
+Executors (`install(exe)` -> `set_monitor_callback`) and with Modules
+(`module.install_monitor(mon)`, which forwards to the bound executors —
+reference: module.py install_monitor).
+"""
+from __future__ import annotations
+
+import re
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """reference: monitor.py:34.
+
+    Parameters
+    ----------
+    interval : batches between collections
+    stat_func : NDArray -> NDArray statistic (default: mean(|x|))
+    pattern : regex on output name
+    sort : sort output rows by name
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return x.abs().mean()
+
+        self.interval = interval
+        self.stat_func = stat_func
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self.exes = []
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        if isinstance(arr, NDArray):
+            self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe):
+        """Attach to an executor, or anything exposing install_monitor
+        (Module) (reference: monitor.py install_to_executor)."""
+        if hasattr(exe, "set_monitor_callback"):
+            exe.set_monitor_callback(self.stat_helper)
+        else:
+            exe.install_monitor(self)
+        self.exes.append(exe)
+
+    install_to_executor = install
+
+    def tic(self):
+        """Start collecting for this batch (reference: monitor.py:87)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish the batch, return [(step, name, stat_str)] (reference:
+        monitor.py:95)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        queue = self.queue
+        if self.sort:
+            queue = sorted(queue, key=lambda q: q[1])
+        for step, name, stat in queue:
+            if isinstance(stat, NDArray):
+                stat = str(stat.asnumpy().reshape(-1)[:10].tolist())
+            res.append((step, name, stat))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """reference: monitor.py:118."""
+        for step, name, stat in self.toc():
+            print("Batch: %7d %30s %s" % (step, name, stat))

@@ -505,7 +505,12 @@ def invoke(op_name, inputs, attrs, out=None):
     rng = _random.next_key() if opdef.needs_rng else None
     call_arrays = (rng,) + in_arrays if opdef.needs_rng else in_arrays
 
-    results = _ops.invoke_jax(op_name, call_arrays, attrs)
+    from .. import profiler as _profiler
+
+    # the ProfileOperator hook (reference: graph_executor.cc:1309 wraps each
+    # pushed op when profiling is enabled)
+    results = _profiler.timed_call(op_name, _ops.invoke_jax,
+                                   (op_name, call_arrays, attrs))
     multi = isinstance(results, (tuple, list))
     results = tuple(results) if multi else (results,)
 

@@ -1,0 +1,305 @@
+"""Profiler: chrome://tracing output + aggregate stats.
+
+TPU-native equivalent of the reference's profiler (src/profiler/profiler.h:87
+emitting chrome-trace JSON; Python front python/mxnet/profiler.py —
+set_config/set_state/dump, scoped Domain/Task/Frame/Event/Counter/Marker;
+the engine wraps every op in a ProfileOperator when profiling is on,
+graph_executor.cc:1309). Here the op hook lives in `ndarray.invoke` /
+`Executor.forward` dispatch; XLA kernel-level traces come from wrapping
+`jax.profiler` (xplane) via `start_xla_trace/stop_xla_trace`.
+
+Op timing semantics: dispatch is async (XLA enqueues); by default the
+recorded duration is dispatch time. Set `profile_sync=True` in set_config
+(or env MXTPU_PROFILE_SYNC=1) to block per op and record true device time —
+the analogue of the reference's engine-side start/end stamps.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .base import MXNetError
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Domain", "Task", "Frame", "Event", "Counter", "Marker",
+           "start_xla_trace", "stop_xla_trace"]
+
+_lock = threading.Lock()
+_events = []            # chrome trace event dicts
+_aggregate = {}         # name -> [count, total_us, min_us, max_us]
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+    "profile_sync": os.environ.get("MXTPU_PROFILE_SYNC", "") not in ("", "0"),
+}
+_state = {"running": False, "paused": False}
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def is_active():
+    return _state["running"] and not _state["paused"]
+
+
+def profile_sync():
+    return _config["profile_sync"]
+
+
+def set_config(**kwargs):
+    """Configure (reference: profiler.py set_config — filename, profile_all,
+    profile_symbolic/imperative/memory/api, aggregate_stats)."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise MXNetError("unknown profiler config keys: %s" % sorted(unknown))
+    _config.update(kwargs)
+
+
+def set_state(state_="stop"):
+    """'run' | 'stop' (reference: profiler.py set_state)."""
+    if state_ not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+    _state["running"] = state_ == "run"
+    _state["paused"] = False
+
+
+def state():
+    return "run" if _state["running"] else "stop"
+
+
+def pause():
+    _state["paused"] = True
+
+
+def resume():
+    _state["paused"] = False
+
+
+def _emit(name, cat, start_us, dur_us, args=None):
+    ev = {"name": name, "cat": cat, "ph": "X", "ts": start_us, "dur": dur_us,
+          "pid": 0, "tid": threading.get_ident() % 10000}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+        if _config["aggregate_stats"]:
+            st = _aggregate.setdefault(name, [0, 0.0, float("inf"), 0.0])
+            st[0] += 1
+            st[1] += dur_us
+            st[2] = min(st[2], dur_us)
+            st[3] = max(st[3], dur_us)
+
+
+def _category_enabled(cat):
+    if _config["profile_all"]:
+        return True
+    if cat == "imperative":
+        return _config["profile_imperative"]
+    if cat == "symbolic":
+        return _config["profile_symbolic"]
+    if cat == "api":
+        return _config["profile_api"]
+    return True
+
+
+def record_op(name, start_us, dur_us, cat="imperative"):
+    """Called from the dispatch layer around each op (the ProfileOperator
+    hook, reference profiler.h:1085). `cat` is the reference's
+    profile_imperative / profile_symbolic config split."""
+    if _category_enabled(cat):
+        _emit(name, cat, start_us, dur_us)
+
+
+def _block_results(results):
+    if isinstance(results, (tuple, list)):
+        for r in results:
+            _block_results(r)
+    elif hasattr(results, "block_until_ready"):
+        results.block_until_ready()
+
+
+def timed_call(name, fn, args, cat="imperative"):
+    """Run fn(*args), recording it as one op event when profiling is active
+    (single shared wrapper for every dispatch site)."""
+    if not is_active():
+        return fn(*args)
+    t0 = _now_us()
+    results = fn(*args)
+    if profile_sync():
+        _block_results(results)
+    record_op(name, t0, _now_us() - t0, cat=cat)
+    return results
+
+
+def record_memory(name, nbytes):
+    if _config["profile_memory"] or _config["profile_all"]:
+        with _lock:
+            _events.append({"name": "memory", "ph": "C", "ts": _now_us(),
+                            "pid": 0, "args": {name: nbytes}})
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the chrome trace file (reference: profiler.py dump ->
+    MXDumpProfile). Open it at chrome://tracing or perfetto.dev."""
+    with _lock:
+        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(data, f)
+    if finished:
+        with _lock:
+            _events.clear()
+
+
+def dumps(reset=False):
+    """Aggregate summary table string (reference: profiler.py dumps ->
+    MXAggregateProfileStatsPrint)."""
+    with _lock:
+        rows = sorted(_aggregate.items(), key=lambda kv: -kv[1][1])
+        out = ["%-40s %10s %14s %14s %14s %14s" %
+               ("Name", "Calls", "Total(ms)", "Avg(ms)", "Min(ms)", "Max(ms)")]
+        for name, (cnt, tot, mn, mx) in rows:
+            out.append("%-40s %10d %14.3f %14.3f %14.3f %14.3f" %
+                       (name, cnt, tot / 1e3, tot / cnt / 1e3, mn / 1e3, mx / 1e3))
+        if reset:
+            _aggregate.clear()
+    return "\n".join(out)
+
+
+# --------------------------------------------------------------------------
+# scoped objects (reference: profiler.py Domain/Task/Frame/Event/Counter/Marker)
+# --------------------------------------------------------------------------
+
+class Domain:
+    """Grouping namespace (reference: profiler.py Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(name, self)
+
+    def new_counter(self, name, value=None):
+        return Counter(name, self, value)
+
+    def new_marker(self, name):
+        return Marker(name, self)
+
+
+class _Scoped:
+    _cat = "task"
+
+    def __init__(self, name, domain=None):
+        self.name = name
+        self.domain = domain
+        self._start = None
+
+    def start(self):
+        self._start = _now_us()
+        return self
+
+    def stop(self):
+        if self._start is None:
+            return
+        if is_active():
+            nm = self.name if self.domain is None else \
+                "%s::%s" % (self.domain.name, self.name)
+            _emit(nm, self._cat, self._start, _now_us() - self._start)
+        self._start = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class Task(_Scoped):
+    _cat = "task"
+
+
+class Frame(_Scoped):
+    _cat = "frame"
+
+
+class Event(_Scoped):
+    _cat = "event"
+
+
+class Counter:
+    """Numeric counter series (reference: profiler.py Counter)."""
+
+    def __init__(self, name, domain=None, value=None):
+        self.name = name
+        self.domain = domain
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        if is_active():
+            with _lock:
+                _events.append({"name": self.name, "ph": "C", "ts": _now_us(),
+                                "pid": 0, "args": {self.name: value}})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    """Instant event (reference: profiler.py Marker)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+        self.domain = domain
+
+    def mark(self, scope="process"):
+        if is_active():
+            with _lock:
+                _events.append({"name": self.name, "ph": "i", "ts": _now_us(),
+                                "pid": 0, "s": {"process": "p", "thread": "t",
+                                                "global": "g"}.get(scope, "p")})
+
+
+# --------------------------------------------------------------------------
+# XLA-level tracing (xplane) — the TPU analogue of nvprof/VTune hooks
+# --------------------------------------------------------------------------
+
+_xla_trace_dir = [None]
+
+
+def start_xla_trace(log_dir="/tmp/mxtpu_xla_trace"):
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    _xla_trace_dir[0] = log_dir
+    return log_dir
+
+
+def stop_xla_trace():
+    import jax
+
+    jax.profiler.stop_trace()
+    d, _xla_trace_dir[0] = _xla_trace_dir[0], None
+    return d

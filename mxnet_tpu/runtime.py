@@ -1,0 +1,91 @@
+"""Runtime feature introspection.
+
+TPU-native equivalent of the reference's `python/mxnet/runtime.py` +
+`src/libinfo.cc` (build-feature flags queryable at runtime: `Features()`,
+`feature_list()`, `is_enabled` — reference runtime.py:28). Features here
+describe the JAX/XLA backend actually present in the process instead of
+compile-time `USE_*` flags.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    feats = {}
+
+    def add(name, fn):
+        try:
+            feats[name] = bool(fn())
+        except Exception:
+            feats[name] = False
+
+    import jax
+
+    platforms = {d.platform for d in jax.devices()}
+    add("TPU", lambda: "tpu" in platforms)
+    add("GPU", lambda: "gpu" in platforms or "cuda" in platforms)
+    add("CPU", lambda: True)
+    add("F16C", lambda: True)          # fp16 compute available through XLA
+    add("BF16", lambda: True)          # native MXU dtype
+    add("INT8", lambda: True)          # int8 dot via XLA (quantization path)
+    add("PALLAS", _pallas_available)
+    add("DIST_KVSTORE", lambda: True)  # collectives-backed kvstore
+    add("OPENCV", _cv_available)       # image decode path
+    add("NATIVE_IO", _native_io_available)  # C++ recordio/pipeline library
+    add("SIGNAL_HANDLER", lambda: True)
+    add("PROFILER", lambda: True)
+    return feats
+
+
+def _pallas_available():
+    from jax.experimental import pallas  # noqa: F401
+
+    return True
+
+
+def _cv_available():
+    try:
+        import cv2  # noqa: F401
+
+        return True
+    except ImportError:
+        from PIL import Image  # noqa: F401
+
+        return True
+
+
+def _native_io_available():
+    from .lib import native
+
+    return native.available()
+
+
+class Features(collections.OrderedDict):
+    """Mapping name -> Feature (reference: runtime.py:45 class Features)."""
+
+    instance = None
+
+    def __init__(self):
+        super().__init__(
+            (name, Feature(name, enabled)) for name, enabled in _detect().items())
+
+    def __repr__(self):
+        return "[%s]" % ", ".join(
+            "%s%s" % ("✔ " if f.enabled else "✖ ", f.name) for f in self.values())
+
+    def is_enabled(self, feature_name):
+        """reference: runtime.py:78 Features.is_enabled."""
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("feature '%s' is unknown" % feature_name)
+        return self[feature_name].enabled
+
+
+def feature_list():
+    """List of Feature tuples (reference: runtime.py:95 feature_list)."""
+    return list(Features().values())

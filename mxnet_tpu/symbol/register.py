@@ -12,7 +12,7 @@ import inspect
 
 from .. import ops as _ops
 from ..base import MXNetError
-from .symbol import Symbol, _Node, _auto_name, var
+from .symbol import Symbol, _Node, var
 
 # op -> ordered array-input slot names; entries after `|` are aux states
 # (BatchNorm moving stats — hidden-output write-back targets).
@@ -106,7 +106,9 @@ def _make_symbol_function(opdef):
         attrs = {k: v for k, v in attrs.items() if v is not None}
         attrs.pop("is_train", None)
 
-        node_name = name or _auto_name(opdef.name.lstrip("_").lower())
+        from .. import name as _name_mod
+
+        node_name = _name_mod.current().get(name, opdef.name.lstrip("_").lower())
         slots, aux_names = _slot_names(opdef.name, attrs)
         if slots is None:
             # no table entry: inputs are whatever Symbols were passed
@@ -133,8 +135,12 @@ def _make_symbol_function(opdef):
                     # auto-create the variable (reference nnvm behavior)
                     edges.append(var("%s_%s" % (node_name, slot))._outputs[0])
             aux_slots = tuple(range(len(slots), len(full)))
+        from .. import attribute as _attribute
+        from .symbol import _wrap_attr_keys
+
+        attr = _attribute.current().get(attr)
         if attr:
-            attrs = dict(attrs, **attr)
+            attrs = dict(attrs, **_wrap_attr_keys(attr))
         node = _Node(opdef.name, node_name, attrs, edges, aux_slots)
         nvis = opdef.visible_outputs if opdef.num_outputs > 0 else 1
         return Symbol([(node, i) for i in range(max(1, nvis))])

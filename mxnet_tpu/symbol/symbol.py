@@ -15,7 +15,6 @@ placement — graph_executor.cc:321, SURVEY §3.5). Gradients come from
 from __future__ import annotations
 
 import json
-import threading
 
 import numpy as _np
 
@@ -24,17 +23,6 @@ from .. import ops as _ops
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
            "pow", "maximum", "minimum", "ones_like", "zeros_like"]
-
-_counter = threading.local()
-
-
-def _auto_name(hint):
-    if not hasattr(_counter, "counts"):
-        _counter.counts = {}
-    c = _counter.counts.get(hint, 0)
-    _counter.counts[hint] = c + 1
-    return "%s%d" % (hint, c)
-
 
 class _Node:
     """One graph node: a variable (op is None) or an op application."""
@@ -188,7 +176,10 @@ class Symbol:
         return {}
 
     def attr(self, key):
-        return self._outputs[0][0].attrs.get(key)
+        attrs = self._outputs[0][0].attrs
+        if key in attrs:
+            return attrs[key]
+        return attrs.get("__%s__" % key.strip("_"))
 
     def attr_dict(self):
         return {n.name: {k: str(v) for k, v in n.attrs.items()}
@@ -316,7 +307,9 @@ class Symbol:
                 continue
             opdef = _ops.get(node.op)
             in_arrays = tuple(computed[id(src)][idx] for src, idx in node.inputs)
-            attrs = dict(node.attrs)
+            # user/scope attributes (`__key__`) are graph metadata, not op params
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not (k.startswith("__") and k.endswith("__"))}
             from ..ndarray.ndarray import _takes_is_train
 
             if _takes_is_train(opdef):
@@ -519,14 +512,27 @@ class Symbol:
         return "\n".join(lines)
 
 
+def _wrap_attr_keys(attr):
+    """User/scope attributes are stored `__key__`-wrapped so they can never
+    collide with op parameters (reference keeps user attrs in the same nnvm
+    dict under the raw key; our op attrs feed jax fns as kwargs, hence the
+    namespacing)."""
+    return {(k if (k.startswith("__") and k.endswith("__")) else "__%s__" % k): v
+            for k, v in attr.items()}
+
+
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         init=None, stype=None, **kwargs):
-    """Create a variable symbol (reference: symbol.py var/Variable)."""
+    """Create a variable symbol (reference: symbol.py var/Variable); merges
+    the active AttrScope's attributes (reference: attribute.py:49)."""
+    from .. import attribute
+
     node = _Node(None, name)
     node._shape = tuple(shape) if shape is not None else None
     node._dtype = dtype
+    attr = attribute.current().get(attr)
     if attr:
-        node.attrs.update(attr)
+        node.attrs.update(_wrap_attr_keys(attr))
     if lr_mult is not None:
         node.attrs["__lr_mult__"] = lr_mult
     if wd_mult is not None:

@@ -1,0 +1,139 @@
+"""Network visualization.
+
+TPU-native equivalent of the reference's `python/mxnet/visualization.py`:
+`print_summary` (layer table with shapes/params, reference
+visualization.py:38) and `plot_network` (graphviz digraph, reference
+visualization.py:204 — gated on graphviz being importable, exactly as the
+reference gates it at call time).
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+from .symbol.symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Print a layer-by-layer summary table (reference: visualization.py:38)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    show_shape = False
+    shape_dict = {}
+    arg_shapes = {}
+    if shape is not None:
+        show_shape = True
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+        in_shapes, _, aux_sh = symbol.infer_shape(**shape)
+        arg_shapes = dict(zip(symbol.list_arguments(), in_shapes))
+        arg_shapes.update(zip(symbol.list_auxiliary_states(), aux_sh))
+        arg_shapes = {k: v for k, v in arg_shapes.items() if k not in shape}
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(f, pos):
+        line = ""
+        for i, field in enumerate(f):
+            line += str(field)
+            line = line[: pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+    total_params = [0]
+
+    def out_shape_of(name):
+        for suffix in ("_output", ""):
+            key = name + suffix
+            if key in shape_dict:
+                return shape_dict[key]
+        return None
+
+    nodes = list(symbol._topo())
+    for node in nodes:
+        if node.is_var:
+            continue
+        name = node.name
+        op = node.op
+        pre = [s.name for s, _ in node.inputs if not s.is_var]
+        cur_param = 0
+        if show_shape:
+            import numpy as np
+
+            for src, _ in node.inputs:
+                if src.is_var and src.name in arg_shapes and arg_shapes[src.name]:
+                    cur_param += int(np.prod(arg_shapes[src.name]))
+        total_params[0] += cur_param
+        out_shape = out_shape_of(name) if show_shape else None
+        first_conn = pre[0] if pre else ""
+        print_row(["%s (%s)" % (name, op), str(out_shape or ""), str(cur_param),
+                   first_conn], positions)
+        for p in pre[1:]:
+            print_row(["", "", "", p], positions)
+        print("_" * line_length)
+    print("Total params: %d" % total_params[0])
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz digraph of the network (reference: visualization.py:204).
+    Requires the `graphviz` package, like the reference."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz python package")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+
+    node_attrs = node_attrs or {}
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+
+    # palette per op family (reference uses the same scheme)
+    def fill(op):
+        if op is None:
+            return "#8dd3c7"
+        if op in ("Convolution", "Deconvolution", "FullyConnected"):
+            return "#fb8072"
+        if op in ("BatchNorm", "LayerNorm"):
+            return "#bebada"
+        if op in ("Activation", "LeakyReLU", "relu", "sigmoid", "tanh"):
+            return "#ffffb3"
+        if op in ("Pooling",):
+            return "#80b1d3"
+        if op in ("Concat", "Flatten", "Reshape"):
+            return "#fdb462"
+        if op in ("Softmax", "SoftmaxOutput", "softmax"):
+            return "#fccde5"
+        return "#b3de69"
+
+    def looks_like_weight(name):
+        return name.endswith(("_weight", "_bias", "_gamma", "_beta",
+                              "_moving_mean", "_moving_var", "_running_mean",
+                              "_running_var"))
+
+    drawn = set()
+    for node in symbol._topo():
+        if node.is_var and hide_weights and looks_like_weight(node.name):
+            continue
+        label = node.name if node.is_var else "%s\n%s" % (node.op, node.name)
+        dot.node(name=node.name, label=label,
+                 **dict(node_attr, fillcolor=fill(node.op)))
+        drawn.add(node.name)
+    for node in symbol._topo():
+        if node.name not in drawn:
+            continue
+        for src, _ in node.inputs:
+            if src.name in drawn:
+                dot.edge(tail_name=src.name, head_name=node.name)
+    return dot
