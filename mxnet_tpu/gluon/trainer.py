@@ -135,6 +135,14 @@ class Trainer:
                             "ignore_stale_grad=True to suppress" % (param.name, data.context))
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
+                if getattr(param, "_grad_stype", "default") == "row_sparse" \
+                        and getattr(self._optimizer, "supports_sparse", False):
+                    # tape grads are dense; cast to row_sparse so the
+                    # optimizer takes the lazy row-update path (reference:
+                    # parameter.py grad_stype + sparse optimizer kernels).
+                    # Optimizers without a sparse kernel stay dense, like the
+                    # reference's storage-fallback wrappers (common/exec_utils.h)
+                    grad = grad.tostype("row_sparse")
                 upd(i, grad, arr)
                 arr._fresh_grad = False
 

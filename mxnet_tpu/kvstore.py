@@ -96,10 +96,17 @@ class KVStore:
         """Initialize key(s) with value(s) (reference: kvstore.py:138)."""
         keys, batched = _key_list(key)
         vals = _group_vals(value, len(keys), batched)
+        from .ndarray.sparse import BaseSparseNDArray
+
         for k, vgroup in zip(keys, vals):
             if k in self._store:
                 continue
-            self._store[k] = vgroup[0].copy()
+            v = vgroup[0]
+            if isinstance(v, BaseSparseNDArray):
+                # store is dense-backed (SURVEY §7.8c): sparse inits densify;
+                # row_sparse_pull gathers rows back out
+                v = v.tostype("default")
+            self._store[k] = v.copy()
 
     def push(self, key, value, priority=0):
         """Aggregate value(s) into the store (reference: kvstore.py:160).
@@ -110,19 +117,32 @@ class KVStore:
         """
         keys, batched = _key_list(key)
         vals = _group_vals(value, len(keys), batched)
+        from .ndarray.sparse import BaseSparseNDArray, add as _sparse_add
+
         for k, vgroup in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("key %r has not been initialized" % (k,))
             merged = vgroup[0]
             for v in vgroup[1:]:
-                merged = merged + v.as_in_context(merged.context)
+                if isinstance(merged, BaseSparseNDArray) or \
+                        isinstance(v, BaseSparseNDArray):
+                    # row_sparse gradient aggregation (reference: CommCPU
+                    # ReduceRowSparse comm.h — union-of-rows merge)
+                    merged = _sparse_add(merged, v)
+                else:
+                    merged = merged + v.as_in_context(merged.context)
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
+                if isinstance(merged, BaseSparseNDArray):
+                    merged = merged.tostype("default")
                 self._store[k] = merged.as_in_context(self._store[k].context)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        """Broadcast stored value(s) into `out` arrays (reference: :240)."""
+        """Broadcast stored value(s) into `out` arrays (reference: :240 —
+        like the reference, sparse outs must use row_sparse_pull)."""
+        from .ndarray.sparse import BaseSparseNDArray
+
         keys, batched = _key_list(key)
         outs = _group_vals(out, len(keys), batched)
         for k, ogroup in zip(keys, outs):
@@ -130,6 +150,12 @@ class KVStore:
                 raise MXNetError("key %r has not been initialized" % (k,))
             src = self._store[k]
             for o in ogroup:
+                if isinstance(o, BaseSparseNDArray):
+                    if ignore_sparse:
+                        continue  # reference: pull skips sparse when asked
+                    raise MXNetError(
+                        "pull into a row_sparse array is not supported; use "
+                        "row_sparse_pull (matches reference kvstore.py:240)")
                 o._set_data(src.as_in_context(o.context)._data)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):

@@ -9,6 +9,8 @@ _register.populate(globals())
 
 # convenience re-exports matching mxnet.nd surface
 from .ndarray import stack  # noqa: F401
+from . import sparse  # noqa: F401
+from .sparse import BaseSparseNDArray, RowSparseNDArray, CSRNDArray  # noqa: F401
 
 
 def concatenate(arrays, axis=0, always_copy=True):

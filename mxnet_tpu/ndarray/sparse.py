@@ -1,0 +1,498 @@
+"""Sparse NDArray storage: row_sparse and csr.
+
+TPU-native equivalent of the reference's sparse storage types
+(include/mxnet/ndarray.h:61-66 kRowSparseStorage/kCSRStorage; Python front
+python/mxnet/ndarray/sparse.py — RowSparseNDArray, CSRNDArray,
+row_sparse_array :?, csr_matrix; kernels src/operator/tensor/cast_storage-inl.h,
+dot-inl.h sparse paths, sparse_retain, square_sum).
+
+TPU-first design: component arrays (data/indices/indptr) are ordinary
+jax.Arrays; every sparse kernel lowers to XLA gather/scatter/segment-sum,
+which the TPU executes natively — there is no CUDA-style hand-written
+scatter kernel to port. Shapes of the components are static per array
+instance, so eager ops compile once per (nnz, shape) signature. Autograd
+stays dense (SURVEY §7.8c): gradients densify on the tape; sparsity is an
+*optimizer/storage/io* optimization (lazy row updates, row_sparse push/pull),
+matching where the reference actually exploits it.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "sparse_retain",
+           "retain", "dot", "square_sum", "add", "zeros", "empty", "array"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base (reference: sparse.py BaseSparseNDArray)."""
+
+    __slots__ = ("_shape",)
+
+    # sparse arrays keep a logical dense shape + component jax arrays in
+    # _data (a dict) — NDArray methods that assume one buffer are overridden
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def size(self):
+        out = 1
+        for s in self._shape:
+            out *= s
+        return int(out)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def wait_to_read(self):
+        for v in self._data.values():
+            v.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data["data"].dtype)
+
+    def asnumpy(self):
+        return _np.asarray(self.todense().asnumpy())
+
+    def astype(self, dtype, copy=True):
+        """Cast the stored values, keeping sparsity (reference: sparse.py
+        BaseSparseNDArray.astype)."""
+        out = type(self).__new__(type(self))
+        NDArray.__init__(out, None, ctx=self._ctx)
+        out._shape = self._shape
+        comps = dict(self._data)
+        comps["data"] = comps["data"].astype(dtype)
+        out._data = comps
+        return out
+
+    def todense(self):
+        return self.tostype("default")
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(str(s) for s in self._shape), self._ctx)
+
+    def __getitem__(self, key):
+        return self.todense()[key]
+
+    def __setitem__(self, key, value):
+        raise MXNetError("sparse NDArray does not support item assignment")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """row_sparse: (indices, values) over the first dimension (reference:
+    sparse.py RowSparseNDArray; storage ndarray.h:64 kRowSparseStorage).
+    `indices` is sorted unique int64 of present rows; `data` is
+    (nnz_rows,) + shape[1:]."""
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return NDArray(self._data["data"], ctx=self._ctx)
+
+    values = data
+
+    @property
+    def indices(self):
+        return NDArray(self._data["indices"], ctx=self._ctx)
+
+    @property
+    def num_rows(self):
+        return int(self._data["indices"].shape[0])
+
+    def tostype(self, stype):
+        import jax.numpy as jnp
+
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self._shape, self._data["data"].dtype)
+            dense = dense.at[self._data["indices"]].set(self._data["data"])
+            return NDArray(dense, ctx=self._ctx)
+        if stype == "csr":
+            return self.todense().tostype("csr")
+        raise MXNetError("unknown stype '%s'" % stype)
+
+    def retain(self, row_ids):
+        return sparse_retain(self, row_ids)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray) and not isinstance(other, BaseSparseNDArray):
+            other._set_data(self.tostype("default")._data)
+            return other
+        return super().copyto(other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """csr: 2-D compressed sparse row (reference: sparse.py CSRNDArray;
+    storage ndarray.h:65 kCSRStorage). Components: data (nnz,),
+    indices (nnz,) column ids, indptr (rows+1,)."""
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return NDArray(self._data["data"], ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._data["indices"], ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._data["indptr"], ctx=self._ctx)
+
+    @property
+    def nnz(self):
+        return int(self._data["data"].shape[0])
+
+    def _row_ids(self):
+        """nnz-length row id per element (host-computed from indptr; static
+        per instance, so downstream XLA segment ops see a constant)."""
+        indptr = _np.asarray(self._data["indptr"])
+        counts = _np.diff(indptr)
+        return _np.repeat(_np.arange(self._shape[0], dtype=_np.int32), counts)
+
+    def tostype(self, stype):
+        import jax.numpy as jnp
+
+        if stype == "csr":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self._shape, self._data["data"].dtype)
+            rows = jnp.asarray(self._row_ids())
+            dense = dense.at[rows, self._data["indices"]].set(self._data["data"])
+            return NDArray(dense, ctx=self._ctx)
+        if stype == "row_sparse":
+            return self.todense().tostype("row_sparse")
+        raise MXNetError("unknown stype '%s'" % stype)
+
+
+# --------------------------------------------------------------------------
+# construction (reference: sparse.py row_sparse_array / csr_matrix)
+# --------------------------------------------------------------------------
+
+def _make_rsp(data, indices, shape, ctx, dtype=None):
+    import jax.numpy as jnp
+
+    out = RowSparseNDArray.__new__(RowSparseNDArray)
+    NDArray.__init__(out, None, ctx=ctx)
+    out._shape = tuple(int(s) for s in shape)
+    # indices are int32 on device: XLA's native index type (the reference
+    # uses int64; jax truncates without x64 mode — values fit, divergence doc'd)
+    out._data = {
+        "data": jnp.asarray(data, dtype=dtype),
+        "indices": jnp.asarray(indices).astype("int32"),
+    }
+    return out
+
+
+def _make_csr(data, indptr, indices, shape, ctx, dtype=None):
+    import jax.numpy as jnp
+
+    out = CSRNDArray.__new__(CSRNDArray)
+    NDArray.__init__(out, None, ctx=ctx)
+    out._shape = tuple(int(s) for s in shape)
+    out._data = {
+        "data": jnp.asarray(data, dtype=dtype),
+        "indices": jnp.asarray(_np.asarray(indices, dtype="int64"), dtype="int32"),
+        "indptr": jnp.asarray(_np.asarray(indptr, dtype="int64"), dtype="int32"),
+    }
+    return out
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray (reference: sparse.py row_sparse_array).
+    Accepts (data, indices) tuple, a dense source, or another sparse array."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not isinstance(arg1[0], int):
+        data, indices = arg1
+        data = _np.asarray(getattr(data, "asnumpy", lambda: data)()
+                           if isinstance(data, NDArray) else data,
+                           dtype=dtype or "float32")
+        indices = _np.asarray(getattr(indices, "asnumpy", lambda: indices)()
+                              if isinstance(indices, NDArray) else indices,
+                              dtype="int64")
+        order = _np.argsort(indices)
+        if shape is None:
+            top = int(indices.max()) + 1 if indices.size else 0
+            shape = (top,) + data.shape[1:]
+        return _make_rsp(data[order], indices[order], shape, ctx,
+                         dtype=dtype or data.dtype)
+    # dense-like source
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (reference: sparse.py csr_matrix). Accepts
+    (data, indices, indptr) — scipy argument order — or a dense source."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        to_np = lambda a, dt: _np.asarray(
+            a.asnumpy() if isinstance(a, NDArray) else a, dtype=dt)
+        data = to_np(data, dtype or "float32")
+        indices = to_np(indices, "int64")
+        indptr = to_np(indptr, "int64")
+        if shape is None:
+            shape = (len(indptr) - 1, int(indices.max()) + 1 if indices.size else 0)
+        return _make_csr(data, indptr, indices, shape, ctx, dtype=dtype or data.dtype)
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-aware nd.sparse.array (reference: sparse.py array)."""
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array
+    try:
+        import scipy.sparse as sps
+
+        if sps.issparse(source_array):
+            csr = source_array.tocsr()
+            return csr_matrix((csr.data, csr.indices, csr.indptr),
+                              shape=csr.shape, ctx=ctx, dtype=dtype)
+    except ImportError:
+        pass
+    return _dense_array(source_array, ctx=ctx, dtype=dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    """All-zero sparse array (reference: sparse.py zeros)."""
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if stype == "row_sparse":
+        return _make_rsp(_np.zeros((0,) + shape[1:], dtype=dtype),
+                         _np.zeros((0,), dtype="int64"), shape, ctx, dtype=dtype)
+    if stype == "csr":
+        return _make_csr(_np.zeros((0,), dtype=dtype),
+                         _np.zeros((shape[0] + 1,), dtype="int64"),
+                         _np.zeros((0,), dtype="int64"), shape, ctx, dtype=dtype)
+    if stype == "default":
+        from . import ndarray as _nd_mod
+
+        return _nd_mod.zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError("unknown stype '%s'" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype="float32"):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+# --------------------------------------------------------------------------
+# kernels (reference: src/operator/tensor/cast_storage-inl.h, sparse_retain,
+# dot-inl.h, square_sum-inl.h — all as XLA gather/scatter/segment ops here)
+# --------------------------------------------------------------------------
+
+def cast_storage(arr, stype):
+    """Convert between storage types (reference: cast_storage op,
+    src/operator/tensor/cast_storage.cc)."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    if stype == "row_sparse":
+        import jax.numpy as jnp
+
+        if arr.ndim < 1:
+            raise MXNetError("row_sparse needs ndim >= 1")
+        # device-side row scan: only the (small) index vector syncs to host;
+        # the dense payload never round-trips (unlike a numpy formulation —
+        # this runs every trainer.step for sparse-grad params)
+        data_j = arr._data
+        mask = jnp.any(data_j.reshape(data_j.shape[0], -1) != 0, axis=1)
+        nz_rows = jnp.nonzero(mask)[0]
+        return _make_rsp(data_j[nz_rows], nz_rows, arr.shape,
+                         arr.context, dtype=data_j.dtype)
+    if stype == "csr":
+        np_arr = arr.asnumpy()
+        if np_arr.ndim != 2:
+            raise MXNetError("csr storage requires a 2-D array")
+        rows, cols = _np.nonzero(np_arr)
+        indptr = _np.zeros(np_arr.shape[0] + 1, dtype="int64")
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr)
+        return _make_csr(np_arr[rows, cols], indptr, cols.astype("int64"),
+                         np_arr.shape, arr.context, dtype=np_arr.dtype)
+    raise MXNetError("unknown stype '%s'" % stype)
+
+
+def sparse_retain(arr, indices):
+    """Keep only the requested rows (reference: sparse_retain op,
+    src/operator/tensor/sparse_retain.cc)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("sparse_retain expects a RowSparseNDArray")
+    want = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                       else indices, dtype="int64")
+    have = _np.asarray(arr._data["indices"])
+    mask = _np.isin(have, want)
+    keep = _np.where(mask)[0]
+    data = _np.asarray(arr._data["data"])[keep]
+    return _make_rsp(data, have[keep], arr.shape, arr.context, dtype=data.dtype)
+
+
+retain = sparse_retain
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: src/operator/tensor/dot-inl.h —
+    csr*dense and csr.T*dense paths; row_sparse via densify). Lowers to
+    XLA segment_sum / scatter-add, the TPU-native SpMM formulation."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(lhs, CSRNDArray):
+        if transpose_b:
+            raise MXNetError("dot(csr, dense, transpose_b=True) unsupported "
+                             "(matches reference)")
+        dense = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+        rows = jnp.asarray(lhs._row_ids())
+        cols = lhs._data["indices"]
+        vals = lhs._data["data"]
+        if not transpose_a:
+            # out[m, n] = sum_k csr[m, k] * dense[k, n]
+            prods = vals[:, None] * dense._data[cols]
+            out = jax.ops.segment_sum(prods, rows,
+                                      num_segments=lhs.shape[0])
+            return NDArray(out, ctx=dense.context)
+        # out[k, n] = sum_m csr[m, k] * dense[m, n]
+        prods = vals[:, None] * dense._data[rows]
+        out = jnp.zeros((lhs.shape[1], dense.shape[1]), prods.dtype)
+        out = out.at[cols].add(prods)
+        return NDArray(out, ctx=dense.context)
+    if isinstance(lhs, RowSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        l = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+        r = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+        return l.dot(r, transpose_a=transpose_a, transpose_b=transpose_b)
+    return lhs.dot(rhs, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def square_sum(arr, axis=None, keepdims=False):
+    """sum(x^2) touching only stored values (reference: _square_sum op,
+    src/operator/tensor/square_sum-inl.h)."""
+    import jax.numpy as jnp
+
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("square_sum expects a RowSparseNDArray")
+    vals = arr._data["data"]
+    if axis is None:
+        return NDArray(jnp.sum(vals * vals), ctx=arr.context)
+    if axis in (1, -1) and arr.ndim == 2:
+        # per-row sums live only at stored rows -> row_sparse result
+        rows_sq = jnp.sum(vals * vals, axis=1, keepdims=keepdims)
+        dense = jnp.zeros((arr.shape[0],) + ((1,) if keepdims else ()),
+                          rows_sq.dtype)
+        dense = dense.at[arr._data["indices"]].set(rows_sq)
+        return NDArray(dense, ctx=arr.context)
+    return NDArray(jnp.sum(jnp.square(arr.todense()._data), axis=axis,
+                           keepdims=keepdims), ctx=arr.context)
+
+
+def add(lhs, rhs):
+    """rsp + rsp -> rsp (union of rows; reference: elemwise_add sparse path)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("shape mismatch in sparse add")
+        li = _np.asarray(lhs._data["indices"])
+        ri = _np.asarray(rhs._data["indices"])
+        union = _np.union1d(li, ri)
+        data = _np.zeros((len(union),) + lhs.shape[1:],
+                         _np.asarray(lhs._data["data"]).dtype)
+        data[_np.searchsorted(union, li)] += _np.asarray(lhs._data["data"])
+        data[_np.searchsorted(union, ri)] += _np.asarray(rhs._data["data"])
+        return _make_rsp(data, union, lhs.shape, lhs.context, dtype=data.dtype)
+    l = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    return l + r
+
+
+# --------------------------------------------------------------------------
+# lazy (row-wise) optimizer updates — the reason row_sparse exists
+# (reference: src/operator/optimizer_op.cc sparse sgd/adam/adagrad kernels:
+# only rows present in the gradient are touched)
+# --------------------------------------------------------------------------
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Lazy SGD: touch only grad.indices rows (reference:
+    SGDUpdateRspImpl optimizer_op.cc)."""
+    import jax.numpy as jnp
+
+    rows = grad._data["indices"]
+    g = grad._data["data"] * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w_rows = weight._data[rows]
+    g = g + wd * w_rows
+    weight._set_data(weight._data.at[rows].add(-lr * g))
+    return weight
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    import jax.numpy as jnp
+
+    rows = grad._data["indices"]
+    g = grad._data["data"] * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight._data[rows]
+    new_mom_rows = momentum * mom._data[rows] - lr * g
+    mom._set_data(mom._data.at[rows].set(new_mom_rows))
+    weight._set_data(weight._data.at[rows].add(new_mom_rows))
+    return weight
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Lazy Adam (reference: AdamUpdateRspImpl optimizer_op.cc; matches the
+    reference's lazy_update semantics — moments of untouched rows stale)."""
+    import jax.numpy as jnp
+
+    rows = grad._data["indices"]
+    g = grad._data["data"] * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight._data[rows]
+    m_rows = beta1 * mean._data[rows] + (1 - beta1) * g
+    v_rows = beta2 * var._data[rows] + (1 - beta2) * g * g
+    mean._set_data(mean._data.at[rows].set(m_rows))
+    var._set_data(var._data.at[rows].set(v_rows))
+    weight._set_data(weight._data.at[rows].add(
+        -lr * m_rows / (jnp.sqrt(v_rows) + epsilon)))
+    return weight
+
+
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    import jax.numpy as jnp
+
+    rows = grad._data["indices"]
+    g = grad._data["data"] * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight._data[rows]
+    h_rows = history._data[rows] + g * g
+    history._set_data(history._data.at[rows].set(h_rows))
+    weight._set_data(weight._data.at[rows].add(
+        -lr * g / (jnp.sqrt(h_rows) + epsilon)))
+    return weight

@@ -75,6 +75,10 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    #: set True on optimizers with a lazy row_sparse update kernel
+    #: (reference: sgd/adam/adagrad Rsp impls in src/operator/optimizer_op.cc)
+    supports_sparse = False
+
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == _np.float16:
             s, w32 = state
@@ -141,6 +145,8 @@ class Optimizer:
 class SGD(Optimizer):
     """SGD(+momentum, multi-precision) — reference optimizer.py:511."""
 
+    supports_sparse = True
+
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -153,11 +159,28 @@ class SGD(Optimizer):
 
     def update(self, index, weight, grad, state):
         kw = self._common(index)
+        if _is_row_sparse(grad):
+            # lazy row update (reference: SGDUpdateRspImpl optimizer_op.cc;
+            # lazy_update=True semantics — untouched rows skip wd/momentum)
+            from .ndarray import sparse as _sp
+
+            if state is None:
+                _sp.sgd_update(weight, grad, **kw)
+            else:
+                _sp.sgd_mom_update(weight, grad, state,
+                                   momentum=self.momentum, **kw)
+            return
         if state is None:
             nd.sgd_update(weight, grad, out=weight, **kw)
         else:
             nd.sgd_mom_update(weight, grad, state, out=[weight, state],
                               momentum=self.momentum, **kw)
+
+
+def _is_row_sparse(arr):
+    from .ndarray.sparse import RowSparseNDArray
+
+    return isinstance(arr, RowSparseNDArray)
 
 
 @register
@@ -182,6 +205,8 @@ class NAG(Optimizer):
 
 @register
 class Adam(Optimizer):
+    supports_sparse = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -198,6 +223,12 @@ class Adam(Optimizer):
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
         kw["lr"] = kw["lr"] * (coef2 ** 0.5) / coef1
+        if _is_row_sparse(grad):
+            from .ndarray import sparse as _sp
+
+            _sp.adam_update(weight, grad, mean, var, beta1=self.beta1,
+                            beta2=self.beta2, epsilon=self.epsilon, **kw)
+            return
         nd.adam_update(weight, grad, mean, var, out=[weight, mean, var],
                        beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, **kw)
 
@@ -225,6 +256,8 @@ class AdamW(Optimizer):
 
 @register
 class AdaGrad(Optimizer):
+    supports_sparse = True
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -234,6 +267,12 @@ class AdaGrad(Optimizer):
 
     def update(self, index, weight, grad, state):
         kw = self._common(index)
+        if _is_row_sparse(grad):
+            from .ndarray import sparse as _sp
+
+            _sp.adagrad_update(weight, grad, state,
+                               epsilon=self.float_stable_eps, **kw)
+            return
         nd.adagrad_update(weight, grad, state, out=[weight, state],
                           epsilon=self.float_stable_eps, **kw)
 

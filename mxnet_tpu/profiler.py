@@ -130,7 +130,7 @@ def _block_results(results):
 def timed_call(name, fn, args, cat="imperative"):
     """Run fn(*args), recording it as one op event when profiling is active
     (single shared wrapper for every dispatch site)."""
-    if not is_active():
+    if not is_active() or not _category_enabled(cat):
         return fn(*args)
     t0 = _now_us()
     results = fn(*args)

@@ -1,0 +1,163 @@
+"""Sparse storage tests (reference strategy: tests/python/unittest/
+test_sparse_ndarray.py + test_sparse_operator.py — roundtrips, retain,
+sparse dot vs dense oracle, lazy optimizer updates)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu import test_utils as tu
+
+
+def _rand_dense_with_zero_rows(shape, density=0.4):
+    arr = np.random.uniform(-1, 1, shape).astype(np.float32)
+    mask = np.random.uniform(0, 1, (shape[0],)) < density
+    return arr * mask.reshape((-1,) + (1,) * (len(shape) - 1))
+
+
+def test_rsp_roundtrip():
+    dense_np = _rand_dense_with_zero_rows((8, 3))
+    x = mx.nd.array(dense_np)
+    rsp = x.tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert rsp.shape == (8, 3)
+    np.testing.assert_allclose(rsp.asnumpy(), dense_np, rtol=1e-6)
+    back = rsp.tostype("default")
+    np.testing.assert_allclose(back.asnumpy(), dense_np, rtol=1e-6)
+    # stored rows == nonzero rows
+    nz = np.where(np.any(dense_np != 0, axis=1))[0]
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), nz)
+
+
+def test_csr_roundtrip():
+    dense_np = np.array([[1, 0, 2], [0, 0, 0], [3, 4, 0]], dtype=np.float32)
+    csr = mx.nd.array(dense_np).tostype("csr")
+    assert csr.stype == "csr"
+    assert csr.nnz == 4
+    np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 2, 2, 4])
+    np.testing.assert_allclose(csr.asnumpy(), dense_np)
+
+
+def test_creation_functions():
+    rsp = sparse.row_sparse_array(([[1.0, 2.0], [3.0, 4.0]], [1, 3]), shape=(5, 2))
+    assert rsp.shape == (5, 2)
+    dense = rsp.asnumpy()
+    np.testing.assert_allclose(dense[1], [1, 2])
+    np.testing.assert_allclose(dense[3], [3, 4])
+    assert np.all(dense[[0, 2, 4]] == 0)
+
+    csr = sparse.csr_matrix(([1.0, 2.0, 3.0], [0, 2, 1], [0, 2, 3]), shape=(2, 3))
+    np.testing.assert_allclose(csr.asnumpy(), [[1, 0, 2], [0, 3, 0]])
+
+    z = sparse.zeros("row_sparse", (4, 2))
+    assert z.asnumpy().sum() == 0
+    zc = sparse.zeros("csr", (4, 2))
+    assert zc.asnumpy().sum() == 0
+
+
+def test_sparse_retain():
+    dense_np = np.arange(12, dtype=np.float32).reshape(4, 3) + 1
+    rsp = mx.nd.array(dense_np).tostype("row_sparse")
+    kept = sparse.sparse_retain(rsp, mx.nd.array([0, 2], dtype="int64"))
+    expect = dense_np.copy()
+    expect[[1, 3]] = 0
+    np.testing.assert_allclose(kept.asnumpy(), expect)
+
+
+def test_csr_dot_vs_dense():
+    np.random.seed(0)
+    dense_np = (np.random.uniform(-1, 1, (5, 7)) *
+                (np.random.uniform(0, 1, (5, 7)) < 0.3)).astype(np.float32)
+    rhs_np = np.random.uniform(-1, 1, (7, 4)).astype(np.float32)
+    csr = mx.nd.array(dense_np).tostype("csr")
+    rhs = mx.nd.array(rhs_np)
+    out = sparse.dot(csr, rhs)
+    tu.assert_almost_equal(out, dense_np @ rhs_np, rtol=1e-5, atol=1e-5)
+
+    # transpose_a
+    rhs2 = mx.nd.array(np.random.uniform(-1, 1, (5, 4)).astype(np.float32))
+    out_t = sparse.dot(csr, rhs2, transpose_a=True)
+    tu.assert_almost_equal(out_t, dense_np.T @ rhs2.asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_square_sum():
+    dense_np = _rand_dense_with_zero_rows((6, 3))
+    rsp = mx.nd.array(dense_np).tostype("row_sparse")
+    tu.assert_almost_equal(sparse.square_sum(rsp), (dense_np ** 2).sum(),
+                           rtol=1e-5, atol=1e-6)
+    tu.assert_almost_equal(sparse.square_sum(rsp, axis=1),
+                           (dense_np ** 2).sum(axis=1), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_add():
+    a = sparse.row_sparse_array(([[1.0]], [0]), shape=(4, 1))
+    b = sparse.row_sparse_array(([[2.0], [3.0]], [0, 2]), shape=(4, 1))
+    c = sparse.add(a, b)
+    assert c.stype == "row_sparse"
+    np.testing.assert_allclose(c.asnumpy().ravel(), [3, 0, 3, 0])
+
+
+def test_lazy_sgd_update():
+    w = mx.nd.array(np.ones((4, 2), dtype=np.float32))
+    grad = sparse.row_sparse_array(([[1.0, 1.0]], [2]), shape=(4, 2))
+    sparse.sgd_update(w, grad, lr=0.5)
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[2], [0.5, 0.5])
+    np.testing.assert_allclose(out[[0, 1, 3]], 1.0)  # untouched rows
+
+
+def test_optimizer_sparse_path():
+    opt = mx.optimizer.create("adam", learning_rate=0.1)
+    w = mx.nd.array(np.ones((5, 2), dtype=np.float32))
+    state = opt.create_state(0, w)
+    grad = sparse.row_sparse_array(([[1.0, 1.0]], [1]), shape=(5, 2))
+    before = w.asnumpy().copy()
+    opt.update(0, w, grad, state)
+    after = w.asnumpy()
+    assert not np.allclose(after[1], before[1])
+    np.testing.assert_allclose(after[[0, 2, 3, 4]], before[[0, 2, 3, 4]])
+
+
+def test_rand_ndarray_sparse():
+    rsp = tu.rand_ndarray((6, 4), stype="row_sparse", density=0.5)
+    assert rsp.stype == "row_sparse"
+    csr = tu.rand_ndarray((6, 4), stype="csr", density=0.5)
+    assert csr.stype == "csr"
+
+
+def test_kvstore_row_sparse():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((4, 2)))
+    g1 = sparse.row_sparse_array(([[1.0, 1.0]], [0]), shape=(4, 2))
+    g2 = sparse.row_sparse_array(([[2.0, 2.0]], [3]), shape=(4, 2))
+    kv.push("w", [g1, g2])
+    out = mx.nd.zeros((4, 2))
+    kv.pull("w", out=out)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[0], [1, 1])
+    np.testing.assert_allclose(got[3], [2, 2])
+
+    # row_sparse_pull gathers requested rows
+    rows = mx.nd.array([3], dtype="int64")
+    buf = mx.nd.zeros((1, 2))
+    kv.row_sparse_pull("w", out=buf, row_ids=rows)
+    np.testing.assert_allclose(buf.asnumpy(), [[2, 2]])
+
+
+def test_embedding_sparse_grad_training():
+    from mxnet_tpu import gluon, autograd
+
+    net = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    net.initialize(ctx=mx.cpu())
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 1.0})
+    x = mx.nd.array([1, 3], dtype="int32")
+    w_before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        out = net(x)
+        loss = out.sum()
+    loss.backward()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    # only looked-up rows changed
+    changed = np.where(np.any(w_before != w_after, axis=1))[0]
+    np.testing.assert_array_equal(sorted(changed), [1, 3])
