@@ -297,9 +297,11 @@ class LayerNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
-    """Embedding lookup (reference: basic_layers.py:550). Gather on TPU; the
-    weight gradient is XLA's native scatter-add (sparse_grad kept for API
-    parity — row_sparse grads are a GPU-memory workaround we don't need)."""
+    """Embedding lookup (reference: basic_layers.py:550). Gather on TPU.
+    sparse_grad=True marks the weight's grad_stype row_sparse: Trainer casts
+    the tape gradient to row_sparse and sparse-capable optimizers take the
+    lazy row-update path (untouched rows skip wd/momentum — same semantics
+    as the reference's sparse kernels)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, **kwargs):

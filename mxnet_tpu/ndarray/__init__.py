@@ -12,6 +12,13 @@ from .ndarray import stack  # noqa: F401
 from . import sparse  # noqa: F401
 from .sparse import BaseSparseNDArray, RowSparseNDArray, CSRNDArray  # noqa: F401
 
+# control-flow ops live on nd.contrib (reference: ndarray/contrib.py)
+from . import control_flow as _control_flow
+
+contrib.foreach = _control_flow.foreach  # noqa: F821
+contrib.while_loop = _control_flow.while_loop  # noqa: F821
+contrib.cond = _control_flow.cond  # noqa: F821
+
 
 def concatenate(arrays, axis=0, always_copy=True):
     """reference: ndarray.py concatenate (list -> one array along axis)."""

@@ -1,0 +1,220 @@
+"""Control-flow operators: foreach, while_loop, cond.
+
+TPU-native equivalent of the reference's control-flow ops
+(src/operator/control_flow.cc:476,487 — subgraphs executed via CachedOp :530;
+Python front python/mxnet/ndarray/contrib.py foreach/while_loop/cond).
+
+Two execution regimes, mirroring the reference's imperative-vs-symbolic split:
+
+- **Eager** (concrete NDArrays): Python unroll, exactly like the reference's
+  imperative foreach — every op lands on the autograd tape, so backward works
+  with no extra machinery.
+- **Traced** (inside hybridize/CachedOp/jit, detected by tracer-backed
+  inputs): lowers to `lax.scan` / `lax.while_loop`-style masked scan /
+  `lax.cond` so the XLA program stays O(1) in sequence length and fuses —
+  the reason the reference needed subgraph ops at all. AD flows through the
+  enclosing jax.vjp.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _is_traced(*arrays):
+    import jax
+
+    return any(isinstance(a._data, jax.core.Tracer)
+               for a in arrays if isinstance(a, NDArray))
+
+
+def _as_list(x):
+    if x is None:
+        return [], True
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+def _restore(lst, single):
+    return lst[0] if single else list(lst)
+
+
+def foreach(body, data, init_states):
+    """Iterate `body` over data's first axis carrying states (reference:
+    contrib.foreach python/mxnet/ndarray/contrib.py; op control_flow.cc:476).
+
+    body(data_slice, states) -> (outputs, new_states)
+    Returns (stacked_outputs, final_states).
+    """
+    data_list, data_single = _as_list(data)
+    states, states_single = _as_list(init_states)
+    if not data_list:
+        raise MXNetError("foreach: data must be a non-empty NDArray or list")
+    length = data_list[0].shape[0]
+    if length == 0:
+        raise MXNetError("foreach: data has zero-length axis 0 — outputs "
+                         "would be undefined (reference raises too)")
+    for d in data_list:
+        if d.shape[0] != length:
+            raise MXNetError("foreach: all data inputs need equal axis-0 length")
+
+    if _is_traced(*(data_list + states)):
+        return _foreach_scan(body, data_list, data_single, states, states_single)
+
+    outputs = None
+    for i in range(length):
+        slices = _restore([d[i] for d in data_list], data_single)
+        outs, new_states = body(slices, _restore(states, states_single))
+        states, _ = _as_list(new_states)
+        outs_l, outs_single = _as_list(outs)
+        if outputs is None:
+            outputs = [[] for _ in outs_l]
+            single_out = outs_single
+        for buf, o in zip(outputs, outs_l):
+            buf.append(o)
+    from . import stack as _stack
+
+    stacked = [_stack(*buf, axis=0) for buf in outputs]
+    return _restore(stacked, single_out), _restore(states, states_single)
+
+
+def _foreach_scan(body, data_list, data_single, states, states_single):
+    import jax
+
+    from .. import autograd
+
+    def scan_body(carry, xs):
+        sts = _restore([NDArray(c) for c in carry], states_single)
+        xnd = _restore([NDArray(x) for x in xs], data_single)
+        with autograd.pause():
+            outs, new_states = body(xnd, sts)
+        new_l, _ = _as_list(new_states)
+        outs_l, outs_single = _as_list(outs)
+        scan_body.single_out = outs_single
+        return tuple(s._data for s in new_l), tuple(o._data for o in outs_l)
+
+    carry, ys = jax.lax.scan(scan_body,
+                             tuple(s._data for s in states),
+                             tuple(d._data for d in data_list))
+    outs = [NDArray(y) for y in ys]
+    final = [NDArray(c) for c in carry]
+    return (_restore(outs, scan_body.single_out),
+            _restore(final, states_single))
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Loop while cond holds, at most max_iterations (reference:
+    contrib.while_loop python/mxnet/ndarray/contrib.py; op control_flow.cc:487).
+
+    cond(*loop_vars) -> scalar; func(*loop_vars) -> (outputs, new_loop_vars).
+    Returns (stacked_outputs padded to max_iterations, final_loop_vars) —
+    same padding contract as the reference.
+    """
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (as in reference)")
+    loop_vars, vars_single = _as_list(loop_vars)
+    if not loop_vars:
+        raise MXNetError("while_loop: loop_vars must be non-empty")
+
+    if _is_traced(*loop_vars):
+        return _while_loop_scan(cond, func, loop_vars, vars_single,
+                                max_iterations)
+
+    import jax.numpy as jnp
+
+    outputs = None
+    single_out = True
+    steps = 0
+    while steps < max_iterations and \
+            bool(cond(*loop_vars).asnumpy().reshape(()).item()):
+        outs, new_vars = func(*loop_vars)
+        loop_vars, _ = _as_list(new_vars)
+        outs_l, single_out = _as_list(outs)
+        if outputs is None:
+            outputs = [[] for _ in outs_l]
+        for buf, o in zip(outputs, outs_l):
+            buf.append(o)
+        steps += 1
+    if outputs is None:
+        raise MXNetError("while_loop: cond was false on entry — outputs "
+                         "undefined (reference raises too)")
+    from . import stack as _stack
+
+    stacked = []
+    for buf in outputs:
+        s = _stack(*buf, axis=0)
+        if steps < max_iterations:
+            # pad to max_iterations (reference pads; contents beyond the
+            # actual step count are zeros)
+            pad = jnp.zeros((max_iterations - steps,) + s.shape[1:], s.dtype)
+            s = NDArray(jnp.concatenate([s._data, pad], axis=0), ctx=s.context)
+        stacked.append(s)
+    return _restore(stacked, single_out), _restore(loop_vars, vars_single)
+
+
+def _while_loop_scan(cond, func, loop_vars, vars_single, max_iterations):
+    """Traced lowering: scan over max_iterations with a done-mask — the
+    static-shape formulation of while+stacked outputs XLA wants (the
+    reference's symbolic while_loop keeps dynamic iteration but pads
+    outputs identically)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import autograd
+
+    def scan_body(carry, _):
+        done, vars_j = carry
+        vars_nd = [NDArray(v) for v in vars_j]
+        with autograd.pause():
+            pred = cond(*vars_nd)._data.reshape(()).astype(bool)
+            outs, new_vars = func(*vars_nd)
+        active = jnp.logical_and(jnp.logical_not(done), pred)
+        new_l, _ = _as_list(new_vars)
+        outs_l, outs_single = _as_list(outs)
+        scan_body.single_out = outs_single
+        kept = tuple(jnp.where(active, n._data, v)
+                     for n, v in zip(new_l, vars_j))
+        ys = tuple(jnp.where(active, o._data, jnp.zeros_like(o._data))
+                   for o in outs_l)
+        return (jnp.logical_or(done, jnp.logical_not(pred)), kept), ys
+
+    init = (jnp.asarray(False), tuple(v._data for v in loop_vars))
+    (done, vars_j), ys = jax.lax.scan(scan_body, init, None,
+                                      length=max_iterations)
+    outs = [NDArray(y) for y in ys]
+    final = [NDArray(v) for v in vars_j]
+    return (_restore(outs, scan_body.single_out), _restore(final, vars_single))
+
+
+def cond(pred, then_func, else_func):
+    """Conditional execution (reference: contrib.cond
+    python/mxnet/ndarray/contrib.py; op control_flow.cc).
+
+    pred: scalar NDArray; then_func/else_func: no-arg callables returning
+    outputs (closure style, as in reference). Returns branch outputs.
+    """
+    if not _is_traced(pred):
+        taken = bool(pred.asnumpy().reshape(()).item())
+        return then_func() if taken else else_func()
+
+    import jax
+
+    from .. import autograd
+
+    def wrap(fn):
+        def run(_):
+            with autograd.pause():
+                outs = fn()
+            outs_l, single = _as_list(outs)
+            wrap.single = single
+            return tuple(o._data for o in outs_l)
+
+        return run
+
+    t, e = wrap(then_func), wrap(else_func)
+    ys = jax.lax.cond(pred._data.reshape(()).astype(bool), t, e, None)
+    outs = [NDArray(y) for y in ys]
+    return _restore(outs, wrap.single)

@@ -88,6 +88,34 @@ class BaseSparseNDArray(NDArray):
     def __setitem__(self, key, value):
         raise MXNetError("sparse NDArray does not support item assignment")
 
+    def copyto(self, other):
+        """Sparse-aware copy (reference: sparse.py BaseSparseNDArray.copyto):
+        to a Context -> same-stype copy on that device; to a dense NDArray ->
+        densify; to a same-stype sparse -> component copy."""
+        import jax
+
+        from ..context import Context
+
+        if isinstance(other, Context):
+            out = type(self).__new__(type(self))
+            NDArray.__init__(out, None, ctx=other)
+            out._shape = self._shape
+            out._data = {k: jax.device_put(v, other.jax_device())
+                         for k, v in self._data.items()}
+            return out
+        if isinstance(other, BaseSparseNDArray):
+            if type(other) is not type(self):
+                raise MXNetError("copyto: stype mismatch (%s -> %s)"
+                                 % (self.stype, other.stype))
+            other._shape = self._shape
+            other._data = {k: jax.device_put(v, other._ctx.jax_device())
+                           for k, v in self._data.items()}
+            return other
+        if isinstance(other, NDArray):
+            # densify then reuse NDArray.copyto for the device transfer
+            return self.tostype("default").copyto(other)
+        raise TypeError("copyto: expected NDArray or Context")
+
 
 class RowSparseNDArray(BaseSparseNDArray):
     """row_sparse: (indices, values) over the first dimension (reference:
@@ -128,12 +156,6 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def retain(self, row_ids):
         return sparse_retain(self, row_ids)
-
-    def copyto(self, other):
-        if isinstance(other, NDArray) and not isinstance(other, BaseSparseNDArray):
-            other._set_data(self.tostype("default")._data)
-            return other
-        return super().copyto(other)
 
 
 class CSRNDArray(BaseSparseNDArray):
@@ -340,14 +362,14 @@ def cast_storage(arr, stype):
 def sparse_retain(arr, indices):
     """Keep only the requested rows (reference: sparse_retain op,
     src/operator/tensor/sparse_retain.cc)."""
+    import jax.numpy as jnp
+
     if not isinstance(arr, RowSparseNDArray):
         raise MXNetError("sparse_retain expects a RowSparseNDArray")
-    want = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
-                       else indices, dtype="int64")
-    have = _np.asarray(arr._data["indices"])
-    mask = _np.isin(have, want)
-    keep = _np.where(mask)[0]
-    data = _np.asarray(arr._data["data"])[keep]
+    want = indices._data if isinstance(indices, NDArray) else jnp.asarray(indices)
+    have = arr._data["indices"]
+    keep = jnp.nonzero(jnp.isin(have, want.astype(have.dtype)))[0]
+    data = arr._data["data"][keep]
     return _make_rsp(data, have[keep], arr.shape, arr.context, dtype=data.dtype)
 
 
@@ -366,18 +388,25 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
             raise MXNetError("dot(csr, dense, transpose_b=True) unsupported "
                              "(matches reference)")
         dense = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+        if dense.ndim not in (1, 2):
+            raise MXNetError("dot(csr, dense): rhs must be 1-D or 2-D, got %dD"
+                             % dense.ndim)
+        vec = dense.ndim == 1
         rows = jnp.asarray(lhs._row_ids())
         cols = lhs._data["indices"]
         vals = lhs._data["data"]
+        gathered = dense._data[cols]          # (nnz,) or (nnz, n)
         if not transpose_a:
-            # out[m, n] = sum_k csr[m, k] * dense[k, n]
-            prods = vals[:, None] * dense._data[cols]
+            # out[m(, n)] = sum_k csr[m, k] * dense[k(, n)]
+            prods = vals * gathered if vec else vals[:, None] * gathered
             out = jax.ops.segment_sum(prods, rows,
                                       num_segments=lhs.shape[0])
             return NDArray(out, ctx=dense.context)
-        # out[k, n] = sum_m csr[m, k] * dense[m, n]
-        prods = vals[:, None] * dense._data[rows]
-        out = jnp.zeros((lhs.shape[1], dense.shape[1]), prods.dtype)
+        # out[k(, n)] = sum_m csr[m, k] * dense[m(, n)]
+        g_rows = dense._data[rows]
+        prods = vals * g_rows if vec else vals[:, None] * g_rows
+        out_shape = (lhs.shape[1],) if vec else (lhs.shape[1], dense.shape[1])
+        out = jnp.zeros(out_shape, prods.dtype)
         out = out.at[cols].add(prods)
         return NDArray(out, ctx=dense.context)
     if isinstance(lhs, RowSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
@@ -399,28 +428,33 @@ def square_sum(arr, axis=None, keepdims=False):
         return NDArray(jnp.sum(vals * vals), ctx=arr.context)
     if axis in (1, -1) and arr.ndim == 2:
         # per-row sums live only at stored rows -> row_sparse result
+        # (reference _square_sum emits row_sparse for axis=1)
         rows_sq = jnp.sum(vals * vals, axis=1, keepdims=keepdims)
-        dense = jnp.zeros((arr.shape[0],) + ((1,) if keepdims else ()),
-                          rows_sq.dtype)
-        dense = dense.at[arr._data["indices"]].set(rows_sq)
-        return NDArray(dense, ctx=arr.context)
+        out_shape = (arr.shape[0],) + ((1,) if keepdims else ())
+        return _make_rsp(rows_sq, arr._data["indices"], out_shape,
+                         arr.context, dtype=rows_sq.dtype)
     return NDArray(jnp.sum(jnp.square(arr.todense()._data), axis=axis,
                            keepdims=keepdims), ctx=arr.context)
 
 
 def add(lhs, rhs):
-    """rsp + rsp -> rsp (union of rows; reference: elemwise_add sparse path)."""
+    """rsp + rsp -> rsp (union of rows; reference: elemwise_add sparse path).
+    Stays on device — unique + segment_sum, no host round trip (this is the
+    kvstore gradient-aggregation hot path)."""
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        import jax
+        import jax.numpy as jnp
+
         if lhs.shape != rhs.shape:
             raise MXNetError("shape mismatch in sparse add")
-        li = _np.asarray(lhs._data["indices"])
-        ri = _np.asarray(rhs._data["indices"])
-        union = _np.union1d(li, ri)
-        data = _np.zeros((len(union),) + lhs.shape[1:],
-                         _np.asarray(lhs._data["data"]).dtype)
-        data[_np.searchsorted(union, li)] += _np.asarray(lhs._data["data"])
-        data[_np.searchsorted(union, ri)] += _np.asarray(rhs._data["data"])
-        return _make_rsp(data, union, lhs.shape, lhs.context, dtype=data.dtype)
+        all_idx = jnp.concatenate([lhs._data["indices"], rhs._data["indices"]])
+        all_data = jnp.concatenate([lhs._data["data"].astype(lhs.dtype),
+                                    rhs._data["data"].astype(lhs.dtype)])
+        union, inv = jnp.unique(all_idx, return_inverse=True)
+        summed = jax.ops.segment_sum(all_data, inv.reshape(-1),
+                                     num_segments=int(union.shape[0]))
+        return _make_rsp(summed, union, lhs.shape, lhs.context,
+                         dtype=summed.dtype)
     l = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
     r = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
     return l + r

@@ -160,16 +160,18 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         kw = self._common(index)
         if _is_row_sparse(grad):
-            # lazy row update (reference: SGDUpdateRspImpl optimizer_op.cc;
-            # lazy_update=True semantics — untouched rows skip wd/momentum)
-            from .ndarray import sparse as _sp
+            if self.lazy_update:
+                # lazy row update (reference: SGDUpdateRspImpl
+                # optimizer_op.cc; untouched rows skip wd/momentum)
+                from .ndarray import sparse as _sp
 
-            if state is None:
-                _sp.sgd_update(weight, grad, **kw)
-            else:
-                _sp.sgd_mom_update(weight, grad, state,
-                                   momentum=self.momentum, **kw)
-            return
+                if state is None:
+                    _sp.sgd_update(weight, grad, **kw)
+                else:
+                    _sp.sgd_mom_update(weight, grad, state,
+                                       momentum=self.momentum, **kw)
+                return
+            grad = grad.tostype("default")  # lazy_update=False: std update
         if state is None:
             nd.sgd_update(weight, grad, out=weight, **kw)
         else:
@@ -211,6 +213,7 @@ class Adam(Optimizer):
                  lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape, ctx=weight.context, dtype="float32"),
@@ -224,11 +227,13 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         kw["lr"] = kw["lr"] * (coef2 ** 0.5) / coef1
         if _is_row_sparse(grad):
-            from .ndarray import sparse as _sp
+            if self.lazy_update:
+                from .ndarray import sparse as _sp
 
-            _sp.adam_update(weight, grad, mean, var, beta1=self.beta1,
-                            beta2=self.beta2, epsilon=self.epsilon, **kw)
-            return
+                _sp.adam_update(weight, grad, mean, var, beta1=self.beta1,
+                                beta2=self.beta2, epsilon=self.epsilon, **kw)
+                return
+            grad = grad.tostype("default")  # lazy_update=False: std update
         nd.adam_update(weight, grad, mean, var, out=[weight, mean, var],
                        beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, **kw)
 

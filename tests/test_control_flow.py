@@ -1,0 +1,156 @@
+"""Control-flow op tests (reference strategy:
+tests/python/unittest/test_contrib_control_flow.py — numeric equivalence of
+foreach/while_loop/cond vs unrolled numpy, autograd through loops, and
+imperative-vs-hybridized consistency)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def test_foreach_cumsum():
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = mx.nd.zeros((3,))
+
+    def body(x, state):
+        new = x + state
+        return new, new
+
+    outs, final = mx.nd.contrib.foreach(body, data, init)
+    expect = np.cumsum(np.arange(12, dtype=np.float32).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), expect, rtol=1e-6)
+    np.testing.assert_allclose(final.asnumpy(), expect[-1], rtol=1e-6)
+
+
+def test_foreach_multiple_states_outputs():
+    data = mx.nd.array(np.ones((3, 2), dtype=np.float32))
+
+    def body(x, states):
+        s1, s2 = states
+        return [x + s1, x * 2], [s1 + 1, s2]
+
+    (o1, o2), (f1, f2) = mx.nd.contrib.foreach(
+        body, data, [mx.nd.zeros((2,)), mx.nd.ones((2,))])
+    np.testing.assert_allclose(o1.asnumpy(), [[1, 1], [2, 2], [3, 3]])
+    np.testing.assert_allclose(o2.asnumpy(), np.full((3, 2), 2.0))
+    np.testing.assert_allclose(f1.asnumpy(), [3, 3])
+
+
+def test_foreach_autograd():
+    data = mx.nd.array(np.random.uniform(-1, 1, (5, 4)).astype(np.float32))
+    w = mx.nd.array(np.random.uniform(-1, 1, (4,)).astype(np.float32))
+    w.attach_grad()
+
+    def body(x, state):
+        out = x * w + state
+        return out, out
+
+    with autograd.record():
+        outs, final = mx.nd.contrib.foreach(body, data, mx.nd.zeros((4,)))
+        loss = outs.sum()
+    loss.backward()
+    # d loss / dw: each row i of data contributes data[i]*(n-i) times
+    n = data.shape[0]
+    coefs = np.arange(n, 0, -1).reshape(-1, 1)
+    expect = (data.asnumpy() * coefs).sum(axis=0)
+    np.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-4)
+
+
+def test_while_loop():
+    def cond(i, s):
+        return i < 5
+
+    def func(i, s):
+        return s + i, [i + 1, s + i]
+
+    outs, (fi, fs) = mx.nd.contrib.while_loop(
+        cond, func, [mx.nd.array([0.0]), mx.nd.array([0.0])],
+        max_iterations=8)
+    # steps: i=0..4, outputs s+i each step: 0,1,3,6,10 then zero-padded
+    np.testing.assert_allclose(outs.asnumpy().ravel(),
+                               [0, 1, 3, 6, 10, 0, 0, 0])
+    assert fi.asscalar() == 5
+    assert fs.asscalar() == 10
+
+
+def test_cond():
+    x = mx.nd.array([2.0])
+    y = mx.nd.array([3.0])
+    out = mx.nd.contrib.cond(x < y, lambda: x + y, lambda: x - y)
+    assert out.asscalar() == 5.0
+    out = mx.nd.contrib.cond(x > y, lambda: x + y, lambda: x - y)
+    assert out.asscalar() == -1.0
+
+
+class _ScanCell(gluon.HybridBlock):
+    """RNN-ish block built on foreach: hybridizing must trace to lax.scan."""
+
+    def __init__(self, hidden, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.dense = gluon.nn.Dense(hidden, flatten=False)
+
+    def hybrid_forward(self, F, seq, h0):
+        def body(x, h):
+            new_h = (self.dense(x) + h).tanh()
+            return new_h, new_h
+
+        outs, final = F.contrib.foreach(body, seq, h0)
+        return outs, final
+
+
+def test_foreach_hybridize_consistency():
+    np.random.seed(0)
+    seq = mx.nd.array(np.random.uniform(-1, 1, (6, 2, 3)).astype(np.float32))
+    h0 = mx.nd.zeros((2, 4))
+    net = _ScanCell(4)
+    net.initialize(ctx=mx.cpu())
+    eager_o, eager_h = net(seq, h0)
+    net.hybridize()
+    hyb_o, hyb_h = net(seq, h0)
+    np.testing.assert_allclose(eager_o.asnumpy(), hyb_o.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(eager_h.asnumpy(), hyb_h.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_while_loop_traced_consistency():
+    """Same while_loop through the eager path and inside a jit trace."""
+    import jax
+
+    def run(i0):
+        def cond(i, acc):
+            return i < 4
+
+        def func(i, acc):
+            return acc, [i + 1, acc + i * i]
+
+        outs, (fi, facc) = mx.nd.contrib.while_loop(
+            cond, func, [i0, mx.nd.zeros((1,))], max_iterations=6)
+        return outs, facc
+
+    eager_outs, eager_acc = run(mx.nd.array([0.0]))
+
+    def jit_fn(i0):
+        outs, acc = run(mx.nd.NDArray(i0))
+        return outs._data, acc._data
+
+    jit_outs, jit_acc = jax.jit(jit_fn)(mx.nd.array([0.0])._data)
+    np.testing.assert_allclose(eager_outs.asnumpy(), np.asarray(jit_outs))
+    np.testing.assert_allclose(eager_acc.asnumpy(), np.asarray(jit_acc))
+
+
+def test_cond_traced():
+    import jax
+
+    def f(x):
+        nd_x = mx.nd.NDArray(x)
+        out = mx.nd.contrib.cond(nd_x.sum() > 0,
+                                 lambda: nd_x * 2,
+                                 lambda: nd_x - 1)
+        return out._data
+
+    pos = jax.jit(f)(mx.nd.array([1.0, 2.0])._data)
+    np.testing.assert_allclose(np.asarray(pos), [2, 4])
+    neg = jax.jit(f)(mx.nd.array([-1.0, -2.0])._data)
+    np.testing.assert_allclose(np.asarray(neg), [-2, -3])
