@@ -481,8 +481,12 @@ _IS_TRAIN_CACHE = {}
 def _takes_is_train(opdef):
     v = _IS_TRAIN_CACHE.get(opdef.name)
     if v is None:
-        names, _ = _sig_params(opdef.fn)
-        v = "is_train" in names
+        try:
+            # any param named is_train counts, incl. keyword-only
+            # (Custom declares it after *arrays)
+            v = "is_train" in inspect.signature(opdef.fn).parameters
+        except (TypeError, ValueError):
+            v = False
         _IS_TRAIN_CACHE[opdef.name] = v
     return v
 

@@ -1,0 +1,262 @@
+"""Custom operators defined in Python.
+
+TPU-native equivalent of the reference's custom-op plugin
+(python/mxnet/operator.py: CustomOp :426, CustomOpProp :472, register :692;
+C++ bridge src/operator/custom/custom.cc running user callbacks on dedicated
+worker threads custom-inl.h:210-222).
+
+Design: one framework op named ``Custom`` is registered whose jax
+implementation is a `jax.custom_vjp`-wrapped `jax.pure_callback` — the
+XLA-era version of the reference's engine-callback bridge. The host callback
+materializes inputs as NDArrays, instantiates the user's CustomOp via
+``CustomOpProp.create_operator`` and runs ``forward``/``backward`` exactly as
+the reference does (same req/assign protocol). Because ``Custom`` is an
+ordinary registry op, every consumer works unchanged: eager `nd.Custom`,
+the autograd tape (vjp hits the custom_vjp rule), `sym.Custom`, and
+hybridized blocks (pure_callback stages the host call out of the compiled
+program).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+_CUSTOM_PROPS = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference: operator.py:426)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring req (reference: operator.py:447)."""
+        if req in ("null",):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError("unknown req '%s'" % req)
+
+
+class CustomOpProp:
+    """Op metadata provider (reference: operator.py:472)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under op_type `reg_name`
+    (reference: operator.py:692)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        # drop every compiled trace that may close over a previous
+        # registration of this op_type (re-registering is the notebook
+        # cell-rerun workflow the reference supports)
+        _make_custom_fn.cache_clear()
+        from . import autograd as _autograd
+        from . import ops as _ops_mod
+
+        _ops_mod._jitted.cache_clear()
+        _autograd._bwd_jitted.cache_clear()
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered_operators():
+    return sorted(_CUSTOM_PROPS)
+
+
+# --------------------------------------------------------------------------
+# the bridge: one registry op "Custom" running user callbacks on host
+# --------------------------------------------------------------------------
+
+def _make_prop(op_type, attr_key):
+    if op_type not in _CUSTOM_PROPS:
+        raise MXNetError("custom op '%s' is not registered (known: %s)"
+                         % (op_type, sorted(_CUSTOM_PROPS)))
+    # reference passes user kwargs to the Prop as strings (custom.cc attrs)
+    kwargs = {k: str(v) for k, v in attr_key}
+    prop = _CUSTOM_PROPS[op_type](**kwargs)
+    if prop.list_auxiliary_states():
+        raise MXNetError("custom ops with auxiliary states are not supported")
+    return prop
+
+
+def _infer(prop, ins):
+    in_shapes = [list(a.shape) for a in ins]
+    in_shapes, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [_np.dtype(a.dtype) for a in ins]
+    _, out_types, _ = prop.infer_type(in_types)
+    return ([tuple(s) for s in out_shapes],
+            [_np.dtype(t) for t in out_types])
+
+
+def _to_nd(arrays):
+    """Wrap host callback arrays as CPU-backed NDArrays. Staying on the CPU
+    XLA backend is load-bearing: the accelerator core is blocked waiting for
+    the pure_callback result, so the callback must never enqueue work on the
+    default (TPU) device or it deadlocks."""
+    import jax
+
+    from .context import cpu
+    from .ndarray.ndarray import NDArray
+
+    ctx = cpu()
+    dev = ctx.jax_device()
+    return [NDArray(jax.device_put(_np.asarray(a), dev), ctx=ctx)
+            for a in arrays]
+
+
+def _run_forward(prop, np_ins, is_train):
+    """Shared forward-recompute used by both callbacks (one definition so
+    the protocol can't diverge between forward and backward paths)."""
+    from . import autograd
+    from . import ndarray as nd
+    from .context import cpu
+
+    ctx = cpu()
+    n_out = len(prop.list_outputs())
+    in_nd = _to_nd(np_ins)
+    out_shapes, out_types = _infer(prop, np_ins)
+    out_nd = [nd.zeros(s, dtype=t, ctx=ctx)
+              for s, t in zip(out_shapes, out_types)]
+    op = prop.create_operator(None, [list(a.shape) for a in np_ins],
+                              [a.dtype for a in np_ins])
+    with autograd.pause():
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_nd, out_data=out_nd, aux=[])
+    return in_nd, out_nd, out_types, op
+
+
+@functools.lru_cache(maxsize=512)
+def _make_custom_fn(op_type, attr_key, is_train):
+    """Build the custom_vjp jax function for (op_type, attrs, is_train)."""
+    import jax
+
+    prop = _make_prop(op_type, attr_key)
+    n_out = len(prop.list_outputs())
+
+    def fwd_host(*np_ins):
+        _, out_nd, out_types, _ = _run_forward(prop, np_ins, is_train)
+        return tuple(_np.asarray(o.asnumpy(), dtype=t)
+                     for o, t in zip(out_nd, out_types))
+
+    def bwd_host(*np_args):
+        """args = inputs + out_grads; recomputes forward for out_data
+        (the tape-recompute formulation used framework-wide). Backward
+        always runs in train mode, as in the reference."""
+        from . import autograd
+        from . import ndarray as nd
+        from .context import cpu
+
+        n_in = len(np_args) - n_out
+        np_ins, np_cots = np_args[:n_in], np_args[n_in:]
+        in_nd, out_nd, _, op = _run_forward(prop, np_ins, True)
+        with autograd.pause():
+            ograd_nd = _to_nd(np_cots)
+            igrad_nd = [nd.zeros(a.shape, dtype=a.dtype, ctx=cpu())
+                        for a in in_nd]
+            op.backward(req=["write"] * n_in, out_grad=ograd_nd,
+                        in_data=in_nd, out_data=out_nd, in_grad=igrad_nd,
+                        aux=[])
+        return tuple(_np.asarray(g.asnumpy(), dtype=a.dtype)
+                     for g, a in zip(igrad_nd, np_ins))
+
+    def primal(*ins):
+        out_shapes, out_types = _infer(prop, ins)
+        structs = tuple(jax.ShapeDtypeStruct(s, t)
+                        for s, t in zip(out_shapes, out_types))
+        return jax.pure_callback(fwd_host, structs, *ins, vmap_method="sequential")
+
+    @jax.custom_vjp
+    def f(*ins):
+        return primal(*ins)
+
+    def f_fwd(*ins):
+        return primal(*ins), ins
+
+    def f_bwd(ins, cots):
+        structs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in ins)
+        return jax.pure_callback(bwd_host, structs, *(tuple(ins) + tuple(cots)),
+                                 vmap_method="sequential")
+
+    f.defvjp(f_fwd, f_bwd)
+    return f, n_out
+
+
+def _custom_dispatch(*arrays, op_type=None, is_train=False, **kwargs):
+    """The registry op function for 'Custom' (reference entry:
+    nd.Custom(*data, op_type=...) -> custom.cc CustomOperator). `is_train`
+    is injected by the dispatch layer from the autograd training flag,
+    like the reference's CustomOperator ctx.is_train."""
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    attr_key = tuple(sorted((k, str(v)) for k, v in kwargs.items()))
+    f, n_out = _make_custom_fn(op_type, attr_key, bool(is_train))
+    out = f(*arrays)
+    if n_out == 1:
+        return out[0]
+    return tuple(out)
+
+
+from . import ops as _ops  # noqa: E402
+
+_ops.register("Custom", num_outputs=-1)(_custom_dispatch)
+
+# install the generated front-end functions (the registry was already
+# populated when nd/sym imported, before this module ran)
+from . import ndarray as _nd_mod  # noqa: E402
+from .ndarray.register import _make_function  # noqa: E402
+
+_nd_mod.Custom = _make_function(_ops.get("Custom"))
+
+from . import symbol as _sym_mod  # noqa: E402
+from .symbol.register import _make_symbol_function  # noqa: E402
+
+_sym_mod.Custom = _make_symbol_function(_ops.get("Custom"))
