@@ -61,3 +61,161 @@ def get():
 
 def available():
     return get() is not None
+
+
+def _checked(lib):
+    """Declare argtypes/restypes once per load."""
+    if getattr(lib, "_mxtpu_typed", False):
+        return lib
+    c = ctypes
+    lib.mxtpu_recio_reader_open.argtypes = [c.c_char_p]
+    lib.mxtpu_recio_reader_open.restype = c.c_void_p
+    lib.mxtpu_recio_reader_next.argtypes = [c.c_void_p,
+                                            c.POINTER(c.POINTER(c.c_char)),
+                                            c.POINTER(c.c_uint64)]
+    lib.mxtpu_recio_reader_next.restype = c.c_int
+    lib.mxtpu_recio_reader_read_at.argtypes = [c.c_void_p, c.c_uint64,
+                                               c.POINTER(c.POINTER(c.c_char)),
+                                               c.POINTER(c.c_uint64)]
+    lib.mxtpu_recio_reader_read_at.restype = c.c_int
+    lib.mxtpu_recio_reader_tell.argtypes = [c.c_void_p]
+    lib.mxtpu_recio_reader_tell.restype = c.c_int64
+    lib.mxtpu_recio_reader_reset.argtypes = [c.c_void_p]
+    lib.mxtpu_recio_reader_close.argtypes = [c.c_void_p]
+    lib.mxtpu_recio_writer_open.argtypes = [c.c_char_p]
+    lib.mxtpu_recio_writer_open.restype = c.c_void_p
+    lib.mxtpu_recio_writer_tell.argtypes = [c.c_void_p]
+    lib.mxtpu_recio_writer_tell.restype = c.c_int64
+    lib.mxtpu_recio_writer_write.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.mxtpu_recio_writer_write.restype = c.c_int
+    lib.mxtpu_recio_writer_close.argtypes = [c.c_void_p]
+    lib.mxtpu_prefetch_open.argtypes = [c.c_char_p, c.c_uint64]
+    lib.mxtpu_prefetch_open.restype = c.c_void_p
+    lib.mxtpu_prefetch_next.argtypes = [c.c_void_p,
+                                        c.POINTER(c.POINTER(c.c_char)),
+                                        c.POINTER(c.c_uint64)]
+    lib.mxtpu_prefetch_next.restype = c.c_int
+    lib.mxtpu_prefetch_close.argtypes = [c.c_void_p]
+    lib.mxtpu_pool_alloc.argtypes = [c.c_size_t]
+    lib.mxtpu_pool_alloc.restype = c.c_void_p
+    lib.mxtpu_pool_free.argtypes = [c.c_void_p]
+    lib.mxtpu_pool_trim.argtypes = []
+    lib.mxtpu_pool_stats.argtypes = [c.POINTER(c.c_uint64)] * 4
+    lib._mxtpu_typed = True
+    return lib
+
+
+class RecordReader:
+    """Sequential/random-access native record reader."""
+
+    def __init__(self, path):
+        self._lib = _checked(get())
+        self._h = self._lib.mxtpu_recio_reader_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def read(self):
+        buf = ctypes.POINTER(ctypes.c_char)()
+        ln = ctypes.c_uint64()
+        st = self._lib.mxtpu_recio_reader_next(self._h, ctypes.byref(buf),
+                                               ctypes.byref(ln))
+        if st == 0:
+            return None
+        if st < 0:
+            raise IOError("corrupt recordio stream")
+        return ctypes.string_at(buf, ln.value)
+
+    def read_at(self, pos):
+        buf = ctypes.POINTER(ctypes.c_char)()
+        ln = ctypes.c_uint64()
+        st = self._lib.mxtpu_recio_reader_read_at(self._h, pos,
+                                                  ctypes.byref(buf),
+                                                  ctypes.byref(ln))
+        if st < 0:
+            raise IOError("corrupt recordio stream / bad offset %d" % pos)
+        if st == 0:
+            return None
+        return ctypes.string_at(buf, ln.value)
+
+    def tell(self):
+        return self._lib.mxtpu_recio_reader_tell(self._h)
+
+    def reset(self):
+        self._lib.mxtpu_recio_reader_reset(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.mxtpu_recio_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordWriter:
+    def __init__(self, path):
+        self._lib = _checked(get())
+        self._h = self._lib.mxtpu_recio_writer_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s for writing" % path)
+
+    def tell(self):
+        return self._lib.mxtpu_recio_writer_tell(self._h)
+
+    def write(self, buf):
+        if self._lib.mxtpu_recio_writer_write(self._h, buf, len(buf)) != 0:
+            raise IOError("recordio write failed")
+
+    def close(self):
+        if self._h:
+            self._lib.mxtpu_recio_writer_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PrefetchReader:
+    """Background-thread record reader (bounded queue in C++)."""
+
+    def __init__(self, path, capacity=16):
+        self._lib = _checked(get())
+        self._h = self._lib.mxtpu_prefetch_open(path.encode(), capacity)
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def read(self):
+        buf = ctypes.POINTER(ctypes.c_char)()
+        ln = ctypes.c_uint64()
+        st = self._lib.mxtpu_prefetch_next(self._h, ctypes.byref(buf),
+                                           ctypes.byref(ln))
+        if st == 0:
+            return None
+        if st < 0:
+            raise IOError("corrupt recordio stream")
+        return ctypes.string_at(buf, ln.value)
+
+    def close(self):
+        if self._h:
+            self._lib.mxtpu_prefetch_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def pool_stats():
+    lib = _checked(get())
+    vals = [ctypes.c_uint64() for _ in range(4)]
+    lib.mxtpu_pool_stats(*[ctypes.byref(v) for v in vals])
+    return {"bytes_allocated": vals[0].value, "bytes_live": vals[1].value,
+            "hits": vals[2].value, "misses": vals[3].value}

@@ -1,0 +1,105 @@
+// Host staging-buffer pool.
+//
+// TPU-native equivalent of the reference's pooled storage managers
+// (src/storage/pooled_storage_manager.h:52 GPUPooledStorageManager — best-fit
+// size-class recycling). Device memory is owned by PJRT/XLA in this build;
+// what remains hot on the host is the input-pipeline staging path, which
+// wants recycled, aligned allocations instead of malloc/free per batch.
+//
+// C ABI (ctypes): mxtpu_pool_* — 64-byte aligned blocks recycled by
+// round-up-to-power-of-two size class, like the reference's "Round" pool
+// (GPUPooledRoundedStorageManager pooled_storage_manager.h:206).
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  std::mutex mu;
+  // size-class (power of two) -> free blocks
+  std::map<size_t, std::vector<void*>> free_blocks;
+  // live ptr -> size-class
+  std::unordered_map<void*, size_t> live;
+  size_t bytes_allocated = 0;  // cumulative from the OS
+  size_t bytes_live = 0;
+  size_t hits = 0, misses = 0;
+
+  ~Pool() {
+    for (auto& kv : free_blocks)
+      for (void* p : kv.second) std::free(p);
+  }
+};
+
+Pool g_pool;
+
+size_t round_class(size_t n) {
+  size_t c = 64;
+  while (c < n) c <<= 1;
+  return c;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_pool_alloc(size_t nbytes) {
+  size_t cls = round_class(nbytes);
+  std::lock_guard<std::mutex> lock(g_pool.mu);
+  auto it = g_pool.free_blocks.find(cls);
+  void* p = nullptr;
+  if (it != g_pool.free_blocks.end() && !it->second.empty()) {
+    p = it->second.back();
+    it->second.pop_back();
+    g_pool.hits++;
+  } else {
+    if (posix_memalign(&p, 64, cls) != 0) return nullptr;
+    g_pool.bytes_allocated += cls;
+    g_pool.misses++;
+  }
+  g_pool.live[p] = cls;
+  g_pool.bytes_live += cls;
+  return p;
+}
+
+void mxtpu_pool_free(void* p) {
+  if (p == nullptr) return;
+  std::lock_guard<std::mutex> lock(g_pool.mu);
+  auto it = g_pool.live.find(p);
+  if (it == g_pool.live.end()) {
+    // unknown pointer (foreign alloc or double free): ignore — freeing here
+    // would corrupt the heap if the block is already back in free_blocks
+    return;
+  }
+  size_t cls = it->second;
+  g_pool.live.erase(it);
+  g_pool.bytes_live -= cls;
+  g_pool.free_blocks[cls].push_back(p);
+}
+
+// release cached free blocks back to the OS (reference: DirectFree /
+// empty_cache semantics, storage.cc)
+void mxtpu_pool_trim() {
+  std::lock_guard<std::mutex> lock(g_pool.mu);
+  for (auto& kv : g_pool.free_blocks) {
+    for (void* p : kv.second) {
+      std::free(p);
+      g_pool.bytes_allocated -= kv.first;
+    }
+    kv.second.clear();
+  }
+}
+
+void mxtpu_pool_stats(uint64_t* allocated, uint64_t* live, uint64_t* hits,
+                      uint64_t* misses) {
+  std::lock_guard<std::mutex> lock(g_pool.mu);
+  *allocated = g_pool.bytes_allocated;
+  *live = g_pool.bytes_live;
+  *hits = g_pool.hits;
+  *misses = g_pool.misses;
+}
+
+}  // extern "C"

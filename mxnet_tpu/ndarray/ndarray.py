@@ -35,22 +35,6 @@ __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
            "arange", "concat", "save", "load", "waitall", "from_jax"]
 
 
-def _sig_params(fn):
-    try:
-        sig = inspect.signature(fn)
-    except (TypeError, ValueError):
-        return [], False
-    names = []
-    var_pos = False
-    for p in sig.parameters.values():
-        if p.kind == inspect.Parameter.VAR_POSITIONAL:
-            var_pos = True
-        elif p.kind in (inspect.Parameter.POSITIONAL_ONLY,
-                        inspect.Parameter.POSITIONAL_OR_KEYWORD):
-            names.append(p.name)
-    return names, var_pos
-
-
 class NDArray:
     """Multi-dimensional array on a device (reference: ndarray.h:82)."""
 

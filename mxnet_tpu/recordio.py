@@ -35,12 +35,22 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        from .lib import native as _native
+
+        self.handle = None
+        self._native = None
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
+            if _native.available() and not os.environ.get("MXTPU_PY_RECORDIO"):
+                self._native = _native.RecordWriter(self.uri)
+            else:
+                self.handle = open(self.uri, "wb")
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
+            if _native.available() and not os.environ.get("MXTPU_PY_RECORDIO"):
+                self._native = _native.RecordReader(self.uri)
+            else:
+                self.handle = open(self.uri, "rb")
         else:
             raise MXNetError("Invalid flag %s" % self.flag)
         self.pid = os.getpid()
@@ -48,7 +58,12 @@ class MXRecordIO:
 
     def close(self):
         if self.is_open:
-            self.handle.close()
+            if self._native is not None:
+                self._native.close()
+                self._native = None
+            if self.handle is not None:
+                self.handle.close()
+                self.handle = None
             self.is_open = False
             self.pid = None
 
@@ -61,6 +76,7 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("handle", None)
+        d.pop("_native", None)
         return d
 
     def __setstate__(self, d):
@@ -68,14 +84,22 @@ class MXRecordIO:
         self.open()
 
     def reset(self):
+        if self._native is not None and not self.writable:
+            self._native.reset()
+            return
         self.close()
         self.open()
 
     def tell(self):
+        if self._native is not None:
+            return self._native.tell()
         return self.handle.tell()
 
     def write(self, buf):
         assert self.writable
+        if self._native is not None:
+            self._native.write(bytes(buf))
+            return
         lrec = len(buf) & _LEN_MASK
         self.handle.write(struct.pack("<II", _MAGIC, lrec))
         self.handle.write(buf)
@@ -85,6 +109,8 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self._native is not None:
+            return self._native.read()
         head = self.handle.read(8)
         if len(head) < 8:
             return None
@@ -133,9 +159,24 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
-        self.handle.seek(self.idx[idx])
+        if self._native is not None:
+            # a subsequent read() must serve this position (same contract as
+            # the python handle.seek path)
+            self._pending_pos = self.idx[idx]
+        else:
+            self.handle.seek(self.idx[idx])
+
+    def read(self):
+        if self._native is not None and not self.writable \
+                and getattr(self, "_pending_pos", None) is not None:
+            pos, self._pending_pos = self._pending_pos, None
+            return self._native.read_at(pos)
+        return super().read()
 
     def read_idx(self, idx):
+        if self._native is not None:
+            self._pending_pos = None
+            return self._native.read_at(self.idx[idx])
         self.seek(idx)
         return self.read()
 

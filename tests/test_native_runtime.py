@@ -1,0 +1,134 @@
+"""Native C++ runtime tests: recordio roundtrip through the native library,
+python/native format interop, threaded prefetch reader, buffer pool.
+(Reference strategy: tests/cpp/storage_test.cc + recordio tests in
+dmlc-core; here driven from Python through the ctypes surface.)"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.lib import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def _write_records(path, records, force_python=False):
+    if force_python:
+        os.environ["MXTPU_PY_RECORDIO"] = "1"
+    try:
+        w = recordio.MXRecordIO(path, "w")
+        for r in records:
+            w.write(r)
+        w.close()
+    finally:
+        os.environ.pop("MXTPU_PY_RECORDIO", None)
+
+
+def _read_records(path, force_python=False):
+    if force_python:
+        os.environ["MXTPU_PY_RECORDIO"] = "1"
+    try:
+        r = recordio.MXRecordIO(path, "r")
+        out = []
+        while True:
+            rec = r.read()
+            if rec is None:
+                break
+            out.append(rec)
+        r.close()
+        return out
+    finally:
+        os.environ.pop("MXTPU_PY_RECORDIO", None)
+
+
+RECORDS = [b"hello", b"x" * 1, b"y" * 7, b"z" * 1024, b"", b"tail"]
+
+
+def test_native_roundtrip(tmp_path):
+    p = str(tmp_path / "a.rec")
+    _write_records(p, RECORDS)
+    assert _read_records(p) == RECORDS
+
+
+def test_python_writes_native_reads(tmp_path):
+    p = str(tmp_path / "b.rec")
+    _write_records(p, RECORDS, force_python=True)
+    assert _read_records(p) == RECORDS
+
+
+def test_native_writes_python_reads(tmp_path):
+    p = str(tmp_path / "c.rec")
+    _write_records(p, RECORDS)
+    assert _read_records(p, force_python=True) == RECORDS
+
+
+def test_indexed_random_access(tmp_path):
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(20):
+        w.write_idx(i, ("record-%d" % i).encode() * (i + 1))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(7) == b"record-7" * 8
+    assert r.read_idx(0) == b"record-0"
+    assert r.read_idx(19) == b"record-19" * 20
+    r.close()
+
+
+def test_prefetch_reader(tmp_path):
+    p = str(tmp_path / "e.rec")
+    records = [os.urandom(np.random.randint(1, 2048)) for _ in range(200)]
+    _write_records(p, records)
+    pf = native.PrefetchReader(p, capacity=8)
+    got = []
+    while True:
+        rec = pf.read()
+        if rec is None:
+            break
+        got.append(rec)
+    pf.close()
+    assert got == records
+
+
+def test_pack_unpack_through_native(tmp_path):
+    p = str(tmp_path / "f.rec")
+    header = recordio.IRHeader(0, 3.0, 42, 0)
+    payload = b"imagebytes"
+    w = recordio.MXRecordIO(p, "w")
+    w.write(recordio.pack(header, payload))
+    w.close()
+    r = recordio.MXRecordIO(p, "r")
+    h, s = recordio.unpack(r.read())
+    r.close()
+    assert h.label == 3.0 and h.id == 42 and s == payload
+
+
+def test_buffer_pool():
+    lib = native._checked(native.get())
+    import ctypes
+
+    p1 = lib.mxtpu_pool_alloc(1000)
+    assert p1
+    ctypes.memset(p1, 0xAB, 1000)
+    lib.mxtpu_pool_free(p1)
+    p2 = lib.mxtpu_pool_alloc(900)  # same 1024 size-class -> recycled
+    stats = native.pool_stats()
+    assert stats["hits"] >= 1
+    lib.mxtpu_pool_free(p2)
+    lib.mxtpu_pool_trim()
+    stats = native.pool_stats()
+    assert stats["bytes_live"] == 0
+
+
+def test_reset_native_reader(tmp_path):
+    p = str(tmp_path / "g.rec")
+    _write_records(p, RECORDS)
+    r = recordio.MXRecordIO(p, "r")
+    assert r.read() == RECORDS[0]
+    r.reset()
+    assert r.read() == RECORDS[0]
+    r.close()
