@@ -405,3 +405,14 @@ def svm_output(data, label, margin=1.0, regularization_coefficient=1.0, use_line
 
     f.defvjp(fwd, bwd)
     return f(data, label)
+
+
+@register("_contrib_flash_attention", aliases=("flash_attention",))
+def _contrib_flash_attention(q, k, v, causal=False, sm_scale=None):
+    """Pallas flash attention over (..., L, D) inputs (ops/pallas_kernels.py;
+    the TPU replacement for batch_dot+softmax+batch_dot attention assembled
+    from reference primitives, src/operator/contrib/transformer.cc)."""
+    from . import pallas_kernels
+
+    return pallas_kernels.flash_attention(q, k, v, causal=causal,
+                                          sm_scale=sm_scale)

@@ -1,0 +1,185 @@
+"""Pallas TPU kernels.
+
+The reference ships hand-written CUDA where library kernels fall short
+(src/operator/contrib/transformer.cu, fused RNN rnn-inl.h); the TPU-native
+equivalent is Pallas. This module holds the kernels where XLA fusion alone
+is insufficient — flash attention first: XLA materializes the (Lq, Lk)
+score matrix in HBM, while the flash kernel streams K/V blocks through VMEM
+with an online softmax, keeping the working set on-chip (HBM traffic
+O(L·D) instead of O(L²)).
+
+On non-TPU backends the same kernels run in interpret mode, so tests and
+CPU development use one code path (the strategy SURVEY §4 prescribes for
+cross-backend consistency).
+
+Backward: recompute-based — the vjp of a plain jnp reference attention
+(jax.checkpoint-style rematerialization). A Pallas backward kernel is the
+round-2 upgrade; forward is where inference/serving time goes.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _use_interpret():
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _attention_reference(q, k, v, causal, sm_scale):
+    """Plain jnp attention (the vjp source for backward; also the numerics
+    oracle in tests)."""
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        row = jnp.arange(lq)[:, None]
+        col = jnp.arange(lk)[None, :]
+        s = jnp.where(col <= row, s, _NEG_INF)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, lq, lk,
+                block_q, block_k, n_kblocks):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (block_q, D)
+    d = q.shape[-1]
+
+    row_ids = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        col_ids = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col_ids < lk
+        if causal:
+            mask = jnp.logical_and(mask, col_ids <= row_ids)
+        s = jnp.where(mask, s, _NEG_INF)
+        new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return new_m, l, acc
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    # causal: blocks strictly above the diagonal contribute nothing — still
+    # iterated (masked) to keep the grid static; XLA pipelines the DMA anyway
+    m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=256)
+def _fwd_compiled(shape_key):
+    (bh, lq, lk, d, dtype, causal, sm_scale, interpret) = shape_key
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block_q = min(128, lq)
+    block_k = min(128, lk)
+    n_q = -(-lq // block_q)
+    n_k = -(-lk // block_k)
+    lq_pad, lk_pad = n_q * block_q, n_k * block_k
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               lq=lq, lk=lk, block_q=block_q, block_k=block_k,
+                               n_kblocks=n_k)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, d), _np.dtype(dtype)),
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, lk_pad, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, lk_pad, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+
+    def run(q, k, v):
+        qp = jnp.pad(q, ((0, 0), (0, lq_pad - lq), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, lk_pad - lk), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, lk_pad - lk), (0, 0)))
+        return call(qp, kp, vp)[:, :lq, :]
+
+    return run
+
+
+def _flash_fwd(q, k, v, causal, sm_scale):
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    run = _fwd_compiled((bh, lq, lk, d, str(q.dtype), bool(causal),
+                         float(sm_scale), _use_interpret()))
+    return run(q, k, v)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None):
+    """Flash attention over (..., L, D) tensors (leading dims are batched).
+
+    TPU-native replacement for attention assembled from the reference's
+    primitive ops (batch_dot + softmax + batch_dot, e.g.
+    src/operator/contrib/transformer.cc usage); same math, O(L·D) HBM
+    traffic. Differentiable via recompute-vjp.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if sm_scale is None:
+        sm_scale = 1.0 / float(_np.sqrt(q.shape[-1]))
+    sm_scale = float(sm_scale)
+
+    lead = q.shape[:-2]
+    lq, d = q.shape[-2:]
+    lk = k.shape[-2]
+    qf = q.reshape((-1, lq, d))
+    kf = k.reshape((-1, lk, d))
+    vf = v.reshape((-1, lk, d))
+
+    @jax.custom_vjp
+    def attn(qf, kf, vf):
+        return _flash_fwd(qf, kf, vf, causal, sm_scale)
+
+    def fwd(qf, kf, vf):
+        return attn(qf, kf, vf), (qf, kf, vf)
+
+    def bwd(res, g):
+        qf, kf, vf = res
+        _, pull = jax.vjp(
+            lambda a, b, c: _attention_reference(a, b, c, causal, sm_scale),
+            qf, kf, vf)
+        return pull(g)
+
+    attn.defvjp(fwd, bwd)
+    return attn(qf, kf, vf).reshape(lead + (lq, d))

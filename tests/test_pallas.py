@@ -1,0 +1,111 @@
+"""Pallas kernel tests: flash attention vs the jnp reference oracle across
+shapes/causality/dtypes; gradient equivalence (reference strategy:
+check_consistency, SURVEY §4 — here flash-vs-reference is the backend pair)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import pallas_kernels as pk
+
+
+def _ref(q, k, v, causal, scale=None):
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    lead = q.shape[:-2]
+    qf = q.reshape((-1,) + q.shape[-2:])
+    kf = k.reshape((-1,) + k.shape[-2:])
+    vf = v.reshape((-1,) + v.shape[-2:])
+    out = pk._attention_reference(jnp.asarray(qf), jnp.asarray(kf),
+                                  jnp.asarray(vf), causal, scale)
+    return np.asarray(out).reshape(lead + q.shape[-2:])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 3, 64, 32), (1, 2, 100, 16)])
+def test_flash_matches_reference(causal, shape):
+    np.random.seed(0)
+    q = np.random.normal(size=shape).astype(np.float32)
+    k = np.random.normal(size=shape).astype(np.float32)
+    v = np.random.normal(size=shape).astype(np.float32)
+    import jax.numpy as jnp
+
+    out = pk.flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                             causal=causal)
+    np.testing.assert_allclose(np.asarray(out), _ref(q, k, v, causal),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_cross_attention_lengths():
+    np.random.seed(1)
+    import jax.numpy as jnp
+
+    q = jnp.asarray(np.random.normal(size=(2, 40, 16)).astype(np.float32))
+    k = jnp.asarray(np.random.normal(size=(2, 70, 16)).astype(np.float32))
+    v = jnp.asarray(np.random.normal(size=(2, 70, 16)).astype(np.float32))
+    out = pk.flash_attention(q, k, v)
+    ref = pk._attention_reference(q, k, v, False, 1.0 / np.sqrt(16))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_flash_gradients():
+    import jax
+    import jax.numpy as jnp
+
+    np.random.seed(2)
+    q = jnp.asarray(np.random.normal(size=(1, 2, 32, 16)).astype(np.float32))
+    k = jnp.asarray(np.random.normal(size=(1, 2, 32, 16)).astype(np.float32))
+    v = jnp.asarray(np.random.normal(size=(1, 2, 32, 16)).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        qf, kf, vf = (a.reshape((-1,) + a.shape[-2:]) for a in (q, k, v))
+        o = pk._attention_reference(qf, kf, vf, True, 1.0 / np.sqrt(16))
+        return jnp.sum(o ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-3)
+
+
+def test_flash_bf16():
+    import jax.numpy as jnp
+
+    np.random.seed(3)
+    q = jnp.asarray(np.random.normal(size=(2, 64, 32)), dtype=jnp.bfloat16)
+    k = jnp.asarray(np.random.normal(size=(2, 64, 32)), dtype=jnp.bfloat16)
+    v = jnp.asarray(np.random.normal(size=(2, 64, 32)), dtype=jnp.bfloat16)
+    out = pk.flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = pk._attention_reference(q, k, v, False, 1.0 / np.sqrt(32))
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref, dtype=np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_flash_as_nd_op():
+    np.random.seed(4)
+    q = mx.nd.array(np.random.normal(size=(2, 2, 32, 16)).astype(np.float32))
+    k = mx.nd.array(np.random.normal(size=(2, 2, 32, 16)).astype(np.float32))
+    v = mx.nd.array(np.random.normal(size=(2, 2, 32, 16)).astype(np.float32))
+    out = mx.nd.contrib.flash_attention(q, k, v, causal=True)
+    ref = _ref(q.asnumpy(), k.asnumpy(), v.asnumpy(), True)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_flash_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    q = jnp.asarray(np.random.normal(size=(2, 32, 16)).astype(np.float32))
+    f = jax.jit(lambda q: pk.flash_attention(q, q, q))
+    out = f(q)
+    ref = pk._attention_reference(q, q, q, False, 1.0 / np.sqrt(16))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
+                               atol=2e-3)
