@@ -1,0 +1,298 @@
+"""ONNX import/export.
+
+Reference: python/mxnet/contrib/onnx/ (onnx2mx/import_model.py:24,
+mx2onnx/export_model.py:35 + per-op translation tables). Like the
+reference, this module requires the `onnx` package at call time; the
+translation tables cover the common CNN/MLP subset (Gemm/Conv/BN/Relu/
+Pool/Reshape/Softmax and elementwise) and raise clearly on anything else.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["import_model", "export_model", "get_model_metadata"]
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+
+        return onnx
+    except ImportError:
+        raise ImportError(
+            "ONNX support requires the `onnx` package (reference gates the "
+            "same way, contrib/onnx/__init__.py); it is not installed in "
+            "this environment")
+
+
+# -- import ---------------------------------------------------------------
+
+_IMPORT_OPS = {}
+
+
+def _imports(name):
+    def deco(fn):
+        _IMPORT_OPS[name] = fn
+        return fn
+
+    return deco
+
+
+def _symmetric_pads(attrs, what):
+    """ONNX pads = (h_begin, w_begin, h_end, w_end); only symmetric padding
+    maps onto the framework's `pad` attr — raise on the rest instead of
+    silently importing wrong geometry."""
+    pads = tuple(attrs.get("pads", (0, 0, 0, 0)))
+    if len(pads) == 2:
+        return pads
+    if len(pads) == 4:
+        if pads[0] != pads[2] or pads[1] != pads[3]:
+            raise MXNetError("%s: asymmetric ONNX pads %s are not supported"
+                             % (what, (pads,)))
+        return pads[:2]
+    raise MXNetError("%s: unsupported pads rank %d" % (what, len(pads)))
+
+
+@_imports("Gemm")
+def _gemm(sym_mod, inputs, attrs, params):
+    if attrs.get("transA", 0) != 0:
+        raise MXNetError("Gemm with transA=1 is not supported")
+    if attrs.get("alpha", 1.0) != 1.0 or attrs.get("beta", 1.0) != 1.0:
+        raise MXNetError("Gemm with alpha/beta != 1 is not supported")
+    data, w, b = inputs[0], inputs[1], inputs[2] if len(inputs) > 2 else None
+    wshape = params[w.name].shape
+    if not attrs.get("transB", 0):
+        # ONNX default stores weight (K, N); FullyConnected wants (N, K) —
+        # transpose the initializer once at import
+        params[w.name] = _np.ascontiguousarray(params[w.name].T)
+        wshape = params[w.name].shape
+    return sym_mod.FullyConnected(data=data, weight=w, bias=b,
+                                  num_hidden=wshape[0], no_bias=b is None)
+
+
+@_imports("Conv")
+def _conv(sym_mod, inputs, attrs, params):
+    kernel = tuple(attrs.get("kernel_shape", ()))
+    strides = tuple(attrs.get("strides", (1, 1)))
+    pads = _symmetric_pads(attrs, "Conv")
+    if tuple(attrs.get("dilations", (1, 1))) not in ((), (1, 1)):
+        raise MXNetError("Conv with dilations != 1 is not supported")
+    w = inputs[1]
+    return sym_mod.Convolution(data=inputs[0], weight=w,
+                               bias=inputs[2] if len(inputs) > 2 else None,
+                               kernel=kernel, stride=strides, pad=pads,
+                               num_filter=params[w.name].shape[0],
+                               no_bias=len(inputs) <= 2)
+
+
+@_imports("Relu")
+def _relu(sym_mod, inputs, attrs, params):
+    return sym_mod.relu(inputs[0])
+
+
+@_imports("MaxPool")
+def _maxpool(sym_mod, inputs, attrs, params):
+    return sym_mod.Pooling(inputs[0], kernel=tuple(attrs["kernel_shape"]),
+                           stride=tuple(attrs.get("strides", (1, 1))),
+                           pad=_symmetric_pads(attrs, "MaxPool"),
+                           pool_type="max")
+
+
+@_imports("AveragePool")
+def _avgpool(sym_mod, inputs, attrs, params):
+    return sym_mod.Pooling(inputs[0], kernel=tuple(attrs["kernel_shape"]),
+                           stride=tuple(attrs.get("strides", (1, 1))),
+                           pad=_symmetric_pads(attrs, "AveragePool"),
+                           pool_type="avg")
+
+
+@_imports("GlobalAveragePool")
+def _gavgpool(sym_mod, inputs, attrs, params):
+    return sym_mod.Pooling(inputs[0], kernel=(1, 1), global_pool=True,
+                           pool_type="avg")
+
+
+@_imports("Softmax")
+def _softmax(sym_mod, inputs, attrs, params):
+    return sym_mod.softmax(inputs[0], axis=attrs.get("axis", -1))
+
+
+@_imports("Flatten")
+def _flatten(sym_mod, inputs, attrs, params):
+    return sym_mod.Flatten(inputs[0])
+
+
+@_imports("Reshape")
+def _reshape(sym_mod, inputs, attrs, params):
+    shape = attrs.get("shape")
+    return sym_mod.Reshape(inputs[0], shape=tuple(shape))
+
+
+@_imports("Add")
+def _add(sym_mod, inputs, attrs, params):
+    return inputs[0] + inputs[1]
+
+
+@_imports("Mul")
+def _mul(sym_mod, inputs, attrs, params):
+    return inputs[0] * inputs[1]
+
+
+@_imports("BatchNormalization")
+def _bn(sym_mod, inputs, attrs, params):
+    return sym_mod.BatchNorm(data=inputs[0], gamma=inputs[1], beta=inputs[2],
+                             moving_mean=inputs[3], moving_var=inputs[4],
+                             eps=attrs.get("epsilon", 1e-5),
+                             momentum=attrs.get("momentum", 0.9))
+
+
+def import_model(model_file):
+    """ONNX file -> (sym, arg_params, aux_params) (reference:
+    onnx2mx/import_model.py:24)."""
+    onnx = _require_onnx()
+    from onnx import numpy_helper
+
+    from .. import ndarray as nd
+    from .. import symbol as sym_mod
+
+    model = onnx.load(model_file)
+    graph = model.graph
+    params = {init.name: _np.asarray(numpy_helper.to_array(init))
+              for init in graph.initializer}
+    tensors = {}
+    for inp in graph.input:
+        if inp.name not in params:
+            tensors[inp.name] = sym_mod.var(inp.name)
+    for name in params:
+        tensors[name] = sym_mod.var(name)
+
+    def get_attrs(node):
+        out = {}
+        for a in node.attribute:
+            out[a.name] = onnx.helper.get_attribute_value(a)
+        return out
+
+    for node in graph.node:
+        if node.op_type not in _IMPORT_OPS:
+            raise MXNetError("ONNX op '%s' is not supported by the importer"
+                             % node.op_type)
+        ins = [tensors[i] for i in node.input if i]
+        out = _IMPORT_OPS[node.op_type](sym_mod, ins, get_attrs(node), params)
+        outs = [out] if not isinstance(out, (list, tuple)) else out
+        for name, o in zip(node.output, outs):
+            tensors[name] = o
+    final = tensors[graph.output[0].name]
+    arg_names = set(final.list_arguments())
+    aux_names = set(final.list_auxiliary_states())
+    arg_params = {k: nd.array(v) for k, v in params.items() if k in arg_names}
+    aux_params = {k: nd.array(v) for k, v in params.items() if k in aux_names}
+    return final, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    onnx = _require_onnx()
+
+    model = onnx.load(model_file)
+    init = {i.name for i in model.graph.initializer}
+    return {
+        "input_tensor_data": [(i.name, tuple(d.dim_value for d in
+                                             i.type.tensor_type.shape.dim))
+                              for i in model.graph.input if i.name not in init],
+        "output_tensor_data": [(o.name, tuple(d.dim_value for d in
+                                              o.type.tensor_type.shape.dim))
+                               for o in model.graph.output],
+    }
+
+
+# -- export ---------------------------------------------------------------
+
+def export_model(sym, params, input_shape, input_type=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Symbol + params -> ONNX file (reference: mx2onnx/export_model.py:35).
+    Covers the same CNN/MLP op subset as the importer."""
+    onnx = _require_onnx()
+    from onnx import TensorProto, helper, numpy_helper
+
+    params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v))
+              for k, v in params.items()}
+    nodes, initializers = [], []
+    name_of = {}
+
+    def edge_name(node, idx):
+        base = name_of[id(node)]
+        return base if idx == 0 else "%s_out%d" % (base, idx)
+
+    topo = list(sym._topo())
+    inputs_proto = []
+    for node in topo:
+        if node.is_var:
+            name_of[id(node)] = node.name
+            if node.name in params:
+                initializers.append(
+                    numpy_helper.from_array(
+                        params[node.name].astype(_np.float32), node.name))
+            else:
+                shape = list(input_shape) if not isinstance(input_shape, dict) \
+                    else list(input_shape[node.name])
+                inputs_proto.append(helper.make_tensor_value_info(
+                    node.name, TensorProto.FLOAT, shape))
+            continue
+        name_of[id(node)] = node.name
+        ins = [edge_name(s, i) for s, i in node.inputs]
+        a = node.attrs
+        if node.op == "FullyConnected":
+            nodes.append(helper.make_node("Gemm", ins[:3], [node.name],
+                                          transB=1))
+        elif node.op == "Convolution":
+            nodes.append(helper.make_node(
+                "Conv", ins[:3] if not a.get("no_bias") else ins[:2],
+                [node.name], kernel_shape=list(a.get("kernel", ())),
+                strides=list(a.get("stride", (1, 1)) or (1, 1)),
+                pads=list(a.get("pad", (0, 0)) or (0, 0)) * 2))
+        elif node.op in ("relu", "Activation") and \
+                a.get("act_type", "relu") == "relu":
+            nodes.append(helper.make_node("Relu", ins[:1], [node.name]))
+        elif node.op == "Pooling":
+            kind = "MaxPool" if a.get("pool_type", "max") == "max" \
+                else "AveragePool"
+            if a.get("global_pool"):
+                nodes.append(helper.make_node("GlobalAveragePool", ins[:1],
+                                              [node.name]))
+            else:
+                nodes.append(helper.make_node(
+                    kind, ins[:1], [node.name],
+                    kernel_shape=list(a.get("kernel", ())),
+                    strides=list(a.get("stride", (1, 1)) or (1, 1))))
+        elif node.op == "Flatten":
+            nodes.append(helper.make_node("Flatten", ins[:1], [node.name]))
+        elif node.op in ("softmax", "SoftmaxOutput"):
+            nodes.append(helper.make_node("Softmax", ins[:1], [node.name]))
+        elif node.op == "elemwise_add":
+            nodes.append(helper.make_node("Add", ins[:2], [node.name]))
+        elif node.op == "elemwise_mul":
+            nodes.append(helper.make_node("Mul", ins[:2], [node.name]))
+        elif node.op == "BatchNorm":
+            nodes.append(helper.make_node(
+                "BatchNormalization", ins[:5], [node.name],
+                epsilon=float(a.get("eps", 1e-5)),
+                momentum=float(a.get("momentum", 0.9))))
+        elif node.op == "Reshape":
+            shape_name = node.name + "_shape"
+            initializers.append(numpy_helper.from_array(
+                _np.asarray(a.get("shape", ()), dtype=_np.int64), shape_name))
+            nodes.append(helper.make_node("Reshape", [ins[0], shape_name],
+                                          [node.name]))
+        else:
+            raise MXNetError("ONNX export: op '%s' not supported" % node.op)
+
+    out_node, out_idx = sym._outputs[0]
+    graph = helper.make_graph(
+        nodes, "mxnet_tpu_model", inputs_proto,
+        [helper.make_tensor_value_info(edge_name(out_node, out_idx),
+                                       TensorProto.FLOAT, None)],
+        initializer=initializers)
+    model = helper.make_model(graph)
+    onnx.save(model, onnx_file_path)
+    return onnx_file_path

@@ -1,0 +1,173 @@
+"""Model quantization driver.
+
+TPU-native equivalent of the reference's `python/mxnet/contrib/quantization.py`
+(`quantize_model` :422 — graph pass src/operator/quantization/
+quantize_graph_pass.cc + calibration). The pass rewrites FullyConnected /
+Convolution nodes into quantize_v2 -> quantized_* (int8 MXU dot) ->
+dequantize chains. Calibration modes:
+
+- 'none'   — runtime min/max per batch (quantize_v2 without calib ranges)
+- 'naive'  — exact min/max of each quantized input collected over the
+             calibration set (reference: collect_layer_output_min_max)
+- 'entropy'— percentile-clipped ranges (99.99th |value|), a light-weight
+             stand-in for the reference's KL-divergence threshold search
+             (documented divergence; same API)
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..symbol.symbol import Symbol, _Node
+
+__all__ = ["quantize_model", "quantize_graph"]
+
+_QUANTIZABLE = {"FullyConnected", "Convolution"}
+
+
+def _can_quantize(node):
+    """Conv variants the int8 kernel doesn't cover stay fp32 (reference
+    skips them in quantize_graph_pass.cc the same way)."""
+    if node.op == "Convolution":
+        dil = tuple(node.attrs.get("dilate") or (1, 1))
+        ng = int(node.attrs.get("num_group") or 1)
+        if dil not in ((), (1, 1)) or ng != 1:
+            return False
+    return True
+
+
+def _collect_ranges(sym, arg_params, aux_params, calib_data,
+                    num_calib_examples, mode, data_names=("data",),
+                    label_names=("softmax_label",)):
+    """Run calibration batches through every internal output, returning
+    {(node_id, out_idx): (min, max)} (reference:
+    _LayerOutputMinMaxCollector)."""
+    internals = sym.get_internals()
+    samples = {}
+    seen = 0
+    for batch in calib_data:
+        values = {}
+        for name, arr in zip(calib_data.provide_data, batch.data):
+            values[name.name if hasattr(name, "name") else name[0]] = arr
+        for name, arr in zip(getattr(calib_data, "provide_label", []) or [],
+                             batch.label or []):
+            values[name.name if hasattr(name, "name") else name[0]] = arr
+        values.update(arg_params)
+        values.update(aux_params)
+        outs, _ = internals._interpret(
+            {k: (v._data if hasattr(v, "_data") else v)
+             for k, v in values.items()})
+        for (node, idx), out in zip(internals._outputs, outs):
+            a = _np.asarray(out)
+            key = (id(node), idx)
+            if mode == "entropy":
+                flat = _np.abs(a.reshape(-1))
+                thr = float(_np.percentile(flat, 99.99)) if flat.size else 0.0
+                mn, mx = -thr, thr
+            else:
+                mn, mx = float(a.min()), float(a.max())
+            if key in samples:
+                omn, omx = samples[key]
+                samples[key] = (min(omn, mn), max(omx, mx))
+            else:
+                samples[key] = (mn, mx)
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    calib_data.reset()
+    return samples
+
+
+def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
+                   weight_ranges=None, quantized_dtype="int8"):
+    """Rewrite the graph, returning the quantized Symbol (reference:
+    quantize_graph_pass.cc QuantizeGraph)."""
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is supported (reference "
+                         "uint8 path is MKLDNN-specific)")
+    excluded = set(excluded_sym_names)
+    calib_ranges = calib_ranges or {}
+    mapping = {}  # id(old node) -> new node
+
+    def new_edge(old_node, idx):
+        return (mapping[id(old_node)], idx)
+
+    for node in sym._topo():
+        if node.is_var:
+            n = _Node(None, node.name, dict(node.attrs))
+            n._shape, n._dtype = node._shape, node._dtype
+            mapping[id(node)] = n
+            continue
+        if node.op in _QUANTIZABLE and node.name not in excluded \
+                and _can_quantize(node):
+            data_edge = node.inputs[0]
+            w_edge = node.inputs[1]
+            no_bias = bool(node.attrs.get("no_bias", False))
+            b_edge = None if (no_bias or len(node.inputs) < 3) else node.inputs[2]
+
+            cal = calib_ranges.get((id(data_edge[0]), data_edge[1]))
+            qattrs = {}
+            if cal is not None:
+                qattrs = {"min_calib_range": cal[0], "max_calib_range": cal[1]}
+            qdata = _Node("_contrib_quantize_v2", node.name + "_quantize",
+                          qattrs, [new_edge(*data_edge)])
+            qweight = _Node("_contrib_quantize_v2", node.name + "_qweight",
+                            {}, [new_edge(*w_edge)])
+            qop = "_contrib_quantized_fully_connected" \
+                if node.op == "FullyConnected" else "_contrib_quantized_conv"
+            qin = [(qdata, 0), (qweight, 0)]
+            # bias (fp32; quantized inside the op) or a zero placeholder
+            if b_edge is not None:
+                qin.append(new_edge(*b_edge))
+            # only the attrs the quantized kernels understand survive
+            # (reference filters the same way in quantize_graph_pass.cc)
+            keep = ("num_hidden", "no_bias", "flatten") \
+                if node.op == "FullyConnected" \
+                else ("kernel", "stride", "pad", "num_filter", "no_bias")
+            attrs = {k: v for k, v in node.attrs.items() if k in keep}
+            attrs["no_bias"] = b_edge is None
+            if b_edge is None:
+                # quantized op signature has a bias slot; reuse weight as a
+                # dummy — no_bias=True means it is never read
+                qin.append((qweight, 0))
+            qin += [(qdata, 1), (qdata, 2), (qweight, 1), (qweight, 2)]
+            qnode = _Node(qop, node.name + "_quantized", attrs, qin)
+            deq = _Node("_contrib_dequantize", node.name + "_dequantize", {},
+                        [(qnode, 0), (qnode, 1), (qnode, 2)])
+            mapping[id(node)] = deq
+        else:
+            n = _Node(node.op, node.name, dict(node.attrs),
+                      [new_edge(*e) for e in node.inputs], node.aux_slots)
+            mapping[id(node)] = n
+    outs = [(mapping[id(n)], i) for n, i in sym._outputs]
+    return Symbol(outs)
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=(), calib_mode="none", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8",
+                   logger=logging):
+    """reference: contrib/quantization.py:422 quantize_model. Returns
+    (quantized_sym, arg_params, aux_params) — weights stay fp32 in the
+    param dict and are quantized in-graph (XLA folds them at jit time)."""
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError("calib_mode must be none/naive/entropy")
+    calib_ranges = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_data required for calib_mode=%s" % calib_mode)
+        arg_j = {k: (v._data if hasattr(v, "_data") else v)
+                 for k, v in arg_params.items()}
+        aux_j = {k: (v._data if hasattr(v, "_data") else v)
+                 for k, v in aux_params.items()}
+        calib_ranges = _collect_ranges(sym, arg_j, aux_j, calib_data,
+                                       num_calib_examples, calib_mode,
+                                       data_names, label_names)
+        logger.info("calibrated %d tensors (%s mode)", len(calib_ranges),
+                    calib_mode)
+    qsym = quantize_graph(sym, excluded_sym_names, calib_ranges,
+                          quantized_dtype=quantized_dtype)
+    return qsym, arg_params, aux_params

@@ -1,0 +1,133 @@
+"""Text utilities: vocabulary + token embeddings.
+
+TPU-native equivalent of the reference's `python/mxnet/contrib/text/`
+(vocab.py Vocabulary, embedding.py TokenEmbedding/CustomEmbedding,
+utils.py count_tokens_from_str). Pretrained-embedding downloads are out of
+scope (zero egress); `CustomEmbedding` loads local files in the same
+`token<space>vec` format.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """reference: contrib/text/utils.py count_tokens_from_str."""
+    source_str = source_str.lower() if to_lower else source_str
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    for seq in filter(None, source_str.split(seq_delim)):
+        counter.update(filter(None, seq.split(token_delim)))
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary (reference: contrib/text/vocab.py Vocabulary)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown_token cannot also be reserved")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq >= min_freq and tok != unknown_token \
+                        and tok not in reserved_tokens:
+                    self._idx_to_token.append(tok)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """reference: vocab.py to_indices."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise MXNetError("index %d out of vocabulary range" % i)
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+
+class CustomEmbedding:
+    """Embedding matrix from a local `token vec...` text file (reference:
+    contrib/text/embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None, init_unknown_vec=None):
+        from .. import ndarray as nd
+
+        vectors = {}
+        dim = None
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                vec = _np.asarray([float(x) for x in parts[1:]],
+                                  dtype=_np.float32)
+                dim = len(vec) if dim is None else dim
+                if len(vec) != dim:
+                    raise MXNetError("inconsistent embedding dims in %s"
+                                     % pretrained_file_path)
+                vectors[parts[0]] = vec
+        self.vec_len = dim or 0
+        if vocabulary is None:
+            vocab = Vocabulary(collections.Counter(vectors.keys()), min_freq=1)
+        else:
+            vocab = vocabulary
+        self.vocabulary = vocab
+        table = _np.zeros((len(vocab), self.vec_len), dtype=_np.float32)
+        if init_unknown_vec is not None:
+            table[0] = _np.asarray(init_unknown_vec, dtype=_np.float32)
+        for tok, vec in vectors.items():
+            i = vocab.token_to_idx.get(tok)
+            if i is not None:
+                table[i] = vec
+        self.idx_to_vec = nd.array(table)
+
+    def get_vecs_by_tokens(self, tokens):
+        from .. import ndarray as nd
+
+        idx = self.vocabulary.to_indices(tokens)
+        single = isinstance(idx, int)
+        out = self.idx_to_vec[nd.array([idx] if single else idx, dtype="int32")]
+        return out[0] if single else out

@@ -1,0 +1,200 @@
+"""Transformer encoder + BERT model family.
+
+The reference provides the transformer/BERT *operator* building blocks
+in-tree (LayerNorm src/operator/nn/layer_norm.cc, GELU activation,
+div_sqrt_dim src/operator/contrib/transformer.cc:34) with the model living
+in external GluonNLP; SURVEY §7 phase 6 calls for the model family here.
+TPU-native: attention runs the Pallas flash kernel
+(ops/pallas_kernels.py) when no padding mask is given — O(L·D) HBM traffic —
+and a masked dense path (batch_dot + softmax) when `valid_length` requires
+arbitrary masking. All blocks hybridize.
+"""
+from __future__ import annotations
+
+import math
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .. import nn
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
+           "TransformerEncoder", "BERTModel", "bert_12_768_12", "bert_mini"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head self/cross attention (reference building blocks:
+    contrib/transformer.cc div_sqrt_dim + batch_dot/softmax assembly)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads != 0:
+            raise MXNetError("num_heads (%d) must evenly divide units (%d)"
+                             % (num_heads, units))
+        self._units = units
+        self._num_heads = num_heads
+        with self.name_scope():
+            self.proj_query = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                       prefix="query_")
+            self.proj_key = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                     prefix="key_")
+            self.proj_value = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                       prefix="value_")
+            self.proj_out = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                     prefix="out_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def _split(self, x, batch, length):
+        # (B, L, C) -> (B, H, L, Dh)
+        h = self._num_heads
+        return x.reshape((batch, length, h, self._units // h)) \
+                .transpose((0, 2, 1, 3))
+
+    def hybrid_forward(self, F, query, key=None, value=None, mask=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        b, lq = query.shape[0], query.shape[1]
+        lk = key.shape[1]
+        q = self._split(self.proj_query(query), b, lq)
+        k = self._split(self.proj_key(key), b, lk)
+        v = self._split(self.proj_value(value), b, lk)
+        dh = self._units // self._num_heads
+        if mask is None:
+            out = F.contrib.flash_attention(q, k, v, causal=False,
+                                            sm_scale=1.0 / math.sqrt(dh))
+        else:
+            # masked dense path: scores (B, H, Lq, Lk); mask (B, Lq, Lk)
+            qf = q.reshape((-1, lq, dh))
+            kf = k.reshape((-1, lk, dh))
+            vf = v.reshape((-1, lk, dh))
+            scores = F.batch_dot(qf, kf, transpose_b=True) / math.sqrt(dh)
+            scores = scores.reshape((b, self._num_heads, lq, lk))
+            neg = F.ones_like(scores) * -1e30
+            m = mask.expand_dims(1).broadcast_to(scores.shape)
+            scores = F.where(m > 0, scores, neg)
+            att = scores.reshape((-1, lq, lk)).softmax(axis=-1)
+            out = F.batch_dot(att, vf).reshape(
+                (b, self._num_heads, lq, dh))
+        out = out.transpose((0, 2, 1, 3)).reshape((b, lq, self._units))
+        out = self.proj_out(out)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    """FFN sublayer with GELU (reference op: Activation act_type='gelu')."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn_1 = nn.Dense(hidden_size, flatten=False, prefix="ffn1_")
+            # gelu is a dedicated block (reference reaches it via
+            # LeakyReLU(act_type='gelu'), not Activation)
+            self.activation = nn.GELU() if activation == "gelu" \
+                else nn.Activation(activation)
+            self.ffn_2 = nn.Dense(units, flatten=False, prefix="ffn2_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        out = self.ffn_2(self.activation(self.ffn_1(x)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre-LN-free (post-LN, BERT-style) encoder layer."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads,
+                                                dropout=dropout)
+            self.attention_norm = nn.LayerNorm()
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout)
+            self.ffn_norm = nn.LayerNorm()
+
+    def hybrid_forward(self, F, x, mask=None):
+        out = self.attention_norm(x + self.attention(x, x, x, mask))
+        return self.ffn_norm(out + self.ffn(out))
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, units=512, hidden_size=2048, num_layers=6, num_heads=8,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        with self.name_scope():
+            self.cells = []
+            for i in range(num_layers):
+                cell = TransformerEncoderCell(units, hidden_size, num_heads,
+                                              dropout=dropout,
+                                              prefix="layer%d_" % i)
+                self.register_child(cell)
+                self.cells.append(cell)
+
+    def hybrid_forward(self, F, x, mask=None):
+        for cell in self.cells:
+            x = cell(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """BERT encoder with token/segment/position embeddings + pooler
+    (model family per SURVEY §7 phase 6; ops parity with the reference's
+    LayerNorm/GELU/attention primitives)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 token_types=2, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units, prefix="word_")
+            self.token_type_embed = nn.Embedding(token_types, units,
+                                                 prefix="segment_")
+            self.position_embed = nn.Embedding(max_length, units, prefix="pos_")
+            self.embed_norm = nn.LayerNorm()
+            self.embed_dropout = nn.Dropout(dropout) if dropout else None
+            self.encoder = TransformerEncoder(units=units,
+                                              hidden_size=hidden_size,
+                                              num_layers=num_layers,
+                                              num_heads=num_heads,
+                                              dropout=dropout)
+            self.pooler = nn.Dense(units, activation="tanh", prefix="pooler_")
+
+    def hybrid_forward(self, F, inputs, token_types=None, valid_length=None):
+        """inputs: (B, L) int token ids. Returns (sequence_out (B, L, C),
+        pooled_out (B, C))."""
+        b, l = inputs.shape[0], inputs.shape[1]
+        x = self.word_embed(inputs)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        pos = F.arange(0, l, dtype="int32")
+        x = x + self.position_embed(pos).expand_dims(0)
+        x = self.embed_norm(x)
+        if self.embed_dropout is not None:
+            x = self.embed_dropout(x)
+        mask = None
+        if valid_length is not None:
+            # (B, Lq, Lk) 1/0 mask from per-sample valid lengths
+            steps = F.arange(0, l)
+            mask = (steps.expand_dims(0) <
+                    valid_length.astype("float32").expand_dims(1)) \
+                .expand_dims(1).broadcast_to((b, l, l))
+        seq = self.encoder(x, mask)
+        pooled = self.pooler(seq.slice_axis(1, 0, 1).reshape((b, self._units)))
+        return seq, pooled
+
+
+def bert_12_768_12(vocab_size=30522, **kwargs):
+    """BERT-base geometry."""
+    return BERTModel(vocab_size=vocab_size, units=768, hidden_size=3072,
+                     num_layers=12, num_heads=12, **kwargs)
+
+
+def bert_mini(vocab_size=1000, **kwargs):
+    """Tiny geometry for tests/examples."""
+    return BERTModel(vocab_size=vocab_size, units=64, hidden_size=128,
+                     num_layers=2, num_heads=4, max_length=128, **kwargs)

@@ -1,0 +1,62 @@
+"""Word-level language model (embedding -> LSTM -> decoder).
+
+Reference: example/rnn/word_lm/model.py (the reference's canonical word-LM:
+Embedding + stacked LSTM + FullyConnected decoder with optional weight
+tying, trained on PTB via Module/bucketing). TPU-native: the LSTM is the
+lax.scan fused layer (gluon/rnn/rnn_layer.py -> ops/rnn.py); sequence
+length is static per bucket, so each bucket compiles once — the executable
+cache plays the role of BucketingModule's shared executors.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .. import nn, rnn
+
+__all__ = ["RNNModel"]
+
+
+class RNNModel(HybridBlock):
+    """reference: example/rnn/word_lm/model.py rnn(bptt, vocab_size, ...)."""
+
+    def __init__(self, vocab_size, embed_size=200, hidden_size=200,
+                 num_layers=2, dropout=0.5, tie_weights=False, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout) if dropout else None
+            self.embedding = nn.Embedding(vocab_size, embed_size,
+                                          prefix="embed_")
+            self.rnn = rnn.LSTM(hidden_size, num_layers=num_layers,
+                                dropout=dropout, input_size=embed_size,
+                                layout="TNC", prefix="lstm_")
+            if tie_weights:
+                if embed_size != hidden_size:
+                    raise ValueError("tie_weights requires embed_size == "
+                                     "hidden_size (as in reference)")
+                self.decoder = nn.Dense(vocab_size, flatten=False,
+                                        params=self.embedding.params,
+                                        prefix="embed_")
+            else:
+                self.decoder = nn.Dense(vocab_size, flatten=False,
+                                        prefix="decoder_")
+
+    def begin_state(self, batch_size, ctx=None, func=None):
+        return self.rnn.begin_state(batch_size=batch_size, ctx=ctx)
+
+    def hybrid_forward(self, F, inputs, state=None):
+        """inputs: (T, B) int ids. Returns (logits (T, B, vocab), state)."""
+        emb = self.embedding(inputs)
+        if self.drop is not None:
+            emb = self.drop(emb)
+        if state is None:
+            out = self.rnn(emb)
+            state = None
+        else:
+            out, state = self.rnn(emb, state)
+        if self.drop is not None:
+            out = self.drop(out)
+        logits = self.decoder(out)
+        if state is None:
+            return logits
+        return logits, state

@@ -60,10 +60,15 @@ class Parameter:
         if self._shape is None:
             self._shape = tuple(new_shape)
             return
+        # 0 is the unknown-dim wildcard on either side (reference:
+        # parameter.py shape setter — weight sharing with deferred init
+        # passes 0 for dims the sharing layer hasn't inferred yet)
         assert len(self._shape) == len(new_shape) and all(
-            s == 0 or s == n for s, n in zip(self._shape, new_shape)), \
+            s == 0 or n == 0 or s == n
+            for s, n in zip(self._shape, new_shape)), \
             "cannot update shape %s -> %s for %s" % (self._shape, new_shape, self.name)
-        self._shape = tuple(new_shape)
+        self._shape = tuple(s if n == 0 else n
+                            for s, n in zip(self._shape, new_shape))
 
     @property
     def grad_req(self):

@@ -116,3 +116,4 @@ from . import optimizer_ops as _optimizer_ops  # noqa: E402,F401
 from . import rnn as _rnn  # noqa: E402,F401
 from . import contrib as _contrib  # noqa: E402,F401
 from . import linalg as _linalg  # noqa: E402,F401
+from . import quantization as _quantization  # noqa: E402,F401

@@ -1,0 +1,137 @@
+"""INT8 quantization ops.
+
+TPU-native equivalent of the reference's quantization operator family
+(src/operator/quantization/**: quantize_v2, dequantize, requantize,
+quantized_fully_connected, quantized_conv — SURVEY §2.1 N10). The reference
+dispatches to cuDNN/MKLDNN int8 kernels; here the int8 compute lowers to
+XLA `dot_general`/`conv_general_dilated` with `preferred_element_type=int32`
+— the MXU executes int8×int8→int32 natively.
+
+Quantization scheme matches the reference's symmetric int8 path
+(quantization_utils.h): scale = 127 / max(|min|, |max|), zero-point-free.
+"""
+from __future__ import annotations
+
+from . import register
+
+_INT8_MAX = 127.0
+
+
+def _range_scale(min_range, max_range):
+    import jax.numpy as jnp
+
+    return _INT8_MAX / jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                               jnp.abs(max_range)), 1e-20)
+
+
+@register("_contrib_quantize_v2", num_outputs=3,
+          aliases=("quantize_v2", "_contrib_quantize", "quantize"))
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """fp32 -> int8 + (min, max) range outputs (reference:
+    quantize_v2-inl.h). Without calibrated ranges the data min/max is used
+    (the reference's runtime-minmax mode)."""
+    import jax.numpy as jnp
+
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    scale = _range_scale(mn, mx)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, mn.reshape((1,)), mx.reshape((1,))
+
+
+def dequantize_int32(data, mn, mx):
+    """Quantized accumulator -> fp32 given its range (shared body for the
+    dequantize op and requantize)."""
+    import jax.numpy as jnp
+
+    scale = _range_scale(mn, mx)
+    return data.astype(jnp.float32) / scale
+
+
+@register("_contrib_dequantize", num_outputs=1, aliases=("dequantize",))
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8/int32 -> fp32 (reference: dequantize-inl.h)."""
+    return dequantize_int32(data, min_range.reshape(()), max_range.reshape(()))
+
+
+@register("_contrib_requantize", num_outputs=3, aliases=("requantize",))
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 -> int8 with new ranges (reference: requantize-inl.h)."""
+    import jax.numpy as jnp
+
+    f = dequantize_int32(data, min_range.reshape(()), max_range.reshape(()))
+    if min_calib_range is None:
+        mn = jnp.min(f).astype(jnp.float32)
+        mx = jnp.max(f).astype(jnp.float32)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    scale = _range_scale(mn, mx)
+    q = jnp.clip(jnp.round(f * scale), -127, 127).astype(jnp.int8)
+    return q, mn.reshape((1,)), mx.reshape((1,))
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          aliases=("quantized_fully_connected",))
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias=None,
+                              max_bias=None, num_hidden=0, no_bias=False,
+                              flatten=True):
+    """int8 FC: int8×int8 → int32 on the MXU (reference:
+    quantized_fully_connected.cc). Inputs carry their fp ranges; output is
+    the int32 accumulator + its implied range. `flatten` matches the fp32
+    FullyConnected semantics (>2-D data collapses to (N, -1))."""
+    import jax
+    import jax.numpy as jnp
+
+    if flatten and data.ndim > 2:
+        data = data.reshape((data.shape[0], -1))
+    acc = jax.lax.dot_general(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        (((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    sd = _range_scale(min_data.reshape(()), max_data.reshape(()))
+    sw = _range_scale(min_weight.reshape(()), max_weight.reshape(()))
+    out_scale = sd * sw
+    if not no_bias and bias is not None:
+        bq = jnp.round(bias * out_scale).astype(jnp.int32)
+        acc = acc + bq
+    # range chosen so dequantize(acc, -m, m) divides by exactly out_scale:
+    # the int32 accumulator's value scale (reference carries ranges the
+    # same way through quantized_* -> requantize/dequantize)
+    out_max = _INT8_MAX / out_scale
+    return acc, (-out_max).reshape((1,)), out_max.reshape((1,))
+
+
+@register("_contrib_quantized_conv", num_outputs=3,
+          aliases=("quantized_conv",))
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias=None, max_bias=None, kernel=(),
+                   stride=(1, 1), pad=(0, 0), num_filter=0, no_bias=False):
+    """int8 NCHW convolution -> int32 accumulator (reference:
+    quantized_conv.cc)."""
+    import jax
+    import jax.numpy as jnp
+
+    stride = tuple(stride) or (1, 1)
+    pad = tuple(pad) or (0, 0)
+    acc = jax.lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    sd = _range_scale(min_data.reshape(()), max_data.reshape(()))
+    sw = _range_scale(min_weight.reshape(()), max_weight.reshape(()))
+    out_scale = sd * sw
+    if not no_bias and bias is not None:
+        bq = jnp.round(bias * out_scale).astype(jnp.int32)
+        acc = acc + bq.reshape((1, -1, 1, 1))
+    out_max = _INT8_MAX / out_scale
+    return acc, (-out_max).reshape((1,)), out_max.reshape((1,))

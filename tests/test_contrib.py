@@ -1,0 +1,193 @@
+"""Contrib subsystem tests: int8 quantization (ops + graph pass + calibrated
+model accuracy), text vocab/embedding, DataLoaderIter, SVRG trainer.
+(Reference strategy: tests/python/quantization/test_quantization.py,
+tests/python/unittest/test_contrib_text.py.)"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.contrib import text as ctext
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.nd.array(np.random.uniform(-3, 3, (4, 5)).astype(np.float32))
+    qx, mn, mxr = mx.nd.contrib.quantize_v2(x)
+    assert qx.dtype == np.int8
+    back = mx.nd.contrib.dequantize(qx, mn, mxr)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=3.0 / 127 * 2)
+
+
+def test_quantized_fc_matches_fp32():
+    np.random.seed(0)
+    x = np.random.uniform(-1, 1, (8, 16)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (4, 16)).astype(np.float32)
+    b = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+    qd, dmin, dmax = mx.nd.contrib.quantize_v2(mx.nd.array(x))
+    qw, wmin, wmax = mx.nd.contrib.quantize_v2(mx.nd.array(w))
+    acc, omin, omax = mx.nd.contrib.quantized_fully_connected(
+        qd, qw, mx.nd.array(b), dmin, dmax, wmin, wmax, num_hidden=4)
+    out = mx.nd.contrib.dequantize(acc, omin, omax)
+    ref = x @ w.T + b
+    np.testing.assert_allclose(out.asnumpy(), ref, atol=0.15, rtol=0.1)
+
+
+def _mlp_sym():
+    data = mx.sym.var("data")
+    h = mx.sym.relu(mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1"))
+    return mx.sym.FullyConnected(data=h, num_hidden=3, name="fc2")
+
+
+def _rand_params(sym, shapes):
+    args, _, _ = sym.infer_shape(**shapes)
+    names = sym.list_arguments()
+    rng = np.random.RandomState(0)
+    return {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+            for n, s in zip(names, args) if n not in shapes}
+
+
+def test_quantize_graph_structure():
+    sym = _mlp_sym()
+    qsym = q.quantize_graph(sym)
+    ops = [n.op for n in qsym._topo() if not n.is_var]
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "_contrib_quantize_v2" in ops
+    assert "_contrib_dequantize" in ops
+    assert "FullyConnected" not in ops
+    # excluded node stays fp32
+    qsym2 = q.quantize_graph(sym, excluded_sym_names=["fc1"])
+    ops2 = [n.op for n in qsym2._topo() if not n.is_var]
+    assert "FullyConnected" in ops2
+
+
+def test_quantize_model_accuracy():
+    """Quantized MLP predictions stay close to fp32 (reference:
+    test_quantization.py accuracy checks)."""
+    sym = _mlp_sym()
+    params = _rand_params(sym, {"data": (8, 10)})
+    X = np.random.RandomState(1).uniform(-1, 1, (32, 10)).astype(np.float32)
+
+    class _Iter:
+        def __init__(self):
+            from mxnet_tpu.io import DataDesc
+
+            self.provide_data = [DataDesc("data", (8, 10), np.float32)]
+            self.provide_label = []
+            self._i = 0
+
+        def __iter__(self):
+            self._i = 0
+            return self
+
+        def __next__(self):
+            from mxnet_tpu.io import DataBatch
+
+            if self._i >= 4:
+                raise StopIteration
+            b = DataBatch(data=[mx.nd.array(X[self._i * 8:(self._i + 1) * 8])])
+            self._i += 1
+            return b
+
+        def reset(self):
+            self._i = 0
+
+    qsym, qargs, _ = q.quantize_model(sym, params, {}, calib_mode="naive",
+                                      calib_data=_Iter())
+    fp = sym.eval_with({**{"data": X}, **params})
+    qt = qsym.eval_with({**{"data": X}, **qargs})
+    fp_np, qt_np = fp.asnumpy(), qt.asnumpy()
+    # predictions should rarely flip
+    agree = (fp_np.argmax(axis=1) == qt_np.argmax(axis=1)).mean()
+    assert agree > 0.9, "int8 flipped too many predictions (%.2f)" % agree
+    np.testing.assert_allclose(qt_np, fp_np, atol=0.25, rtol=0.25)
+
+
+def test_text_vocab():
+    counter = ctext.count_tokens_from_str("a b b c c c\nd d d d")
+    vocab = ctext.Vocabulary(counter, min_freq=2, unknown_token="<unk>")
+    assert vocab.to_indices("d") == 1  # most frequent first
+    assert vocab.to_tokens(1) == "d"
+    assert vocab.to_indices("zzz") == 0  # unk
+    assert len(vocab) == 4  # unk, d, c, b
+
+
+def test_text_custom_embedding(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0\nworld 3.0 4.0\n")
+    emb = ctext.CustomEmbedding(str(p))
+    v = emb.get_vecs_by_tokens(["hello", "world"])
+    np.testing.assert_allclose(v.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_dataloader_iter():
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    X = np.random.uniform(size=(20, 4)).astype(np.float32)
+    y = np.arange(20).astype(np.float32)
+    loader = DataLoader(ArrayDataset(X, y), batch_size=5)
+    it = DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (5, 4)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_svrg_trainer():
+    from mxnet_tpu.contrib.svrg_optimization import SVRGTrainer
+
+    np.random.seed(0)
+    X = np.random.uniform(-1, 1, (64, 5)).astype(np.float32)
+    w_true = np.random.uniform(-1, 1, (5, 1)).astype(np.float32)
+    Y = X @ w_true
+    net = gluon.nn.Dense(1, use_bias=False)
+    net.initialize(ctx=mx.cpu())
+    lossfn = gluon.loss.L2Loss()
+    xs, ys = mx.nd.array(X), mx.nd.array(Y)
+    net(xs)  # materialize deferred params before snapshotting
+    trainer = SVRGTrainer(net.collect_params(), learning_rate=0.2)
+
+    def _grads_on(snapshot_params, xb, yb, scale):
+        """Grads of loss(xb, yb) at snapshot params (restores live params)."""
+        saved = [p.data().asnumpy() for p in trainer._params]
+        for p, s in zip(trainer._params, snapshot_params):
+            p.data()._set_data(s._data)
+        with autograd.record():
+            L = lossfn(net(xb), yb)
+        L.backward()
+        out = [(p.grad() * scale).copy() for p in trainer._params]
+        for p, s in zip(trainer._params, saved):
+            p.data()._set_data(mx.nd.array(s)._data)
+        return out
+
+    def full_mean_grads(snapshot_params):
+        return _grads_on(snapshot_params, xs, ys, 1.0 / X.shape[0])
+
+    losses = []
+    for epoch in range(12):
+        if epoch % 2 == 0:
+            trainer.take_snapshot(full_mean_grads)
+        for i in range(0, 64, 16):
+            xb, yb = xs[i:i + 16], ys[i:i + 16]
+            with autograd.record():
+                L = lossfn(net(xb), yb)
+            L.backward()
+            trainer.step(16, lambda snap, xb=xb, yb=yb:
+                         _grads_on(snap, xb, yb, 1.0))
+            losses.append(float(L.mean().asscalar()))
+    assert losses[-1] < losses[0] * 0.2, losses[-1]
+
+
+def test_onnx_gated():
+    try:
+        import onnx  # noqa: F401
+
+        pytest.skip("onnx installed; gating test not applicable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError):
+        mx.contrib.onnx.import_model("nonexistent.onnx")

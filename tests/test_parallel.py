@@ -29,10 +29,14 @@ def test_sharding_rules():
     assert tuple(rules.spec_for("gamma", (), m)) == ()
 
 
-def _mlp():
-    net = nn.HybridSequential()
-    net.add(nn.Dense(32, activation="relu"))
-    net.add(nn.Dense(10))
+def _mlp(prefix=None):
+    # explicit prefixes: auto-numbered names (dense9_/dense10_) sort
+    # differently as global counters grow, breaking sorted-name pairing
+    # between two nets when the whole suite runs
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu", prefix="d1_"))
+        net.add(nn.Dense(10, prefix="d2_"))
     net.initialize()
     return net
 
@@ -44,10 +48,10 @@ def test_distributed_trainer_dp_matches_local():
 
     # local single-device reference run
     mx.random.seed(42)
-    net_a = _mlp()
+    net_a = _mlp(prefix="neta_")
     net_a(mx.nd.array(x))  # materialize deferred shapes
     mx.random.seed(7)
-    net_b = _mlp()
+    net_b = _mlp(prefix="netb_")
     net_b(mx.nd.array(x))
     # copy A's weights into B so both start identical
     pa = sorted(net_a.collect_params().items())
