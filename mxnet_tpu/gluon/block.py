@@ -320,6 +320,13 @@ class HybridBlock(Block):
         return self.hybrid_forward(nd, *args, **params)
 
     def forward(self, *args):
+        from ..symbol.symbol import Symbol
+
+        if any(isinstance(a, Symbol) for a in args):
+            # symbolic tracing: hybrid_forward composes a Symbol graph, with
+            # parameters as named vars (the reference's HybridBlock Symbol
+            # path, block.py:748 _build_cache) — used by export()/predictor
+            return self._symbolic_forward(*args)
         if self._active and not _is_tracing():
             return self._call_cached(*args)
         try:
@@ -327,6 +334,13 @@ class HybridBlock(Block):
         except DeferredInitializationError:
             self._finish_deferred(*args)
             return self._eager_forward(*args)
+
+    def _symbolic_forward(self, *args):
+        from .. import symbol as sym_mod
+
+        params = {name: sym_mod.var(p.name, shape=p.shape)
+                  for name, p in self._reg_params.items()}
+        return self.hybrid_forward(sym_mod, *args, **params)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
@@ -460,23 +474,30 @@ class HybridBlock(Block):
             autograd._LIVE[id(o)] = o
 
     # -- export ------------------------------------------------------------
-    def export(self, path, epoch=0):
+    def export(self, path, epoch=0, n_inputs=1, input_names=None):
         """Serialize for deployment (reference: block.py:868 — symbol.json +
-        params). The TPU build stores params + an input-signature manifest;
-        StableHLO export of the jitted graph is produced when a cache entry
-        exists."""
-        import json
+        params, reloadable by SymbolBlock.imports / the predict API). The
+        symbol json is produced by tracing hybrid_forward with Symbol
+        inputs; params are saved under their full names with the
+        reference's 'arg:' prefix."""
+        from .. import symbol as sym_mod
 
-        params = self._collect_params_with_prefix()
-        arg_dict = {"arg:" + k: v.data() for k, v in params.items()}
-        nd.save("%s-%04d.params" % (path, epoch), {k: v for k, v in arg_dict.items()})
-        manifest = {
-            "framework": "mxnet_tpu",
-            "block": self.__class__.__name__,
-            "params": {k: list(p.shape or ()) for k, p in params.items()},
-        }
-        with open("%s-symbol.json" % path, "w") as f:
-            json.dump(manifest, f, indent=2)
+        if input_names is None:
+            input_names = ["data"] if n_inputs == 1 else \
+                ["data%d" % i for i in range(n_inputs)]
+        inputs = [sym_mod.var(n) for n in input_names]
+        out = self(*inputs)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        out.save("%s-symbol.json" % path)
+        params = self.collect_params()
+        aux_names = set(out.list_auxiliary_states())
+        # aux states (BatchNorm running stats) carry the aux: prefix so
+        # load_params/Predictor bind them as aux, not args (reference
+        # format, model.py:394)
+        save_dict = {("aux:" if k in aux_names else "arg:") + k: v.data()
+                     for k, v in params.items()}
+        nd.save("%s-%04d.params" % (path, epoch), save_dict)
 
 
 import contextlib
@@ -493,13 +514,21 @@ def _probe_scope():
 
 
 class SymbolBlock(HybridBlock):
-    """Run a symbolic graph as a Block (reference: block.py:952). Implemented
-    once the Symbol API lands; imports from `export` manifests."""
+    """Run a symbolic graph as a Block (reference: block.py:952): wraps an
+    exported symbol; every non-input argument becomes a Parameter."""
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
-        self._outputs = outputs
+        self._sym_outputs = outputs
         self._inputs = inputs
+        input_names = {i.name for i in inputs}
+        for name in outputs.list_arguments():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            if name not in input_names:
+                self.params.get(name, grad_req="null",
+                                allow_deferred_init=True)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
@@ -515,9 +544,7 @@ class SymbolBlock(HybridBlock):
         return ret
 
     def forward(self, *args):
-        from .. import symbol as sym_mod
-
         arg_names = [i.name for i in self._inputs]
         kwargs = dict(zip(arg_names, args))
         params = {name: p.data() for name, p in self.collect_params().items()}
-        return self._outputs.eval_with(dict(kwargs, **params))
+        return self._sym_outputs.eval_with(dict(kwargs, **params))

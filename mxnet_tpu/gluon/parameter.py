@@ -353,6 +353,10 @@ class ParameterDict:
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
         loaded = nd.load(filename)
+        # checkpoint files prefix entries with arg:/aux: (reference
+        # model.py:394 format); strip for parameter matching
+        loaded = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                   else k): v for k, v in loaded.items()}
         if restore_prefix:
             loaded = {restore_prefix + k: v for k, v in loaded.items()}
         if not allow_missing:

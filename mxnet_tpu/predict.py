@@ -1,0 +1,138 @@
+"""Deployment predictor.
+
+TPU-native equivalent of the reference's C predict API
+(include/mxnet/c_predict_api.h — 17 functions: MXPredCreate,
+MXPredSetInput, MXPredForward, MXPredGetOutput, MXPredReshape,
+MXPredPartialOut, MXPredFree; src/c_api/c_predict_api.cc). The surface is a
+`Predictor` class whose methods map 1:1 onto those entry points; it loads
+the `prefix-symbol.json` + `prefix-0000.params` artifacts produced by
+`HybridBlock.export` / `model.save_checkpoint` and runs inference through
+the jit-compiled Executor — one XLA executable per input signature, cached
+across calls (the predict API's raison d'être: cheap repeated forward).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+from .context import current_context
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+
+def load_ndarray_file(nd_bytes_or_file):
+    """reference: MXNDListCreate c_predict_api.h — load a saved NDArray
+    dict/list for feeding a predictor."""
+    return nd.load(nd_bytes_or_file)
+
+
+class Predictor:
+    """reference: MXPredCreate/MXPredCreatePartialOut (c_predict_api.h).
+
+    Parameters
+    ----------
+    symbol_file : path to prefix-symbol.json (or a Symbol)
+    param_file : path to prefix-%04d.params
+    ctx : device context
+    input_shapes : dict name -> shape (batch included)
+    output_names : optional internal-output selection (PartialOut parity)
+    """
+
+    def __init__(self, symbol_file, param_file=None, ctx=None,
+                 input_shapes=None, output_names=None):
+        self._ctx = ctx or current_context()
+        if isinstance(symbol_file, sym_mod.Symbol):
+            symbol = symbol_file
+        else:
+            symbol = sym_mod.load(symbol_file)
+        if output_names:
+            internals = symbol.get_internals()
+            outs = internals.list_outputs()
+            picked = []
+            for name in output_names:
+                if name not in outs:
+                    raise MXNetError("output '%s' not in graph (have %s...)"
+                                     % (name, outs[:10]))
+                picked.append(internals[outs.index(name)])
+            symbol = sym_mod.Group(picked)
+        self._symbol = symbol
+        self._arg_params, self._aux_params = {}, {}
+        if param_file is not None:
+            from .model import load_params
+
+            self._arg_params, self._aux_params = load_params(param_file)
+        if not input_shapes:
+            raise MXNetError("input_shapes is required (as in MXPredCreate)")
+        self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        self._inputs = {}
+        self._outputs = None
+        self._bind()
+
+    def _bind(self):
+        args = {}
+        for name in self._symbol.list_arguments():
+            if name in self._input_shapes:
+                args[name] = nd.zeros(self._input_shapes[name], ctx=self._ctx)
+            elif name in self._arg_params:
+                args[name] = self._arg_params[name].as_in_context(self._ctx)
+            else:
+                raise MXNetError(
+                    "argument '%s' has neither a param nor an input shape"
+                    % name)
+        aux = {k: v.as_in_context(self._ctx)
+               for k, v in self._aux_params.items()}
+        self._exe = self._symbol.bind(self._ctx, args=args, grad_req="null",
+                                      aux_states=aux)
+        self._args = args
+
+    # -- the c_predict_api surface ----------------------------------------
+    def set_input(self, name, data):
+        """reference: MXPredSetInput."""
+        if name not in self._input_shapes:
+            raise MXNetError("'%s' is not an input (inputs: %s)"
+                             % (name, sorted(self._input_shapes)))
+        arr = data if isinstance(data, nd.NDArray) else \
+            nd.array(_np.asarray(data, dtype=_np.float32), ctx=self._ctx)
+        if tuple(arr.shape) != self._input_shapes[name]:
+            raise MXNetError("input '%s' shape %s != declared %s (use "
+                             "reshape())" % (name, arr.shape,
+                                             self._input_shapes[name]))
+        self._args[name]._set_data(arr.as_in_context(self._ctx)._data)
+
+    def forward(self, **kwargs):
+        """reference: MXPredForward (kwargs are a set_input shorthand)."""
+        for k, v in kwargs.items():
+            self.set_input(k, v)
+        self._outputs = self._exe.forward(is_train=False)
+        return self
+
+    def get_output(self, index=0):
+        """reference: MXPredGetOutput."""
+        if self._outputs is None:
+            raise MXNetError("forward() has not been called")
+        return self._outputs[index]
+
+    @property
+    def num_outputs(self):
+        return len(self._symbol.list_outputs())
+
+    def get_output_shape(self, index=0):
+        """reference: MXPredGetOutputShape."""
+        _, out_shapes, _ = self._symbol.infer_shape(**self._input_shapes)
+        return out_shapes[index]
+
+    def reshape(self, new_input_shapes):
+        """reference: MXPredReshape — rebind for new input geometry (the
+        executable cache keeps previously-compiled signatures warm)."""
+        self._input_shapes.update(
+            {k: tuple(v) for k, v in new_input_shapes.items()})
+        self._bind()
+        return self
+
+    def free(self):
+        """reference: MXPredFree (a no-op beyond dropping references —
+        buffers are garbage-collected)."""
+        self._exe = None
+        self._outputs = None

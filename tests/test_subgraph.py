@@ -1,0 +1,102 @@
+"""Subgraph partition framework tests (reference strategy:
+tests/python/unittest/test_subgraph_op.py — partition + numeric equivalence
++ custom property fusion) and 2-bit gradient compression
+(tests/nightly/dist_sync_kvstore.py compression numerics)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import subgraph as sg
+
+
+def _mlp_sym():
+    data = mx.sym.var("data")
+    h = mx.sym.relu(mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1"))
+    return mx.sym.FullyConnected(data=h, num_hidden=3, name="fc2")
+
+
+def _vals():
+    rng = np.random.RandomState(0)
+    return {
+        "data": rng.uniform(-1, 1, (4, 6)).astype(np.float32),
+        "fc1_weight": rng.uniform(-0.5, 0.5, (8, 6)).astype(np.float32),
+        "fc1_bias": np.zeros(8, np.float32),
+        "fc2_weight": rng.uniform(-0.5, 0.5, (3, 8)).astype(np.float32),
+        "fc2_bias": np.zeros(3, np.float32),
+    }
+
+
+def test_default_property_whole_graph():
+    sym = _mlp_sym()
+    part = sg.partition(sym, "default")
+    ops = [n.op for n in part._topo() if not n.is_var]
+    assert len(ops) == 1 and ops[0].startswith("_subgraph_"), ops
+    vals = _vals()
+    np.testing.assert_allclose(part.eval_with(dict(vals)).asnumpy(),
+                               sym.eval_with(dict(vals)).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_custom_fc_relu_fusion():
+    class FCReluSelector(sg.SubgraphSelector):
+        def select(self, node):
+            return node.op == "relu"
+
+        def select_input(self, node, input_node):
+            return node.op == "relu" and input_node.op == "FullyConnected"
+
+    class FCReluProperty(sg.SubgraphProperty):
+        def create_subgraph_selector(self):
+            return FCReluSelector()
+
+    sym = _mlp_sym()
+    part = sg.partition(sym, FCReluProperty())
+    ops = [n.op for n in part._topo() if not n.is_var]
+    fused = [o for o in ops if o.startswith("_subgraph_")]
+    assert len(fused) == 1
+    assert "FullyConnected" in ops  # fc2 stays unfused
+    assert "relu" not in ops        # relu was absorbed
+    vals = _vals()
+    np.testing.assert_allclose(part.eval_with(dict(vals)).asnumpy(),
+                               sym.eval_with(dict(vals)).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_partition_keeps_batchnorm_unfused():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data=data, name="bn")
+    out = mx.sym.relu(bn)
+    part = sg.partition(out, "default")
+    ops = [n.op for n in part._topo() if not n.is_var]
+    assert "BatchNorm" in ops  # aux-output op must not be captured
+
+
+def test_registered_properties():
+    assert "default" in sg.list_subgraph_properties()
+
+
+def test_gradient_compression_numerics():
+    from mxnet_tpu.gradient_compression import GradientCompression
+
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    g = mx.nd.array([0.7, -0.9, 0.2, -0.1])
+    q1 = gc.quantize("k", g)
+    np.testing.assert_allclose(q1.asnumpy(), [0.5, -0.5, 0, 0])
+    # error feedback: residuals accumulate so small grads eventually send
+    q2 = gc.quantize("k", g)
+    np.testing.assert_allclose(q2.asnumpy(), [0.5, -0.5, 0, 0])
+    q3 = gc.quantize("k", g)
+    # 0.2*3 = 0.6 >= 0.5 now crosses threshold
+    assert q3.asnumpy()[2] == 0.5
+
+
+def test_kvstore_with_compression():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.nd.zeros((4,)))
+    g1 = mx.nd.array([0.6, 0.1, -0.7, 0.0])
+    g2 = mx.nd.array([0.6, 0.1, 0.7, 0.0])
+    kv.push("w", [g1, g2])
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    # each device grad quantized to {-0.5, 0, 0.5} then summed
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 0.0, 0.0, 0.0])

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""im2rec: pack an image dataset into RecordIO.
+
+Equivalent of the reference's tools/im2rec.py / tools/im2rec.cc: builds a
+.lst index (``--list``) from a directory tree, or packs a .lst into
+``prefix.rec`` + ``prefix.idx`` readable by ImageIter / ImageRecordDataset.
+Record payloads use the reference's IRHeader format (recordio.pack_img), so
+datasets interchange both ways. The heavy IO path (record framing) runs
+through the native C++ writer when built.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=True):
+    """Yield (relative_path, label) with one label per subdirectory
+    (reference: im2rec.py list_image)."""
+    cat = {}
+    if recursive:
+        for path, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                if f.lower().endswith(_EXTS):
+                    d = os.path.relpath(path, root)
+                    if d not in cat:
+                        cat[d] = len(cat)
+                    yield os.path.join(os.path.relpath(path, root), f), cat[d]
+    else:
+        for f in sorted(os.listdir(root)):
+            if f.lower().endswith(_EXTS):
+                yield f, 0
+
+
+def make_list(args):
+    """Write prefix.lst: lines of 'index\\tlabel\\trelpath' (reference:
+    im2rec.py make_list)."""
+    items = list(list_images(args.root, recursive=not args.no_recursive))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(items)
+    with open(args.prefix + ".lst", "w") as f:
+        for i, (path, label) in enumerate(items):
+            f.write("%d\t%f\t%s\n" % (i, float(label), path))
+    return len(items)
+
+
+def read_list(lst_path):
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(args):
+    """Pack prefix.lst -> prefix.rec + prefix.idx (reference: im2rec.py
+    image_encode/write worker pipeline)."""
+    import numpy as np
+
+    from mxnet_tpu import image, recordio
+
+    lst = args.prefix + ".lst"
+    rec = args.prefix + ".rec"
+    idx = args.prefix + ".idx"
+    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
+    count = 0
+    for i, labels, relpath in read_list(lst):
+        path = os.path.join(args.root, relpath)
+        with open(path, "rb") as f:
+            buf = f.read()
+        if args.resize or args.quality != 95 or args.center_crop:
+            img = image.imdecode(buf, to_ndarray=False)
+            if args.resize:
+                img = image.resize_short(img, args.resize)
+            if args.center_crop:
+                h, w = img.shape[:2]
+                s = min(h, w)
+                y0, x0 = (h - s) // 2, (w - s) // 2
+                img = img[y0:y0 + s, x0:x0 + s]
+            buf = image.imencode(img, quality=args.quality,
+                                 fmt="." + args.encoding)
+        label = labels[0] if len(labels) == 1 else np.asarray(labels)
+        header = recordio.IRHeader(0, label, i, 0)
+        writer.write_idx(i, recordio.pack(header, buf))
+        count += 1
+    writer.close()
+    return count
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true",
+                   help="generate prefix.lst instead of packing")
+    p.add_argument("--no-recursive", action="store_true")
+    p.add_argument("--no-shuffle", dest="shuffle", action="store_false",
+                   default=True, help="keep deterministic listing order")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter side to this many pixels")
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", choices=("jpg", "png"), default="jpg")
+    args = p.parse_args(argv)
+    if args.list:
+        n = make_list(args)
+        print("wrote %s.lst (%d items)" % (args.prefix, n))
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            n = make_list(args)
+            print("wrote %s.lst (%d items)" % (args.prefix, n))
+        n = pack(args)
+        print("packed %d records -> %s.rec" % (n, args.prefix))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""rec2idx: rebuild the .idx file for an existing RecordIO file
+(equivalent of the reference's tools/rec2idx.py: walks the record stream
+recording byte offsets)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_index(rec_path, idx_path):
+    from mxnet_tpu import recordio
+
+    # force the python reader: it exposes tell() positions for free and the
+    # native reader is only used for the (hot) training path
+    os.environ["MXTPU_PY_RECORDIO"] = "1"
+    try:
+        reader = recordio.MXRecordIO(rec_path, "r")
+        count = 0
+        with open(idx_path, "w") as f:
+            while True:
+                pos = reader.tell()
+                buf = reader.read()
+                if buf is None:
+                    break
+                f.write("%d\t%d\n" % (count, pos))
+                count += 1
+        reader.close()
+    finally:
+        os.environ.pop("MXTPU_PY_RECORDIO", None)
+    return count
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("record", help="path to the .rec file")
+    p.add_argument("index", nargs="?", help="output .idx path")
+    args = p.parse_args(argv)
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = build_index(args.record, idx)
+    print("indexed %d records -> %s" % (n, idx))
+
+
+if __name__ == "__main__":
+    main()
