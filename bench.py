@@ -20,6 +20,11 @@ BASELINE_IMGS_PER_SEC = 298.51  # reference docs/faq/perf.md:234 (V100, bs=32)
 BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", 32))
 WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", 3))
 ITERS = int(os.environ.get("MXTPU_BENCH_ITERS", 10))
+# bf16 compute + fp32 master weights is the TPU-native training precision
+# (the MXU's native dtype); set MXTPU_BENCH_DTYPE=float32 for the fp32 run
+AMP_DTYPE = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16")
+if AMP_DTYPE in ("float32", "fp32", "none"):
+    AMP_DTYPE = None
 
 
 def main():
@@ -47,7 +52,8 @@ def main():
     mesh = make_mesh([("dp", 1)], devices=jax.devices()[:1])
     trainer = DistributedTrainer(
         net, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
-        loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh)
+        loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh,
+        amp_dtype=AMP_DTYPE)
 
     for _ in range(WARMUP):
         loss = trainer.step(x, label)

@@ -57,13 +57,18 @@ class DistributedTrainer:
     """
 
     def __init__(self, block, optimizer, optimizer_params=None, loss=None,
-                 mesh=None, rules=None):
+                 mesh=None, rules=None, amp_dtype=None):
         import jax
 
         self._block = block
         self._mesh = mesh or current_mesh()
         self._rules = rules or ShardingRules()
         self._loss = loss
+        # mixed precision: compute forward/backward in `amp_dtype`
+        # (bfloat16 — the MXU's native dtype) while parameters, gradients
+        # as accumulated through the cast's vjp, and the optimizer update
+        # stay fp32 (master weights; reference analogue: multi_precision)
+        self._amp_dtype = amp_dtype
 
         param_items = sorted(block.collect_params().items())
         if not param_items:
@@ -194,6 +199,13 @@ class DistributedTrainer:
         trainable, aux = self._trainable, self._aux
         loss_blk = self._loss
 
+        amp = self._amp_dtype
+
+        def maybe_cast(a):
+            if amp is not None and jnp.issubdtype(a.dtype, jnp.floating):
+                return a.astype(amp)
+            return a
+
         def step(key, t, lr, arrays, states, *batch):
             train_arrays = [arrays[i] for i in trainable]
             other = list(arrays)
@@ -201,17 +213,23 @@ class DistributedTrainer:
             def loss_fn(train_arrs):
                 full = list(other)
                 for k, i in enumerate(trainable):
-                    full[i] = train_arrs[k]
+                    # cast INSIDE the grad closure: the cast's vjp returns
+                    # fp32 cotangents, i.e. grads accumulate at full precision
+                    full[i] = maybe_cast(train_arrs[k])
                 fwd_in = batch[:-1] if loss_blk is not None else batch
+                fwd_in = tuple(maybe_cast(b) for b in fwd_in)
                 out, aux_up = self._trace_forward(fwd_in, full, key, True)
                 pred = out[0] if isinstance(out, (list, tuple)) else out
+                # aux states (BatchNorm stats) keep their stored dtype
+                aux_up = {i: u.astype(arrays[i].dtype)
+                          for i, u in aux_up.items()}
                 if loss_blk is not None:
                     label_nd = pred.__class__(batch[-1],
                                               ctx=self._params[0].list_ctx()[0])
                     l = loss_blk(pred, label_nd)
-                    lval = jnp.mean(l._data)
+                    lval = jnp.mean(l._data.astype(jnp.float32))
                 else:
-                    lval = jnp.mean(pred._data)
+                    lval = jnp.mean(pred._data.astype(jnp.float32))
                 return lval, aux_up
 
             (loss_val, aux_up), grads = jax.value_and_grad(
