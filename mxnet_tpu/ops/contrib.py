@@ -84,9 +84,17 @@ def index_copy(old, index, new):
 
 @register("_contrib_index_array", aliases=("index_array",))
 def index_array(data, axes=None):
+    """Index coordinates of every element: shape data.shape + (len(axes),)
+    (reference: src/operator/contrib/index_array.cc — the full data shape is
+    kept even when only a subset of axes is requested)."""
     axes = tuple(axes) if axes else tuple(range(data.ndim))
-    grids = jnp.meshgrid(*[jnp.arange(data.shape[a]) for a in axes], indexing="ij")
-    return jnp.stack(grids, axis=-1).astype(jnp.int64)
+    comps = []
+    for a in axes:
+        shape1 = [1] * data.ndim
+        shape1[a] = data.shape[a]
+        comps.append(jnp.broadcast_to(
+            jnp.arange(data.shape[a]).reshape(shape1), data.shape))
+    return jnp.stack(comps, axis=-1).astype(jnp.int64)
 
 
 @register("_contrib_fft", aliases=("fft",))

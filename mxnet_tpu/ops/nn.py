@@ -85,9 +85,11 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+        # NOTE: no preferred_element_type here — an fp32-widened primal makes
+        # the conv transpose rule pair an fp32 cotangent with bf16 operands and
+        # throw under grad. TPU's MXU accumulates bf16 convs in fp32 natively,
+        # so bf16-in/bf16-out loses nothing.
     )
-    out = out.astype(data.dtype)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nsp)
     return out
@@ -529,7 +531,52 @@ def upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
 @register("Correlation")
 def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
                 stride2=1, pad_size=0, is_multiply=True):
-    raise NotImplementedError("Correlation op: not yet ported to TPU build")
+    """FlowNet-style correlation (reference:
+    src/operator/correlation-inl.h / correlation.cc). For each displacement
+    (dy, dx) on the stride2 grid within ±max_displacement, correlates a
+    kernel_size² patch of data1 with the displaced patch of data2, averaged
+    over channels and patch. Output channel order is dy-major, matching the
+    reference's neighborhood-grid layout. Implemented as a static Python
+    loop over the (small) displacement grid of shifted elementwise products
+    + one reduce_window box filter each — everything fuses under XLA."""
+    import numpy as _onp
+
+    b, c, h, w = data1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    pad2 = [(0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size)]
+    p1 = jnp.pad(data1, pad2)
+    p2 = jnp.pad(data2, pad2)
+    ph, pw = h + 2 * pad_size, w + 2 * pad_size
+    out_h = int(_onp.ceil((ph - 2 * border) / stride1))
+    out_w = int(_onp.ceil((pw - 2 * border) / stride1))
+    rad = max_displacement // stride2
+    # extra pad so every displaced slice of p2 is in-bounds
+    p2x = jnp.pad(p2, [(0, 0), (0, 0),
+                       (max_displacement, max_displacement),
+                       (max_displacement, max_displacement)])
+    norm = float(c * kernel_size * kernel_size)
+    chans = []
+    for dy in range(-rad, rad + 1):
+        for dx in range(-rad, rad + 1):
+            oy, ox = dy * stride2, dx * stride2
+            shifted = lax.dynamic_slice(
+                p2x, (0, 0, max_displacement + oy, max_displacement + ox),
+                (b, c, ph, pw))
+            prod = p1 * shifted if is_multiply else jnp.abs(p1 - shifted)
+            box = lax.reduce_window(
+                prod, 0.0, lax.add,
+                window_dimensions=(1, c, kernel_size, kernel_size),
+                window_strides=(1, c, 1, 1), padding="VALID")
+            # box[y'] sums the window STARTING at y'; a window centered at
+            # y starts at y - kr
+            sl = lax.slice(
+                box, (0, 0, border - kr, border - kr),
+                (b, 1, border - kr + (out_h - 1) * stride1 + 1,
+                 border - kr + (out_w - 1) * stride1 + 1),
+                (1, 1, stride1, stride1))
+            chans.append(sl / norm)
+    return jnp.concatenate(chans, axis=1)
 
 
 @register("IdentityAttachKLSparseReg")

@@ -183,9 +183,11 @@ def multi_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0, clip_gradient=-1.0
                      num_weights=1):
     """Aggregated update (reference: optimizer_op.cc multi_sgd) — one fused
     launch updating many weights; XLA compiles the whole batch into one
-    executable, amortizing dispatch like the reference's aggregated kernels."""
-    weights = args[:num_weights]
-    grads = args[num_weights:2 * num_weights]
+    executable, amortizing dispatch like the reference's aggregated kernels.
+    Inputs are INTERLEAVED per weight — (w0, g0, w1, g1, ...) — matching the
+    reference's MultiSGDUpdate data layout."""
+    weights = args[0::2]
+    grads = args[1::2]
     outs = []
     for i in range(num_weights):
         g = _prep(grads[i], rescale_grad, clip_gradient, wds[i], weights[i])
@@ -196,9 +198,10 @@ def multi_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0, clip_gradient=-1.0
 @register("multi_sgd_mom_update", num_outputs=-1)
 def multi_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0, rescale_grad=1.0,
                          clip_gradient=-1.0, num_weights=1):
-    weights = args[:num_weights]
-    grads = args[num_weights:2 * num_weights]
-    moms = args[2 * num_weights:3 * num_weights]
+    # interleaved (w0, g0, m0, w1, g1, m1, ...) — reference layout
+    weights = args[0::3]
+    grads = args[1::3]
+    moms = args[2::3]
     outs = []
     new_moms = []
     for i in range(num_weights):

@@ -61,9 +61,40 @@ def random_randint(rng, low=0, high=1, shape=(), dtype="int32"):
 
 @register("_sample_unique_zipfian", needs_rng=True)
 def sample_unique_zipfian(rng, range_max=1, shape=()):
-    u = jax.random.uniform(rng, shape)
-    out = jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0
-    return out.astype(jnp.int64)
+    """Unique draws per row from the zipfian (log-uniform) class
+    distribution p(k) ∝ log((k+2)/(k+1)) — reference:
+    src/operator/random/unique_sample_op.cc (draws until unique). The
+    TPU-native version samples WITHOUT replacement in one shot via the
+    Gumbel-top-k trick, which is both compile-friendly (static shapes, no
+    rejection loop) and exactly equivalent in distribution."""
+    rows, k = (shape[0], shape[1]) if len(shape) == 2 else (1, int(shape[0]))
+    if rows * range_max <= (1 << 24):
+        # exact sampling without replacement: Gumbel-top-k over the class
+        # log-probs (equivalent in distribution to draw-until-unique).
+        # Covers every case where k is comparable to range_max.
+        classes = jnp.arange(range_max)
+        logp = jnp.log(jnp.log((classes + 2.0) / (classes + 1.0)))
+        g = jax.random.gumbel(rng, (rows, range_max))
+        _, idx = jax.lax.top_k(logp[None, :] + g, k)
+        return idx.reshape(shape).astype(jnp.int64)
+    # Huge vocab (sampled-softmax scale, k << range_max): materializing
+    # (rows, range_max) would be GBs. Oversample m = 4k+32 i.i.d. zipfian
+    # draws via the inverse CDF, deduplicate per row (uniques compacted
+    # first), and take the first k uniques. Fewer than k uniques would need
+    # >3k+32 collisions among m draws over a range of millions — vanishing
+    # probability; in that tail the row keeps duplicates rather than
+    # fabricating out-of-distribution fillers (documented divergence from
+    # the reference's unbounded draw-until-unique loop).
+    m = 4 * k + 32
+    u = jax.random.uniform(rng, (rows, m))
+    draws = (jnp.exp(u * jnp.log(float(range_max + 1))) - 1.0).astype(jnp.int64)
+    draws = jnp.clip(draws, 0, range_max - 1)
+    s = jnp.sort(draws, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((rows, 1), bool), s[:, 1:] == s[:, :-1]], axis=1)
+    order = jnp.argsort(dup, axis=1, stable=True)
+    return jnp.take_along_axis(s, order, axis=1)[:, :k] \
+        .reshape(shape).astype(jnp.int64)
 
 
 @register("_sample_multinomial", needs_rng=True, aliases=("sample_multinomial", "multinomial"))

@@ -66,8 +66,17 @@ class Optimizer:
     def create_state(self, index, weight):
         return None
 
+    @staticmethod
+    def _is_half(dtype):
+        """float16 OR bfloat16 — on TPU bf16 is the half-precision training
+        dtype (the MXU's native input type), so multi_precision master
+        weights must cover it too (reference handles fp16 only:
+        optimizer.py multi-precision SGD)."""
+        return str(_np.dtype(dtype) if dtype is not None else None) in (
+            "float16", "bfloat16")
+
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == _np.float16:
+        if self.multi_precision and self._is_half(weight.dtype):
             w32 = weight.astype("float32")
             return (self.create_state(index, w32), w32)
         return self.create_state(index, weight)
@@ -80,11 +89,12 @@ class Optimizer:
     supports_sparse = False
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == _np.float16:
+        if self.multi_precision and self._is_half(weight.dtype):
+            half = str(_np.dtype(weight.dtype))
             s, w32 = state
             g32 = grad.astype("float32")
             self.update(index, w32, g32, s)
-            weight._set_data(w32.astype("float16")._data)
+            weight._set_data(w32.astype(half)._data)
         else:
             self.update(index, weight, grad, state)
 

@@ -1,0 +1,259 @@
+"""AMP (bf16 mixed-precision) coverage — the flagship TPU training precision.
+
+Round-1 lesson: the default bench path (bf16 conv train) shipped broken
+because no test exercised a conv BACKWARD in bf16 (forward-only AMP tests
+missed a dtype mismatch in the conv transpose rule). These tests pin:
+
+  * bf16 forward+backward for the whole nn op family (conv/dense/BN/pool/
+    softmax/layernorm/... — mirrors the reference's fp16 coverage,
+    tests/python/train/test_dtype.py + test_operator.py fp16 runs);
+  * bf16 end-to-end training convergence through BOTH trainers
+    (parallel.DistributedTrainer amp_dtype path and gluon.Trainer with a
+    bf16-cast net + multi_precision optimizer);
+  * master-weight dtype invariants (params/optimizer state stay fp32 while
+    compute runs bf16 — reference analogue: multi-precision SGD,
+    python/mxnet/optimizer/optimizer.py fp32 master weights).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, loss as gloss
+
+
+BF16 = "bfloat16"
+
+
+def _bf16(arr):
+    return mx.nd.array(arr).astype(BF16)
+
+
+# ---------------------------------------------------------------------------
+# op-family bf16 forward + backward sweep
+# ---------------------------------------------------------------------------
+
+def _grad_through(net_fn, *inputs):
+    """Run fwd+bwd under autograd; return (out, grads). All bf16 in/out."""
+    nds = [x.copy() for x in inputs]
+    for nd_ in nds:
+        nd_.attach_grad()
+    with autograd.record():
+        out = net_fn(*nds)
+        loss = out.astype("float32").sum()
+    loss.backward()
+    return out, [nd_.grad for nd_ in nds]
+
+
+@pytest.mark.parametrize("case", [
+    "convolution", "deconvolution", "fully_connected", "batchnorm",
+    "layernorm", "pooling", "global_pool", "activation", "softmax",
+    "log_softmax", "dropout", "embedding_out", "leaky_relu",
+])
+def test_bf16_nn_family_fwd_bwd(case):
+    rng = np.random.RandomState(0)
+    x = _bf16(rng.uniform(-1, 1, (4, 3, 8, 8)).astype(np.float32))
+    if case == "convolution":
+        w = _bf16(rng.uniform(-1, 1, (6, 3, 3, 3)).astype(np.float32))
+        out, grads = _grad_through(
+            lambda a, b: mx.nd.Convolution(a, b, kernel=(3, 3), num_filter=6,
+                                           no_bias=True, pad=(1, 1)), x, w)
+        assert out.shape == (4, 6, 8, 8)
+    elif case == "deconvolution":
+        w = _bf16(rng.uniform(-1, 1, (3, 6, 3, 3)).astype(np.float32))
+        out, grads = _grad_through(
+            lambda a, b: mx.nd.Deconvolution(a, b, kernel=(3, 3),
+                                             num_filter=6, no_bias=True), x, w)
+    elif case == "fully_connected":
+        xf = _bf16(rng.uniform(-1, 1, (4, 12)).astype(np.float32))
+        w = _bf16(rng.uniform(-1, 1, (5, 12)).astype(np.float32))
+        b = _bf16(np.zeros(5, np.float32))
+        out, grads = _grad_through(
+            lambda a, ww, bb: mx.nd.FullyConnected(a, ww, bb, num_hidden=5),
+            xf, w, b)
+    elif case == "batchnorm":
+        g = _bf16(np.ones(3, np.float32))
+        bt = _bf16(np.zeros(3, np.float32))
+        mean = mx.nd.zeros((3,)).astype(BF16)
+        var = mx.nd.ones((3,)).astype(BF16)
+        with autograd.record():
+            xx = x.copy()
+            xx.attach_grad()
+            out = mx.nd.BatchNorm(xx, g, bt, mean, var)
+            out.astype("float32").sum().backward()
+        grads = [xx.grad]
+    elif case == "layernorm":
+        g = _bf16(np.ones(8, np.float32))
+        bt = _bf16(np.zeros(8, np.float32))
+        out, grads = _grad_through(
+            lambda a, gg, bb: mx.nd.LayerNorm(a, gg, bb, axis=-1), x, g, bt)
+    elif case == "pooling":
+        out, grads = _grad_through(
+            lambda a: mx.nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                                    pool_type="max"), x)
+    elif case == "global_pool":
+        out, grads = _grad_through(
+            lambda a: mx.nd.Pooling(a, global_pool=True, pool_type="avg"), x)
+    elif case == "activation":
+        out, grads = _grad_through(
+            lambda a: mx.nd.Activation(a, act_type="relu"), x)
+    elif case == "softmax":
+        out, grads = _grad_through(lambda a: mx.nd.softmax(a, axis=-1), x)
+    elif case == "log_softmax":
+        out, grads = _grad_through(lambda a: mx.nd.log_softmax(a, axis=-1), x)
+    elif case == "dropout":
+        with autograd.record(train_mode=True):
+            xx = x.copy()
+            xx.attach_grad()
+            out = mx.nd.Dropout(xx, p=0.5)
+            out.astype("float32").sum().backward()
+        grads = [xx.grad]
+    elif case == "embedding_out":
+        idx = mx.nd.array(np.array([[0, 1], [2, 1]], np.float32))
+        w = _bf16(rng.uniform(-1, 1, (4, 6)).astype(np.float32))
+        with autograd.record():
+            ww = w.copy()
+            ww.attach_grad()
+            out = mx.nd.Embedding(idx, ww, input_dim=4, output_dim=6)
+            out.astype("float32").sum().backward()
+        grads = [ww.grad]
+    elif case == "leaky_relu":
+        out, grads = _grad_through(
+            lambda a: mx.nd.LeakyReLU(a, act_type="leaky", slope=0.1), x)
+    else:  # pragma: no cover
+        raise AssertionError(case)
+
+    assert str(np.dtype(out.dtype)) == BF16, f"{case}: out dtype {out.dtype}"
+    for g_ in grads:
+        assert g_ is not None, f"{case}: missing grad"
+        assert str(np.dtype(g_.dtype)) == BF16, f"{case}: grad dtype {g_.dtype}"
+        assert np.isfinite(g_.astype("float32").asnumpy()).all(), \
+            f"{case}: non-finite grad"
+
+
+# ---------------------------------------------------------------------------
+# DistributedTrainer amp_dtype=bfloat16 (the bench.py default path)
+# ---------------------------------------------------------------------------
+
+def _conv_net(prefix):
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, prefix="c1_"),
+                nn.BatchNorm(prefix="bn1_"),
+                nn.Activation("relu", prefix="a1_"),
+                nn.GlobalAvgPool2D(prefix="p1_"),
+                nn.Dense(4, prefix="d1_"))
+    net.initialize()
+    return net
+
+
+def test_distributed_trainer_bf16_convergence():
+    import jax
+
+    from mxnet_tpu.parallel import DistributedTrainer, make_mesh
+
+    rng = np.random.RandomState(0)
+    xs = rng.uniform(-1, 1, (16, 3, 8, 8)).astype(np.float32)
+    ys = (np.arange(16) % 4).astype(np.float32)
+    # class-dependent channel shift → linearly separable through GAP features
+    for i, c in enumerate(ys.astype(int)):
+        xs[i, c % 3] += 2.0 * (1 + c // 3)
+    x, y = mx.nd.array(xs), mx.nd.array(ys)
+    net = _conv_net("ampconv_")
+    net(x)
+
+    mesh = make_mesh([("dp", 2)], devices=jax.devices()[:2])
+    tr = DistributedTrainer(net, "sgd",
+                            {"learning_rate": 0.2, "momentum": 0.9},
+                            loss=gloss.SoftmaxCrossEntropyLoss(),
+                            mesh=mesh, amp_dtype=BF16)
+    losses = [float(tr.step(x, y).asnumpy()) for _ in range(12)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0] * 0.8, f"bf16 training did not learn: {losses}"
+    # master weights + optimizer state stay fp32 (bf16 is compute-only)
+    for arr in (tr._arrays[i] for i in tr._trainable):
+        assert str(arr.dtype) == "float32"
+    import jax as _jax
+    for st in tr._states:
+        for leaf in _jax.tree_util.tree_leaves(st):
+            assert str(leaf.dtype) == "float32"
+
+
+def test_distributed_trainer_bf16_matches_fp32_direction():
+    """One bf16 step moves the loss the same direction as fp32 (sanity that
+    the cast-inside-grad AMP wiring computes real gradients)."""
+    import jax
+
+    from mxnet_tpu.parallel import DistributedTrainer, make_mesh
+
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.uniform(-1, 1, (8, 10)).astype(np.float32))
+    y = mx.nd.array((np.arange(8) % 3).astype(np.float32))
+
+    results = {}
+    for tag, amp in [("fp32", None), ("bf16", BF16)]:
+        mx.random.seed(3)
+        net = nn.HybridSequential(prefix=f"ampdir{tag}_")
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu", prefix="d1_"),
+                    nn.Dense(3, prefix="d2_"))
+        net.initialize()
+        net(x)
+        mesh = make_mesh([("dp", 1)], devices=jax.devices()[:1])
+        tr = DistributedTrainer(net, "sgd", {"learning_rate": 0.5},
+                                loss=gloss.SoftmaxCrossEntropyLoss(),
+                                mesh=mesh, amp_dtype=amp)
+        results[tag] = [float(tr.step(x, y).asnumpy()) for _ in range(6)]
+    # both learn, and bf16 tracks fp32 loss within coarse tolerance
+    for tag in results:
+        assert results[tag][-1] < results[tag][0]
+    assert abs(results["bf16"][-1] - results["fp32"][-1]) < 0.35, results
+
+
+# ---------------------------------------------------------------------------
+# gluon.Trainer path: bf16-cast net + multi_precision master weights
+# ---------------------------------------------------------------------------
+
+def test_gluon_trainer_bf16_multi_precision():
+    rng = np.random.RandomState(2)
+    x = _bf16(rng.uniform(-1, 1, (16, 10)).astype(np.float32))
+    y = mx.nd.array((np.arange(16) % 3).astype(np.float32))
+
+    net = nn.HybridSequential(prefix="gtbf16_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", prefix="d1_"),
+                nn.Dense(3, prefix="d2_"))
+    net.initialize()
+    net.cast(BF16)
+    net(x)  # deferred init in bf16
+
+    for p in net.collect_params().values():
+        assert str(np.dtype(p.dtype)) == BF16
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9,
+                             "multi_precision": True})
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            out = net(x)
+            l = lfn(out.astype("float32"), y)
+        l.backward()
+        trainer.step(16)
+        losses.append(float(l.mean().asnumpy()))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0] * 0.9, f"bf16 gluon training stuck: {losses}"
+    # weights remain bf16; the updater holds fp32 master copies
+    for p in net.collect_params().values():
+        assert str(np.dtype(p.data().dtype)) == BF16
+    states = trainer._updaters[0].states if hasattr(trainer, "_updaters") \
+        else {}
+    saw_master = False
+    for st in states.values():
+        if isinstance(st, tuple) and len(st) == 2:
+            _, w32 = st
+            if hasattr(w32, "dtype"):
+                assert str(np.dtype(w32.dtype)) == "float32"
+                saw_master = True
+    assert saw_master, "multi_precision updater kept no fp32 master weights"
