@@ -127,7 +127,15 @@ def _flatten(sym_mod, inputs, attrs, params):
 @_imports("Reshape")
 def _reshape(sym_mod, inputs, attrs, params):
     shape = attrs.get("shape")
-    return sym_mod.Reshape(inputs[0], shape=tuple(shape))
+    if shape is None:
+        # opset >= 5: shape arrives as the 2nd input tensor (an initializer);
+        # resolve it through params like the reference's onnx2mx reshape
+        # translation (reference: onnx2mx/_op_translations.py reshape)
+        if len(inputs) < 2 or inputs[1].name not in params:
+            raise MXNetError("Reshape: no shape attribute and the shape "
+                             "input is not a constant initializer")
+        shape = params[inputs[1].name]
+    return sym_mod.Reshape(inputs[0], shape=tuple(int(s) for s in shape))
 
 
 @_imports("Add")
@@ -264,7 +272,10 @@ def export_model(sym, params, input_shape, input_type=_np.float32,
                 nodes.append(helper.make_node(
                     kind, ins[:1], [node.name],
                     kernel_shape=list(a.get("kernel", ())),
-                    strides=list(a.get("stride", (1, 1)) or (1, 1))))
+                    strides=list(a.get("stride", (1, 1)) or (1, 1)),
+                    # like the Conv branch: padded pools must export their
+                    # geometry, else the consumer sees implicit zero pad
+                    pads=list(a.get("pad", (0, 0)) or (0, 0)) * 2))
         elif node.op == "Flatten":
             nodes.append(helper.make_node("Flatten", ins[:1], [node.name]))
         elif node.op in ("softmax", "SoftmaxOutput"):

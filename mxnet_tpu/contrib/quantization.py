@@ -9,9 +9,10 @@ dequantize chains. Calibration modes:
 - 'none'   — runtime min/max per batch (quantize_v2 without calib ranges)
 - 'naive'  — exact min/max of each quantized input collected over the
              calibration set (reference: collect_layer_output_min_max)
-- 'entropy'— percentile-clipped ranges (99.99th |value|), a light-weight
-             stand-in for the reference's KL-divergence threshold search
-             (documented divergence; same API)
+- 'entropy'— KL-divergence threshold search over layer-output histograms
+             (reference: contrib/quantization.py _get_optimal_threshold —
+             minimize KL(P||Q) between the clipped fp32 distribution P and
+             its 255-bin int8 quantization Q over candidate thresholds)
 """
 from __future__ import annotations
 
@@ -38,14 +39,48 @@ def _can_quantize(node):
     return True
 
 
-def _collect_ranges(sym, arg_params, aux_params, calib_data,
-                    num_calib_examples, mode, data_names=("data",),
-                    label_names=("softmax_label",)):
-    """Run calibration batches through every internal output, returning
-    {(node_id, out_idx): (min, max)} (reference:
-    _LayerOutputMinMaxCollector)."""
+def _kl_divergence(p, q):
+    """KL(P||Q) over matched nonzero support, both unnormalized counts."""
+    mask = p > 0
+    p = p[mask].astype(_np.float64)
+    q = q[mask].astype(_np.float64)
+    q = _np.maximum(q, 1e-12)
+    p = p / p.sum()
+    q = q / q.sum()
+    return float(_np.sum(p * _np.log(p / q)))
+
+
+def _optimal_threshold(hist, amax, num_quantized_bins=255):
+    """KL-minimizing symmetric clip threshold from an |value| histogram
+    (reference: contrib/quantization.py _get_optimal_threshold — the
+    TensorRT-style search: for each candidate bin count i, fold outliers
+    into the edge bin to form P, quantize P's support into
+    num_quantized_bins to form Q, keep the threshold with least KL)."""
+    num_bins = hist.size
+    if amax == 0.0 or hist.sum() == 0:
+        return amax
+    best_div, best_i = _np.inf, num_bins
+    hist = hist.astype(_np.float64)
+    tail = _np.concatenate([_np.cumsum(hist[::-1])[::-1][1:], [0.0]])
+    for i in range(num_quantized_bins, num_bins + 1, 2):
+        p = hist[:i].copy()
+        p[i - 1] += tail[i - 1]          # clipped outliers -> edge bin
+        idx = _np.arange(i) * num_quantized_bins // i
+        counts = _np.bincount(idx, weights=p, minlength=num_quantized_bins)
+        nz = (p > 0).astype(_np.float64)
+        denom = _np.bincount(idx, weights=nz, minlength=num_quantized_bins)
+        # expand Q back over P's support: each nonzero source bin gets its
+        # quantized bin's mass split evenly over that bin's nonzero sources
+        q = _np.where(nz > 0, counts[idx] / _np.maximum(denom[idx], 1.0), 0.0)
+        div = _kl_divergence(p, q)
+        if div < best_div:
+            best_div, best_i = div, i
+    return (best_i + 0.5) * amax / num_bins
+
+
+def _iter_calib(sym, arg_params, aux_params, calib_data, num_calib_examples):
+    """Yield lists of per-internal-output numpy arrays per batch."""
     internals = sym.get_internals()
-    samples = {}
     seen = 0
     for batch in calib_data:
         values = {}
@@ -59,24 +94,55 @@ def _collect_ranges(sym, arg_params, aux_params, calib_data,
         outs, _ = internals._interpret(
             {k: (v._data if hasattr(v, "_data") else v)
              for k, v in values.items()})
-        for (node, idx), out in zip(internals._outputs, outs):
-            a = _np.asarray(out)
-            key = (id(node), idx)
-            if mode == "entropy":
-                flat = _np.abs(a.reshape(-1))
-                thr = float(_np.percentile(flat, 99.99)) if flat.size else 0.0
-                mn, mx = -thr, thr
-            else:
-                mn, mx = float(a.min()), float(a.max())
-            if key in samples:
-                omn, omx = samples[key]
-                samples[key] = (min(omn, mn), max(omx, mx))
-            else:
-                samples[key] = (mn, mx)
+        yield [((node, idx), _np.asarray(out))
+               for (node, idx), out in zip(internals._outputs, outs)]
         seen += batch.data[0].shape[0]
         if num_calib_examples is not None and seen >= num_calib_examples:
             break
     calib_data.reset()
+
+
+def _collect_ranges(sym, arg_params, aux_params, calib_data,
+                    num_calib_examples, mode, data_names=("data",),
+                    label_names=("softmax_label",), num_bins=8001):
+    """Run calibration batches through every internal output, returning
+    {(node_id, out_idx): (min, max)} (reference:
+    _LayerOutputMinMaxCollector / _LayerHistogramCollector)."""
+    samples = {}
+    if mode != "entropy":
+        for batch_outs in _iter_calib(sym, arg_params, aux_params,
+                                      calib_data, num_calib_examples):
+            for (node, idx), a in batch_outs:
+                key = (id(node), idx)
+                mn, mx = float(a.min()), float(a.max())
+                if key in samples:
+                    omn, omx = samples[key]
+                    samples[key] = (min(omn, mn), max(omx, mx))
+                else:
+                    samples[key] = (mn, mx)
+        return samples
+    # entropy: pass 1 finds each tensor's |max| (fixing its histogram
+    # range), pass 2 accumulates histograms, then the KL search picks the
+    # clip threshold per tensor
+    amax = {}
+    for batch_outs in _iter_calib(sym, arg_params, aux_params, calib_data,
+                                  num_calib_examples):
+        for (node, idx), a in batch_outs:
+            key = (id(node), idx)
+            m = float(_np.abs(a).max()) if a.size else 0.0
+            amax[key] = max(amax.get(key, 0.0), m)
+    hists = {k: _np.zeros(num_bins, _np.int64) for k in amax}
+    for batch_outs in _iter_calib(sym, arg_params, aux_params, calib_data,
+                                  num_calib_examples):
+        for (node, idx), a in batch_outs:
+            key = (id(node), idx)
+            if amax[key] > 0 and a.size:
+                h, _ = _np.histogram(_np.abs(a.reshape(-1)), bins=num_bins,
+                                     range=(0.0, amax[key]))
+                hists[key] += h
+    for key in amax:
+        thr = _optimal_threshold(hists[key], amax[key])
+        samples[key] = (-thr, thr)
     return samples
 
 

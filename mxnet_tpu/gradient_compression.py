@@ -36,6 +36,14 @@ class GradientCompression:
     def get_params(self):
         return {"type": self.type, "threshold": self.threshold}
 
+    def reset(self, key):
+        """Drop error-feedback residuals for `key` (all devices) — called
+        when a kvstore key is (re)initialized."""
+        for rk in [rk for rk in self._residual
+                   if rk == key or (isinstance(rk, tuple) and rk
+                                    and rk[0] == key)]:
+            del self._residual[rk]
+
     def quantize(self, key, grad):
         """grad (NDArray) -> ternary compressed NDArray {-t, 0, +t}; the
         unsent remainder accumulates in the residual for `key`

@@ -107,6 +107,12 @@ class KVStore:
                 # row_sparse_pull gathers rows back out
                 v = v.tostype("default")
             self._store[k] = v.copy()
+            # a (re)initialized key starts with clean error-feedback state —
+            # stale residuals from a previous life of the key would inject
+            # phantom gradient mass into the first push
+            comp = getattr(self, "_compression", None)
+            if comp is not None:
+                comp.reset(k)
 
     def push(self, key, value, priority=0):
         """Aggregate value(s) into the store (reference: kvstore.py:160).
@@ -125,10 +131,12 @@ class KVStore:
                 raise MXNetError("key %r has not been initialized" % (k,))
             if comp is not None and not isinstance(vgroup[0], BaseSparseNDArray):
                 # quantize each device's contribution separately, with a
-                # per-(key, slot) residual — the reference keeps one residual
-                # per worker the same way (kvstore_dist.h gc_->Quantize)
-                vgroup = [comp.quantize((k, i), v)
-                          for i, v in enumerate(vgroup)]
+                # per-(key, device) residual — keyed by the gradient's
+                # context, which is stable even if the number/order of
+                # per-device grads changes between pushes (the reference
+                # keeps one residual per worker: kvstore_dist.h gc_->Quantize)
+                vgroup = [comp.quantize((k, str(v.context)), v)
+                          for v in vgroup]
             merged = vgroup[0]
             for v in vgroup[1:]:
                 if isinstance(merged, BaseSparseNDArray) or \

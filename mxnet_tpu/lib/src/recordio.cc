@@ -202,7 +202,13 @@ int mxtpu_prefetch_next(void* handle, const char** data, uint64_t* len) {
 
 void mxtpu_prefetch_close(void* handle) {
   auto* p = static_cast<Prefetcher*>(handle);
-  p->stop.store(true);
+  {
+    // store stop under the mutex: a bare store+notify can land between the
+    // worker's predicate check and its wait, and the wakeup is lost — the
+    // worker then blocks forever and join() hangs
+    std::lock_guard<std::mutex> lock(p->mu);
+    p->stop.store(true);
+  }
   p->cv_push.notify_all();
   if (p->worker.joinable()) p->worker.join();
   if (p->f) std::fclose(p->f);

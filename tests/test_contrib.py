@@ -105,6 +105,75 @@ def test_quantize_model_accuracy():
     np.testing.assert_allclose(qt_np, fp_np, atol=0.25, rtol=0.25)
 
 
+def _calib_iter(X, batch=8, shape=None):
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    shape = shape or (batch,) + X.shape[1:]
+
+    class _Iter:
+        def __init__(self):
+            self.provide_data = [DataDesc("data", shape, np.float32)]
+            self.provide_label = []
+            self._i = 0
+
+        def __iter__(self):
+            self._i = 0
+            return self
+
+        def __next__(self):
+            if (self._i + 1) * batch > X.shape[0]:
+                raise StopIteration
+            b = DataBatch(
+                data=[mx.nd.array(X[self._i * batch:(self._i + 1) * batch])])
+            self._i += 1
+            return b
+
+        def reset(self):
+            self._i = 0
+
+    return _Iter()
+
+
+def test_kl_optimal_threshold_clips_outliers():
+    """The KL search must clip a lone huge outlier instead of stretching the
+    int8 range over it (reference: _get_optimal_threshold behavior)."""
+    rng = np.random.RandomState(0)
+    vals = rng.normal(0, 1.0, 50000).astype(np.float32)
+    vals[0] = 100.0  # one outlier 25x the bulk
+    amax = float(np.abs(vals).max())
+    hist, _ = np.histogram(np.abs(vals), bins=8001, range=(0, amax))
+    thr = q._optimal_threshold(hist, amax)
+    assert thr < 10.0, f"KL threshold {thr} failed to clip the outlier"
+    assert thr > 1.0, f"KL threshold {thr} clipped the bulk"
+
+
+def test_quantize_model_entropy_conv_accuracy():
+    """entropy (KL) calibration on a small conv net: <1% of predictions may
+    flip vs fp32 (VERDICT round-1 item 10 done-criterion)."""
+    data = mx.sym.var("data")
+    h = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                           pad=(1, 1), name="conv1")
+    h = mx.sym.relu(h)
+    h = mx.sym.Pooling(h, global_pool=True, pool_type="avg", name="gap")
+    h = mx.sym.Flatten(h)
+    sym = mx.sym.FullyConnected(data=h, num_hidden=4, name="fc1")
+
+    params = _rand_params(sym, {"data": (8, 3, 8, 8)})
+    rng = np.random.RandomState(3)
+    X = rng.uniform(-1, 1, (64, 3, 8, 8)).astype(np.float32)
+    # heavy-tailed activations: make KL clipping actually matter
+    X[::17] *= 5.0
+
+    qsym, qargs, _ = q.quantize_model(sym, params, {}, calib_mode="entropy",
+                                      calib_data=_calib_iter(X),
+                                      num_calib_examples=32)
+    fp = sym.eval_with({**{"data": X}, **params}).asnumpy()
+    qt = qsym.eval_with({**{"data": X}, **qargs}).asnumpy()
+    agree = (fp.argmax(axis=1) == qt.argmax(axis=1)).mean()
+    assert agree >= 0.99, "entropy-calibrated int8 flipped %.1f%% preds" % (
+        100 * (1 - agree))
+
+
 def test_text_vocab():
     counter = ctext.count_tokens_from_str("a b b c c c\nd d d d")
     vocab = ctext.Vocabulary(counter, min_freq=2, unknown_token="<unk>")
