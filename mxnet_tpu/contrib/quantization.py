@@ -40,14 +40,22 @@ def _can_quantize(node):
 
 
 def _kl_divergence(p, q):
-    """KL(P||Q) over matched nonzero support, both unnormalized counts."""
+    """KL(P||Q), both unnormalized counts. Each is normalized by its FULL
+    mass (not just P's support) so mass Q fails to place where P has it is
+    charged — masking+renormalizing Q over P's support would score a
+    single-spike P as a perfect match for any Q."""
+    p = p.astype(_np.float64)
+    q = q.astype(_np.float64)
+    psum, qsum = p.sum(), q.sum()
+    if psum == 0.0:
+        return 0.0
+    if qsum == 0.0:
+        return _np.inf
+    p = p / psum
+    q = q / qsum
     mask = p > 0
-    p = p[mask].astype(_np.float64)
-    q = q[mask].astype(_np.float64)
-    q = _np.maximum(q, 1e-12)
-    p = p / p.sum()
-    q = q / q.sum()
-    return float(_np.sum(p * _np.log(p / q)))
+    return float(_np.sum(p[mask] * _np.log(p[mask] /
+                                           _np.maximum(q[mask], 1e-12))))
 
 
 def _optimal_threshold(hist, amax, num_quantized_bins=255):
@@ -63,10 +71,17 @@ def _optimal_threshold(hist, amax, num_quantized_bins=255):
     hist = hist.astype(_np.float64)
     tail = _np.concatenate([_np.cumsum(hist[::-1])[::-1][1:], [0.0]])
     for i in range(num_quantized_bins, num_bins + 1, 2):
-        p = hist[:i].copy()
+        sliced = hist[:i]
+        p = sliced.copy()
         p[i - 1] += tail[i - 1]          # clipped outliers -> edge bin
         idx = _np.arange(i) * num_quantized_bins // i
-        counts = _np.bincount(idx, weights=p, minlength=num_quantized_bins)
+        # Q is built from the UNFOLDED slice (reference quantization.py
+        # _get_optimal_threshold): P carries the clipped-outlier mass in its
+        # edge bin but Q cannot represent it, so KL(P||Q) charges each
+        # candidate threshold for what it clips. Folding the tail into Q too
+        # would make Q==P at i==num_quantized_bins (identity bin map) and the
+        # search would degenerate to always picking the smallest threshold.
+        counts = _np.bincount(idx, weights=sliced, minlength=num_quantized_bins)
         nz = (p > 0).astype(_np.float64)
         denom = _np.bincount(idx, weights=nz, minlength=num_quantized_bins)
         # expand Q back over P's support: each nonzero source bin gets its

@@ -36,9 +36,13 @@ class GradientCompression:
     def get_params(self):
         return {"type": self.type, "threshold": self.threshold}
 
-    def reset(self, key):
-        """Drop error-feedback residuals for `key` (all devices) — called
-        when a kvstore key is (re)initialized."""
+    def reset(self, key=None):
+        """Drop error-feedback residuals for `key` (all devices), or all
+        residuals when key is None — called when a kvstore key is
+        (re)initialized."""
+        if key is None:
+            self._residual.clear()
+            return
         for rk in [rk for rk in self._residual
                    if rk == key or (isinstance(rk, tuple) and rk
                                     and rk[0] == key)]:
@@ -66,6 +70,3 @@ class GradientCompression:
         format packs 2-bit codes; the value decode yields the same ternary
         array this returns)."""
         return compressed
-
-    def reset(self):
-        self._residual.clear()

@@ -164,14 +164,28 @@ def test_quantize_model_entropy_conv_accuracy():
     # heavy-tailed activations: make KL clipping actually matter
     X[::17] *= 5.0
 
-    qsym, qargs, _ = q.quantize_model(sym, params, {}, calib_mode="entropy",
-                                      calib_data=_calib_iter(X),
-                                      num_calib_examples=32)
     fp = sym.eval_with({**{"data": X}, **params}).asnumpy()
-    qt = qsym.eval_with({**{"data": X}, **qargs}).asnumpy()
-    agree = (fp.argmax(axis=1) == qt.argmax(axis=1)).mean()
-    assert agree >= 0.99, "entropy-calibrated int8 flipped %.1f%% preds" % (
-        100 * (1 - agree))
+    agree = {}
+    for mode in ("naive", "entropy"):
+        qsym, qargs, _ = q.quantize_model(sym, params, {}, calib_mode=mode,
+                                          calib_data=_calib_iter(X),
+                                          num_calib_examples=32)
+        qt = qsym.eval_with({**{"data": X}, **qargs}).asnumpy()
+        agree[mode] = (fp.argmax(axis=1) == qt.argmax(axis=1))
+        if mode == "entropy":
+            err = np.abs(fp - qt).max()
+    # KL clipping must not lose to exact min/max ranges on heavy-tailed data,
+    # logits must stay close, and any flip must be a genuine near-tie (int8
+    # rounding noise alone flips sub-noise margins even with perfect ranges)
+    assert agree["entropy"].mean() >= agree["naive"].mean(), \
+        "entropy (%.3f) worse than naive (%.3f)" % (agree["entropy"].mean(),
+                                                    agree["naive"].mean())
+    assert err < 0.1, "entropy-calibrated int8 logit error %.3f" % err
+    top2 = np.sort(fp, axis=1)
+    margin = top2[:, -1] - top2[:, -2]
+    decisive = margin >= 0.1
+    assert agree["entropy"][decisive].all(), \
+        "entropy calibration flipped a decisively-classified sample"
 
 
 def test_text_vocab():
