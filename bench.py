@@ -24,6 +24,11 @@ Reported fields beyond the driver's required four:
 MXTPU_BENCH_MODE=score switches to inference scoring (mirrors the
 reference's example/image-classification/benchmark_score.py — forward-only
 imgs/sec vs the V100 1076.81 fp32 / 2085.51 fp16 rows, perf.md:176,190).
+
+MXTPU_BENCH_MODE=bert runs a BERT-base (12/768/12) masked-LM-shaped train
+step (flash-attention MHA) and reports tokens/sec + MFU. The reference has
+no in-tree BERT throughput number (GluonNLP is external — SURVEY §6), so
+vs_baseline is measured against BASELINE.json's ≥60%-MFU target instead.
 """
 from __future__ import annotations
 
@@ -219,6 +224,101 @@ def bench_score():
     }))
 
 
+def bench_bert():
+    """BERT-base train-step tokens/sec (BASELINE.json config 'BERT-base
+    pretraining'). Synthetic token batches; the step is the full compiled
+    fwd (flash-attention encoder) + vocab-head CE + bwd + Adam update."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.gluon.model_zoo.transformer import bert_12_768_12
+    from mxnet_tpu.parallel import DistributedTrainer, make_mesh
+
+    seq_len = int(os.environ.get("MXTPU_BENCH_SEQLEN", 512))
+    batch = int(os.environ.get("MXTPU_BENCH_BATCH", 8))
+    vocab = 30522
+
+    class BERTPretrain(HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                # dropout 0: throughput benchmark measures the math, not rng
+                self.bert = bert_12_768_12(dropout=0.0)
+                self.mlm = nn.Dense(vocab, flatten=False, prefix="mlm_")
+
+        def hybrid_forward(self, F, tokens):
+            seq, _ = self.bert(tokens)
+            return self.mlm(seq)
+
+    ctx = mx.tpu()
+    dev = jax.devices()[0]
+    with ctx:
+        net = BERTPretrain()
+        net.initialize(mx.init.Xavier())
+        rng = np.random.RandomState(0)
+        tokens = mx.nd.array(rng.randint(0, vocab, (batch, seq_len))
+                             .astype(np.int32), ctx=ctx, dtype="int32")
+        labels = mx.nd.array(rng.randint(0, vocab, (batch, seq_len))
+                             .astype(np.float32), ctx=ctx)
+        net(tokens)
+
+    mesh = make_mesh([("dp", 1)], devices=[dev])
+    trainer = DistributedTrainer(
+        net, "adam", {"learning_rate": 1e-4},
+        loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh,
+        amp_dtype=AMP_DTYPE)
+
+    for _ in range(WARMUP):
+        trainer.step(tokens, labels)
+    trainer.step(tokens, labels).asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = trainer.step(tokens, labels)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch * seq_len * ITERS / dt
+
+    step_ms = []
+    for _ in range(ITERS):
+        t1 = time.perf_counter()
+        trainer.step(tokens, labels).asnumpy()
+        step_ms.append((time.perf_counter() - t1) * 1e3)
+
+    # standard transformer accounting: 6*N FLOPs per token for fwd+bwd over
+    # the non-embedding params, + 12*layers*units*seq for attention scores
+    n_params = sum(int(np.prod(p.shape))
+                   for n, p in net.collect_params().items())
+    # embedding tables don't contribute matmul FLOPs; they are created with
+    # the word_/segment_/pos_ prefixes (transformer.py BERTModel)
+    n_embed = sum(int(np.prod(p.shape))
+                  for n, p in net.collect_params().items()
+                  if any(t in n for t in ("word_", "segment_", "pos_")))
+    flops_per_token = 6 * (n_params - n_embed) + 12 * 12 * 768 * seq_len
+    peak = _chip_peak_tflops(dev)
+    mfu = (tokens_per_sec * flops_per_token / (peak * 1e12)) if peak else None
+
+    out = {
+        "metric": "bert_base_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.60, 3) if mfu is not None else None,
+        "dtype": AMP_DTYPE or "float32",
+        "baseline": {"target_mfu": 0.60,
+                     "note": "no in-tree reference BERT number (perf.md has "
+                             "CNNs only); ratio is mfu/target"},
+        "batch": batch, "seq_len": seq_len,
+        "params": n_params, "flops_per_token": flops_per_token,
+        "peak_bf16_tflops": peak,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+    }
+    out.update(_percentiles(step_ms))
+    print(json.dumps(out))
+
+
 def main():
     # a sitecustomize PJRT hook force-overrides jax_platforms at interpreter
     # start; re-assert the env's explicit choice so JAX_PLATFORMS=cpu smoke
@@ -229,6 +329,8 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     if MODE == "score":
         bench_score()
+    elif MODE == "bert":
+        bench_bert()
     else:
         bench_train()
 

@@ -13,6 +13,13 @@ import numpy as _np
 
 __all__ = ["MXNetError", "string_types", "numeric_types", "integer_types"]
 
+# Host-array mode: when True, host-side pipeline stages (image decode,
+# dataset __getitem__) hand back plain numpy instead of NDArray. Set in
+# DataLoader worker processes, where touching the (forked) jax runtime
+# deadlocks and where the TPU tunnel must never be dialed. See
+# gluon/data/dataloader.py.
+HOST_ARRAY_MODE = False
+
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (reference: python/mxnet/base.py:49)."""

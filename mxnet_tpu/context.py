@@ -65,15 +65,22 @@ class Context:
 
     # -- JAX resolution ---------------------------------------------------
     def jax_device(self):
-        """Resolve to a concrete `jax.Device`."""
+        """Resolve to a concrete `jax.Device`. Always a process-LOCAL device:
+        under jax.distributed the global list contains other hosts'
+        non-addressable devices, which a Context must never resolve to (the
+        reference's Context is likewise host-local; cross-host placement
+        goes through the mesh/sharding layer)."""
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            try:
+                devs = jax.local_devices(backend="cpu")
+            except RuntimeError:
+                devs = jax.devices("cpu")
             return devs[min(self.device_id, len(devs) - 1)]
         # 'gpu' and 'tpu' both mean "accelerator": prefer the default backend's
         # devices (TPU when present), fall back to cpu so CPU-only test runs work.
-        devs = jax.devices()
+        devs = jax.local_devices()
         if devs[0].platform == "cpu" and self.device_type in ("gpu", "tpu"):
             return devs[min(self.device_id, len(devs) - 1)]
         if self.device_id >= len(devs):

@@ -30,9 +30,16 @@ class _DownloadedDataset(Dataset):
         self._get_data()
 
     def __getitem__(self, idx):
+        from ....base import HOST_ARRAY_MODE
+
+        # payloads are stored numpy (host memory); wrapped per-item so that
+        # DataLoader worker processes (HOST_ARRAY_MODE) never touch jax
+        data = self._data[idx]
+        if not HOST_ARRAY_MODE:
+            data = nd.array(data, dtype=str(data.dtype))
         if self._transform is not None:
-            return self._transform(self._data[idx], self._label[idx])
-        return self._data[idx], self._label[idx]
+            return self._transform(data, self._label[idx])
+        return data, self._label[idx]
 
     def __len__(self):
         return len(self._label)
@@ -72,7 +79,7 @@ class MNIST(_DownloadedDataset):
         raw = self._read_file(images)
         magic, num, rows, cols = struct.unpack(">IIII", raw[:16])
         data = _np.frombuffer(raw[16:], dtype=_np.uint8).reshape(num, rows, cols, 1)
-        self._data = nd.array(data, dtype="uint8")
+        self._data = data  # numpy uint8 (host)
         self._label = label
 
 
@@ -117,7 +124,7 @@ class CIFAR10(_DownloadedDataset):
             d = self._load_batch(name)
             data.append(d["data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
             labels.append(_np.asarray(d["labels" if "labels" in d else "fine_labels"]))
-        self._data = nd.array(_np.concatenate(data), dtype="uint8")
+        self._data = _np.concatenate(data)  # numpy uint8 (host)
         self._label = _np.concatenate(labels).astype(_np.int32)
 
 

@@ -10,13 +10,19 @@ from .squeezenet import *  # noqa: F401,F403
 from .densenet import *  # noqa: F401,F403
 from .inception import *  # noqa: F401,F403
 from .mobilenet import *  # noqa: F401,F403
-
-from . import resnet, alexnet, vgg, squeezenet, densenet, inception, mobilenet  # noqa: F401
+from .ssd import *  # noqa: F401,F403
 
 from ....base import MXNetError
 
+# resolve submodules via sys.modules: `from .alexnet import *` binds the
+# *function* alexnet over the package attribute, so `from . import alexnet`
+# would hand the loop a function with no __all__ and silently skip the family
+import sys as _sys
+
 _models = {}
-for _mod in (resnet, alexnet, vgg, squeezenet, densenet, inception, mobilenet):
+for _mod in [_sys.modules[__name__ + "." + _m]
+             for _m in ("resnet", "alexnet", "vgg", "squeezenet", "densenet",
+                        "inception", "mobilenet", "ssd")]:
     for _name in getattr(_mod, "__all__", []):
         _obj = getattr(_mod, _name)
         if callable(_obj) and _name[0].islower():

@@ -4,8 +4,6 @@ from __future__ import annotations
 from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
-from .... import ndarray as nd
-
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201"]
 
 
@@ -22,9 +20,9 @@ class _DenseLayer(HybridBlock):
         if dropout:
             self.body.add(nn.Dropout(dropout))
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         out = self.body(x)
-        return nd.concat(x, out, dim=1)
+        return F.concat(x, out, dim=1)
 
 
 def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
@@ -69,7 +67,7 @@ class DenseNet(HybridBlock):
             self.features.add(nn.Flatten())
             self.output = nn.Dense(classes)
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

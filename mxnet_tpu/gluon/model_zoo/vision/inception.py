@@ -4,8 +4,6 @@ from __future__ import annotations
 from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
-from .... import ndarray as nd
-
 __all__ = ["Inception3", "inception_v3"]
 
 
@@ -24,8 +22,8 @@ class _Concurrent(HybridBlock):
     def add(self, block):
         self.register_child(block)
 
-    def _eager_forward(self, x):
-        return nd.concat(*[block(x) for block in self._children.values()], dim=1)
+    def hybrid_forward(self, F, x):
+        return F.concat(*[block(x) for block in self._children.values()], dim=1)
 
 
 def _make_branch(use_pool, *conv_settings):
@@ -104,14 +102,14 @@ class _InceptionE(HybridBlock):
                                           padding=(1, 0))
         self.branch4 = _make_branch("avg", (192, 1, None, None))
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         b1 = self.branch1(x)
         s2 = self.branch2_stem(x)
-        b2 = nd.concat(self.branch2_a(s2), self.branch2_b(s2), dim=1)
+        b2 = F.concat(self.branch2_a(s2), self.branch2_b(s2), dim=1)
         s3 = self.branch3_stem(x)
-        b3 = nd.concat(self.branch3_a(s3), self.branch3_b(s3), dim=1)
+        b3 = F.concat(self.branch3_a(s3), self.branch3_b(s3), dim=1)
         b4 = self.branch4(x)
-        return nd.concat(b1, b2, b3, b4, dim=1)
+        return F.concat(b1, b2, b3, b4, dim=1)
 
 
 class Inception3(HybridBlock):
@@ -141,7 +139,7 @@ class Inception3(HybridBlock):
             self.features.add(nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

@@ -39,7 +39,7 @@ class _LinearBottleneck(HybridBlock):
                   num_group=in_channels * t, relu6=True)
         _add_conv(self.out, channels, active=False, relu6=True)
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         out = self.out(x)
         if self.use_shortcut:
             out = out + x
@@ -65,7 +65,7 @@ class MobileNet(HybridBlock):
                 self.features.add(nn.Flatten())
             self.output = nn.Dense(classes)
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x
@@ -98,7 +98,7 @@ class MobileNetV2(HybridBlock):
                 self.output.add(nn.Conv2D(classes, 1, use_bias=False, prefix="pred_"),
                                 nn.Flatten())
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

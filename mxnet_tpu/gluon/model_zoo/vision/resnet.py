@@ -39,14 +39,12 @@ class BasicBlockV1(HybridBlock):
         else:
             self.downsample = None
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         residual = x
         out = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(x)
-        from .... import ndarray as nd
-
-        return nd.Activation(out + residual, act_type="relu")
+        return F.Activation(out + residual, act_type="relu")
 
 
 class BottleneckV1(HybridBlock):
@@ -70,14 +68,12 @@ class BottleneckV1(HybridBlock):
         else:
             self.downsample = None
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         residual = x
         out = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(x)
-        from .... import ndarray as nd
-
-        return nd.Activation(out + residual, act_type="relu")
+        return F.Activation(out + residual, act_type="relu")
 
 
 class BasicBlockV2(HybridBlock):
@@ -93,17 +89,15 @@ class BasicBlockV2(HybridBlock):
         else:
             self.downsample = None
 
-    def _eager_forward(self, x):
-        from .... import ndarray as nd
-
+    def hybrid_forward(self, F, x):
         residual = x
         out = self.bn1(x)
-        out = nd.Activation(out, act_type="relu")
+        out = F.Activation(out, act_type="relu")
         if self.downsample is not None:
             residual = self.downsample(out)
         out = self.conv1(out)
         out = self.bn2(out)
-        out = nd.Activation(out, act_type="relu")
+        out = F.Activation(out, act_type="relu")
         out = self.conv2(out)
         return out + residual
 
@@ -123,20 +117,18 @@ class BottleneckV2(HybridBlock):
         else:
             self.downsample = None
 
-    def _eager_forward(self, x):
-        from .... import ndarray as nd
-
+    def hybrid_forward(self, F, x):
         residual = x
         out = self.bn1(x)
-        out = nd.Activation(out, act_type="relu")
+        out = F.Activation(out, act_type="relu")
         if self.downsample is not None:
             residual = self.downsample(out)
         out = self.conv1(out)
         out = self.bn2(out)
-        out = nd.Activation(out, act_type="relu")
+        out = F.Activation(out, act_type="relu")
         out = self.conv2(out)
         out = self.bn3(out)
-        out = nd.Activation(out, act_type="relu")
+        out = F.Activation(out, act_type="relu")
         out = self.conv3(out)
         return out + residual
 
@@ -172,7 +164,7 @@ class ResNetV1(HybridBlock):
                 layer.add(block(channels, 1, False, in_channels=channels, prefix=""))
         return layer
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x
@@ -208,7 +200,7 @@ class ResNetV2(HybridBlock):
 
     _make_layer = ResNetV1._make_layer
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

@@ -4,8 +4,6 @@ from __future__ import annotations
 from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
-from .... import ndarray as nd
-
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -19,9 +17,9 @@ class _Fire(HybridBlock):
         self.expand3x3 = nn.Conv2D(expand3x3_channels, kernel_size=3, padding=1,
                                    activation="relu")
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.squeeze(x)
-        return nd.concat(self.expand1x1(x), self.expand3x3(x), dim=1)
+        return F.concat(self.expand1x1(x), self.expand3x3(x), dim=1)
 
 
 class SqueezeNet(HybridBlock):
@@ -65,7 +63,7 @@ class SqueezeNet(HybridBlock):
             self.output.add(nn.AvgPool2D(13))
             self.output.add(nn.Flatten())
 
-    def _eager_forward(self, x):
+    def hybrid_forward(self, F, x):
         x = self.features(x)
         x = self.output(x)
         return x

@@ -1,0 +1,250 @@
+"""SSD detection model family (reference: example/ssd/symbol/symbol_builder.py
+get_symbol_train/get_symbol + symbol/common.py multi_layer_feature/
+multibox_layer, configs from symbol/symbol_factory.py get_config).
+
+TPU-native design notes:
+- The whole network is a HybridBlock: one jit-compiled XLA program per shape
+  covers base features, the extra pyramid, all predictor heads, and the
+  anchor constants (MultiBoxPrior folds to a compile-time constant).
+- Predictor convs keep NCHW; the (B, A, C+1) / (B, A*4) gathers are pure
+  reshapes/transposes that XLA fuses into the conv epilogues.
+- Training targets come from the static-shape MultiBoxTarget op
+  (ops/contrib.py — vmapped IoU matching + rank-based hard negative
+  mining, no data-dependent shapes), so the full train step jits.
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ...loss import Loss
+from ... import nn
+
+__all__ = ["SSD", "SSDMultiBoxLoss", "get_ssd", "ssd_512_resnet50_v1",
+           "ssd_300_resnet50_v1", "ssd_512_mobilenet1_0", "ssd_test_tiny"]
+
+# per-(network, data_shape) anchor configs
+# (reference: example/ssd/symbol/symbol_factory.py get_config)
+_SIZES_512 = [[.1, .141], [.2, .272], [.37, .447], [.54, .619],
+              [.71, .79], [.88, .961]]
+_SIZES_300 = [[.1, .141], [.2, .272], [.37, .447], [.54, .619],
+              [.71, .79], [.88, .961]]
+_RATIOS_6 = [[1, 2, .5], [1, 2, .5, 3, 1. / 3], [1, 2, .5, 3, 1. / 3],
+             [1, 2, .5, 3, 1. / 3], [1, 2, .5, 3, 1. / 3], [1, 2, .5]]
+
+
+class _ExtraFeature(HybridBlock):
+    """One extra downsampling pyramid block: 1x1 channel-reduce then 3x3
+    stride-2 (reference: symbol/common.py multi_layer_feature extra-layer
+    branch — conv_act_layer pairs)."""
+
+    def __init__(self, num_filters, min_filter=128, stride=2, padding=1,
+                 **kwargs):
+        super().__init__(**kwargs)
+        reduced = max(num_filters // 2, min_filter)
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            self.body.add(nn.Conv2D(reduced, kernel_size=1, use_bias=False))
+            self.body.add(nn.BatchNorm())
+            self.body.add(nn.Activation("relu"))
+            self.body.add(nn.Conv2D(num_filters, kernel_size=3, strides=stride,
+                                    padding=padding, use_bias=False))
+            self.body.add(nn.BatchNorm())
+            self.body.add(nn.Activation("relu"))
+
+    def hybrid_forward(self, F, x):
+        return self.body(x)
+
+
+class SSD(HybridBlock):
+    """Single-shot detector over a truncated backbone + extra feature
+    pyramid. forward(x) -> (cls_preds (B, A, C+1), loc_preds (B, A*4),
+    anchors (1, A, 4)) — the layouts MultiBoxTarget / MultiBoxDetection
+    consume (cls_preds transposed to (B, C+1, A) where those ops expect
+    the reference layout)."""
+
+    def __init__(self, num_classes, base_blocks, num_extras=4,
+                 extra_filters=(512, 256, 256, 128), sizes=None, ratios=None,
+                 anchor_clip=False, **kwargs):
+        super().__init__(**kwargs)
+        nscales = len(base_blocks) + num_extras
+        sizes = sizes if sizes is not None else _SIZES_512[:nscales]
+        ratios = ratios if ratios is not None else _RATIOS_6[:nscales]
+        if not len(sizes) == len(ratios) == nscales:
+            raise MXNetError("sizes/ratios must have one entry per scale "
+                             "(%d base + %d extra)" % (len(base_blocks),
+                                                       num_extras))
+        self.num_classes = num_classes
+        self._sizes = [tuple(s) for s in sizes]
+        self._ratios = [tuple(r) for r in ratios]
+        self._anchor_clip = anchor_clip
+        with self.name_scope():
+            self.base_stages = nn.HybridSequential(prefix="base_")
+            for b in base_blocks:
+                self.base_stages.add(b)
+            self.extras = nn.HybridSequential(prefix="extra_")
+            for i, f in enumerate(extra_filters[:num_extras]):
+                self.extras.add(_ExtraFeature(f))
+            self.class_preds = nn.HybridSequential(prefix="cls_pred_")
+            self.box_preds = nn.HybridSequential(prefix="box_pred_")
+            for s, r in zip(self._sizes, self._ratios):
+                na = len(s) + len(r) - 1
+                self.class_preds.add(
+                    nn.Conv2D(na * (num_classes + 1), kernel_size=3, padding=1))
+                self.box_preds.add(
+                    nn.Conv2D(na * 4, kernel_size=3, padding=1))
+
+    def hybrid_forward(self, F, x):
+        feats = []
+        for stage in self.base_stages._children.values():
+            x = stage(x)
+            feats.append(x)
+        for extra in self.extras._children.values():
+            x = extra(x)
+            feats.append(x)
+
+        cls_list, loc_list, anchor_list = [], [], []
+        for feat, cp, bp, s, r in zip(feats,
+                                      self.class_preds._children.values(),
+                                      self.box_preds._children.values(),
+                                      self._sizes, self._ratios):
+            cls = cp(feat)                       # (B, na*(C+1), H, W)
+            cls = F.transpose(cls, (0, 2, 3, 1))
+            cls_list.append(F.reshape(cls, (0, -1, self.num_classes + 1)))
+            loc = bp(feat)                       # (B, na*4, H, W)
+            loc = F.transpose(loc, (0, 2, 3, 1))
+            loc_list.append(F.reshape(loc, (0, -1)))
+            anchor_list.append(F.contrib.MultiBoxPrior(
+                feat, sizes=s, ratios=r, clip=self._anchor_clip))
+        cls_preds = F.concat(*cls_list, dim=1)   # (B, A, C+1)
+        loc_preds = F.concat(*loc_list, dim=1)   # (B, A*4)
+        anchors = F.concat(*anchor_list, dim=1)  # (1, A, 4)
+        return cls_preds, loc_preds, anchors
+
+    def training_targets(self, anchors, cls_preds, labels,
+                         overlap_threshold=0.5, negative_mining_ratio=3,
+                         negative_mining_thresh=0.5,
+                         variances=(0.1, 0.1, 0.2, 0.2)):
+        """Anchor matching + encoding for one batch (reference train symbol:
+        the contrib.MultiBoxTarget call in symbol_builder.py get_symbol_train).
+        labels: (B, M, 5) [cls, x1, y1, x2, y2], pad rows cls=-1.
+        Returns (cls_target (B, A), loc_target (B, A*4), loc_mask (B, A*4))."""
+        from .... import ndarray as nd
+
+        cls_t = nd.transpose(cls_preds, (0, 2, 1))  # (B, C+1, A)
+        loc_target, loc_mask, cls_target = nd.contrib.MultiBoxTarget(
+            anchors, labels, cls_t, overlap_threshold=overlap_threshold,
+            ignore_label=-1, negative_mining_ratio=negative_mining_ratio,
+            negative_mining_thresh=negative_mining_thresh,
+            variances=variances)
+        return cls_target, loc_target, loc_mask
+
+    def detections(self, cls_preds, loc_preds, anchors, nms_thresh=0.45,
+                   nms_topk=400, threshold=0.01, force_suppress=False,
+                   variances=(0.1, 0.1, 0.2, 0.2)):
+        """Decode + NMS (reference: get_symbol's contrib.MultiBoxDetection).
+        Returns (B, A, 6) rows [cls_id, score, x1, y1, x2, y2], id -1 =
+        suppressed/invalid."""
+        from .... import ndarray as nd
+
+        cls_prob = nd.softmax(nd.transpose(cls_preds, (0, 2, 1)), axis=1)
+        return nd.contrib.MultiBoxDetection(
+            cls_prob, loc_preds, anchors, nms_threshold=nms_thresh,
+            nms_topk=nms_topk, threshold=threshold,
+            force_suppress=force_suppress, variances=variances)
+
+
+class SSDMultiBoxLoss(Loss):
+    """Joint classification + localization loss (reference train symbol:
+    SoftmaxOutput(ignore_label=-1, normalization='valid') for classes +
+    MakeLoss(smooth_l1(loc_mask*(loc_preds-loc_target))) for boxes,
+    symbol_builder.py get_symbol_train)."""
+
+    def __init__(self, negative_mining_ratio=3, lambd=1.0, weight=None,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._lambd = lambd
+
+    def hybrid_forward(self, F, cls_preds, loc_preds, cls_target, loc_target,
+                       loc_mask):
+        # cls_preds (B, A, C+1); cls_target (B, A) with -1 = ignore
+        lp = F.log_softmax(cls_preds, axis=-1)
+        valid = cls_target >= 0
+        tgt = F.maximum(cls_target, 0.0)
+        ce = -F.pick(lp, tgt, axis=-1)
+        n_valid = F.maximum(F.sum(valid.astype(lp.dtype)), 1.0)
+        cls_loss = F.sum(F.where(valid, ce, F.zeros_like(ce))) / n_valid
+        sl1 = F.smooth_l1(loc_mask * (loc_preds - loc_target), scalar=1.0)
+        n_loc = F.maximum(F.sum(loc_mask), 1.0)
+        loc_loss = F.sum(sl1) / n_loc
+        return cls_loss + self._lambd * loc_loss
+
+
+def _resnet_base(version, num_layers, **kwargs):
+    """Backbone stages for SSD: [stem..stage3] (stride 16) and [stage4]
+    (stride 32) — the reference's '_plus12'/'_plus15' cut points for
+    resnet50 (symbol_factory.py get_config 'resnet50')."""
+    from .resnet import get_resnet
+
+    net = get_resnet(version, num_layers, **kwargs)
+    children = list(net.features._children.values())
+    # [conv, bn, relu, pool, stage1, stage2, stage3, stage4, gap(, flat)]
+    stem_through_stage3 = nn.HybridSequential(prefix="")
+    for c in children[:7]:
+        stem_through_stage3.add(c)
+    stage4 = nn.HybridSequential(prefix="")
+    stage4.add(children[7])
+    return [stem_through_stage3, stage4]
+
+
+def _mobilenet_base(multiplier=1.0, **kwargs):
+    from .mobilenet import get_mobilenet
+
+    net = get_mobilenet(multiplier, **kwargs)
+    children = list(net.features._children.values())
+    # cut at the stride-16 / stride-32 boundary (dw-conv with stride 2 at
+    # index 33 of the conv stack); features end with GlobalAvgPool+Flatten
+    body = children[:-2]
+    cut = max(1, len(body) * 3 // 4)
+    first = nn.HybridSequential(prefix="")
+    for c in body[:cut]:
+        first.add(c)
+    second = nn.HybridSequential(prefix="")
+    for c in body[cut:]:
+        second.add(c)
+    return [first, second]
+
+
+def get_ssd(base="resnet50_v1", data_shape=512, num_classes=20,
+            pretrained_base=False, **kwargs):
+    """Factory (reference: symbol_factory.py get_symbol_train(get_config))."""
+    if base == "resnet50_v1":
+        blocks = _resnet_base(1, 50)
+    elif base == "resnet18_v1":
+        blocks = _resnet_base(1, 18)
+    elif base == "mobilenet1.0":
+        blocks = _mobilenet_base(1.0)
+    else:
+        raise MXNetError("unsupported SSD base '%s'" % base)
+    sizes = _SIZES_512 if data_shape >= 512 else _SIZES_300
+    return SSD(num_classes, blocks, num_extras=4, sizes=sizes,
+               ratios=_RATIOS_6, **kwargs)
+
+
+def ssd_512_resnet50_v1(num_classes=20, **kwargs):
+    return get_ssd("resnet50_v1", 512, num_classes, **kwargs)
+
+
+def ssd_300_resnet50_v1(num_classes=20, **kwargs):
+    return get_ssd("resnet50_v1", 300, num_classes, **kwargs)
+
+
+def ssd_512_mobilenet1_0(num_classes=20, **kwargs):
+    return get_ssd("mobilenet1.0", 512, num_classes, **kwargs)
+
+
+def ssd_test_tiny(num_classes=3, **kwargs):
+    """Small config for unit tests / CPU smoke: resnet18 base, 2 extra
+    scales, works from 64x64 inputs."""
+    blocks = _resnet_base(1, 18)
+    return SSD(num_classes, blocks, num_extras=2, extra_filters=(128, 128),
+               sizes=_SIZES_512[:4], ratios=_RATIOS_6[:4], **kwargs)

@@ -22,7 +22,12 @@ __all__ = ["imdecode", "imencode", "imread", "imresize", "resize_short",
            "center_crop", "random_crop", "fixed_crop", "color_normalize",
            "Augmenter", "ResizeAug", "RandomCropAug", "CenterCropAug",
            "HorizontalFlipAug", "ColorNormalizeAug", "CastAug",
-           "CreateAugmenter", "ImageIter", "ImageRecordIterPy"]
+           "SaturationJitterAug", "HueJitterAug", "LightingAug", "RandomGrayAug",
+           "CreateAugmenter", "ImageIter", "ImageRecordIterPy",
+           "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "DetResizeAug", "CreateMultiRandCropAugmenter",
+           "CreateDetAugmenter", "ImageDetIter"]
 
 
 def _pil():
@@ -45,6 +50,10 @@ def imdecode(buf, flag=1, to_rgb=True, to_ndarray=True):
     if not to_rgb:
         arr = arr[:, :, ::-1]
     if to_ndarray:
+        from . import base as _base
+
+        if _base.HOST_ARRAY_MODE:   # DataLoader worker: stay numpy
+            return arr
         return nd.array(arr, dtype="uint8")
     return arr
 
@@ -69,16 +78,23 @@ def imread(filename, flag=1, to_rgb=True):
 
 
 def imresize(src, w, h, interp=1):
-    """Resize HWC image (reference: image.py imresize)."""
+    """Resize HWC image (reference: image.py imresize). Container-preserving:
+    numpy in -> numpy out (the DataLoader worker / HOST_ARRAY_MODE path must
+    never touch jax), NDArray in -> NDArray out."""
     Image = _pil()
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else _np.asarray(src)
+    was_nd = isinstance(src, nd.NDArray)
+    arr = src.asnumpy() if was_nd else _np.asarray(src)
     squeeze = arr.ndim == 3 and arr.shape[2] == 1
     pil = Image.fromarray(arr[:, :, 0] if squeeze else arr.astype(_np.uint8))
     resample = Image.NEAREST if interp == 0 else Image.BILINEAR
     out = _np.asarray(pil.resize((w, h), resample))
     if squeeze:
         out = out[:, :, None]
-    return nd.array(out, dtype="uint8")
+    from . import base as _base
+
+    if was_nd and not _base.HOST_ARRAY_MODE:
+        return nd.array(out, dtype="uint8")
+    return out
 
 
 def resize_short(src, size, interp=2):
@@ -175,7 +191,9 @@ class HorizontalFlipAug(Augmenter):
 
     def __call__(self, src):
         if _np.random.rand() < self.p:
-            return src.flip(axis=1)
+            if isinstance(src, nd.NDArray):
+                return src.flip(axis=1)
+            return src[:, ::-1]
         return src
 
 
@@ -215,8 +233,109 @@ class ContrastJitterAug(Augmenter):
 
     def __call__(self, src):
         alpha = 1.0 + _np.random.uniform(-self.contrast, self.contrast)
-        gray = float(src.mean().asscalar())
+        m = src.mean()
+        gray = float(m.asscalar()) if hasattr(m, "asscalar") else float(m)
         return (src * alpha + gray * (1 - alpha)).clip(0, 255)
+
+
+def _apply_np(src, fn):
+    """Run fn on the numpy view of src, returning src's container type."""
+    if isinstance(src, nd.NDArray):
+        out = fn(src.asnumpy().astype(_np.float32))
+        return nd.array(out.clip(0, 255), dtype=str(src.dtype)) \
+            if str(src.dtype) == "uint8" else nd.array(out)
+    out = fn(_np.asarray(src, _np.float32))
+    return out.clip(0, 255).astype(src.dtype) \
+        if _np.asarray(src).dtype == _np.uint8 else out
+
+
+_GRAY_COEF = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+
+class SaturationJitterAug(Augmenter):
+    """reference: image.py SaturationJitterAug — blend with per-pixel gray."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.saturation, self.saturation)
+
+        def fn(a):
+            gray = (a * _GRAY_COEF).sum(axis=2, keepdims=True)
+            return a * alpha + gray * (1.0 - alpha)
+
+        return _apply_np(src, fn)
+
+
+class HueJitterAug(Augmenter):
+    """reference: image.py HueJitterAug — YIQ-space hue rotation."""
+
+    _TYIQ = _np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], _np.float32)
+    _ITYIQ = _np.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], _np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = _np.random.uniform(-self.hue, self.hue)
+        u = _np.cos(alpha * _np.pi)
+        w = _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       _np.float32)
+        t = self._ITYIQ.dot(bt).dot(self._TYIQ).T
+
+        def fn(a):
+            return a.dot(t)
+
+        return _apply_np(src, fn)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise (reference: image.py LightingAug)."""
+
+    def __init__(self, alphastd, eigval=None, eigvec=None):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32) if eigval is not None \
+            else _np.array([55.46, 4.794, 1.148], _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32) if eigvec is not None \
+            else _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = self.eigvec.dot(alpha * self.eigval).astype(_np.float32)
+
+        def fn(a):
+            return a + rgb
+
+        return _apply_np(src, fn)
+
+
+class RandomGrayAug(Augmenter):
+    """reference: image.py RandomGrayAug — grayscale with probability p."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.rand() >= self.p:
+            return src
+
+        def fn(a):
+            gray = (a * _GRAY_COEF).sum(axis=2, keepdims=True)
+            return _np.broadcast_to(gray, a.shape).copy()
+
+        return _apply_np(src, fn)
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
@@ -240,6 +359,14 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(BrightnessJitterAug(brightness))
     if contrast:
         auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise:
+        auglist.append(LightingAug(pca_noise))
+    if rand_gray:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = _np.array([123.68, 116.28, 103.53])
     if std is True:
@@ -338,8 +465,12 @@ class ImageIter(DataIter):
                 if not batch_data:
                     raise
                 pad = self.batch_size - len(batch_data)
-                batch_data.extend(batch_data[:pad])
-                batch_label.extend(batch_label[:pad])
+                k = 0
+                while len(batch_data) < self.batch_size:
+                    # cycle: pad may exceed the collected count
+                    batch_data.append(batch_data[k])
+                    batch_label.append(batch_label[k])
+                    k += 1
                 break
             for aug in self.auglist:
                 img = aug(img)
@@ -413,3 +544,374 @@ class ImageRecordIterPy(ImageIter):
         if isinstance(item, Exception):
             raise item
         return item
+
+
+# --------------------------------------------------------------------------
+# Detection pipeline (reference: python/mxnet/image/detection.py + the C++
+# detection-augmenting iterator src/io/iter_image_det_recordio.cc:509 /
+# image_aug_default.cc det variant). Labels are normalized corner boxes:
+# each row [cls, x1, y1, x2, y2, ...], coordinates in [0, 1].
+# --------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Detection augmenter base (reference: detection.py:39) — __call__
+    takes and returns (image HWC uint8/float ndarray, label (N, 5+))."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__, self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter for detection (reference:
+    detection.py:65) — geometry-preserving augs (color, cast, normalize)."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.__class__.__name__)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one augmenter from a list, or skip entirely
+    (reference: detection.py:90)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or _np.random.random() < self.skip_prob:
+            return src, label
+        idx = _np.random.randint(len(self.aug_list))
+        return self.aug_list[idx](src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image + boxes with probability p (reference: detection.py:126)."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _np.random.random() < self.p:
+            src = _np.asarray(src)[:, ::-1]
+            label = label.copy()
+            label[:, 1], label[:, 3] = 1.0 - label[:, 3], 1.0 - label[:, 1].copy()
+        return src, label
+
+
+def _box_coverage(boxes, crop):
+    """Fraction of each box's area inside crop (x1,y1,x2,y2 normalized)."""
+    ix1 = _np.maximum(boxes[:, 0], crop[0])
+    iy1 = _np.maximum(boxes[:, 1], crop[1])
+    ix2 = _np.minimum(boxes[:, 2], crop[2])
+    iy2 = _np.minimum(boxes[:, 3], crop[3])
+    inter = _np.maximum(ix2 - ix1, 0) * _np.maximum(iy2 - iy1, 0)
+    area = _np.maximum((boxes[:, 2] - boxes[:, 0]) *
+                       (boxes[:, 3] - boxes[:, 1]), 1e-12)
+    return inter / area
+
+
+class DetRandomCropAug(DetAugmenter):
+    """SSD-style constrained random crop (reference: detection.py:152): try
+    up to max_attempts crops sampled in area/aspect range; accept when every
+    kept object is covered >= min_object_covered; objects whose center falls
+    outside or coverage < min_eject_coverage are ejected from the label."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _try_crop(self, label):
+        area = _np.random.uniform(*self.area_range)
+        ratio = _np.random.uniform(*self.aspect_ratio_range)
+        w = min(_np.sqrt(area * ratio), 1.0)
+        h = min(_np.sqrt(area / ratio), 1.0)
+        x0 = _np.random.uniform(0, 1 - w)
+        y0 = _np.random.uniform(0, 1 - h)
+        crop = (x0, y0, x0 + w, y0 + h)
+        boxes = label[:, 1:5]
+        cov = _box_coverage(boxes, crop)
+        cx = (boxes[:, 0] + boxes[:, 2]) / 2
+        cy = (boxes[:, 1] + boxes[:, 3]) / 2
+        center_in = (cx >= crop[0]) & (cx <= crop[2]) & \
+                    (cy >= crop[1]) & (cy <= crop[3])
+        keep = center_in & (cov >= self.min_eject_coverage)
+        if not keep.any():
+            return None
+        if cov[keep].min() < self.min_object_covered:
+            return None
+        new = label[keep].copy()
+        b = new[:, 1:5]
+        b[:, (0, 2)] = (b[:, (0, 2)] - crop[0]) / max(crop[2] - crop[0], 1e-12)
+        b[:, (1, 3)] = (b[:, (1, 3)] - crop[1]) / max(crop[3] - crop[1], 1e-12)
+        new[:, 1:5] = _np.clip(b, 0.0, 1.0)
+        return crop, new
+
+    def __call__(self, src, label):
+        for _ in range(self.max_attempts):
+            got = self._try_crop(label)
+            if got is None:
+                continue
+            crop, new_label = got
+            src = _np.asarray(src)
+            h, w = src.shape[:2]
+            x1 = int(round(crop[0] * w))
+            y1 = int(round(crop[1] * h))
+            x2 = max(int(round(crop[2] * w)), x1 + 1)
+            y2 = max(int(round(crop[3] * h)), y1 + 1)
+            return src[y1:y2, x1:x2], new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom-out: place the image on a larger canvas (reference:
+    detection.py:323)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        src = _np.asarray(src)
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            area = _np.random.uniform(*self.area_range)
+            ratio = _np.random.uniform(*self.aspect_ratio_range)
+            nw = _np.sqrt(area * ratio)
+            nh = _np.sqrt(area / ratio)
+            if nw < 1 or nh < 1:
+                continue
+            pw = int(round(w * nw))
+            ph = int(round(h * nh))
+            x0 = _np.random.randint(0, pw - w + 1)
+            y0 = _np.random.randint(0, ph - h + 1)
+            canvas = _np.empty((ph, pw, src.shape[2]), dtype=src.dtype)
+            canvas[:] = _np.asarray(self.pad_val, dtype=src.dtype)
+            canvas[y0:y0 + h, x0:x0 + w] = src
+            label = label.copy()
+            b = label[:, 1:5]
+            b[:, (0, 2)] = (b[:, (0, 2)] * w + x0) / pw
+            b[:, (1, 3)] = (b[:, (1, 3)] * h + y0) / ph
+            label[:, 1:5] = b
+            return canvas, label
+        return src, label
+
+
+class DetResizeAug(DetAugmenter):
+    """Force resize to (w, h) — normalized boxes are unchanged."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src, label):
+        img = imresize(src, self.size[0], self.size[1], self.interp)
+        return _np.asarray(img), label
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0.0):
+    """One DetRandomCropAug per listed constraint set, random-selected
+    (reference: detection.py:417)."""
+    def _as_list(v):
+        return v if isinstance(v, (list, tuple)) and v and \
+            isinstance(v[0], (list, tuple)) else [v]
+
+    covered = min_object_covered if isinstance(min_object_covered,
+                                               (list, tuple)) else \
+        [min_object_covered]
+    aspects = _as_list(aspect_ratio_range)
+    areas = _as_list(area_range)
+    ejects = min_eject_coverage if isinstance(min_eject_coverage,
+                                              (list, tuple)) else \
+        [min_eject_coverage]
+    n = max(len(covered), len(aspects), len(areas), len(ejects))
+
+    def _at(seq, i):
+        return seq[i % len(seq)]
+
+    augs = [DetRandomCropAug(_at(covered, i), _at(aspects, i), _at(areas, i),
+                             _at(ejects, i), max_attempts) for i in range(n)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 3.0),
+                       min_eject_coverage=0.3, max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """Detection augmentation chain (reference: detection.py:482 — same
+    option set/order: color jitter borrow, rand crop (prob), rand pad
+    (prob), mirror, resize to data_shape, cast/normalize borrow)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if brightness:
+        auglist.append(DetBorrowAug(BrightnessJitterAug(brightness)))
+    if contrast:
+        auglist.append(DetBorrowAug(ContrastJitterAug(contrast)))
+    if saturation:
+        auglist.append(DetBorrowAug(SaturationJitterAug(saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise:
+        auglist.append(DetBorrowAug(LightingAug(pca_noise)))
+    if rand_gray:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if rand_crop > 0:
+        crop = CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(area_range[1], 1.0)),
+            min_eject_coverage, max_attempts, skip_prob=1 - rand_crop)
+        auglist.append(crop)
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(area_range[1], 1.0)), max_attempts,
+                              pad_val)
+        auglist.append(DetRandomSelectAug([pad], skip_prob=1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetResizeAug((data_shape[2], data_shape[1]), inter_method))
+    auglist.append(DetBorrowAug(CastAug()))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and (isinstance(mean, _np.ndarray) or mean):
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (reference: detection.py:624 ImageDetIter /
+    C++ iter_image_det_recordio.cc). Labels use the im2rec detection
+    format: [header_width, obj_width, (extras...), obj0..., obj1...] with
+    each object [cls, x1, y1, x2, y2, ...] normalized; batches pad the
+    object dimension with -1 rows to the dataset-wide max object count."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="label", **kwargs):
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle,
+                         aug_list=[], imglist=imglist, data_name=data_name,
+                         label_name=label_name)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape, **kwargs)
+        self.label_shape = self._estimate_label_shape()
+
+    def _parse_label(self, label):
+        """reference: detection.py _parse_label — strip the header, reshape
+        to (N, obj_width), drop degenerate boxes."""
+        raw = _np.asarray(label, dtype=_np.float32).ravel()
+        if raw.size < 7:
+            raise MXNetError("detection label too short: %d" % raw.size)
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5 or (raw.size - header_width) % obj_width != 0:
+            raise MXNetError("label shape %s inconsistent with obj width %d"
+                             % (raw.shape, obj_width))
+        out = raw[header_width:].reshape(-1, obj_width)
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        if not valid.any():
+            raise MXNetError("sample with no valid box")
+        return out[valid]
+
+    def _estimate_label_shape(self):
+        max_count, width = 0, 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                lab = self._parse_label(label)
+                max_count = max(max_count, lab.shape[0])
+                width = lab.shape[1]
+        except StopIteration:
+            pass
+        self.reset()
+        return (max_count, width)
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self.label_shape)]
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.data_shape = tuple(data_shape)
+        if label_shape is not None:
+            self.label_shape = tuple(label_shape)
+
+    def next(self):
+        batch_data = []
+        batch_label = []
+        pad = 0
+        while len(batch_data) < self.batch_size:
+            try:
+                label, img = self.next_sample()
+            except StopIteration:
+                if not batch_data:
+                    raise
+                pad = self.batch_size - len(batch_data)
+                k = 0
+                while len(batch_data) < self.batch_size:
+                    batch_data.append(batch_data[k])
+                    batch_label.append(batch_label[k])
+                    k += 1
+                break
+            try:
+                lab = self._parse_label(label)
+            except MXNetError:
+                continue
+            img = _np.asarray(img)
+            for aug in self.auglist:
+                img, lab = aug(img, lab)
+            arr = img.asnumpy() if isinstance(img, nd.NDArray) else \
+                _np.asarray(img)
+            batch_data.append(
+                _np.transpose(arr.astype(_np.float32), (2, 0, 1)))
+            padded = _np.full(self.label_shape, -1.0, _np.float32)
+            n = min(lab.shape[0], self.label_shape[0])
+            padded[:n, :lab.shape[1]] = lab[:n]
+            batch_label.append(padded)
+        data = nd.array(_np.stack(batch_data))
+        label = nd.array(_np.stack(batch_label))
+        return DataBatch(data=[data], label=[label], pad=pad)

@@ -253,8 +253,8 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     anchors = anchor.reshape(-1, 4)  # (A,4)
     A = anchors.shape[0]
 
-    def per_sample(lab):
-        # lab: (M, 5+) [cls, x1, y1, x2, y2]
+    def per_sample(lab, cls_p):
+        # lab: (M, 5+) [cls, x1, y1, x2, y2]; cls_p: (C, A) raw predictions
         valid = lab[:, 0] >= 0
         ious = _box_iou_corner(anchors[:, None, :], lab[None, :, 1:5])  # (A,M)
         ious = jnp.where(valid[None, :], ious, 0.0)
@@ -267,6 +267,24 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         matched = matched | forced
         gt = lab[best_gt]
         cls_target = jnp.where(matched, gt[:, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining (reference multibox_target.cc): unmatched
+            # anchors below the mining IoU threshold are ranked by their
+            # predicted non-background confidence; the hardest ratio*num_pos
+            # stay background, the rest get ignore_label. Static-shape: the
+            # dynamic quota is a rank comparison, not a gather.
+            prob = jax.nn.softmax(cls_p, axis=0)           # (C, A)
+            hardness = 1.0 - prob[0]                        # non-bg confidence
+            candidate = (~matched) & (best_iou < negative_mining_thresh)
+            score = jnp.where(candidate, hardness, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.zeros(A, jnp.int32).at[order].set(jnp.arange(A, dtype=jnp.int32))
+            quota = jnp.maximum(
+                (negative_mining_ratio * jnp.sum(matched)).astype(jnp.int32),
+                jnp.int32(minimum_negative_samples))
+            keep_neg = candidate & (rank < quota)
+            cls_target = jnp.where(matched, cls_target,
+                                   jnp.where(keep_neg, 0.0, float(ignore_label)))
         # encode regression targets (center form, variances)
         aw = anchors[:, 2] - anchors[:, 0]
         ah = anchors[:, 3] - anchors[:, 1]
@@ -286,7 +304,10 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         loc_mask = jnp.broadcast_to(loc_mask, (A, 4))
         return loc_t.reshape(-1), loc_mask.reshape(-1), cls_target
 
-    loc_target, loc_mask, cls_target = jax.vmap(per_sample)(label)
+    # targets are training labels, not differentiable functions of the
+    # predictions (reference MultiBoxTarget registers no gradient)
+    loc_target, loc_mask, cls_target = jax.vmap(per_sample)(
+        lax.stop_gradient(label), lax.stop_gradient(cls_pred))
     return loc_target, loc_mask, cls_target
 
 

@@ -12,9 +12,11 @@ On non-TPU backends the same kernels run in interpret mode, so tests and
 CPU development use one code path (the strategy SURVEY §4 prescribes for
 cross-backend consistency).
 
-Backward: recompute-based — the vjp of a plain jnp reference attention
-(jax.checkpoint-style rematerialization). A Pallas backward kernel is the
-round-2 upgrade; forward is where inference/serving time goes.
+Backward: Pallas kernels too (flash-attention backward): the forward saves
+only O and the per-row logsumexp; backward recomputes P blockwise in VMEM —
+one kernel accumulating dQ over k-blocks, one accumulating dK/dV over
+q-blocks — so the backward pass has the same O(L·D) HBM traffic as forward
+instead of materializing the (Lq, Lk) probability matrix.
 """
 from __future__ import annotations
 
@@ -50,8 +52,8 @@ def _attention_reference(q, k, v, causal, sm_scale):
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, lq, lk,
-                block_q, block_k, n_kblocks):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                lq, lk, block_q, block_k, n_kblocks):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -87,10 +89,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, lq, lk,
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    # causal: blocks strictly above the diagonal contribute nothing — still
-    # iterated (masked) to keep the grid static; XLA pipelines the DMA anyway
-    m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
+    # causal: k-blocks strictly above this q-block's diagonal contribute
+    # nothing — skip them (dynamic fori bound lowers to while_loop)
+    hi = n_kblocks if not causal else jnp.minimum(
+        n_kblocks, ((iq + 1) * block_q + block_k - 1) // block_k)
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # logsumexp per q row — the only softmax state backward needs. Stored
+    # (bh, 8, lq): TPU blocks need sublane-dim multiples of 8, so the row
+    # vector is broadcast across 8 sublanes rather than stored (bh, lq).
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
 
 
 @functools.lru_cache(maxsize=256)
@@ -113,7 +122,8 @@ def _fwd_compiled(shape_key):
 
     call = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, d), _np.dtype(dtype)),
+        out_shape=(jax.ShapeDtypeStruct((bh, lq_pad, d), _np.dtype(dtype)),
+                   jax.ShapeDtypeStruct((bh, 8, lq_pad), _np.float32)),
         grid=(bh, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
@@ -123,8 +133,10 @@ def _fwd_compiled(shape_key):
             pl.BlockSpec((1, lk_pad, d), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=(pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i),
+                                memory_space=pltpu.VMEM)),
         interpret=interpret,
     )
 
@@ -132,7 +144,8 @@ def _fwd_compiled(shape_key):
         qp = jnp.pad(q, ((0, 0), (0, lq_pad - lq), (0, 0)))
         kp = jnp.pad(k, ((0, 0), (0, lk_pad - lk), (0, 0)))
         vp = jnp.pad(v, ((0, 0), (0, lk_pad - lk), (0, 0)))
-        return call(qp, kp, vp)[:, :lq, :]
+        o, lse = call(qp, kp, vp)
+        return o[:, :lq, :], lse[:, 0, :lq]
 
     return run
 
@@ -143,6 +156,177 @@ def _flash_fwd(q, k, v, causal, sm_scale):
     run = _fwd_compiled((bh, lq, lk, d, str(q.dtype), bool(causal),
                          float(sm_scale), _use_interpret()))
     return run(q, k, v)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   sm_scale, causal, lk, block_q, block_k, n_kblocks):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                     # (bq, d)
+    do = do_ref[0].astype(jnp.float32)                   # (bq, d)
+    lse = lse_ref[0, 0][:, None]                         # (bq, 1)
+    delta = delta_ref[0, 0][:, None]                     # (bq, 1)
+    d = q.shape[-1]
+    row_ids = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(i, acc):
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        col_ids = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col_ids < lk
+        if causal:
+            mask = jnp.logical_and(mask, col_ids <= row_ids)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        return acc + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    hi = n_kblocks if not causal else jnp.minimum(
+        n_kblocks, ((iq + 1) * block_q + block_k - 1) // block_k)
+    dq_ref[0] = jax.lax.fori_loop(0, hi, body, acc0).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, lq, lk, block_q,
+                    block_k, n_qblocks):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                     # (bk, d)
+    v = v_ref[0].astype(jnp.float32)                     # (bk, d)
+    d = k.shape[-1]
+    col_ids = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        row_ids = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        # mask padded q rows too: their lse is garbage and exp could
+        # overflow — dO=0 alone doesn't save p itself
+        mask = jnp.logical_and(col_ids < lk, row_ids < lq)
+        if causal:
+            mask = jnp.logical_and(mask, col_ids <= row_ids)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((block_k, d), jnp.float32)
+    lo = 0 if not causal else (ik * block_k) // block_q
+    dk, dv = jax.lax.fori_loop(lo, n_qblocks, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.lru_cache(maxsize=256)
+def _bwd_compiled(shape_key):
+    (bh, lq, lk, d, dtype, causal, sm_scale, interpret) = shape_key
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block_q = min(128, lq)
+    block_k = min(128, lk)
+    n_q = -(-lq // block_q)
+    n_k = -(-lk // block_k)
+    lq_pad, lk_pad = n_q * block_q, n_k * block_k
+
+    dq_call = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          lk=lk, block_q=block_q, block_k=block_k,
+                          n_kblocks=n_k),
+        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, d), _np.dtype(dtype)),
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),     # q
+            pl.BlockSpec((1, lk_pad, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),     # k
+            pl.BlockSpec((1, lk_pad, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),     # v
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),     # do
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),     # lse
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),     # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+
+    dkv_call = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          lq=lq, lk=lk, block_q=block_q, block_k=block_k,
+                          n_qblocks=n_q),
+        out_shape=(jax.ShapeDtypeStruct((bh, lk_pad, d), _np.dtype(dtype)),
+                   jax.ShapeDtypeStruct((bh, lk_pad, d), _np.dtype(dtype))),
+        grid=(bh, n_k),
+        in_specs=[
+            pl.BlockSpec((1, lq_pad, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),     # q
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),     # k
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),     # v
+            pl.BlockSpec((1, lq_pad, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),     # do
+            pl.BlockSpec((1, 8, lq_pad), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),     # lse
+            pl.BlockSpec((1, 8, lq_pad), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),     # delta
+        ],
+        out_specs=(pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )
+
+    def run(q, k, v, o, lse, do):
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)                        # (bh, lq)
+        qp = jnp.pad(q, ((0, 0), (0, lq_pad - lq), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, lk_pad - lk), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, lk_pad - lk), (0, 0)))
+        dop = jnp.pad(do, ((0, 0), (0, lq_pad - lq), (0, 0)))
+        lsep = jnp.broadcast_to(
+            jnp.pad(lse, ((0, 0), (0, lq_pad - lq)))[:, None, :],
+            (bh, 8, lq_pad))
+        deltap = jnp.broadcast_to(
+            jnp.pad(delta, ((0, 0), (0, lq_pad - lq)))[:, None, :],
+            (bh, 8, lq_pad))
+        dq = dq_call(qp, kp, vp, dop, lsep, deltap)
+        dk, dv = dkv_call(qp, kp, vp, dop, lsep, deltap)
+        return (dq[:, :lq, :], dk[:, :lk, :], dv[:, :lk, :])
+
+    return run
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None):
@@ -169,17 +353,19 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
 
     @jax.custom_vjp
     def attn(qf, kf, vf):
-        return _flash_fwd(qf, kf, vf, causal, sm_scale)
+        return _flash_fwd(qf, kf, vf, causal, sm_scale)[0]
 
     def fwd(qf, kf, vf):
-        return attn(qf, kf, vf), (qf, kf, vf)
+        o, lse = _flash_fwd(qf, kf, vf, causal, sm_scale)
+        return o, (qf, kf, vf, o, lse)
 
     def bwd(res, g):
-        qf, kf, vf = res
-        _, pull = jax.vjp(
-            lambda a, b, c: _attention_reference(a, b, c, causal, sm_scale),
-            qf, kf, vf)
-        return pull(g)
+        qf, kf, vf, o, lse = res
+        bh, lq_, d_ = qf.shape
+        lk_ = kf.shape[1]
+        run = _bwd_compiled((bh, lq_, lk_, d_, str(qf.dtype), bool(causal),
+                             float(sm_scale), _use_interpret()))
+        return run(qf, kf, vf, o, lse, g.astype(qf.dtype))
 
     attn.defvjp(fwd, bwd)
     return attn(qf, kf, vf).reshape(lead + (lq, d))

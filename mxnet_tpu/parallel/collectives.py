@@ -147,10 +147,23 @@ def init_process_group(coordinator_address=None, num_processes=None,
     import jax
 
     if num_processes is None:
-        num_processes = int(os.environ.get("MXNET_TPU_NUM_WORKERS",
-                                           os.environ.get("DMLC_NUM_WORKER", "1")))
+        num_processes = int(os.environ.get(
+            "MXTPU_NUM_WORKERS",
+            os.environ.get("MXNET_TPU_NUM_WORKERS",
+                           os.environ.get("DMLC_NUM_WORKER", "1"))))
     if num_processes <= 1:
         return
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MXTPU_COORDINATOR")
+    if process_id is None:
+        pid = os.environ.get("MXTPU_PROCESS_ID",
+                             os.environ.get("DMLC_WORKER_ID"))
+        process_id = int(pid) if pid is not None else None
+    if jax.distributed.is_initialized():
+        return  # idempotent re-entry
+    # NOTE: must run before the first jax computation — the backend snapshots
+    # the process group at creation (call this before importing anything
+    # that touches jax arrays, or at worker start; tools/launch.py pattern)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

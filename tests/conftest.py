@@ -4,15 +4,28 @@ reference tests spawn real localhost processes; we use
 xla_force_host_platform_device_count)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# MXTPU_TEST_TPU=1 runs against the real chip (the `-m tpu` smoke suite,
+# test_tpu_smoke.py); default runs pin CPU with 8 virtual devices.
+_ON_TPU = os.environ.get("MXTPU_TEST_TPU") == "1"
 
-# Some environments install a PJRT plugin hook that force-overrides
-# jax_platforms at interpreter start (sitecustomize), which would make
-# backend init try to reach real accelerator hardware even for CPU test
-# runs. Re-assert CPU before any computation triggers backends().
-import jax  # noqa: E402
+if not _ON_TPU:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
 
-jax.config.update("jax_platforms", "cpu")
+    # Some environments install a PJRT plugin hook that force-overrides
+    # jax_platforms at interpreter start (sitecustomize), which would make
+    # backend init try to reach real accelerator hardware even for CPU test
+    # runs. Re-assert CPU before any computation triggers backends().
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: needs the real TPU chip — run `MXTPU_TEST_TPU=1 python -m "
+        "pytest tests/test_tpu_smoke.py -m tpu` before each snapshot")

@@ -1,0 +1,40 @@
+"""Multi-process dist kvstore test (VERDICT round-1 item 8; reference
+pattern: tests/nightly/dist_sync_kvstore.py launched by tools/launch.py).
+
+Spawns real localhost worker processes through the launcher CLI — the
+KVStoreDist rank>1 code paths (cross-process reduce, row_sparse, gradient
+compression, barrier) execute for real, no hardware needed."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_dist_sync_kvstore_multiprocess(n):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # workers use their own single cpu device
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", str(n), "--",
+         sys.executable,
+         os.path.join(_ROOT, "tests", "dist_sync_kvstore_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    for r in range(n):
+        assert ("DIST_KV_OK rank=%d/%d" % (r, n)) in out, out[-4000:]
+
+
+def test_launch_cli_propagates_failure():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--", sys.executable, "-c", "import sys; sys.exit(3)"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0

@@ -109,3 +109,63 @@ def test_flash_under_jit():
     ref = pk._attention_reference(q, q, q, False, 1.0 / np.sqrt(16))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
                                atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 64, 64, 32), (1, 200, 260, 16),
+                                   (2, 300, 300, 64)])
+def test_flash_backward_kernel_matches_reference_vjp(causal, shape):
+    """The Pallas backward kernels (dq / dkv) must match the reference
+    attention's vjp on every input (VERDICT round-1 item 7 done-criterion).
+    Covers padded blocks (200/260/300 are not multiples of 128) and
+    cross-attention lengths."""
+    import jax
+    import jax.numpy as jnp
+
+    b, lq, lk, d = shape
+    if causal and lq != lk:
+        pytest.skip("causal cross-attention undefined")
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.normal(size=(b, lq, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, lk, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, lk, d)).astype(np.float32))
+    g = jnp.asarray(rng.normal(size=(b, lq, d)).astype(np.float32))
+    scale = 1.0 / np.sqrt(d)
+
+    out, pull = jax.vjp(
+        lambda a, b_, c: pk.flash_attention(a, b_, c, causal=causal), q, k, v)
+    grads = pull(g)
+    out_r, pull_r = jax.vjp(
+        lambda a, b_, c: pk._attention_reference(a, b_, c, causal, scale),
+        q, k, v)
+    grads_r = pull_r(g)
+    # CPU interpret mode is exact to f32 roundoff; real TPU MXU default
+    # precision moves both paths by ~1e-2 (see perf notes)
+    import jax as _jax
+    tol = 3e-2 if _jax.default_backend() == "tpu" else 5e-4
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_r), atol=tol)
+    for a, b_ in zip(grads, grads_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=tol)
+
+
+def test_flash_backward_bf16_finite_and_close():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(6)
+    mk = lambda: jnp.asarray(rng.normal(size=(2, 128, 64)), dtype=jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+
+    def loss(q, k, v):
+        return pk.flash_attention(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(lambda a, b_, c: pk._attention_reference(
+        a, b_, c, True, 1.0 / 8.0).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip((dq, dk, dv), ref):
+        an = np.asarray(a.astype(jnp.float32))
+        assert np.isfinite(an).all()
+        np.testing.assert_allclose(an, np.asarray(b_.astype(jnp.float32)),
+                                   atol=0.25)

@@ -1,0 +1,233 @@
+"""SSD end-to-end tests (reference coverage model: example/ssd/ +
+tests/python/unittest/test_operator.py MultiBox cases)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo.vision import (SSDMultiBoxLoss, ssd_test_tiny)
+
+
+def _tiny_net(num_classes=3, seed=7):
+    np.random.seed(seed)
+    net = ssd_test_tiny(num_classes=num_classes)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_ssd_forward_shapes():
+    net = _tiny_net()
+    x = mx.nd.random.uniform(shape=(2, 3, 64, 64))
+    cls_preds, loc_preds, anchors = net(x)
+    A = anchors.shape[1]
+    assert anchors.shape == (1, A, 4)
+    assert cls_preds.shape == (2, A, 4)          # 3 classes + background
+    assert loc_preds.shape == (2, A * 4)
+    a = anchors.asnumpy()
+    assert (a[..., 2] > a[..., 0]).all() and (a[..., 3] > a[..., 1]).all()
+
+
+def test_ssd_target_encode_decode_roundtrip():
+    """A confidently-predicted matched anchor must decode back to its gt box
+    (MultiBoxTarget encoding is MultiBoxDetection's inverse)."""
+    net = _tiny_net()
+    x = mx.nd.random.uniform(shape=(1, 3, 64, 64))
+    cls_preds, loc_preds, anchors = net(x)
+    A = anchors.shape[1]
+    gt = np.array([[[1, 0.22, 0.25, 0.58, 0.63]]], np.float32)
+    labels = mx.nd.array(gt)
+    cls_t, loc_t, loc_m = net.training_targets(anchors, cls_preds, labels)
+    assert (cls_t.asnumpy() == 2.0).sum() >= 1   # cls 1 -> target 2 (bg=0)
+    # feed the *targets* back as perfect predictions
+    probs = np.full((1, 4, A), 0.0, np.float32)
+    matched = cls_t.asnumpy()[0] == 2.0
+    probs[0, 2, matched] = 1.0
+    probs[0, 0, ~matched] = 1.0
+    det = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(probs), mx.nd.array(loc_t.asnumpy()), anchors,
+        nms_threshold=0.45)
+    d = det.asnumpy()[0]
+    kept = d[d[:, 0] == 1.0]
+    assert kept.shape[0] >= 1
+    best = kept[np.argmax(kept[:, 1])]
+    np.testing.assert_allclose(best[2:6], gt[0, 0, 1:], atol=2e-2)
+
+
+def test_ssd_hard_negative_mining_ratio():
+    net = _tiny_net()
+    x = mx.nd.random.uniform(shape=(2, 3, 64, 64))
+    cls_preds, loc_preds, anchors = net(x)
+    labels = mx.nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.5, 0.5], [1, 0.6, 0.6, 0.9, 0.9]],
+         [[2, 0.2, 0.3, 0.7, 0.8], [-1, 0, 0, 0, 0]]], np.float32))
+    cls_t, _, _ = net.training_targets(anchors, cls_preds, labels,
+                                       negative_mining_ratio=3)
+    ct = cls_t.asnumpy()
+    for b in range(2):
+        pos = (ct[b] > 0).sum()
+        neg = (ct[b] == 0).sum()
+        ign = (ct[b] < 0).sum()
+        assert neg == 3 * pos, (pos, neg)
+        assert ign == ct.shape[1] - pos - neg
+    # ratio<0 disables mining: every unmatched anchor is a negative
+    cls_t2, _, _ = net.training_targets(anchors, cls_preds, labels,
+                                        negative_mining_ratio=-1)
+    assert (cls_t2.asnumpy() >= 0).all()
+
+
+def test_ssd_loss_decreases_overfit():
+    """One-batch overfit: the joint loss must fall substantially (reference
+    train-style convergence check, tests/python/train)."""
+    net = _tiny_net(num_classes=2)
+    loss_fn = SSDMultiBoxLoss()
+    np.random.seed(0)
+    x = mx.nd.random.uniform(shape=(4, 3, 64, 64))
+    labels = mx.nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.45, 0.5]], [[1, 0.5, 0.4, 0.9, 0.85]],
+         [[0, 0.3, 0.2, 0.7, 0.6]], [[1, 0.2, 0.5, 0.55, 0.95]]], np.float32))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    first = last = None
+    for i in range(25):
+        with autograd.record():
+            cls_preds, loc_preds, anchors = net(x)
+            cls_t, loc_t, loc_m = net.training_targets(anchors, cls_preds,
+                                                       labels)
+            L = loss_fn(cls_preds, loc_preds, cls_t, loc_t, loc_m)
+        L.backward()
+        trainer.step(4)
+        v = float(L.asnumpy())
+        first = v if first is None else first
+        last = v
+    assert np.isfinite(last)
+    assert last < 0.5 * first, (first, last)
+
+
+def test_ssd_hybridize_parity():
+    net = _tiny_net()
+    x = mx.nd.random.uniform(shape=(2, 3, 64, 64))
+    outs0 = net(x)
+    net.hybridize()
+    outs1 = net(x)
+    for a, b in zip(outs0, outs1):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=2e-5,
+                                   atol=2e-5)
+
+
+def test_ssd_symbol_trace_parity():
+    net = _tiny_net()
+    x = mx.nd.random.uniform(shape=(1, 3, 64, 64))
+    eager = net(x)
+    outs = net(mx.sym.var("data", shape=(1, 3, 64, 64)))
+    g = mx.sym.Group(list(outs))
+    vals = {"data": x._data}
+    vals.update({k: v.data()._data for k, v in net.collect_params().items()})
+    res = g.eval_with(vals)
+    for r, e in zip(res, eager):
+        np.testing.assert_allclose(np.asarray(r), e.asnumpy(), rtol=1e-5,
+                                   atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# detection data pipeline
+# ---------------------------------------------------------------------------
+
+def _det_label(boxes):
+    """im2rec detection format: [header_w, obj_w, obj...]"""
+    flat = [2.0, 5.0]
+    for b in boxes:
+        flat.extend(b)
+    return flat
+
+
+def _make_det_imglist(tmp_path, n=6):
+    from PIL import Image
+
+    items = []
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        arr = rng.randint(0, 255, (48, 64, 3), np.uint8)
+        p = tmp_path / ("img%d.jpg" % i)
+        Image.fromarray(arr).save(p)
+        boxes = [[i % 3, 0.2, 0.25, 0.6, 0.7]]
+        if i % 2:
+            boxes.append([(i + 1) % 3, 0.5, 0.5, 0.9, 0.95])
+        items.append(_det_label(boxes) + [str(p)])
+    return items
+
+
+def test_image_det_iter(tmp_path):
+    imglist = _make_det_imglist(tmp_path)
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                               imglist=imglist, path_root="")
+    assert it.label_shape == (2, 5)
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 32, 32)
+    assert batch.label[0].shape == (2, 2, 5)
+    lab = batch.label[0].asnumpy()
+    valid = lab[lab[:, :, 0] >= 0]
+    assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+    n = 1
+    try:
+        while True:
+            it.next()
+            n += 1
+    except StopIteration:
+        pass
+    assert n == 3
+
+
+def test_det_horizontal_flip():
+    aug = mx.image.DetHorizontalFlipAug(p=1.0)
+    img = np.arange(4 * 4 * 3, dtype=np.uint8).reshape(4, 4, 3)
+    lab = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    out, lab2 = aug(img, lab)
+    np.testing.assert_array_equal(out, img[:, ::-1])
+    np.testing.assert_allclose(lab2[0], [0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+
+
+def test_det_random_crop_keeps_valid_boxes():
+    np.random.seed(3)
+    aug = mx.image.DetRandomCropAug(min_object_covered=0.5,
+                                    area_range=(0.3, 1.0))
+    img = np.zeros((64, 64, 3), np.uint8)
+    lab = np.array([[1, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    for _ in range(10):
+        out, lab2 = aug(img, lab)
+        assert lab2.shape[1] == 5 and lab2.shape[0] >= 1
+        assert (lab2[:, 1:] >= -1e-6).all() and (lab2[:, 1:] <= 1 + 1e-6).all()
+        assert (lab2[:, 3] > lab2[:, 1]).all()
+        assert (lab2[:, 4] > lab2[:, 2]).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    np.random.seed(4)
+    aug = mx.image.DetRandomPadAug(area_range=(2.0, 2.5))
+    img = np.full((32, 32, 3), 255, np.uint8)
+    lab = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    out, lab2 = aug(img, lab)
+    assert out.shape[0] >= 32 and out.shape[1] >= 32
+    w = lab2[0, 3] - lab2[0, 1]
+    h = lab2[0, 4] - lab2[0, 2]
+    assert w < 1.0 and h < 1.0
+
+
+def test_ssd_train_from_det_iter(tmp_path):
+    """iterator -> targets -> loss -> trainer.step end-to-end."""
+    imglist = _make_det_imglist(tmp_path, n=4)
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 64, 64),
+                               imglist=imglist, path_root="",
+                               rand_mirror=True)
+    net = _tiny_net(num_classes=3)
+    loss_fn = SSDMultiBoxLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1e-2})
+    batch = it.next()
+    with autograd.record():
+        cls_preds, loc_preds, anchors = net(batch.data[0])
+        cls_t, loc_t, loc_m = net.training_targets(anchors, cls_preds,
+                                                   batch.label[0])
+        L = loss_fn(cls_preds, loc_preds, cls_t, loc_t, loc_m)
+    L.backward()
+    trainer.step(2)
+    assert np.isfinite(float(L.asnumpy()))

@@ -1,0 +1,87 @@
+"""Distributed job launcher (reference: tools/launch.py — the dmlc-tracker
+front-end that spawned scheduler/server/worker processes over ssh/mpi/yarn).
+
+TPU-native: there are no parameter servers; every process is a worker in a
+synchronous `jax.distributed` group (the coordinator service replaces the
+ps-lite scheduler rendezvous — SURVEY §5.8). This launcher covers the
+`local` cluster type (N processes on this host — the reference's nightly
+dist tests pattern, tests/nightly/test_all.sh:55) and emits the standard
+env-var protocol so `mxnet_tpu.kv.create('dist_sync')` works unmodified:
+
+  MXTPU_COORDINATOR     host:port of process 0's coordinator service
+  MXTPU_NUM_WORKERS     group size        (alias: DMLC_NUM_WORKER)
+  MXTPU_PROCESS_ID      this process rank (alias: DMLC_WORKER_ID)
+
+For multi-host, run the same command on each host with MXTPU_PROCESS_ID
+set per host and MXTPU_COORDINATOR pointing at host 0 (ssh/mpi orchestration
+is left to the cluster scheduler — slurm/k8s do what dmlc-tracker did).
+
+Usage: python tools/launch.py -n 4 [--port 52321] python train.py ...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (local cluster)")
+    parser.add_argument("-n", "--num-workers", required=True, type=int)
+    parser.add_argument("--launcher", default="local",
+                        choices=["local"],
+                        help="only 'local' is built in; use your cluster "
+                             "scheduler for multi-host (see module doc)")
+    parser.add_argument("--port", type=int, default=0,
+                        help="coordinator port (default: pick a free one)")
+    parser.add_argument("--env", action="append", default=[],
+                        help="extra KEY=VAL for every worker")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.command:
+        parser.error("no command given")
+
+    port = args.port or _free_port()
+    coord = "127.0.0.1:%d" % port
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env["MXTPU_COORDINATOR"] = coord
+            env["MXTPU_NUM_WORKERS"] = str(args.num_workers)
+            env["MXTPU_PROCESS_ID"] = str(rank)
+            # reference-compatible aliases (DMLC_* protocol, launch.py:29)
+            env["DMLC_NUM_WORKER"] = str(args.num_workers)
+            env["DMLC_WORKER_ID"] = str(rank)
+            env["DMLC_ROLE"] = "worker"
+            for kv in args.env:
+                k, _, v = kv.partition("=")
+                env[k] = v
+            procs.append(subprocess.Popen(args.command, env=env))
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
