@@ -1,0 +1,70 @@
+"""LSTM word language model (reference: example/rnn/word_lm/train.py).
+Trains model_zoo.word_lm.RNNModel with truncated BPTT on a synthetic
+Markov-chain corpus (zero-egress stand-in for PTB).
+
+    JAX_PLATFORMS=cpu python examples/rnn/word_lm.py --epochs 2
+"""
+import argparse
+
+import numpy as np
+
+
+def synth_corpus(vocab=200, length=20000, seed=0):
+    """Second-order Markov text: learnable structure, ppl well below vocab."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.ones(vocab) * 0.05, size=vocab)
+    data = [0]
+    for _ in range(length - 1):
+        data.append(rng.choice(vocab, p=trans[data[-1]]))
+    return np.asarray(data, np.int32)
+
+
+def batchify(data, batch_size):
+    n = len(data) // batch_size
+    return data[:n * batch_size].reshape(batch_size, n).T  # (T, B)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--bptt", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo.word_lm import RNNModel
+
+    corpus = batchify(synth_corpus(args.vocab), args.batch_size)
+    model = RNNModel(vocab_size=args.vocab, embed_size=64, hidden_size=128,
+                     num_layers=1, dropout=0.0)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total, count = 0.0, 0
+        state = model.begin_state(args.batch_size)
+        for t in range(0, corpus.shape[0] - args.bptt - 1, args.bptt):
+            # TNC layout: (T, B) ids, next-token targets
+            x = mx.nd.array(corpus[t:t + args.bptt], dtype="int32")
+            y = mx.nd.array(corpus[t + 1:t + args.bptt + 1]
+                            .astype(np.float32))
+            state = [s.detach() for s in state]
+            with autograd.record():
+                out, state = model(x, state)
+                loss = ce(out, y)
+            loss.backward()
+            trainer.step(args.batch_size * args.bptt)
+            total += float(loss.asnumpy().mean()) * args.bptt
+            count += args.bptt
+        ppl = float(np.exp(total / count))
+        print("epoch %d: perplexity %.1f (uniform would be %d)"
+              % (epoch, ppl, args.vocab))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""SSD training loop (reference: example/ssd/train.py). Uses the gluon SSD
+model family + ImageDetIter; generates a synthetic colored-shape detection
+set if no .rec is given so the example runs anywhere.
+
+    JAX_PLATFORMS=cpu python examples/ssd/train.py --epochs 2
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+
+def synth_dataset(n=32, size=96):
+    from PIL import Image
+
+    tmp = tempfile.mkdtemp(prefix="ssd_synth_")
+    rng = np.random.RandomState(0)
+    imglist = []
+    for i in range(n):
+        arr = rng.randint(0, 80, (size, size, 3), np.uint8)
+        cls = i % 2
+        w = h = size // 3
+        x0 = rng.randint(0, size - w)
+        y0 = rng.randint(0, size - h)
+        color = (255, 40, 40) if cls == 0 else (40, 255, 40)
+        arr[y0:y0 + h, x0:x0 + w] = color
+        p = os.path.join(tmp, "i%d.jpg" % i)
+        Image.fromarray(arr).save(p)
+        imglist.append([2.0, 5.0, float(cls), x0 / size, y0 / size,
+                        (x0 + w) / size, (y0 + h) / size, p])
+    return imglist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--data-shape", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--network", default="tiny",
+                    choices=["tiny", "resnet50_v1"])
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo.vision import (SSDMultiBoxLoss, get_ssd,
+                                                  ssd_test_tiny)
+
+    it = mx.image.ImageDetIter(
+        batch_size=args.batch_size, data_shape=(3, args.data_shape,
+                                                args.data_shape),
+        imglist=synth_dataset(), path_root="", rand_mirror=True)
+
+    net = ssd_test_tiny(num_classes=2) if args.network == "tiny" else \
+        get_ssd(args.network, args.data_shape, num_classes=2)
+    net.initialize(mx.init.Xavier())
+    loss_fn = SSDMultiBoxLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    for epoch in range(args.epochs):
+        it.reset()
+        total, batches = 0.0, 0
+        for batch in it:
+            with autograd.record():
+                cls_preds, loc_preds, anchors = net(batch.data[0])
+                cls_t, loc_t, loc_m = net.training_targets(
+                    anchors, cls_preds, batch.label[0])
+                loss = loss_fn(cls_preds, loc_preds, cls_t, loc_t, loc_m)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.asnumpy())
+            batches += 1
+        print("epoch %d: loss %.4f" % (epoch, total / max(batches, 1)))
+
+    # decode a batch of detections
+    det = net.detections(cls_preds, loc_preds, anchors)
+    d = det.asnumpy()
+    kept = (d[:, :, 0] >= 0).sum()
+    print("detections kept after NMS (last batch):", int(kept))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,8 @@ import threading
 
 from .base import MXNetError
 
-__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context", "num_gpus", "num_tpus"]
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+           "num_gpus", "num_tpus", "gpu_memory_info"]
 
 
 class Context:
@@ -111,6 +112,16 @@ class Context:
         """Release cached device memory (reference: context.py:292). XLA owns
         the allocator; this is a best-effort no-op hook."""
 
+    def memory_info(self):
+        """(free_bytes, total_bytes) for this context's device (reference:
+        context.py gpu_memory_info / cudaMemGetInfo). Sourced from PJRT
+        device memory stats; CPU backends report (0, 0) — the host allocator
+        has no device pool (SURVEY N2: PJRT owns device memory)."""
+        stats = self.jax_device().memory_stats() or {}
+        total = stats.get("bytes_limit", 0)
+        used = stats.get("bytes_in_use", 0)
+        return (max(total - used, 0), total)
+
 
 def cpu(device_id=0):
     return Context("cpu", device_id)
@@ -140,6 +151,12 @@ def num_gpus():
 
 def num_tpus():
     return num_gpus()
+
+
+def gpu_memory_info(device_id=0):
+    """reference: python/mxnet/context.py gpu_memory_info — (free, total)
+    bytes on the accelerator device."""
+    return Context("gpu", device_id).memory_info()
 
 
 _DEFAULT = Context("cpu", 0)

@@ -161,6 +161,9 @@ class SSDMultiBoxLoss(Loss):
 
     def __init__(self, negative_mining_ratio=3, lambd=1.0, weight=None,
                  batch_axis=0, **kwargs):
+        # negative_mining_ratio is accepted for signature parity but unused
+        # here: hard negative mining happens in SSD.training_targets /
+        # MultiBoxTarget (where the reference does it), not in the loss
         super().__init__(weight, batch_axis, **kwargs)
         self._lambd = lambd
 
@@ -218,11 +221,11 @@ def get_ssd(base="resnet50_v1", data_shape=512, num_classes=20,
             pretrained_base=False, **kwargs):
     """Factory (reference: symbol_factory.py get_symbol_train(get_config))."""
     if base == "resnet50_v1":
-        blocks = _resnet_base(1, 50)
+        blocks = _resnet_base(1, 50, pretrained=pretrained_base)
     elif base == "resnet18_v1":
-        blocks = _resnet_base(1, 18)
+        blocks = _resnet_base(1, 18, pretrained=pretrained_base)
     elif base == "mobilenet1.0":
-        blocks = _mobilenet_base(1.0)
+        blocks = _mobilenet_base(1.0, pretrained=pretrained_base)
     else:
         raise MXNetError("unsupported SSD base '%s'" % base)
     sizes = _SIZES_512 if data_shape >= 512 else _SIZES_300

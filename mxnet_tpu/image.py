@@ -855,18 +855,29 @@ class ImageDetIter(ImageIter):
             raise MXNetError("sample with no valid box")
         return out[valid]
 
+    def _raw_labels(self):
+        """Yield raw label vectors WITHOUT decoding images (the reference's
+        label scan reads only recordio headers — decoding a whole COCO-scale
+        .rec at construction would take minutes)."""
+        if self.record is not None:
+            from . import recordio
+
+            for idx in self.seq:
+                header, _ = recordio.unpack(self.record.read_idx(idx))
+                yield header.label
+        else:
+            for idx in self.seq:
+                yield _np.asarray(self.imglist[idx][1], dtype=_np.float32)
+
     def _estimate_label_shape(self):
         max_count, width = 0, 5
-        self.reset()
-        try:
-            while True:
-                label, _ = self.next_sample()
+        for label in self._raw_labels():
+            try:
                 lab = self._parse_label(label)
-                max_count = max(max_count, lab.shape[0])
-                width = lab.shape[1]
-        except StopIteration:
-            pass
-        self.reset()
+            except MXNetError:
+                continue  # degenerate-only samples are skipped by next() too
+            max_count = max(max_count, lab.shape[0])
+            width = lab.shape[1]
         return (max_count, width)
 
     @property

@@ -33,6 +33,9 @@ class OpDef:
     num_visible_outputs: int = None  # outputs exposed to the user (rest are aux,
                                      # e.g. batch_norm's batch stats)
     aliases: tuple = ()
+    num_outputs_fn: _t.Callable = None  # attrs -> output count, for variadic
+                                        # ops whose arity depends on attrs
+                                        # (e.g. Proposal output_score)
 
     @property
     def visible_outputs(self):
@@ -42,11 +45,13 @@ class OpDef:
 _REGISTRY: dict = {}
 
 
-def register(name, num_outputs=1, needs_rng=False, num_visible_outputs=None, aliases=()):
+def register(name, num_outputs=1, needs_rng=False, num_visible_outputs=None,
+             aliases=(), num_outputs_fn=None):
     """Decorator registering a pure-jax op function under `name`."""
 
     def deco(fn):
-        op = OpDef(name, fn, num_outputs, needs_rng, num_visible_outputs, tuple(aliases))
+        op = OpDef(name, fn, num_outputs, needs_rng, num_visible_outputs,
+                   tuple(aliases), num_outputs_fn)
         _REGISTRY[name] = op
         for a in aliases:
             _REGISTRY[a] = op

@@ -445,3 +445,181 @@ def _contrib_flash_attention(q, k, v, causal=False, sm_scale=None):
 
     return pallas_kernels.flash_attention(q, k, v, causal=causal,
                                           sm_scale=sm_scale)
+
+
+# --------------------------------------------------------------------------
+# RPN Proposal (reference: src/operator/contrib/proposal-inl.h:93 — anchors
+# + bbox deltas -> clip -> min-size filter -> top-k -> NMS -> fixed-count
+# rois). Static shapes throughout: top-k and the NMS alive-mask keep XLA
+# happy; short outputs pad by repeating the best proposal like the
+# reference's workspace fill.
+# --------------------------------------------------------------------------
+
+def _rpn_anchors(h, w, stride, scales, ratios):
+    import numpy as np
+
+    base = float(stride)
+    anchors = []
+    for r in ratios:
+        for s in scales:
+            size = base * base * s * s
+            w_a = np.sqrt(size / r)
+            h_a = w_a * r
+            anchors.append([-(w_a - 1) / 2, -(h_a - 1) / 2,
+                            (w_a - 1) / 2, (h_a - 1) / 2])
+    base_a = np.asarray(anchors, np.float32)          # (A, 4)
+    cy, cx = np.meshgrid(np.arange(h) * stride, np.arange(w) * stride,
+                         indexing="ij")
+    shift = np.stack([cx, cy, cx, cy], axis=-1).reshape(-1, 1, 4)
+    return (shift + base_a[None]).reshape(-1, 4)      # (H*W*A, 4)
+
+
+@register("_contrib_Proposal", num_outputs=-1,
+          num_outputs_fn=lambda attrs: 2 if attrs.get("output_score") else 1,
+          aliases=("Proposal", "_contrib_MultiProposal", "MultiProposal"))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """cls_prob (B, 2A, H, W), bbox_pred (B, 4A, H, W), im_info (B, 3)
+    -> rois (B*post_nms_top_n, 5) [batch_idx, x1, y1, x2, y2]."""
+    b, c2a, h, w = cls_prob.shape
+    na = c2a // 2
+    anchors = jnp.asarray(_rpn_anchors(h, w, feature_stride, scales, ratios))
+    total = anchors.shape[0]
+    pre_n = min(int(rpn_pre_nms_top_n), total)
+    post_n = int(rpn_post_nms_top_n)
+
+    def per_image(scores, deltas, info):
+        # scores (2A, H, W) -> fg (A, H, W) -> (H*W*A,)
+        fg = scores[na:].transpose(1, 2, 0).reshape(-1)
+        d = deltas.reshape(na, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        acx = anchors[:, 0] + 0.5 * (aw - 1)
+        acy = anchors[:, 1] + 0.5 * (ah - 1)
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        pw = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        ph = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        x1 = cx - 0.5 * (pw - 1)
+        y1 = cy - 0.5 * (ph - 1)
+        x2 = cx + 0.5 * (pw - 1)
+        y2 = cy + 0.5 * (ph - 1)
+        # clip to image (im_info = [height, width, scale])
+        x1 = jnp.clip(x1, 0, info[1] - 1.0)
+        y1 = jnp.clip(y1, 0, info[0] - 1.0)
+        x2 = jnp.clip(x2, 0, info[1] - 1.0)
+        y2 = jnp.clip(y2, 0, info[0] - 1.0)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        min_size = rpn_min_size * info[2]
+        keep = ((x2 - x1 + 1.0) >= min_size) & ((y2 - y1 + 1.0) >= min_size)
+        score = jnp.where(keep, fg, -jnp.inf)
+        top_s, top_i = jax.lax.top_k(score, pre_n)
+        top_b = boxes[top_i]
+
+        def body(i, alive):
+            # one IoU row per step: keeps NMS memory O(pre_n) instead of a
+            # pre_n^2 matrix (6000^2 f32 = 144MB/image at the default top_n)
+            row = _box_iou_corner(top_b[i][None, :], top_b)
+            cur = alive[i]
+            kill = (row > threshold) & (jnp.arange(pre_n) > i) & cur
+            return alive & ~kill
+
+        alive = lax.fori_loop(0, pre_n, body,
+                              jnp.isfinite(top_s))
+        # order survivors first (stable), pad by repeating the best
+        rank = jnp.argsort(~alive, stable=True)
+        sel = rank[:post_n] if post_n <= pre_n else \
+            jnp.concatenate([rank, jnp.zeros(post_n - pre_n, rank.dtype)])
+        out_boxes = top_b[sel]
+        out_alive = alive[sel]
+        out_boxes = jnp.where(out_alive[:, None], out_boxes, top_b[0])
+        out_score = jnp.where(out_alive, top_s[sel], top_s[0])
+        return out_boxes, out_score
+
+    boxes, scores = jax.vmap(per_image)(cls_prob, bbox_pred, im_info)
+    batch_ids = jnp.repeat(jnp.arange(b, dtype=boxes.dtype), post_n)
+    rois = jnp.concatenate([batch_ids[:, None],
+                            boxes.reshape(-1, 4)], axis=-1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+# --------------------------------------------------------------------------
+# DeformableConvolution (reference:
+# src/operator/contrib/deformable_convolution-inl.h:99 — bilinear sampling
+# at learned per-tap offsets, then a standard grouped conv contraction).
+# TPU-native: the sampled column tensor is built with vectorized gathers
+# (XLA fuses the 4-corner interpolation) and contracted with one einsum on
+# the MXU — no explicit im2col buffer in HBM.
+# --------------------------------------------------------------------------
+
+@register("_contrib_DeformableConvolution", aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(),
+                           stride=(), dilate=(), pad=(), num_filter=0,
+                           num_group=1, num_deformable_group=1,
+                           no_bias=False, workspace=0, layout=None):
+    n, c, h, w = data.shape
+    kh, kw = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    g = int(num_group)
+    dg = int(num_deformable_group)
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    oy = jnp.arange(oh) * sh - ph
+    ox = jnp.arange(ow) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    # base sampling grids (kh, kw, oh, ow)
+    base_y = jnp.broadcast_to(
+        (oy[None, None, :, None] + ky[:, None, None, None]).astype(data.dtype),
+        (kh, kw, oh, ow))
+    base_x = jnp.broadcast_to(
+        (ox[None, None, None, :] + kx[None, :, None, None]).astype(data.dtype),
+        (kh, kw, oh, ow))
+
+    # reference offset layout: (N, dg*2*kh*kw, OH, OW), y before x per tap
+    off = offset.reshape(n, dg, kh * kw, 2, oh, ow) \
+                .reshape(n, dg, kh, kw, 2, oh, ow)
+    sy = base_y[None, None] + off[:, :, :, :, 0]
+    sx = base_x[None, None] + off[:, :, :, :, 1]   # (N, dg, kh, kw, oh, ow)
+
+    def bilinear(img, y, x):
+        # img (C', H, W); y/x (kh, kw, oh, ow)
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy = y - y0
+        wx = x - x0
+
+        def at(yy, xx):
+            inb = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = img[:, yc, xc]                      # (C', kh, kw, oh, ow)
+            return jnp.where(inb[None], v, 0.0)
+
+        return (at(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                + at(y0, x0 + 1) * ((1 - wy) * wx)[None]
+                + at(y0 + 1, x0) * (wy * (1 - wx))[None]
+                + at(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+    def per_sample(img, y, x):
+        # img (C, H, W) split into dg channel groups sharing offsets
+        imgs = img.reshape(dg, c // dg, h, w)
+        cols = jax.vmap(bilinear)(imgs, y, x)       # (dg, C/dg, kh, kw, ...)
+        return cols.reshape(c, kh, kw, oh, ow)
+
+    cols = jax.vmap(per_sample)(data, sy, sx)       # (N, C, kh, kw, oh, ow)
+    cols = cols.reshape(n, g, c // g, kh, kw, oh, ow)
+    wgt = weight.reshape(g, num_filter // g, c // g, kh, kw)
+    out = jnp.einsum("ngcijyx,gocij->ngoyx", cols, wgt,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(n, num_filter, oh, ow).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

@@ -345,6 +345,46 @@ def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125
     raise ValueError("unknown act_type %s" % act_type)
 
 
+@register("im2col")
+def im2col(data, kernel=(), stride=(), dilate=(), pad=()):
+    """Sliding-window patch extraction (reference: src/operator/nn/im2col.cc
+    — the building block DeformableConvolution/custom convs use). data
+    (N, C, H, W) -> (N, C*prod(kernel), L) column matrix."""
+    kh, kw = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    n, c = data.shape[0], data.shape[1]
+    patches = lax.conv_general_dilated_patches(
+        data, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+        rhs_dilation=(dh, dw))               # (N, C*kh*kw, OH, OW)
+    oh, ow = patches.shape[2], patches.shape[3]
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+@register("col2im")
+def col2im(data, output_size=(), kernel=(), stride=(), dilate=(), pad=()):
+    """Scatter-add columns back into an image — im2col's exact transpose
+    (reference: im2col.cc col2im). Implemented as the vjp of im2col, which
+    XLA lowers to one scatter-add."""
+    h, w = output_size
+    n = data.shape[0]
+    kh, kw = kernel
+    c = data.shape[1] // (kh * kw)
+
+    def f(img):
+        sh, sw = stride if stride else (1, 1)
+        dh, dw = dilate if dilate else (1, 1)
+        ph, pw = pad if pad else (0, 0)
+        patches = lax.conv_general_dilated_patches(
+            img, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+            rhs_dilation=(dh, dw))
+        return patches.reshape(n, c * kh * kw, -1)
+
+    _, pull = jax.vjp(f, jnp.zeros((n, c, h, w), data.dtype))
+    return pull(data)[0]
+
+
 # --------------------------------------------------------------------------
 # Softmax family (softmax.cc, softmax_output.cc, loss_binary_op.cc)
 # --------------------------------------------------------------------------

@@ -171,3 +171,79 @@ def spatial_transformer(data, loc, target_shape=(), transform_type="affine",
                         sampler_type="bilinear", cudnn_off=False):
     grid = grid_generator(loc, "affine", target_shape)
     return bilinear_sampler(data, grid)
+
+
+# --------------------------------------------------------------------------
+# vectorized per-distribution sampling (reference: multisample_op.cc —
+# `sample_uniform` et al: one distribution per input element, `shape` draws
+# from each; output shape = param.shape + shape)
+# --------------------------------------------------------------------------
+
+def _multisample(rng, params, shape, draw, dtype):
+    shape = tuple(shape) if isinstance(shape, (tuple, list)) else \
+        ((int(shape),) if shape else ())
+    lead = params[0].shape
+    flat = [jnp.reshape(p, (-1,)) for p in params]
+    keys = jax.random.split(rng, flat[0].shape[0])
+    out = jax.vmap(lambda k, *ps: draw(k, shape, *ps))(keys, *flat)
+    return out.reshape(lead + shape).astype(np_dtype(dtype))
+
+
+@register("_sample_uniform", needs_rng=True, aliases=("sample_uniform",))
+def sample_uniform(rng, low, high, shape=(), dtype="float32"):
+    return _multisample(
+        rng, [low, high], shape,
+        lambda k, s, lo, hi: jax.random.uniform(k, s) * (hi - lo) + lo, dtype)
+
+
+@register("_sample_normal", needs_rng=True, aliases=("sample_normal",))
+def sample_normal(rng, mu, sigma, shape=(), dtype="float32"):
+    return _multisample(
+        rng, [mu, sigma], shape,
+        lambda k, s, m, sd: jax.random.normal(k, s) * sd + m, dtype)
+
+
+@register("_sample_gamma", needs_rng=True, aliases=("sample_gamma",))
+def sample_gamma(rng, alpha, beta, shape=(), dtype="float32"):
+    return _multisample(
+        rng, [alpha, beta], shape,
+        lambda k, s, a, b: jax.random.gamma(k, a, s) * b, dtype)
+
+
+@register("_sample_exponential", needs_rng=True,
+          aliases=("sample_exponential",))
+def sample_exponential(rng, lam, shape=(), dtype="float32"):
+    return _multisample(
+        rng, [lam], shape,
+        lambda k, s, l: jax.random.exponential(k, s) / l, dtype)
+
+
+@register("_sample_poisson", needs_rng=True, aliases=("sample_poisson",))
+def sample_poisson(rng, lam, shape=(), dtype="float32"):
+    return _multisample(
+        rng, [lam], shape,
+        lambda k, s, l: jax.random.poisson(k, l, s).astype(jnp.float32),
+        dtype)
+
+
+@register("_sample_negative_binomial", needs_rng=True,
+          aliases=("sample_negative_binomial",))
+def sample_negative_binomial(rng, k, p, shape=(), dtype="float32"):
+    def draw(key, s, kk, pp):
+        k1, k2 = jax.random.split(key)
+        lam = jax.random.gamma(k1, kk, s) * ((1 - pp) / pp)
+        return jax.random.poisson(k2, lam, s).astype(jnp.float32)
+
+    return _multisample(rng, [k, p], shape, draw, dtype)
+
+
+@register("_sample_generalized_negative_binomial", needs_rng=True,
+          aliases=("sample_generalized_negative_binomial", "sample_gnb"))
+def sample_generalized_negative_binomial(rng, mu, alpha, shape=(),
+                                         dtype="float32"):
+    def draw(key, s, m, a):
+        k1, k2 = jax.random.split(key)
+        lam = jax.random.gamma(k1, 1.0 / a, s) * (a * m)
+        return jax.random.poisson(k2, lam, s).astype(jnp.float32)
+
+    return _multisample(rng, [mu, alpha], shape, draw, dtype)

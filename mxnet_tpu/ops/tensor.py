@@ -206,6 +206,7 @@ _UNARY_FNS = {
     "erfinv": jax.scipy.special.erfinv,
     "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
     "gammaln": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
     "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
 }
 
@@ -213,6 +214,35 @@ for _n, _f in _UNARY_FNS.items():
     register(_n)((lambda f: lambda data: f(data))(_f))
 
 register("identity", aliases=("_copy",))(lambda data: data)
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """reference: src/operator/tensor/elemwise_unary_op_basic.cc HardSigmoid"""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("ravel_multi_index", aliases=("ravel_index",))
+def ravel_multi_index(data, shape=()):
+    """data: (ndim, N) indices -> (N,) flat ids (reference: ravel.cc)."""
+    strides = []
+    acc = 1
+    for d in reversed(shape):
+        strides.append(acc)
+        acc *= d
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return (data * strides[:, None]).sum(axis=0)
+
+
+@register("unravel_index", aliases=("unravel",))
+def unravel_index(data, shape=()):
+    """(N,) flat ids -> (ndim, N) indices (reference: ravel.cc UnravelIndex)."""
+    idx = data.reshape(-1)
+    out = []
+    for d in reversed(shape):
+        out.append(idx % d)
+        idx = idx // d
+    return jnp.stack(list(reversed(out))).astype(data.dtype)
 
 
 @register("BlockGrad", aliases=("stop_gradient",))

@@ -142,7 +142,12 @@ def _make_symbol_function(opdef):
         if attr:
             attrs = dict(attrs, **_wrap_attr_keys(attr))
         node = _Node(opdef.name, node_name, attrs, edges, aux_slots)
-        nvis = opdef.visible_outputs if opdef.num_outputs > 0 else 1
+        if opdef.num_outputs > 0:
+            nvis = opdef.visible_outputs
+        elif opdef.num_outputs_fn is not None:
+            nvis = opdef.num_outputs_fn(attrs)
+        else:
+            nvis = 1
         return Symbol([(node, i) for i in range(max(1, nvis))])
 
     generated.__name__ = opdef.name

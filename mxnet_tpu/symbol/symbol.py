@@ -45,12 +45,22 @@ class _Node:
     def num_outputs(self):
         if self.is_var:
             return 1
-        return max(1, _ops.get(self.op).num_outputs)
+        od = _ops.get(self.op)
+        if od.num_outputs > 0:
+            return od.num_outputs
+        if od.num_outputs_fn is not None:
+            # variadic arity resolved from this node's attrs (e.g. Proposal
+            # grows a score output under output_score=True)
+            return max(1, od.num_outputs_fn(self.attrs))
+        return 1
 
     def visible_outputs(self):
         if self.is_var:
             return 1
-        return max(1, _ops.get(self.op).visible_outputs)
+        od = _ops.get(self.op)
+        if od.num_outputs > 0:
+            return max(1, od.visible_outputs)
+        return self.num_outputs()
 
 
 class Symbol:

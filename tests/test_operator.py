@@ -921,6 +921,121 @@ def _quantized_conv_shape():
     assert outs[0].shape == (1, 3, 3, 3)
 
 
+# -- round-2 op additions (VERDICT item: missing ops) -----------------------
+
+def _np_im2col(x, kh, kw, sh, sw, ph, pw):
+    n, c, h, w = x.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    cols = np.zeros((n, c * kh * kw, oh * ow), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i:i + sh * oh:sh, j:j + sw * ow:sw]
+            cols[:, (np.arange(c) * kh * kw + i * kw + j)] = \
+                patch.reshape(n, c, -1)
+    return cols
+
+
+case("digamma", P(3, 4, lo=0.5, hi=3.0),
+     ref=lambda x: __import__("scipy.special",
+                              fromlist=["psi"]).psi(x).astype(np.float32),
+     grad=[0])
+case("hard_sigmoid", U(3, 4, lo=-4, hi=4),
+     ref=lambda x: np.clip(0.2 * x + 0.5, 0, 1), grad=[0])
+case("hard_sigmoid", U(3, 4, lo=-4, hi=4), attrs={"alpha": 0.5, "beta": 0.1},
+     ref=lambda x, **kw: np.clip(0.5 * x + 0.1, 0, 1),
+     cid="hard_sigmoid_ab")
+case("unravel_index", np.array([0, 5, 11], np.int64),
+     attrs={"shape": (3, 4)},
+     ref=lambda x, **kw: np.stack(np.unravel_index(x, (3, 4))).astype(x.dtype))
+case("ravel_multi_index", np.array([[1, 2], [1, 3]], np.int64),
+     attrs={"shape": (3, 4)},
+     ref=lambda x, **kw: np.ravel_multi_index(
+         tuple(x), (3, 4)).astype(x.dtype))
+case("im2col", U(2, 3, 5, 5),
+     attrs={"kernel": (3, 3), "stride": (1, 1), "pad": (1, 1)},
+     ref=lambda x, **kw: _np_im2col(x, 3, 3, 1, 1, 1, 1), grad=[0])
+case("im2col", U(1, 2, 6, 6),
+     attrs={"kernel": (2, 2), "stride": (2, 2), "pad": (0, 0)},
+     ref=lambda x, **kw: _np_im2col(x, 2, 2, 2, 2, 0, 0),
+     cid="im2col_stride")
+case("col2im", np.ones((1, 2 * 9, 25), np.float32),
+     attrs={"output_size": (5, 5), "kernel": (3, 3), "stride": (1, 1),
+            "pad": (1, 1)}, grad=[0],
+     check=lambda outs, c: (outs[0].shape == (1, 2, 5, 5)
+                            and abs(outs[0][0, 0, 2, 2] - 9.0) < 1e-5)
+     or pytest.fail("col2im scatter-add wrong: %s" % outs[0][0, 0]))
+
+case("_contrib_Proposal", P(1, 2 * 6, 4, 4, lo=0.0, hi=1.0),
+     U(1, 4 * 6, 4, 4, lo=-0.1, hi=0.1),
+     np.array([[64, 64, 1.0]], np.float32),
+     attrs={"rpn_pre_nms_top_n": 40, "rpn_post_nms_top_n": 8,
+            "feature_stride": 16, "scales": (2, 4), "ratios": (0.5, 1, 2)},
+     naive=False,
+     check=lambda outs, c: (outs[0].shape == (8, 5)
+                            and (outs[0][:, 3] >= outs[0][:, 1]).all()
+                            and outs[0][:, 1:].min() >= 0
+                            and outs[0][:, 1:].max() <= 63)
+     or pytest.fail("Proposal rois invalid: %s" % outs[0]))
+
+_dc_x = U(1, 4, 6, 6)
+_dc_w = U(5, 4, 3, 3)
+case("_contrib_DeformableConvolution", _dc_x,
+     np.zeros((1, 2 * 9, 6, 6), np.float32), _dc_w,
+     attrs={"kernel": (3, 3), "pad": (1, 1), "num_filter": 5,
+            "no_bias": True}, grad=[0, 2],
+     check=lambda outs, c: np.allclose(
+         outs[0],
+         run_op("Convolution", [c.arrays[0], c.arrays[2]],
+                {"kernel": (3, 3), "pad": (1, 1), "num_filter": 5,
+                 "no_bias": True}).asnumpy(), atol=1e-4)
+     or pytest.fail("deformable(offset=0) != Convolution"))
+# offset gradient checked away from integer sampling positions (bilinear
+# interpolation is non-differentiable exactly at cell corners — same caveat
+# as the reference's finite-difference tests)
+case("_contrib_DeformableConvolution", _dc_x,
+     U(1, 2 * 9, 6, 6, lo=0.2, hi=0.4), _dc_w,
+     attrs={"kernel": (3, 3), "pad": (1, 1), "num_filter": 5,
+            "no_bias": True}, grad=[0, 1, 2], grad_tol=5e-2,
+     cid="DeformableConvolution_offset_grad")
+
+case("_sample_uniform", np.array([0.0, 10.0], np.float32),
+     np.array([1.0, 20.0], np.float32), attrs={"shape": (600,)}, naive=False,
+     check=lambda outs, c: (outs[0].shape == (2, 600)
+                            and 0.4 < outs[0][0].mean() < 0.6
+                            and 14.0 < outs[0][1].mean() < 16.0)
+     or pytest.fail("sample_uniform stats %s" % outs[0].mean(axis=1)))
+case("_sample_normal", np.array([0.0, 50.0], np.float32),
+     np.array([1.0, 2.0], np.float32), attrs={"shape": (800,)}, naive=False,
+     check=lambda outs, c: (abs(outs[0][0].mean()) < 0.2
+                            and 49.0 < outs[0][1].mean() < 51.0)
+     or pytest.fail("sample_normal stats %s" % outs[0].mean(axis=1)))
+case("_sample_gamma", np.array([2.0, 4.0], np.float32),
+     np.array([1.0, 0.5], np.float32), attrs={"shape": (900,)}, naive=False,
+     check=lambda outs, c: (1.6 < outs[0][0].mean() < 2.4
+                            and 1.6 < outs[0][1].mean() < 2.4)
+     or pytest.fail("sample_gamma stats %s" % outs[0].mean(axis=1)))
+case("_sample_exponential", np.array([1.0, 4.0], np.float32),
+     attrs={"shape": (900,)}, naive=False,
+     check=lambda outs, c: (0.8 < outs[0][0].mean() < 1.25
+                            and 0.2 < outs[0][1].mean() < 0.32)
+     or pytest.fail("sample_exponential stats %s" % outs[0].mean(axis=1)))
+case("_sample_poisson", np.array([1.0, 6.0], np.float32),
+     attrs={"shape": (900,)}, naive=False,
+     check=lambda outs, c: (0.8 < outs[0][0].mean() < 1.25
+                            and 5.3 < outs[0][1].mean() < 6.7)
+     or pytest.fail("sample_poisson stats %s" % outs[0].mean(axis=1)))
+case("_sample_negative_binomial", np.array([4.0], np.float32),
+     np.array([0.5], np.float32), attrs={"shape": (900,)}, naive=False,
+     check=lambda outs, c: 3.2 < outs[0][0].mean() < 4.9
+     or pytest.fail("sample_nb stats %s" % outs[0].mean()))
+case("_sample_generalized_negative_binomial", np.array([3.0], np.float32),
+     np.array([0.3], np.float32), attrs={"shape": (900,)}, naive=False,
+     check=lambda outs, c: 2.4 < outs[0][0].mean() < 3.7
+     or pytest.fail("sample_gnb stats %s" % outs[0].mean()))
+
+
 # ---------------------------------------------------------------------------
 # exclusions (name -> reason). Every registry op must be swept or listed.
 # ---------------------------------------------------------------------------
