@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Sanitizer CI for the native runtime (SURVEY §5.2 — the reference's
+# USE_ASAN CMake option + ci ASAN job, runtime_functions.sh:432-438).
+# Builds the C++ runtime + test driver under ASan/UBSan and TSan and runs
+# both; any sanitizer report aborts with nonzero status.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+SRC="mxnet_tpu/lib/src/recordio.cc mxnet_tpu/lib/src/bufpool.cc \
+     mxnet_tpu/lib/tests/native_tests.cc"
+OUT=$(mktemp -d)
+
+echo "== ASan + UBSan =="
+g++ -std=c++17 -O1 -g -fno-omit-frame-pointer \
+    -fsanitize=address,undefined -fno-sanitize-recover=all \
+    $SRC -o "$OUT/native_tests_asan" -lpthread
+ASAN_OPTIONS=detect_leaks=1 "$OUT/native_tests_asan"
+
+echo "== TSan =="
+g++ -std=c++17 -O1 -g -fno-omit-frame-pointer \
+    -fsanitize=thread -fno-sanitize-recover=all \
+    $SRC -o "$OUT/native_tests_tsan" -lpthread
+"$OUT/native_tests_tsan"
+
+echo "SANITIZERS CLEAN"

@@ -2,3 +2,4 @@
 VariationalDropoutCell, etc.)."""
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
+from . import moe  # noqa: F401

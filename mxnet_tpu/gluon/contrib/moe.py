@@ -1,0 +1,56 @@
+"""Mixture-of-experts layers (expert parallelism over the `ep` mesh axis).
+
+Not in the reference — the EP extension SURVEY §2.3 plans for. MoEFFN drops
+into a transformer cell where PositionwiseFFN sits; under DistributedTrainer
+with an `ep` axis the expert tables shard over `ep` (parallel/sharding.py
+names any parameter containing "expert" onto it) and the dispatch/combine
+einsums become ICI all_to_alls.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["MoEFFN"]
+
+
+class MoEFFN(HybridBlock):
+    """Switch-style top-1 MoE feed-forward: x (..., units) -> (..., units).
+
+    Load-balancing aux loss (Switch Transformer, alpha~0.01): in EAGER
+    training, read `self.aux_loss` after the forward and add
+    `moe.aux_loss * alpha` to the loss. Inside a compiled/traced step
+    (hybridize, DistributedTrainer) attribute side-channels would capture
+    dead tracers, so construct with `return_aux=True` — the forward then
+    returns `(out, aux)` and the training function folds `aux` into its
+    loss directly."""
+
+    def __init__(self, units, hidden_size, num_experts,
+                 capacity_factor=1.25, return_aux=False, **kwargs):
+        super().__init__(**kwargs)
+        if num_experts < 2:
+            raise MXNetError("num_experts must be >= 2")
+        self._cf = float(capacity_factor)
+        self._return_aux = bool(return_aux)
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(num_experts, units))
+            self.expert_w_in = self.params.get(
+                "expert_w_in", shape=(num_experts, units, hidden_size))
+            self.expert_w_out = self.params.get(
+                "expert_w_out", shape=(num_experts, hidden_size, units))
+        self.aux_loss = None
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w_in, expert_w_out):
+        out, aux = F.contrib.switch_moe(x, gate_weight, expert_w_in,
+                                        expert_w_out,
+                                        capacity_factor=self._cf)
+        if self._return_aux:
+            return out, aux
+        from ..block import _is_tracing
+
+        if not _is_tracing():
+            # concrete eager value only — a traced assignment would leak a
+            # dead tracer into later (non-traced) reads
+            self.aux_loss = aux
+        return out

@@ -1,0 +1,141 @@
+// Sanitizer test driver for the native runtime (SURVEY §5.2: the
+// reference's race strategy = engine var-dependency construction + ASAN CI
+// builds, runtime_functions.sh:432-438. Our native surface is the C++
+// recordio reader/writer, the threaded prefetcher, and the host buffer
+// pool; this driver exercises them under ASan/UBSan/TSan via
+// ci/sanitize.sh — pure C++, no Python, so sanitizer output is clean.)
+//
+// Build: see ci/sanitize.sh. Exit 0 = all checks passed and no sanitizer
+// report (sanitizers abort the process on findings).
+#include <atomic>
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* mxtpu_recio_writer_open(const char* path);
+int64_t mxtpu_recio_writer_tell(void* handle);
+int mxtpu_recio_writer_write(void* handle, const char* data, uint64_t len);
+void mxtpu_recio_writer_close(void* handle);
+void* mxtpu_recio_reader_open(const char* path);
+int mxtpu_recio_reader_next(void* handle, const char** data, uint64_t* len);
+int mxtpu_recio_reader_read_at(void* handle, uint64_t pos, const char** data,
+                               uint64_t* len);
+void mxtpu_recio_reader_reset(void* handle);
+void mxtpu_recio_reader_close(void* handle);
+void* mxtpu_prefetch_open(const char* path, uint64_t capacity);
+int mxtpu_prefetch_next(void* handle, const char** data, uint64_t* len);
+void mxtpu_prefetch_close(void* handle);
+void* mxtpu_pool_alloc(size_t nbytes);
+void mxtpu_pool_free(void* p);
+void mxtpu_pool_trim();
+void mxtpu_pool_stats(uint64_t* allocated, uint64_t* live, uint64_t* hits,
+                      uint64_t* misses);
+}
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                                      \
+      std::abort();                                                       \
+    }                                                                     \
+  } while (0)
+
+static std::string write_file(const char* path, int n) {
+  void* w = mxtpu_recio_writer_open(path);
+  CHECK(w != nullptr);
+  for (int i = 0; i < n; ++i) {
+    std::string payload(100 + (i % 37) * 13, char('a' + i % 26));
+    CHECK(mxtpu_recio_writer_write(w, payload.data(), payload.size()) == 0);
+  }
+  mxtpu_recio_writer_close(w);
+  return path;
+}
+
+static void test_roundtrip(const char* path) {
+  void* r = mxtpu_recio_reader_open(path);
+  CHECK(r != nullptr);
+  const char* data;
+  uint64_t len;
+  int count = 0;
+  // status convention: 1 = record, 0 = EOF, -1 = corrupt
+  while (mxtpu_recio_reader_next(r, &data, &len) == 1) {
+    CHECK(len == 100 + (count % 37) * 13);
+    CHECK(data[0] == char('a' + count % 26));
+    ++count;
+  }
+  CHECK(count == 200);
+  mxtpu_recio_reader_reset(r);
+  CHECK(mxtpu_recio_reader_next(r, &data, &len) == 1);
+  CHECK(len == 100);
+  mxtpu_recio_reader_close(r);
+}
+
+static void test_prefetch_full_drain(const char* path) {
+  void* p = mxtpu_prefetch_open(path, 8);
+  CHECK(p != nullptr);
+  const char* data;
+  uint64_t len;
+  int count = 0;
+  while (mxtpu_prefetch_next(p, &data, &len) == 1) ++count;
+  CHECK(count == 200);
+  mxtpu_prefetch_close(p);
+}
+
+static void test_prefetch_early_close(const char* path) {
+  // the lost-wakeup regression (ADVICE round-1): close while the worker
+  // is blocked on a FULL queue must not hang. Loop it to give TSan/ASan
+  // many interleavings.
+  for (int it = 0; it < 50; ++it) {
+    void* p = mxtpu_prefetch_open(path, 2);
+    CHECK(p != nullptr);
+    const char* data;
+    uint64_t len;
+    // consume a couple then abandon mid-stream
+    for (int i = 0; i < it % 3; ++i) mxtpu_prefetch_next(p, &data, &len);
+    mxtpu_prefetch_close(p);
+  }
+}
+
+static void test_pool_concurrent() {
+  std::atomic<int> errors{0};
+  auto worker = [&](int seed) {
+    std::vector<void*> held;
+    for (int i = 0; i < 2000; ++i) {
+      size_t sz = 64 + ((seed * 2654435761u + i * 40503u) % 8192);
+      void* p = mxtpu_pool_alloc(sz);
+      if (!p) { errors.fetch_add(1); continue; }
+      std::memset(p, seed & 0xff, sz);  // touch the whole allocation
+      held.push_back(p);
+      if (held.size() > 16) {
+        mxtpu_pool_free(held.front());
+        held.erase(held.begin());
+      }
+    }
+    for (void* p : held) mxtpu_pool_free(p);
+  };
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; ++t) ts.emplace_back(worker, t + 1);
+  for (auto& t : ts) t.join();
+  CHECK(errors.load() == 0);
+  mxtpu_pool_trim();
+  uint64_t allocated, live, hits, misses;
+  mxtpu_pool_stats(&allocated, &live, &hits, &misses);
+  CHECK(live == 0);
+}
+
+int main() {
+  const char* path = "/tmp/mxtpu_native_test.rec";
+  write_file(path, 200);
+  test_roundtrip(path);
+  test_prefetch_full_drain(path);
+  test_prefetch_early_close(path);
+  test_pool_concurrent();
+  std::printf("NATIVE TESTS OK\n");
+  return 0;
+}

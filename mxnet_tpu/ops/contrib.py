@@ -623,3 +623,55 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(),
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+# --------------------------------------------------------------------------
+# Switch-style mixture-of-experts FFN (NOT in the reference — the expert-
+# parallel extension SURVEY §2.3 lists as a TPU-native goal). Top-1 routing
+# with capacity, dense dispatch/combine einsums (the GSPMD formulation:
+# under a mesh with an `ep` axis the expert tables shard over `ep` and XLA
+# lowers the token->expert resharding to an all_to_all over ICI).
+# --------------------------------------------------------------------------
+
+@register("_contrib_switch_moe", num_outputs=2, num_visible_outputs=2,
+          aliases=("switch_moe",))
+def switch_moe(data, gate_weight, expert_w_in, expert_w_out,
+               capacity_factor=1.25):
+    """data (..., d); gate_weight (E, d); expert tables (E, d, h)/(E, h, d).
+    Returns (output (..., d), aux_loss ()) — aux is the Switch load-balance
+    loss E * sum_e(frac_tokens_e * frac_probs_e)."""
+    lead = data.shape[:-1]
+    d = data.shape[-1]
+    tokens = data.reshape(-1, d)
+    t = tokens.shape[0]
+    e = gate_weight.shape[0]
+    cap = max(1, int(capacity_factor * t / e))
+
+    logits = jnp.einsum("td,ed->te", tokens, gate_weight,
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                   # (T,)
+    gate_val = jnp.max(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (T, E)
+    # position of each token within its expert's queue; overflow drops
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # (T, E)
+    keep = (pos < cap) & (onehot > 0)
+    slot = jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), cap,
+                          dtype=jnp.float32)               # (T, C)
+    dispatch = keep.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+    # (T, E, C) -> gather tokens into (E, C, d): the ep resharding point
+    xe = jnp.einsum("tec,td->ecd", dispatch, tokens,
+                    preferred_element_type=jnp.float32).astype(data.dtype)
+    he = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, expert_w_in,
+                                preferred_element_type=jnp.float32)
+                     .astype(data.dtype))
+    ye = jnp.einsum("ech,ehd->ecd", he, expert_w_out,
+                    preferred_element_type=jnp.float32).astype(data.dtype)
+    combine = dispatch * gate_val[:, None, None]
+    out = jnp.einsum("tec,ecd->td", combine, ye,
+                     preferred_element_type=jnp.float32).astype(data.dtype)
+    # Switch aux loss (load balancing): E * sum_e mean_t(route_e)*mean_t(p_e)
+    frac_tokens = onehot.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = (frac_tokens * frac_probs).sum() * e
+    return out.reshape(lead + (d,)), aux.astype(jnp.float32)

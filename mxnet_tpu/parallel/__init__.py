@@ -10,6 +10,8 @@ with mesh-sharded compiled steps:
   trainer     — DistributedTrainer: fwd+loss+bwd+optimizer as ONE compiled
                 sharded step with donated buffers
   ring_attention — exact sequence-parallel attention over the sp axis
+  pipeline    — GPipe-style microbatch pipeline over the pp axis
+  (expert parallelism: gluon.contrib.moe.MoEFFN + the `ep` sharding rule)
 """
 from .mesh import (make_mesh, default_mesh, current_mesh, use_mesh,
                    local_devices, DP, FSDP, TP, PP, SP, EP)
@@ -20,6 +22,7 @@ from .collectives import (init_process_group, rank, num_workers, barrier,
                           all_reduce_arrays)
 from .trainer import DistributedTrainer
 from .ring_attention import ring_attention, ring_attention_sharded
+from .pipeline import pipeline_apply, pipeline_stack_params
 
 __all__ = [
     "make_mesh", "default_mesh", "current_mesh", "use_mesh", "local_devices",
@@ -28,4 +31,5 @@ __all__ = [
     "param_spec", "constraint", "collectives", "init_process_group", "rank",
     "num_workers", "barrier", "all_reduce_arrays", "DistributedTrainer",
     "ring_attention", "ring_attention_sharded",
+    "pipeline_apply", "pipeline_stack_params",
 ]

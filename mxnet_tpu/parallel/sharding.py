@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import re
 
-from .mesh import DP, FSDP, TP
+from .mesh import DP, EP, FSDP, TP
 
 __all__ = ["ShardingRules", "named_sharding", "shard_array", "batch_spec",
            "param_spec", "constraint"]
@@ -61,9 +61,15 @@ class ShardingRules:
         if not shape:
             return _P()
         parts = [None] * len(shape)
-        if TP in axis_sizes and axis_sizes[TP] > 1:
+        if EP in axis_sizes and axis_sizes[EP] > 1 and "expert" in name \
+                and shape and shape[0] % axis_sizes[EP] == 0:
+            # MoE expert tables (E, ...) live expert-parallel: the dispatch
+            # einsum reshards tokens over `ep` (XLA inserts the all_to_all)
+            parts[0] = EP
+        if TP in axis_sizes and axis_sizes[TP] > 1 and parts[0] is None:
             # column-parallel by default: shard dim 0 (out-features for Dense
-            # [out,in]; out-channels for Conv OIHW-style weights)
+            # [out,in]; out-channels for Conv OIHW-style weights) — unless a
+            # higher-priority rule (EP expert tables) already claimed dim 0
             if shape[0] % axis_sizes[TP] == 0 and shape[0] >= axis_sizes[TP]:
                 parts[0] = TP
         if FSDP in axis_sizes and axis_sizes[FSDP] > 1:

@@ -1036,6 +1036,31 @@ case("_sample_generalized_negative_binomial", np.array([3.0], np.float32),
      or pytest.fail("sample_gnb stats %s" % outs[0].mean()))
 
 
+def _moe_ref(tok, gw, wi, wo):
+    """Dense per-token reference for top-1 switch routing (capacity ample)."""
+    logits = tok @ gw.T
+    p = np.exp(logits - logits.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    sel = p.argmax(1)
+    gv = p.max(1)
+    return np.stack([gv[i] * (np.maximum(tok[i] @ wi[sel[i]], 0) @ wo[sel[i]])
+                     for i in range(len(tok))])
+
+
+# strictly-positive tokens/in-weights keep every relu pre-activation away
+# from the kink, so the finite-difference oracle is valid
+_moe_tok = P(12, 8, lo=0.2, hi=1.0)
+_moe_gw = U(4, 8)
+_moe_wi = P(4, 8, 16, lo=0.05, hi=0.3)
+_moe_wo = U(4, 16, 8)
+case("_contrib_switch_moe", _moe_tok, _moe_gw, _moe_wi, _moe_wo,
+     attrs={"capacity_factor": 4.0}, grad=[0, 2, 3], naive=True,
+     check=lambda outs, c: (np.allclose(
+         outs[0], _moe_ref(*c.arrays), atol=1e-4)
+         and outs[1].shape == () and outs[1] >= 1.0 - 1e-5)
+     or pytest.fail("switch_moe mismatch vs dense routing reference"))
+
+
 # ---------------------------------------------------------------------------
 # exclusions (name -> reason). Every registry op must be swept or listed.
 # ---------------------------------------------------------------------------
