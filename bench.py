@@ -29,6 +29,10 @@ MXTPU_BENCH_MODE=bert runs a BERT-base (12/768/12) masked-LM-shaped train
 step (flash-attention MHA) and reports tokens/sec + MFU. The reference has
 no in-tree BERT throughput number (GluonNLP is external — SURVEY §6), so
 vs_baseline is measured against BASELINE.json's ≥60%-MFU target instead.
+
+MXTPU_BENCH_MODE=lstm runs the word-LM 2x650 LSTM (reference
+example/rnn/word_lm defaults, PTB-shaped synthetic data) and reports
+tokens/sec + MFU under the same stance as the bert mode.
 """
 from __future__ import annotations
 
@@ -319,6 +323,91 @@ def bench_bert():
     print(json.dumps(out))
 
 
+def bench_lstm():
+    """LSTM word-LM train-step tokens/sec (BASELINE.json config 'LSTM
+    language model' — reference example/rnn/word_lm trains a 2x650 LSTM on
+    PTB with bptt=35, batch=32; no imgs/sec-style number is published
+    in-tree so vs_baseline is mfu/0.60 like the BERT mode). The step is the
+    full compiled fwd (lax.scan fused LSTM) + CE + bwd + SGD update."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo.word_lm import RNNModel
+    from mxnet_tpu.parallel import DistributedTrainer, make_mesh
+
+    bptt = int(os.environ.get("MXTPU_BENCH_SEQLEN", 35))
+    batch = int(os.environ.get("MXTPU_BENCH_BATCH", 32))
+    vocab, embed, hidden, layers = 10000, 650, 650, 2
+
+    ctx = mx.tpu()
+    dev = jax.devices()[0]
+    with ctx:
+        # dropout 0: measure the math, not rng (same stance as bench_bert)
+        net = RNNModel(vocab, embed, hidden, layers, dropout=0.0)
+        net.initialize(mx.init.Xavier())
+        rng = np.random.RandomState(0)
+        tokens = mx.nd.array(rng.randint(0, vocab, (bptt, batch))
+                             .astype(np.int32), ctx=ctx, dtype="int32")
+        labels = mx.nd.array(rng.randint(0, vocab, (bptt, batch))
+                             .astype(np.float32), ctx=ctx)
+        net(tokens)
+
+    mesh = make_mesh([("dp", 1)], devices=[dev])
+
+    class SeqCE(gluon.loss.SoftmaxCrossEntropyLoss):
+        def hybrid_forward(self, F, pred, label):
+            return super().hybrid_forward(
+                F, pred.reshape((-1, vocab)), label.reshape((-1,)))
+
+    trainer = DistributedTrainer(
+        net, "sgd", {"learning_rate": 1.0},
+        loss=SeqCE(), mesh=mesh, amp_dtype=AMP_DTYPE)
+
+    for _ in range(WARMUP):
+        trainer.step(tokens, labels)
+    trainer.step(tokens, labels).asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = trainer.step(tokens, labels)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch * bptt * ITERS / dt
+
+    step_ms = []
+    for _ in range(ITERS):
+        t1 = time.perf_counter()
+        trainer.step(tokens, labels).asnumpy()
+        step_ms.append((time.perf_counter() - t1) * 1e3)
+
+    # fwd FLOPs/token: 4 gates x (h x in + h x h) MACs x 2 per layer,
+    # + decoder h x vocab x 2; train = 3x fwd
+    fwd = sum(2 * 4 * (hidden * (embed if l == 0 else hidden)
+                       + hidden * hidden) for l in range(layers))
+    fwd += 2 * hidden * vocab
+    flops_per_token = 3 * fwd
+    peak = _chip_peak_tflops(dev)
+    mfu = (tokens_per_sec * flops_per_token / (peak * 1e12)) if peak else None
+
+    out = {
+        "metric": "lstm_word_lm_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.60, 3) if mfu is not None else None,
+        "dtype": AMP_DTYPE or "float32",
+        "baseline": {"target_mfu": 0.60,
+                     "note": "no in-tree reference LSTM number; ratio is "
+                             "mfu/target (same stance as bert mode)"},
+        "batch": batch, "bptt": bptt,
+        "flops_per_token": flops_per_token,
+        "peak_bf16_tflops": peak,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+    }
+    out.update(_percentiles(step_ms))
+    print(json.dumps(out))
+
+
 def main():
     # a sitecustomize PJRT hook force-overrides jax_platforms at interpreter
     # start; re-assert the env's explicit choice so JAX_PLATFORMS=cpu smoke
@@ -331,6 +420,8 @@ def main():
         bench_score()
     elif MODE == "bert":
         bench_bert()
+    elif MODE == "lstm":
+        bench_lstm()
     else:
         bench_train()
 

@@ -15,48 +15,93 @@ import os
 import subprocess
 import threading
 
-_LOCK = threading.Lock()
-_LIB = None
-_TRIED = False
-
-_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
-_SO_PATH = os.path.join(os.path.dirname(__file__), "libmxtpu.so")
+_LIB_DIR = os.path.dirname(__file__)
 
 
-def _build():
-    sources = sorted(glob.glob(os.path.join(_SRC_DIR, "*.cc")))
-    if not sources:
-        return None
-    if os.path.exists(_SO_PATH):
-        so_mtime = os.path.getmtime(_SO_PATH)
-        if all(os.path.getmtime(s) <= so_mtime for s in sources):
-            return _SO_PATH
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-o", _SO_PATH] + sources
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
-    except (subprocess.SubprocessError, FileNotFoundError, OSError):
-        return None
-    return _SO_PATH
+class _Loader:
+    """Build-once/load-once holder for one native shared object: mtime-based
+    rebuild cache, g++ subprocess (failures degrade to None so pure-Python
+    fallbacks kick in), MXTPU_NO_NATIVE gate, double-checked-lock load."""
+
+    def __init__(self, src_subdir, so_name, extra_flags=(), cdll_mode=None):
+        self._src_dir = os.path.join(_LIB_DIR, src_subdir)
+        self._so_path = os.path.join(_LIB_DIR, so_name)
+        self._extra_flags = extra_flags
+        self._cdll_mode = cdll_mode
+        self._lock = threading.Lock()
+        self._lib = None
+        self._tried = False
+
+    def _build(self):
+        sources = sorted(glob.glob(os.path.join(self._src_dir, "*.cc")))
+        if not sources:
+            return None
+        if os.path.exists(self._so_path):
+            so_mtime = os.path.getmtime(self._so_path)
+            if all(os.path.getmtime(s) <= so_mtime for s in sources):
+                return self._so_path
+        flags = []
+        for f in self._extra_flags:
+            flags.extend(f() if callable(f) else [f])
+        pre = [f for f in flags if f.startswith("-I")]
+        post = [f for f in flags if not f.startswith("-I")]
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"] \
+            + pre + ["-o", self._so_path] + sources + post
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+        return self._so_path
+
+    def get(self):
+        if self._lib is not None or self._tried:
+            return self._lib
+        with self._lock:
+            if self._lib is None and not self._tried:
+                self._tried = True
+                if os.environ.get("MXTPU_NO_NATIVE"):
+                    return None
+                path = self._build()
+                if path is not None:
+                    try:
+                        if self._cdll_mode is None:
+                            self._lib = ctypes.CDLL(path)
+                        else:
+                            self._lib = ctypes.CDLL(path,
+                                                    mode=self._cdll_mode)
+                    except OSError:
+                        self._lib = None
+        return self._lib
+
+
+def _python_link_flags():
+    """-I/-L/-l flags for embedding CPython (the capi lib only)."""
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = (sysconfig.get_config_var("LDVERSION")
+           or sysconfig.get_config_var("VERSION") or "3")
+    return ["-I" + inc, "-L" + libdir, "-lpython" + ver,
+            "-Wl,-rpath," + libdir]
+
+
+_MAIN = _Loader("src", "libmxtpu.so")
+# separate lib: only this one embeds/links CPython. RTLD_GLOBAL so it
+# resolves libpython symbols from the hosting interpreter under ctypes.
+_CAPI = _Loader("src_capi", "libmxtpu_capi.so",
+                extra_flags=(lambda: _python_link_flags(),),
+                cdll_mode=ctypes.RTLD_GLOBAL)
 
 
 def get():
-    """The loaded CDLL, or None if unavailable."""
-    global _LIB, _TRIED
-    if _LIB is not None or _TRIED:
-        return _LIB
-    with _LOCK:
-        if _LIB is None and not _TRIED:
-            _TRIED = True
-            if os.environ.get("MXTPU_NO_NATIVE"):
-                return None
-            path = _build()
-            if path is not None:
-                try:
-                    _LIB = ctypes.CDLL(path)
-                except OSError:
-                    _LIB = None
-    return _LIB
+    """The loaded runtime CDLL (libmxtpu.so), or None if unavailable."""
+    return _MAIN.get()
+
+
+def get_capi():
+    """The loaded C predict-API CDLL (libmxtpu_capi.so), or None."""
+    return _CAPI.get()
 
 
 def available():

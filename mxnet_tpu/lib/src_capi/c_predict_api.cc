@@ -1,0 +1,433 @@
+// Flat C ABI for deployment inference — the TPU-native equivalent of the
+// reference's include/mxnet/c_predict_api.h (17 MXNET_DLL entry points,
+// implemented in src/c_api/c_predict_api.cc). Signatures mirror the
+// reference exactly so a C/C++ host written against libmxnet's predict API
+// recompiles against libmxtpu_capi unchanged.
+//
+// Architecture: the reference's implementation binds a GraphExecutor; here
+// each predictor handle owns a Python `mxnet_tpu.predict.Predictor` (whose
+// forward is a cached XLA executable). The C layer embeds CPython: when
+// loaded from a Python process (ctypes) it attaches to the running
+// interpreter; when loaded from a plain C host it initializes one. All
+// array marshalling crosses as raw bytes — the Python bridge functions
+// (_capi_* in mxnet_tpu/predict.py) do the numpy work, so this file needs
+// only the stable CPython ABI.
+//
+// Build: see mxnet_tpu/lib/native.py get_capi() — compiled separately from
+// libmxtpu.so because only this library links libpython.
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void ensure_python() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      // plain-C host: bring up an interpreter and release the GIL so the
+      // per-call PyGILState_Ensure below works from any thread
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() {
+    ensure_python();
+    st = PyGILState_Ensure();
+  }
+  ~GIL() { PyGILState_Release(st); }
+};
+
+// capture the pending Python exception into the thread-local error ring
+// (reference: c_api_error.cc MXAPISetLastError)
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != nullptr) g_last_error = msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject *predict_module() {
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.predict");
+  return mod;  // nullptr on failure with exception set
+}
+
+// call mxnet_tpu.predict.<fn>(*args) -> new ref or nullptr (exception set)
+PyObject *call_bridge(const char *fn, PyObject *args) {
+  PyObject *mod = predict_module();
+  if (mod == nullptr) return nullptr;
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) return nullptr;
+  PyObject *res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return res;
+}
+
+struct Pred {
+  PyObject *obj;                              // mxnet_tpu.predict.Predictor
+  std::vector<std::vector<mx_uint>> shapes;   // GetOutputShape storage
+};
+
+struct NDList {
+  std::vector<std::string> keys;
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<std::string> data;  // float32 bytes, stable until Free
+};
+
+// build {name: shape_tuple} from the API's CSR-style shape encoding
+PyObject *shapes_dict(mx_uint num, const char **keys,
+                      const mx_uint *indptr, const mx_uint *data) {
+  PyObject *d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyObject *shape = PyTuple_New(hi - lo);
+    if (shape == nullptr) {
+      Py_DECREF(d);
+      return nullptr;
+    }
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shape, j - lo, PyLong_FromUnsignedLong(data[j]));
+    if (PyDict_SetItemString(d, keys[i], shape) != 0) {
+      Py_DECREF(shape);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(shape);
+  }
+  return d;
+}
+
+int create_impl(const char *symbol_json_str, const void *param_bytes,
+                int param_size, int dev_type, int dev_id,
+                mx_uint num_input_nodes, const char **input_keys,
+                const mx_uint *input_shape_indptr,
+                const mx_uint *input_shape_data, mx_uint num_output_nodes,
+                const char **output_keys, PredictorHandle *out) {
+  *out = nullptr;
+  GIL gil;
+  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  if (shapes == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *params;
+  if (param_bytes != nullptr && param_size > 0) {
+    params = PyBytes_FromStringAndSize(
+        static_cast<const char *>(param_bytes), param_size);
+  } else {
+    params = Py_None;
+    Py_INCREF(params);
+  }
+  PyObject *outputs;
+  if (num_output_nodes > 0) {
+    outputs = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i)
+      PyList_SET_ITEM(outputs, i, PyUnicode_FromString(output_keys[i]));
+  } else {
+    outputs = Py_None;
+    Py_INCREF(outputs);
+  }
+  PyObject *args = Py_BuildValue("(sOiiOO)", symbol_json_str, params,
+                                 dev_type, dev_id, shapes, outputs);
+  Py_DECREF(shapes);
+  Py_DECREF(params);
+  Py_DECREF(outputs);
+  if (args == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *pred = call_bridge("_capi_create", args);
+  Py_DECREF(args);
+  if (pred == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Pred *h = new Pred();
+  h->obj = pred;
+  *out = h;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  return create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                     dev_id, num_input_nodes, input_keys, input_shape_indptr,
+                     input_shape_data, 0, nullptr, out);
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes, const char **output_keys,
+                           PredictorHandle *out) {
+  return create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                     dev_id, num_input_nodes, input_keys, input_shape_indptr,
+                     input_shape_data, num_output_nodes, output_keys, out);
+}
+
+int MXPredCreateMultiThread(const char *symbol_json_str,
+                            const void *param_bytes, int param_size,
+                            int dev_type, int dev_id, mx_uint num_input_nodes,
+                            const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data, int num_threads,
+                            PredictorHandle *out) {
+  // one independent predictor per thread (reference semantics: shared
+  // weights, private input/output buffers; XLA executables are shared via
+  // the process-wide compile cache, so the per-predictor cost is small)
+  for (int i = 0; i < num_threads; ++i) {
+    int rc = create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                         dev_id, num_input_nodes, input_keys,
+                         input_shape_indptr, input_shape_data, 0, nullptr,
+                         &out[i]);
+    if (rc != 0) {
+      for (int j = 0; j < i; ++j) {
+        Pred *h = static_cast<Pred *>(out[j]);
+        GIL gil;
+        Py_DECREF(h->obj);
+        delete h;
+        out[j] = nullptr;
+      }
+      return rc;
+    }
+  }
+  return 0;
+}
+
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out) {
+  *out = nullptr;
+  Pred *h = static_cast<Pred *>(handle);
+  GIL gil;
+  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  if (shapes == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *args = Py_BuildValue("(OO)", h->obj, shapes);
+  Py_DECREF(shapes);
+  PyObject *res = args ? call_bridge("_capi_reshape", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Pred *nh = new Pred();
+  nh->obj = res;  // bridge returns the (rebound) predictor — new reference
+  *out = nh;
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  Pred *h = static_cast<Pred *>(handle);
+  GIL gil;
+  PyObject *args = Py_BuildValue("(OI)", h->obj, index);
+  PyObject *res = args ? call_bridge("_capi_output_shape", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(res);
+  if (h->shapes.size() <= index) h->shapes.resize(index + 1);
+  std::vector<mx_uint> &shp = h->shapes[index];
+  shp.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    shp[i] = static_cast<mx_uint>(PyLong_AsUnsignedLong(
+        PyTuple_GET_ITEM(res, i)));
+  Py_DECREF(res);
+  *shape_data = shp.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  Pred *h = static_cast<Pred *>(handle);
+  GIL gil;
+  PyObject *raw = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float));
+  if (raw == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *args = Py_BuildValue("(OsO)", h->obj, key, raw);
+  Py_DECREF(raw);
+  PyObject *res = args ? call_bridge("_capi_set_input", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Pred *h = static_cast<Pred *>(handle);
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", h->obj);
+  PyObject *res = args ? call_bridge("_capi_forward", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left) {
+  // the whole forward is ONE fused XLA executable — there is no per-layer
+  // stepping to expose (reference walks GraphExecutor nodes). step 0 runs
+  // everything; step_left reports 0 so the documented polling loop
+  // (c_predict_api.h:210-217) terminates after one iteration.
+  if (step == 0) {
+    int rc = MXPredForward(handle);
+    if (rc != 0) return rc;
+  }
+  *step_left = 0;
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  Pred *h = static_cast<Pred *>(handle);
+  GIL gil;
+  PyObject *args = Py_BuildValue("(OI)", h->obj, index);
+  PyObject *res = args ? call_bridge("_capi_get_output", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *raw = PyTuple_GET_ITEM(res, 0);
+  Py_ssize_t nbytes = PyBytes_Size(raw);
+  if (nbytes != static_cast<Py_ssize_t>(size) * sizeof(mx_float)) {
+    g_last_error = "MXPredGetOutput: size mismatch (got " +
+                   std::to_string(size) + " floats, output has " +
+                   std::to_string(nbytes / sizeof(mx_float)) + ")";
+    Py_DECREF(res);
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(raw), nbytes);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Pred *h = static_cast<Pred *>(handle);
+  if (h == nullptr) return 0;
+  {
+    GIL gil;
+    Py_DECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length) {
+  *out = nullptr;
+  *out_length = 0;
+  GIL gil;
+  PyObject *raw = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  if (raw == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *args = Py_BuildValue("(O)", raw);
+  Py_DECREF(raw);
+  PyObject *res = args ? call_bridge("_capi_ndlist", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  NDList *lst = new NDList();
+  Py_ssize_t n = PyList_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *item = PyList_GET_ITEM(res, i);  // (key, shape, bytes)
+    lst->keys.emplace_back(PyUnicode_AsUTF8(PyTuple_GET_ITEM(item, 0)));
+    PyObject *shape = PyTuple_GET_ITEM(item, 1);
+    std::vector<mx_uint> shp(PyTuple_Size(shape));
+    for (size_t j = 0; j < shp.size(); ++j)
+      shp[j] = static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, j)));
+    lst->shapes.push_back(std::move(shp));
+    PyObject *bytes = PyTuple_GET_ITEM(item, 2);
+    lst->data.emplace_back(PyBytes_AsString(bytes), PyBytes_Size(bytes));
+  }
+  Py_DECREF(res);
+  *out = lst;
+  *out_length = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim) {
+  NDList *lst = static_cast<NDList *>(handle);
+  if (index >= lst->keys.size()) {
+    g_last_error = "MXNDListGet: index out of range";
+    return -1;
+  }
+  *out_key = lst->keys[index].c_str();
+  *out_data = reinterpret_cast<const mx_float *>(lst->data[index].data());
+  *out_shape = lst->shapes[index].data();
+  *out_ndim = static_cast<mx_uint>(lst->shapes[index].size());
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  delete static_cast<NDList *>(handle);
+  return 0;
+}
+
+}  // extern "C"

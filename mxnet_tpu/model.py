@@ -31,7 +31,9 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
 
 
 def load_params(fname):
-    """Split an arg:/aux: prefixed params file (reference: model.py:424)."""
+    """Split an arg:/aux: prefixed params file (reference: model.py:424).
+    `fname` may also be raw file bytes (the C predict API passes params
+    in-memory — c_predict_api.h MXPredCreate param_bytes)."""
     loaded = nd.load(fname)
     arg_params, aux_params = {}, {}
     for k, v in loaded.items():

@@ -629,6 +629,11 @@ def save(fname, data):
 
 
 def load(fname):
+    if isinstance(fname, (bytes, bytearray)):
+        # in-memory load (reference: MXNDListCreate takes raw file bytes)
+        import io
+
+        fname = io.BytesIO(bytes(fname))
     with _np.load(fname, allow_pickle=False) as f:
         out = {k: array(f[k]) for k in f.files}
     keys = list(out)

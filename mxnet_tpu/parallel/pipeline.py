@@ -92,7 +92,11 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches=None,
     """
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax: only the experimental location exists
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from .mesh import current_mesh
@@ -115,11 +119,12 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches=None,
 
     pspec = jax.tree_util.tree_map(
         lambda _: P(axis_name), stacked_params)
-    fn = shard_map(
-        functools.partial(_pipeline_loop, stage_fn, axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
-        check_rep=False)
+    body = functools.partial(_pipeline_loop, stage_fn, axis_name=axis_name)
+    try:
+        fn = shard_map(body, mesh=mesh, in_specs=(pspec, P()),
+                       out_specs=P(), check_vma=False)
+    except TypeError:  # pre-0.9 jax uses check_rep
+        fn = shard_map(body, mesh=mesh, in_specs=(pspec, P()),
+                       out_specs=P(), check_rep=False)
     out = fn(stacked_params, xm)
     return out.reshape((b,) + x.shape[1:])

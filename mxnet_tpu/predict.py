@@ -45,6 +45,10 @@ class Predictor:
         self._ctx = ctx or current_context()
         if isinstance(symbol_file, sym_mod.Symbol):
             symbol = symbol_file
+        elif isinstance(symbol_file, str) and symbol_file.lstrip()[:1] == "{":
+            # a JSON string rather than a path (MXPredCreate passes the
+            # symbol json by value — c_predict_api.h:78 symbol_json_str)
+            symbol = sym_mod.load_json(symbol_file)
         else:
             symbol = sym_mod.load(symbol_file)
         if output_names:
@@ -136,3 +140,71 @@ class Predictor:
         buffers are garbage-collected)."""
         self._exe = None
         self._outputs = None
+
+
+# ---------------------------------------------------------------------------
+# Bridge functions for the native flat C ABI (mxnet_tpu/lib/src_capi/
+# c_predict_api.cc — the reference's include/mxnet/c_predict_api.h surface).
+# The C side passes/receives plain bytes + tuples so it never needs the
+# numpy C API; all array handling stays here.
+# ---------------------------------------------------------------------------
+
+_DEVTYPE = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+
+
+def _capi_create(symbol_json, param_bytes, dev_type, dev_id,
+                 input_shapes, output_names=None):
+    """reference: MXPredCreate / MXPredCreatePartialOut
+    (src/c_api/c_predict_api.cc). dev_type uses the reference's encoding
+    (1=cpu, 2=gpu — which resolves to the accelerator here, 6=tpu)."""
+    from .context import Context
+
+    ctx = Context(_DEVTYPE.get(int(dev_type), "cpu"), int(dev_id))
+    return Predictor(symbol_json,
+                     bytes(param_bytes) if param_bytes else None,
+                     ctx=ctx, input_shapes=dict(input_shapes),
+                     output_names=list(output_names) if output_names else None)
+
+
+def _capi_set_input(pred, key, raw):
+    shape = pred._input_shapes.get(key)
+    if shape is None:
+        raise MXNetError("'%s' is not an input (inputs: %s)"
+                         % (key, sorted(pred._input_shapes)))
+    n = int(_np.prod(shape)) if shape else 1
+    arr = _np.frombuffer(raw, dtype=_np.float32)
+    if arr.size != n:
+        raise MXNetError("MXPredSetInput: size %d != declared %s (=%d floats)"
+                         % (arr.size, shape, n))
+    pred.set_input(key, arr.reshape(shape))
+
+
+def _capi_forward(pred):
+    pred.forward()
+
+
+def _capi_get_output(pred, index):
+    out = pred.get_output(int(index)).asnumpy()
+    out = _np.ascontiguousarray(out, dtype=_np.float32)
+    return out.tobytes(), tuple(int(d) for d in out.shape)
+
+
+def _capi_output_shape(pred, index):
+    return tuple(int(d) for d in pred.get_output_shape(int(index)))
+
+
+def _capi_reshape(pred, input_shapes):
+    pred.reshape(dict(input_shapes))
+    return pred
+
+
+def _capi_ndlist(raw):
+    """reference: MXNDListCreate — returns [(key, shape, float32-bytes)]."""
+    loaded = load_ndarray_file(bytes(raw))
+    items = loaded.items() if isinstance(loaded, dict) else \
+        ((str(i), v) for i, v in enumerate(loaded))
+    out = []
+    for k, v in items:
+        a = _np.ascontiguousarray(v.asnumpy(), dtype=_np.float32)
+        out.append((k, tuple(int(d) for d in a.shape), a.tobytes()))
+    return out

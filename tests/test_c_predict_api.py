@@ -1,0 +1,228 @@
+"""Drive the flat C predict ABI (libmxtpu_capi.so) end-to-end via ctypes.
+
+Mirrors how a C host uses the reference's include/mxnet/c_predict_api.h:
+export a Gluon model to symbol-json + params, then run MXPredCreate /
+SetInput / Forward / GetOutputShape / GetOutput purely through the C entry
+points and compare against the in-process Python forward.
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.lib import native
+
+
+def _capi():
+    lib = native.get_capi()
+    if lib is None:
+        pytest.skip("native toolchain unavailable (libmxtpu_capi build "
+                    "failed)")
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _export_mlp(tmp_path, in_dim=6, hidden=5, out_dim=4):
+    net = nn.HybridSequential(prefix="mlp_")
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation="relu"))
+        net.add(nn.Dense(out_dim))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0)
+                    .uniform(-1, 1, (2, in_dim)).astype(np.float32))
+    ref_out = net(x).asnumpy()
+    prefix = str(tmp_path / "mlp")
+    net.export(prefix, epoch=0)
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0000.params", "rb") as f:
+        param_bytes = f.read()
+    return sym_json, param_bytes, x.asnumpy(), ref_out
+
+
+def _create(lib, sym_json, param_bytes, shape, name=b"data"):
+    keys = (ctypes.c_char_p * 1)(name)
+    indptr = (ctypes.c_uint * 2)(0, len(shape))
+    sdata = (ctypes.c_uint * len(shape))(*shape)
+    handle = ctypes.c_void_p()
+    rc = lib.MXPredCreate(
+        sym_json.encode(), param_bytes, len(param_bytes), 1, 0,
+        1, keys, indptr, sdata, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError().decode()
+    return handle
+
+
+def test_c_predict_roundtrip(tmp_path):
+    lib = _capi()
+    sym_json, param_bytes, x, ref_out = _export_mlp(tmp_path)
+    handle = _create(lib, sym_json, param_bytes, x.shape)
+
+    flat = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    buf = flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    rc = lib.MXPredSetInput(handle, b"data", buf, flat.size)
+    assert rc == 0, lib.MXGetLastError().decode()
+    assert lib.MXPredForward(handle) == 0, lib.MXGetLastError().decode()
+
+    shape_ptr = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    rc = lib.MXPredGetOutputShape(handle, 0, ctypes.byref(shape_ptr),
+                                  ctypes.byref(ndim))
+    assert rc == 0, lib.MXGetLastError().decode()
+    shape = tuple(shape_ptr[i] for i in range(ndim.value))
+    assert shape == ref_out.shape
+
+    n = int(np.prod(shape))
+    out = np.empty(n, dtype=np.float32)
+    rc = lib.MXPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+    assert rc == 0, lib.MXGetLastError().decode()
+    np.testing.assert_allclose(out.reshape(shape), ref_out, rtol=1e-5,
+                               atol=1e-5)
+    assert lib.MXPredFree(handle) == 0
+
+
+def test_c_predict_partial_forward_and_errors(tmp_path):
+    lib = _capi()
+    sym_json, param_bytes, x, ref_out = _export_mlp(tmp_path)
+    handle = _create(lib, sym_json, param_bytes, x.shape)
+
+    flat = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    lib.MXPredSetInput(handle, b"data",
+                       flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                       flat.size)
+    # documented polling loop (c_predict_api.h:210-217)
+    step_left = ctypes.c_int(1)
+    steps = 0
+    while step_left.value != 0:
+        rc = lib.MXPredPartialForward(handle, steps,
+                                      ctypes.byref(step_left))
+        assert rc == 0
+        steps += 1
+    assert steps == 1  # one fused XLA executable
+
+    # wrong input name -> rc=-1 with a real message in MXGetLastError
+    rc = lib.MXPredSetInput(handle, b"nonsense",
+                            flat.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_float)),
+                            flat.size)
+    assert rc == -1
+    assert b"not an input" in lib.MXGetLastError()
+
+    # wrong output size -> rc=-1
+    bad = np.empty(3, dtype=np.float32)
+    rc = lib.MXPredGetOutput(
+        handle, 0, bad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 3)
+    assert rc == -1
+    lib.MXPredFree(handle)
+
+
+def test_c_predict_reshape(tmp_path):
+    lib = _capi()
+    sym_json, param_bytes, x, _ = _export_mlp(tmp_path)
+    handle = _create(lib, sym_json, param_bytes, x.shape)
+
+    new_shape = (5, x.shape[1])
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    sdata = (ctypes.c_uint * 2)(*new_shape)
+    out_h = ctypes.c_void_p()
+    rc = lib.MXPredReshape(1, keys, indptr, sdata, handle,
+                           ctypes.byref(out_h))
+    assert rc == 0, lib.MXGetLastError().decode()
+
+    xb = np.random.RandomState(1).uniform(
+        -1, 1, new_shape).astype(np.float32).ravel()
+    assert lib.MXPredSetInput(out_h, b"data",
+                              xb.ctypes.data_as(
+                                  ctypes.POINTER(ctypes.c_float)),
+                              xb.size) == 0, lib.MXGetLastError().decode()
+    assert lib.MXPredForward(out_h) == 0
+    shape_ptr = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    lib.MXPredGetOutputShape(out_h, 0, ctypes.byref(shape_ptr),
+                             ctypes.byref(ndim))
+    assert shape_ptr[0] == 5
+    lib.MXPredFree(out_h)
+    lib.MXPredFree(handle)
+
+
+def test_c_predict_partial_out(tmp_path):
+    lib = _capi()
+    sym_json, param_bytes, x, _ = _export_mlp(tmp_path)
+    # pick an internal layer output by name (PartialOut parity)
+    from mxnet_tpu import symbol as sym_mod
+
+    sym = sym_mod.load_json(sym_json)
+    internals = sym.get_internals().list_outputs()
+    relu = [n for n in internals if "relu" in n or "activation" in n.lower()]
+    if not relu:
+        pytest.skip("no internal activation output found: %s" % internals)
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, len(x.shape))
+    sdata = (ctypes.c_uint * len(x.shape))(*x.shape)
+    out_keys = (ctypes.c_char_p * 1)(relu[0].encode())
+    handle = ctypes.c_void_p()
+    rc = lib.MXPredCreatePartialOut(
+        sym_json.encode(), param_bytes, len(param_bytes), 1, 0,
+        1, keys, indptr, sdata, 1, out_keys, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError().decode()
+    flat = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    lib.MXPredSetInput(handle, b"data",
+                       flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                       flat.size)
+    assert lib.MXPredForward(handle) == 0
+    shape_ptr = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    lib.MXPredGetOutputShape(handle, 0, ctypes.byref(shape_ptr),
+                             ctypes.byref(ndim))
+    shape = tuple(shape_ptr[i] for i in range(ndim.value))
+    assert shape == (2, 5)  # hidden layer activations
+    n = int(np.prod(shape))
+    out = np.empty(n, dtype=np.float32)
+    assert lib.MXPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n) == 0
+    assert np.all(out >= 0)  # relu output
+    lib.MXPredFree(handle)
+
+
+def test_c_ndlist(tmp_path):
+    lib = _capi()
+    arrs = {"mean_img": mx.nd.array(np.arange(12, dtype=np.float32)
+                                    .reshape(3, 4)),
+            "std": mx.nd.array(np.ones((2,), dtype=np.float32))}
+    path = str(tmp_path / "mean.nd")
+    mx.nd.save(path, arrs)
+    with open(path, "rb") as f:
+        raw = f.read()
+
+    handle = ctypes.c_void_p()
+    length = ctypes.c_uint()
+    rc = lib.MXNDListCreate(raw, len(raw), ctypes.byref(handle),
+                            ctypes.byref(length))
+    assert rc == 0, lib.MXGetLastError().decode()
+    assert length.value == 2
+
+    seen = {}
+    for i in range(length.value):
+        key = ctypes.c_char_p()
+        data = ctypes.POINTER(ctypes.c_float)()
+        shape = ctypes.POINTER(ctypes.c_uint)()
+        ndim = ctypes.c_uint()
+        rc = lib.MXNDListGet(handle, i, ctypes.byref(key),
+                             ctypes.byref(data), ctypes.byref(shape),
+                             ctypes.byref(ndim))
+        assert rc == 0
+        shp = tuple(shape[j] for j in range(ndim.value))
+        n = int(np.prod(shp))
+        seen[key.value.decode()] = np.array(
+            [data[j] for j in range(n)], dtype=np.float32).reshape(shp)
+    np.testing.assert_array_equal(seen["mean_img"],
+                                  arrs["mean_img"].asnumpy())
+    np.testing.assert_array_equal(seen["std"], arrs["std"].asnumpy())
+    assert lib.MXNDListFree(handle) == 0
