@@ -31,7 +31,7 @@ _SUBMODULES = [
     "optimizer", "initializer", "lr_scheduler", "metric", "symbol", "executor",
     "module", "io", "recordio", "image", "kvstore", "gluon", "callback",
     "model", "profiler", "runtime", "test_utils", "visualization", "monitor",
-    "parallel", "attribute", "name", "operator", "contrib",
+    "parallel", "attribute", "name", "operator", "contrib", "rtc",
 ]
 import importlib as _importlib
 import os as _os

@@ -2,7 +2,8 @@
 from __future__ import annotations
 
 from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty, arange,
-                      concat, save, load, waitall, from_jax)
+                      concat, save, load, waitall, from_jax, from_dlpack,
+                      to_dlpack_for_read, to_dlpack_for_write)
 from . import register as _register
 
 _register.populate(globals())

@@ -148,7 +148,16 @@ class NDArray:
         return invoke("Cast", (self,), {"dtype": _np.dtype(np_dtype(dtype)).name})
 
     def to_dlpack_for_read(self):
+        """DLPack capsule view (reference: ndarray.py:2231 over
+        3rdparty/dlpack — zero-copy tensor exchange with torch/numpy)."""
         return self._data.__dlpack__()
+
+    def to_dlpack_for_write(self):
+        """reference: ndarray.py to_dlpack_for_write. jax.Arrays are
+        immutable, so writable export is a copy-on-write divergence: the
+        consumer gets a writable host COPY of the data; writes do not
+        alias back (README divergences)."""
+        return _np.array(self._data, copy=True).__dlpack__()
 
     # -- autograd ---------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
@@ -626,6 +635,44 @@ def save(fname, data):
     # numpy appends .npz; keep the exact requested filename
     if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
         os.replace(fname + ".npz", fname)
+
+
+class _DLPackCapsule:
+    """Adapter: modern jax/numpy from_dlpack want the protocol object, but
+    the reference API (and our to_dlpack_for_*) hands around raw PyCapsules
+    (ndarray.py:2231). Wraps a capsule as a one-shot protocol object;
+    capsules carry no device tag, so host (kDLCPU) is assumed — the only
+    transport the reference's dlpack path supports either."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **_kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(capsule_or_tensor):
+    """Build an NDArray from a DLPack capsule or any object with
+    ``__dlpack__`` (torch tensors, numpy arrays, jax arrays) —
+    reference: ndarray.py from_dlpack."""
+    import jax.numpy as jnp
+
+    obj = capsule_or_tensor
+    if not hasattr(obj, "__dlpack__"):
+        obj = _DLPackCapsule(obj)
+    return array(jnp.from_dlpack(obj))
+
+
+def to_dlpack_for_read(arr):
+    """Module-level form (reference exports both)."""
+    return arr.to_dlpack_for_read()
+
+
+def to_dlpack_for_write(arr):
+    return arr.to_dlpack_for_write()
 
 
 def load(fname):

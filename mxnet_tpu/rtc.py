@@ -1,0 +1,286 @@
+"""Runtime kernel compilation (RTC) — TPU-native analogue of the
+reference's NVRTC bridge.
+
+Reference: python/mxnet/rtc.py:42 `CudaModule` / :173 `CudaKernel` over
+src/common/rtc.cc:35-60 (NVRTC compile at runtime, kernels launched as
+engine ops). The TPU equivalent of "hand me kernel source at runtime and
+launch it on device arrays" is Pallas: `PallasModule` takes Python source
+defining Pallas kernel functions (written over `Ref`s, with `jax`, `jnp`,
+`pl` (jax.experimental.pallas) and `np` in scope), compiles it once, and
+`get_kernel(...).launch(args, ctx, grid_dims, block_dims)` runs it through
+`pl.pallas_call` — Mosaic-compiled on TPU, interpret mode elsewhere (same
+split as ops/pallas_kernels.py).
+
+The launch contract mirrors CudaKernel.launch (rtc.py:185):
+
+- `signature` is a C-style parameter list, e.g.
+  ``"const float *x, float *y, float alpha"``. Pointer parameters are
+  device arrays; non-pointer parameters are scalars. A **non-const
+  pointer is an output**: it is updated in place (the buffer is aliased
+  into the kernel, as CUDA kernels mutate global memory in place).
+- the kernel function's parameters correspond 1:1 to the signature:
+  each pointer argument arrives as a block `Ref`; each scalar arrives as
+  a (1,)-shaped `Ref` (read it as ``s_ref[0]`` — scalars ride small
+  memory, the Pallas idiom for kernel parameters).
+- `grid_dims` is the Pallas grid (CUDA gridDim); `block_dims` is the
+  per-program block shape applied to the *leading* dimensions of every
+  array argument (CUDA blockDim). Trailing 1s are ignored, so CUDA-style
+  3-tuples like ``(1, 1, 1)`` work unchanged. With ``block_dims=None``
+  each program sees whole arrays.
+
+Example (the reference's axpy, rtc.py:46-59, in Pallas form)::
+
+    source = '''
+    def axpy(x_ref, y_ref, alpha_ref):
+        y_ref[...] += alpha_ref[0] * x_ref[...]
+    '''
+    module = mx.rtc.PallasModule(source, exports=["axpy"])
+    func = module.get_kernel("axpy", "const float *x, float *y, float alpha")
+    x = mx.nd.ones((10,), ctx=mx.tpu(0))
+    y = mx.nd.zeros((10,), ctx=mx.tpu(0))
+    func.launch([x, y, 3.0], mx.tpu(0), (1, 1, 1), (10, 1, 1))
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["PallasModule", "PallasKernel", "CudaModule"]
+
+# reference: rtc.py:30 _DTYPE_CPP_TO_NP (plus bfloat16 — the TPU-native
+# half precision; "__half" keeps meaning float16 for signature parity)
+_DTYPE_CPP_TO_NP = {
+    "float": _np.float32,
+    "double": _np.float64,
+    "__half": _np.float16,
+    "bfloat16": "bfloat16",
+    "uint8_t": _np.uint8,
+    "int": _np.int32,
+    "int32_t": _np.int32,
+    "int8_t": _np.int8,
+    "char": _np.int8,
+    "int64_t": _np.int64,
+}
+
+
+def _parse_signature(signature):
+    """reference: CudaModule.get_kernel rtc.py:112-171 — same C-style
+    parameter grammar; returns [(name, dtype, is_ndarray, is_const)]."""
+    pattern = re.compile(r"""^\s*(const)?\s*([\w_]+)\s*(\*)?\s*([\w_]+)\s*$""")
+    args = []
+    for param in signature.split(","):
+        match = pattern.match(param)
+        if not match:
+            raise MXNetError(
+                "Invalid function prototype \"%s\". Must be in the form of "
+                "\"(const) type (*) name\"" % param)
+        is_const, ctype, is_ptr, name = match.groups()
+        if ctype not in _DTYPE_CPP_TO_NP:
+            raise MXNetError("Unsupported kernel argument type %s" % param)
+        args.append((name, _np.dtype(_DTYPE_CPP_TO_NP[ctype]),
+                     bool(is_ptr), bool(is_const)))
+    return args
+
+
+def _trim(dims):
+    """Drop trailing 1s (CUDA-style 3-tuples -> minimal Pallas rank)."""
+    dims = tuple(int(d) for d in dims)
+    while len(dims) > 1 and dims[-1] == 1:
+        dims = dims[:-1]
+    return dims
+
+
+class PallasModule:
+    """Compile Pallas kernel source at runtime (reference: CudaModule
+    rtc.py:42; compile step analogue of src/common/rtc.cc:35-60)."""
+
+    def __init__(self, source, options=(), exports=()):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        if options:
+            raise MXNetError("PallasModule does not take nvcc options "
+                             "(got %s) — Pallas source is Python" %
+                             (options,))
+        self._namespace = {"jax": jax, "jnp": jnp, "pl": pl, "np": _np}
+        try:
+            code = compile(source, "<rtc.PallasModule>", "exec")
+            exec(code, self._namespace)
+        except SyntaxError as e:
+            raise MXNetError("PallasModule source failed to compile: %s" % e)
+        self._exports = tuple(exports)
+        for name in self._exports:
+            if not callable(self._namespace.get(name)):
+                raise MXNetError("exported kernel '%s' is not defined by the "
+                                 "source" % name)
+
+    def get_kernel(self, name, signature):
+        """reference: CudaModule.get_kernel rtc.py:112."""
+        fn = self._namespace.get(name)
+        if not callable(fn):
+            raise MXNetError("kernel '%s' is not defined by the source "
+                             "(defined: %s)" % (name, sorted(
+                                 k for k, v in self._namespace.items()
+                                 if callable(v) and not k.startswith("_")
+                                 and k not in ("jax", "jnp", "pl", "np"))))
+        if self._exports and name not in self._exports:
+            raise MXNetError("kernel '%s' is not exported (exports=%s)"
+                             % (name, list(self._exports)))
+        return PallasKernel(fn, name, _parse_signature(signature))
+
+
+class PallasKernel:
+    """A compiled kernel (reference: CudaKernel rtc.py:173). Executables
+    are cached per (shapes, grid, block) signature — repeated launches
+    re-use the compiled Mosaic binary, matching the engine-op reuse of the
+    reference's CUfunction."""
+
+    def __init__(self, fn, name, args):
+        self._fn = fn
+        self._name = name
+        self._args = args  # [(name, dtype, is_ndarray, is_const)]
+        self._cache = {}
+
+    def launch(self, args, ctx, grid_dims, block_dims=None, shared_mem=0):
+        """reference: CudaKernel.launch rtc.py:185. Non-const pointer args
+        are updated in place; their NDArrays get the new value. Returns the
+        list of output NDArrays (in signature order)."""
+        from .ndarray import NDArray
+        from .ndarray import array as nd_array
+
+        if shared_mem:
+            raise MXNetError("shared_mem is CUDA-specific; Pallas manages "
+                             "VMEM via block shapes")
+        if len(args) != len(self._args):
+            raise MXNetError("kernel '%s' takes %d arguments, got %d"
+                             % (self._name, len(self._args), len(args)))
+        grid = _trim(grid_dims)
+        block = _trim(block_dims) if block_dims is not None else None
+
+        jax_vals = []
+        for val, (aname, dtype, is_nd, _c) in zip(args, self._args):
+            if is_nd:
+                if not isinstance(val, NDArray):
+                    val = nd_array(_np.asarray(val, dtype=dtype), ctx=ctx)
+                if val.dtype != dtype and str(val.dtype) != str(dtype):
+                    raise MXNetError(
+                        "arg '%s' expects dtype %s, got %s"
+                        % (aname, dtype, val.dtype))
+                jax_vals.append(val._data)
+            else:
+                jax_vals.append(_np.asarray([val], dtype=dtype))
+
+        key = (grid, block,
+               tuple((tuple(v.shape), str(v.dtype)) for v in jax_vals))
+        run = self._cache.get(key)
+        if run is None:
+            run = self._build(grid, block, jax_vals)
+            self._cache[key] = run
+        results = run(*jax_vals)
+
+        outs = []
+        ri = iter(results)
+        for val, (aname, dtype, is_nd, is_const) in zip(args, self._args):
+            if is_nd and not is_const:
+                new = next(ri)
+                if isinstance(val, NDArray):
+                    val._set_data(new)  # in-place CUDA semantics
+                    outs.append(val)
+                else:
+                    outs.append(NDArray(new, ctx=ctx))
+        return outs
+
+    # ------------------------------------------------------------------
+    def _build(self, grid, block, jax_vals):
+        import jax
+        from jax.experimental import pallas as pl
+
+        from .ops.pallas_kernels import _use_interpret
+
+        specs = []
+        out_specs, out_shapes, aliases = [], [], {}
+        n_out = 0
+        for i, (val, (aname, dtype, is_nd, is_const)) in enumerate(
+                zip(jax_vals, self._args)):
+            if is_nd:
+                spec = self._block_spec(pl, val.shape, grid, block, aname)
+            else:
+                # scalars ride as (1,)-shaped blocks, whole-array
+                spec = pl.BlockSpec((1,), lambda *_: (0,) )
+            specs.append(spec)
+            if is_nd and not is_const:
+                aliases[i] = n_out
+                out_specs.append(spec)
+                out_shapes.append(
+                    jax.ShapeDtypeStruct(val.shape, val.dtype))
+                n_out += 1
+        if n_out == 0:
+            raise MXNetError(
+                "kernel '%s' has no output (a non-const pointer arg); "
+                "CUDA kernels write through global pointers — declare at "
+                "least one non-const pointer" % self._name)
+
+        n_in = len(self._args)
+        user_fn = self._fn
+        arg_meta = list(self._args)
+
+        def wrapper(*refs):
+            ins, outs_r = refs[:n_in], refs[n_in:]
+            mapped, oi = [], 0
+            for j, (_n, _d, is_nd_j, is_const_j) in enumerate(arg_meta):
+                if is_nd_j and not is_const_j:
+                    # aliased buffer: the out ref IS the in-place array
+                    mapped.append(outs_r[oi])
+                    oi += 1
+                else:
+                    mapped.append(ins[j])
+            user_fn(*mapped)
+
+        call = pl.pallas_call(
+            wrapper,
+            grid=grid,
+            in_specs=specs,
+            out_specs=out_specs if len(out_specs) > 1 else out_specs[0],
+            out_shape=out_shapes if len(out_shapes) > 1 else out_shapes[0],
+            input_output_aliases=aliases,
+            interpret=_use_interpret(),
+        )
+
+        def run(*vals):
+            res = call(*vals)
+            return res if isinstance(res, (list, tuple)) else [res]
+
+        return run
+
+    @staticmethod
+    def _block_spec(pl, shape, grid, block, aname):
+        if block is None:
+            return pl.BlockSpec(shape, lambda *ids: (0,) * len(shape))
+        if len(block) > len(shape):
+            raise MXNetError(
+                "block_dims %s has higher rank than arg '%s' shape %s"
+                % (block, aname, shape))
+        blk = tuple(block) + tuple(shape[len(block):])
+        ngrid = len(grid)
+
+        def index_map(*ids):
+            # grid ids advance the blocked leading dims; trailing dims full
+            ids = ids[:len(blk)]
+            return tuple(ids) + (0,) * (len(blk) - len(ids))
+
+        if ngrid > len(blk):
+            raise MXNetError(
+                "grid_dims %s has higher rank than block shape %s for arg "
+                "'%s'" % (grid, blk, aname))
+        return pl.BlockSpec(blk, index_map)
+
+
+# API-parity alias: code written against the reference's mx.rtc.CudaModule
+# gets the Pallas implementation (source must be Pallas, not CUDA — there
+# is no CUDA toolchain on a TPU host; the class exists so the module
+# surface matches python/mxnet/rtc.py).
+CudaModule = PallasModule

@@ -30,6 +30,23 @@ _INPUT_SLOTS = {
     "LinearRegressionOutput": (["data", "label"], []),
     "LogisticRegressionOutput": (["data", "label"], []),
     "MAERegressionOutput": (["data", "label"], []),
+    # quantized compute ops (quantize_graph output): weight/bias vars sit
+    # behind _contrib_quantize_v2 nodes; min/max slots carry no var shapes
+    "_contrib_quantized_conv": (
+        ["data", "weight", "bias", "min_data", "max_data", "min_weight",
+         "max_weight"], []),
+    "_contrib_quantized_fully_connected": (
+        ["data", "weight", "bias", "min_data", "max_data", "min_weight",
+         "max_weight"], []),
+}
+
+# single-input ops whose output shape equals the first input's shape AND
+# that sit between a weight var and its consuming rule-op in real graphs
+# (quantized graphs put _contrib_quantize_v2 between var and conv/fc);
+# shape assignment walks through them to reach the var
+_SHAPE_TRANSPARENT = {
+    "_contrib_quantize_v2", "quantize_v2", "_contrib_quantize", "quantize",
+    "Cast", "cast", "amp_cast", "BlockGrad", "identity", "_copy",
 }
 
 # ops whose optional trailing array inputs are dropped by a flag
@@ -274,6 +291,10 @@ _ARG_SHAPE_RULES = {
     "Embedding": _embed_rule,
     "LeakyReLU": _prelu_rule,
     "RNN": _rnn_rule,
+    # quantized kernels keep the fp32 op's weight geometry (the int8 conv
+    # consumes the same OIHW weight the fp32 conv would)
+    "_contrib_quantized_conv": _conv_rule,
+    "_contrib_quantized_fully_connected": _fc_rule,
 }
 
 
@@ -289,49 +310,78 @@ def infer_var_shapes(sym, known):
     shapes = dict(known)
     out_shapes = {}   # id(node) -> tuple of output shapes
 
-    for node in sym._topo():
-        if node.is_var:
-            if node.name not in shapes and node._shape is not None and \
-                    not any(s == 0 for s in node._shape):
-                shapes[node.name] = tuple(node._shape)
-            if node.name in shapes:
-                out_shapes[id(node)] = (shapes[node.name],)
-            continue
-        in_nodes = [src for src, _ in node.inputs]
-        rule = _ARG_SHAPE_RULES.get(node.op)
-        if rule is not None:
-            first_src, first_idx = node.inputs[0]
-            if id(first_src) in out_shapes:
-                data_shape = out_shapes[id(first_src)][first_idx]
-                try:
-                    slot_shapes = rule(node.attrs, [data_shape])
-                except (KeyError, MXNetError):
-                    slot_shapes = {}
-                slots, aux = _slot_names(node.op, node.attrs)
-                full = (slots or []) + list(aux)
-                for slot, (src, _) in zip(full, node.inputs):
-                    if src.is_var and src.name not in shapes and slot in slot_shapes:
-                        shapes[src.name] = tuple(slot_shapes[slot])
-                        out_shapes[id(src)] = (shapes[src.name],)
-        # forward eval if every input known
-        ready = all(id(src) in out_shapes and
-                    len(out_shapes[id(src)]) > idx
-                    for src, idx in node.inputs)
-        if not ready:
-            continue
-        opdef = _ops.get(node.op)
-        attrs = dict(node.attrs)
-        if _takes_is_train(opdef):
-            attrs.setdefault("is_train", True)
-        in_structs = [jax.ShapeDtypeStruct(out_shapes[id(src)][idx], jnp.float32)
-                      for src, idx in node.inputs]
-        if opdef.needs_rng:
-            in_structs = [jax.ShapeDtypeStruct((2,), jnp.uint32)] + in_structs
+    def resolve_var(src):
+        """Walk through shape-preserving ops (quantize/cast/...) to the
+        underlying variable, so rule shapes land on the var even when the
+        graph interposes a quantize node (quantize_graph output)."""
+        seen = 0
+        while not src.is_var and src.op in _SHAPE_TRANSPARENT \
+                and src.inputs and seen < 16:
+            src = src.inputs[0][0]
+            seen += 1
+        return src if src.is_var else None
 
-        try:
-            res = jax.eval_shape(lambda *a: opdef.fn(*a, **attrs), *in_structs)
-        except Exception:
-            continue
-        res = tuple(res) if isinstance(res, (tuple, list)) else (res,)
-        out_shapes[id(node)] = tuple(tuple(r.shape) for r in res)
+    # iterate to fixpoint: a rule visit can assign a var whose consuming
+    # quantize/cast node topologically precedes the rule op — the next
+    # pass then forward-evals that node (at most a few passes in practice)
+    topo = list(sym._topo())
+    for _pass in range(max(2, len(topo))):
+        progressed = False
+        for node in topo:
+            if node.is_var:
+                if node.name not in shapes and node._shape is not None and \
+                        not any(s == 0 for s in node._shape):
+                    shapes[node.name] = tuple(node._shape)
+                if node.name in shapes and id(node) not in out_shapes:
+                    out_shapes[id(node)] = (shapes[node.name],)
+                    progressed = True
+                continue
+            rule = _ARG_SHAPE_RULES.get(node.op)
+            if rule is not None:
+                first_src, first_idx = node.inputs[0]
+                if id(first_src) in out_shapes:
+                    data_shape = out_shapes[id(first_src)][first_idx]
+                    try:
+                        slot_shapes = rule(node.attrs, [data_shape])
+                    except (KeyError, MXNetError):
+                        slot_shapes = {}
+                    slots, aux = _slot_names(node.op, node.attrs)
+                    full = (slots or []) + list(aux)
+                    for slot, (src, _) in zip(full, node.inputs):
+                        if slot not in slot_shapes:
+                            continue
+                        var = src if src.is_var else resolve_var(src)
+                        if var is not None and var.name not in shapes:
+                            shapes[var.name] = tuple(slot_shapes[slot])
+                            out_shapes[id(var)] = (shapes[var.name],)
+                            progressed = True
+            if id(node) in out_shapes:
+                continue
+            # forward eval if every input known
+            ready = all(id(src) in out_shapes and
+                        len(out_shapes[id(src)]) > idx
+                        for src, idx in node.inputs)
+            if not ready:
+                continue
+            opdef = _ops.get(node.op)
+            attrs = dict(node.attrs)
+            if _takes_is_train(opdef):
+                attrs.setdefault("is_train", True)
+            in_structs = [jax.ShapeDtypeStruct(out_shapes[id(src)][idx],
+                                               jnp.float32)
+                          for src, idx in node.inputs]
+            if opdef.needs_rng:
+                in_structs = [jax.ShapeDtypeStruct((2,), jnp.uint32)] \
+                    + in_structs
+
+            try:
+                res = jax.eval_shape(lambda *a: opdef.fn(*a, **attrs),
+                                     *in_structs)
+            except Exception:
+                continue
+            res = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+            out_shapes[id(node)] = tuple(tuple(r.shape) for r in res)
+            progressed = True
+        if not progressed:
+            break
     return shapes

@@ -1,0 +1,38 @@
+"""tools/bandwidth.py (reference: tools/bandwidth/measure.py +
+test_measure.py) — smoke the collective and kvstore modes as real CLI
+invocations on the 8-virtual-device CPU mesh."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "bandwidth.py")
+
+
+def _run(args, timeout=240):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    return subprocess.run([sys.executable, TOOL] + args, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_collective_mode_json():
+    res = _run(["--mode", "collective", "--sizes-mb", "1", "--json"])
+    assert res.returncode == 0, res.stderr[-2000:]
+    rows = [json.loads(l) for l in res.stdout.splitlines()
+            if l.startswith("{")]
+    names = {r["collective"] for r in rows}
+    assert names == {"psum", "all_gather", "reduce_scatter", "ppermute"}
+    assert all(r["n_dev"] == 8 and r["algbw_gbps"] > 0 for r in rows)
+
+
+def test_kvstore_mode_numerics():
+    res = _run(["--mode", "kvstore", "--network", "alexnet",
+                "--num-batches", "2", "--kv-store", "local", "--json"])
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "numerics ok" in res.stdout
+    rows = [json.loads(l) for l in res.stdout.splitlines()
+            if l.startswith("{")]
+    assert len(rows) == 2 and all(r["gbps"] > 0 for r in rows)

@@ -63,6 +63,40 @@ def test_quantize_graph_structure():
     assert "FullyConnected" in ops2
 
 
+def test_quantized_symbol_module_bind():
+    """A quantized symbol must bind in Module (the reference deployment
+    flow: example/quantization/imagenet_inference.py mod.bind on qsym).
+    Regression: weight vars sit behind _contrib_quantize_v2 nodes, so
+    infer_shape must resolve rule shapes through them."""
+    from mxnet_tpu.contrib import quantization as q
+
+    data = mx.sym.var("data")
+    h = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=4,
+                           name="qc1")
+    h = mx.sym.relu(h)
+    h = mx.sym.Flatten(h)
+    sym = mx.sym.FullyConnected(data=h, num_hidden=3, name="qf1")
+    params = _rand_params(sym, {"data": (2, 1, 8, 8)})
+    qsym, qa, qx = q.quantize_model(sym, params, {}, calib_mode="none")
+
+    arg_shapes, out_shapes, _ = qsym.infer_shape(data=(2, 1, 8, 8))
+    by_name = dict(zip(qsym.list_arguments(), arg_shapes))
+    assert by_name["qc1_weight"] == (4, 1, 3, 3)
+    assert by_name["qf1_weight"] == (3, 4 * 6 * 6)
+
+    mod = mx.module.Module(qsym, label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 1, 8, 8))], for_training=False)
+    mod.set_params(qa, qx, allow_missing=True)
+    X = np.random.RandomState(0).uniform(-1, 1, (2, 1, 8, 8)) \
+        .astype(np.float32)
+    mod.forward(mx.io.DataBatch([mx.nd.array(X)], None), is_train=False)
+    fp = sym.eval_with({**{"data": mx.nd.array(X)}, **params}).asnumpy()
+    got = mod.get_outputs()[0].asnumpy()
+    assert got.shape == fp.shape
+    # int8 quantization: predictions close to fp32 on this tiny net
+    assert np.argmax(got, 1).tolist() == np.argmax(fp, 1).tolist()
+
+
 def test_quantize_model_accuracy():
     """Quantized MLP predictions stay close to fp32 (reference:
     test_quantization.py accuracy checks)."""

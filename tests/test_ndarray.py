@@ -145,3 +145,23 @@ def test_topk_sort():
     assert np.allclose(v.asnumpy(), [[3], [5]])
     s = mx.nd.sort(a, is_ascend=False)
     assert np.allclose(s.asnumpy(), [[3, 2, 1], [5, 4, 0]])
+
+
+def test_dlpack_roundtrip_numpy_and_torch():
+    """DLPack interop (reference: ndarray.py:2231 to_dlpack_for_read /
+    from_dlpack over 3rdparty/dlpack): exchange with torch and back."""
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    # self-roundtrip via capsule
+    y = mx.nd.from_dlpack(x.to_dlpack_for_read())
+    np.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+
+    torch = pytest.importorskip("torch")
+    t = torch.from_dlpack(x.to_dlpack_for_read())
+    assert t.shape == (3, 4)
+    np.testing.assert_array_equal(t.numpy(), x.asnumpy())
+    # torch -> mx
+    t2 = torch.arange(6, dtype=torch.float32).reshape(2, 3) + 1
+    z = mx.nd.from_dlpack(t2)
+    np.testing.assert_array_equal(z.asnumpy(), t2.numpy())
+    # write-capsule exists (copy-on-write divergence documented)
+    assert mx.nd.from_dlpack(x.to_dlpack_for_write()).shape == (3, 4)

@@ -1,0 +1,100 @@
+"""Runtime kernel compilation (mx.rtc.PallasModule) — the TPU analogue of
+the reference's NVRTC CudaModule (python/mxnet/rtc.py:42). Kernels run in
+interpret mode on CPU (same split as ops/pallas_kernels.py tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_rtc_axpy_in_place():
+    # the reference's doc example (rtc.py:46-59) in Pallas form
+    source = """
+def axpy(x_ref, y_ref, alpha_ref):
+    y_ref[...] += alpha_ref[0] * x_ref[...]
+"""
+    module = mx.rtc.PallasModule(source, exports=["axpy"])
+    func = module.get_kernel("axpy",
+                             "const float *x, float *y, float alpha")
+    x = mx.nd.ones((10,))
+    y = mx.nd.zeros((10,))
+    outs = func.launch([x, y, 3.0], mx.cpu(0), (1, 1, 1), (10, 1, 1))
+    np.testing.assert_allclose(y.asnumpy(), np.full(10, 3.0), rtol=1e-6)
+    assert outs[0] is y  # in-place CUDA semantics
+
+
+def test_rtc_gridded_blocks():
+    # 2-d saxpby over a (8, 16) array, blocked (2, 16) x grid 4
+    source = """
+def scale(a_ref, out_ref, s_ref):
+    out_ref[...] = a_ref[...] * s_ref[0]
+"""
+    module = mx.rtc.PallasModule(source)
+    func = module.get_kernel("scale", "const float *a, float *o, float s")
+    a = mx.nd.array(np.arange(128, dtype=np.float32).reshape(8, 16))
+    o = mx.nd.zeros((8, 16))
+    func.launch([a, o, 0.5], mx.cpu(0), (4,), (2,))
+    np.testing.assert_allclose(o.asnumpy(), a.asnumpy() * 0.5, rtol=1e-6)
+
+
+def test_rtc_executable_cache_and_relaunch():
+    source = """
+def inc(y_ref):
+    y_ref[...] += 1.0
+"""
+    func = mx.rtc.PallasModule(source).get_kernel("inc", "float *y")
+    y = mx.nd.zeros((4,))
+    for _ in range(3):
+        func.launch([y], mx.cpu(0), (1,))
+    np.testing.assert_allclose(y.asnumpy(), np.full(4, 3.0))
+    assert len(func._cache) == 1  # one executable for the repeated launch
+
+
+def test_rtc_int_dtype():
+    source = """
+def addk(x_ref, y_ref, k_ref):
+    y_ref[...] = x_ref[...] + k_ref[0]
+"""
+    func = mx.rtc.PallasModule(source).get_kernel(
+        "addk", "const int32_t *x, int32_t *y, int32_t k")
+    x = mx.nd.array(np.arange(6, dtype=np.int32), dtype="int32")
+    y = mx.nd.array(np.zeros(6, dtype=np.int32), dtype="int32")
+    func.launch([x, y, 7], mx.cpu(0), (1,))
+    np.testing.assert_array_equal(y.asnumpy(), np.arange(6) + 7)
+
+
+def test_rtc_errors():
+    module = mx.rtc.PallasModule(
+        "def k(y_ref):\n    y_ref[...] = y_ref[...] * 0.0\n")
+    # bad prototype
+    with pytest.raises(MXNetError, match="prototype"):
+        module.get_kernel("k", "float* *bad name")
+    # unknown kernel
+    with pytest.raises(MXNetError, match="not defined"):
+        module.get_kernel("missing", "float *y")
+    # no output arg
+    f = module.get_kernel("k", "const float *y")
+    with pytest.raises(MXNetError, match="no output"):
+        f.launch([mx.nd.zeros((2,))], mx.cpu(0), (1,))
+    # wrong arg count
+    f2 = module.get_kernel("k", "float *y")
+    with pytest.raises(MXNetError, match="takes 1 arguments"):
+        f2.launch([mx.nd.zeros((2,)), 1.0], mx.cpu(0), (1,))
+    # dtype mismatch (int32 array into a float* parameter)
+    with pytest.raises(MXNetError, match="dtype"):
+        f2.launch([mx.nd.array(np.zeros(2, dtype=np.int32),
+                               dtype="int32")], mx.cpu(0), (1,))
+    # syntax error in source
+    with pytest.raises(MXNetError, match="failed to compile"):
+        mx.rtc.PallasModule("def broken(:\n")
+    # exports gate
+    m = mx.rtc.PallasModule("def a(y_ref):\n    y_ref[...] = 1.0\n"
+                            "def b(y_ref):\n    y_ref[...] = 2.0\n",
+                            exports=["a"])
+    with pytest.raises(MXNetError, match="not exported"):
+        m.get_kernel("b", "float *y")
+
+
+def test_rtc_cudamodule_alias():
+    assert mx.rtc.CudaModule is mx.rtc.PallasModule
