@@ -287,17 +287,107 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     _run_backward(heads, head_grads, retain_graph)
 
 
+def _build_replay_scalar(heads, variables, head_grads):
+    """Replay the current tape as a pure function of `variables` AND every
+    other graph leaf, reducing the heads to the scalar
+    sum_i <head_i, head_grad_i>. This is the functional form of the
+    recorded graph that create_graph differentiates: the reference keeps
+    its symbolic grad-graph attached for re-derivation (nnvm/gradient.cc);
+    here the replay + jax.grad plays that role. Leaves are traced (not
+    constants) so second-order cotangents flow back into the enclosing
+    tape — e.g. gradient penalties reach layer weights. Custom-Function
+    node outputs are the one exception (their forward isn't re-traceable);
+    they stay constant.
+
+    Returns (scalar_fn, leaf_arrays): scalar_fn takes
+    (*var_values, *leaf_values); leaf_arrays are the NDArrays to feed."""
+    import jax.numpy as jnp
+
+    st = _st()
+    tape = list(st.tape)
+    var_keys = [(id(v), v._version) for v in variables]
+    head_keys = [(id(h), h._version) for h in heads]
+    hgs = [None if hg is None else
+           (hg._data if hasattr(hg, "_data") else jnp.asarray(hg))
+           for hg in head_grads]
+
+    # prune to ancestors of the heads: unrelated branches recorded in the
+    # same scope (other losses, metrics) must not be replayed or traced
+    needed = set(head_keys)
+    keep = []
+    for node in reversed(tape):
+        if not any(k in needed for k in node.out_keys):
+            continue
+        if node.opdef is None:
+            raise MXNetError(
+                "create_graph=True cannot differentiate through a custom "
+                "Function / bridged op in the heads' graph (its forward is "
+                "not re-traceable); compute that grad without create_graph")
+        keep.append(node)
+        needed.update((id(a), v) for a, v in node.inputs)
+    tape = list(reversed(keep))
+
+    produced = set()
+    for node in tape:
+        produced.update(node.out_keys)
+    leaf_info = {}
+    for node in tape:
+        for (arr, ver), const in zip(node.inputs, node.in_arrays):
+            k = (id(arr), ver)
+            if k not in produced and k not in var_keys \
+                    and k not in leaf_info:
+                leaf_info[k] = arr
+    leaf_keys = list(leaf_info)
+    leaf_arrays = [leaf_info[k] for k in leaf_keys]
+    var_seeded = set(var_keys)
+
+    def scalar_fn(*vals):
+        env = dict(zip(var_keys + leaf_keys, vals))
+        for node in tape:
+            ins = [env.get((id(a), v), const)
+                   for (a, v), const in zip(node.inputs, node.in_arrays)]
+            kwargs = dict(node.attr_key)
+            call = ((node.rng,) + tuple(ins) if node.opdef.needs_rng
+                    else tuple(ins))
+            out = node.opdef.fn(*call, **kwargs)
+            out = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+            for k, o in zip(node.out_keys, out):
+                # a variable's traced value stays authoritative: grads wrt
+                # an intermediate differentiate from that point on, not
+                # through its recomputation
+                if k not in var_seeded:
+                    env[k] = o
+        total = jnp.zeros((), jnp.float32)
+        for hk, hg in zip(head_keys, hgs):
+            val = env.get(hk)
+            if val is None:
+                continue  # head independent of the recorded graph
+            seed = hg if hg is not None else jnp.ones(val.shape, val.dtype)
+            total = total + jnp.sum(val.astype(jnp.float32)
+                                    * seed.astype(jnp.float32))
+        return total
+
+    return scalar_fn, leaf_arrays
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Return grads of heads wrt variables without touching .grad buffers
-    (reference: autograd.py:270). create_graph (higher-order) is not yet
-    supported on the tape; use hybridized blocks + jax.grad for that."""
+    (reference: autograd.py:270). With create_graph=True the returned grads
+    are themselves recorded on the tape (via a replay of the recorded
+    graph), so a further backward()/grad() differentiates through them —
+    reference semantics for gradient penalties / higher-order grads."""
     from .ndarray.ndarray import NDArray
 
-    if create_graph:
-        raise MXNetError("create_graph=True not supported by the eager tape yet")
     if head_grads is None:
         head_grads = [None] * len(heads)
+    if create_graph:
+        scalar_fn, leaf_arrays = _build_replay_scalar(heads, variables,
+                                                      head_grads)
+        op = _ReplayGradFn(scalar_fn, n_vars=len(variables))
+        op.save_for_backward(*variables, *leaf_arrays)
+        outs = op(*variables, *leaf_arrays)
+        return list(outs)
     retain = True if retain_graph is None else retain_graph
     cot = _run_backward(heads, head_grads, retain_graph=retain)
     outs = []
@@ -361,3 +451,40 @@ class Function:
             for o in outs:
                 _LIVE[id(o)] = o
         return outputs
+
+
+class _ReplayGradFn(Function):
+    """The differentiable-gradient op create_graph records: forward emits
+    d(scalar)/d(variables); backward is the vjp of that gradient function
+    (Hessian-vector product), both derived by jax from the tape replay."""
+
+    def __init__(self, scalar_fn, n_vars):
+        super().__init__()
+        self._scalar_fn = scalar_fn
+        self._n_vars = n_vars
+
+    def _grad_fn(self):
+        """d scalar / d variables, as a function of (vars + leaves)."""
+        import jax
+
+        return jax.grad(self._scalar_fn,
+                        argnums=tuple(range(self._n_vars)))
+
+    def forward(self, *all_nds):
+        from .ndarray.ndarray import NDArray
+
+        vals = [v._data for v in all_nds]
+        gvals = self._grad_fn()(*vals)
+        return tuple(NDArray(g.astype(v._data.dtype), ctx=v._ctx)
+                     for g, v in zip(gvals, all_nds[:self._n_vars]))
+
+    def backward(self, *ograds):
+        import jax
+
+        vals = [v._data for v in self.saved_tensors]
+        _, pull = jax.vjp(self._grad_fn(), *vals)
+        cots = pull(tuple(o._data.astype(vals[i].dtype)
+                          for i, o in enumerate(ograds)))
+        # raw jax values (float0 for int leaves); _run_backward's
+        # py_backward path accepts them and skips float0 cotangents
+        return tuple(cots)

@@ -1,0 +1,153 @@
+"""Torch op bridge (plugin parity).
+
+Reference: python/mxnet/torch.py + plugin/torch — exposes Torch tensor
+functions/criterions as MXNet operators. The TPU-native analogue runs the
+torch computation on the HOST (torch-cpu) and exchanges tensors zero-copy
+via DLPack (ndarray.from_dlpack / to_dlpack_for_read); gradients flow
+through the autograd tape by delegating the node's backward to
+torch.autograd — the same plugin-op shape as CustomOp (operator.py), with
+torch as the kernel author instead of numpy.
+
+Like the reference's plugin this is an interop escape hatch, not a compute
+path: anything inside `jit`/hybridize stays pure-XLA, and a bridged op
+forces a host sync (documented; the reference's torch plugin likewise ran
+outside the graph compiler's reach).
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import torch_bridge as th
+
+    softshrink = th.function(lambda t: torch.nn.functional.softshrink(t))
+    y = softshrink(x_nd)              # NDArray in, NDArray out
+    y.backward()                      # tape-integrated via torch.autograd
+"""
+from __future__ import annotations
+
+from .autograd import Function
+from .base import MXNetError
+
+__all__ = ["available", "to_torch", "from_torch", "function", "criterion"]
+
+
+def _torch():
+    try:
+        import torch
+
+        return torch
+    except ImportError:
+        raise MXNetError("torch is not installed; the torch bridge needs "
+                         "torch-cpu (reference plugin/torch analogue)")
+
+
+def available():
+    try:
+        _torch()
+        return True
+    except MXNetError:
+        return False
+
+
+def to_torch(arr):
+    """NDArray -> torch.Tensor (host, zero-copy via DLPack where possible)."""
+    torch = _torch()
+    return torch.from_dlpack(arr.to_dlpack_for_read())
+
+
+def from_torch(tensor):
+    """torch.Tensor -> NDArray."""
+    from . import ndarray as nd
+
+    return nd.from_dlpack(tensor.detach().contiguous())
+
+
+class _TorchFn(Function):
+    """One bridged call: forward runs the torch fn under torch.enable_grad,
+    backward asks torch.autograd for input grads (the reference's torch
+    plugin pairs TH forward/backward entry points the same way)."""
+
+    def __init__(self, fn, kwargs):
+        super().__init__()
+        self._fn = fn
+        self._kwargs = kwargs
+        self._tin = None
+        self._tout = None
+
+    def forward(self, *inputs):
+        torch = _torch()
+        self._tin = [to_torch(a).detach().clone().requires_grad_(True)
+                     for a in inputs]
+        with torch.enable_grad():
+            out = self._fn(*self._tin, **self._kwargs)
+        self._tout = out if isinstance(out, (tuple, list)) else (out,)
+        res = tuple(from_torch(t) for t in self._tout)
+        return res if len(res) > 1 else res[0]
+
+    def backward(self, *ograds):
+        torch = _torch()
+        # only differentiable outputs participate (e.g. topk indices are
+        # int tensors with no grad_fn); retain_graph so retained-tape
+        # semantics (second backward over the same node) keep working
+        pairs = [(t, to_torch(g).to(t.dtype))
+                 for g, t in zip(ograds, self._tout)
+                 if t.requires_grad and t.grad_fn is not None]
+        if not pairs:
+            return tuple(from_torch(torch.zeros_like(t))
+                         for t in self._tin)
+        outs, seeds = zip(*pairs)
+        gins = torch.autograd.grad(outs, self._tin, seeds,
+                                   allow_unused=True, retain_graph=True)
+        return tuple(
+            from_torch(g) if g is not None
+            else from_torch(torch.zeros_like(t))
+            for g, t in zip(gins, self._tin))
+
+
+def function(torch_fn):
+    """Wrap a torch callable as an NDArray operator (reference: torch.py
+    generated mx.th.* functions). Differentiable through the tape."""
+
+    def wrapped(*inputs, **kwargs):
+        return _TorchFn(torch_fn, kwargs)(*inputs)
+
+    wrapped.__name__ = getattr(torch_fn, "__name__", "torch_fn")
+    return wrapped
+
+
+class _TorchCriterion(Function):
+    """One bridged (pred, label) loss call: like _TorchFn but the label is
+    non-differentiable (reference: plugin/torch criterions)."""
+
+    def __init__(self, criterion_fn, kwargs):
+        super().__init__()
+        self._fn = criterion_fn
+        self._kwargs = kwargs
+        self._tp = None
+        self._tl = None
+        self._tout = None
+
+    def forward(self, p, lbl):
+        torch = _torch()
+        self._tp = to_torch(p).detach().clone().requires_grad_(True)
+        self._tl = to_torch(lbl).detach()
+        with torch.enable_grad():
+            self._tout = self._fn(self._tp, self._tl, **self._kwargs)
+        return from_torch(self._tout)
+
+    def backward(self, ograd):
+        torch = _torch()
+        seed = to_torch(ograd).to(self._tout.dtype)
+        (gp,) = torch.autograd.grad(self._tout, [self._tp], seed,
+                                    retain_graph=True)
+        zeros = torch.zeros_like(self._tl, dtype=self._tp.dtype) \
+            if self._tl.dtype.is_floating_point \
+            else torch.zeros(self._tl.shape)
+        return from_torch(gp), from_torch(zeros)
+
+
+def criterion(torch_criterion):
+    """Wrap a torch loss module/callable as (pred, label) -> scalar loss
+    (reference: plugin/torch criterions). Label is non-differentiable."""
+
+    def wrapped(pred, label, **kwargs):
+        return _TorchCriterion(torch_criterion, kwargs)(pred, label)
+
+    return wrapped

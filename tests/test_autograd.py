@@ -1,8 +1,10 @@
 """Autograd tape tests (mirrors reference tests/python/unittest/test_autograd.py)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd
+from mxnet_tpu.base import MXNetError
 
 
 def test_simple_grad():
@@ -163,3 +165,88 @@ def test_batchnorm_updates_running_stats():
     o = out.asnumpy()
     assert np.allclose(o.mean(axis=0), 0, atol=1e-4)
     assert np.allclose(o.var(axis=0), 1, atol=1e-2)
+
+
+def test_grad_create_graph_second_order():
+    """reference: autograd.py:270 grad(create_graph=True) — gradient of
+    gradient. d2/dx2 sum((d/dx x^3)^2): gx = 3x^2, z = sum(gx^2),
+    dz/dx = 36 x^3."""
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        gx = autograd.grad([y], [x], create_graph=True)[0]
+        z = (gx * gx).sum()
+    z.backward()
+    np.testing.assert_allclose(
+        x.grad.asnumpy(), 36 * np.array([1, 8, 27], dtype=np.float32),
+        rtol=1e-5)
+
+
+def test_grad_create_graph_gradient_penalty():
+    """WGAN-GP-style use: ||d loss/d input||^2 as a training loss whose
+    gradient flows into layer weights via the replayed graph."""
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(1, use_bias=False)
+    net.initialize(mx.init.Constant(0.5), ctx=mx.cpu())
+    x = mx.nd.array(np.ones((2, 3), dtype=np.float32))
+    x.attach_grad()
+    net(x)  # materialize
+    w = net.weight.data()
+    w.attach_grad()
+    with autograd.record():
+        out = net(x).sum()
+        gx = autograd.grad([out], [x], create_graph=True)[0]  # = broadcast w
+        penalty = (gx * gx).sum()
+    penalty.backward()
+    # penalty = 2 * sum_j w_j^2 (two rows) -> d/dw = 4w
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               4 * w.asnumpy(), rtol=1e-5)
+
+
+def test_grad_create_graph_trig_second_order():
+    """sin -> second derivative is -sin (reference test_autograd-style
+    numeric check through a transcendental op)."""
+    v = np.linspace(-1.5, 1.5, 7).astype(np.float32)
+    x = mx.nd.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.sin(x)
+        gx = autograd.grad([y], [x], create_graph=True)[0]  # cos(x)
+    gx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), -np.sin(v),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grad_create_graph_wrt_intermediate():
+    """grad wrt a tape-produced intermediate must differentiate from that
+    point, not through its recomputation (regression: replay overwrote the
+    traced variable)."""
+    x = mx.nd.array(np.array([1.0, 2.0], dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = (y * y).sum()
+        gy = autograd.grad([z], [y], create_graph=True)[0]
+    np.testing.assert_allclose(gy.asnumpy(), 2 * (x.asnumpy() ** 2),
+                               rtol=1e-6)
+
+
+def test_grad_create_graph_through_custom_function_raises():
+    """create_graph through a custom Function ancestor must fail loudly,
+    not silently return zeros."""
+    class Noop(autograd.Function):
+        def forward(self, a):
+            return a * 1.0
+
+        def backward(self, og):
+            return og
+
+    x = mx.nd.array(np.ones((2,), dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = Noop()(x)
+        z = (y * y).sum()
+        with pytest.raises(MXNetError, match="custom Function"):
+            autograd.grad([z], [x], create_graph=True)

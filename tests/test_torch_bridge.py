@@ -1,0 +1,70 @@
+"""Torch plugin bridge (reference: python/mxnet/torch.py + plugin/torch) —
+torch ops as tape-integrated NDArray operators over DLPack."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu import torch_bridge as th
+
+torch = pytest.importorskip("torch")
+
+
+def test_function_forward_matches_torch():
+    softshrink = th.function(torch.nn.functional.softshrink)
+    x = np.linspace(-2, 2, 9).astype(np.float32)
+    got = softshrink(mx.nd.array(x)).asnumpy()
+    want = torch.nn.functional.softshrink(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_function_backward_through_tape():
+    gelu = th.function(torch.nn.functional.gelu)
+    v = np.linspace(-1.5, 1.5, 7).astype(np.float32)
+    x = mx.nd.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = gelu(x * 2.0)  # mx op feeding a bridged op
+        z = (y * y).sum()
+    z.backward()
+    tx = torch.tensor(v, requires_grad=True)
+    tz = (torch.nn.functional.gelu(tx * 2.0) ** 2).sum()
+    tz.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), tx.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_criterion():
+    mse = th.criterion(torch.nn.functional.mse_loss)
+    p = mx.nd.array(np.array([1.0, 2.0, 3.0], dtype=np.float32))
+    t = mx.nd.array(np.array([0.0, 2.0, 5.0], dtype=np.float32))
+    p.attach_grad()
+    with autograd.record():
+        l = mse(p, t)
+    l.backward()
+    np.testing.assert_allclose(float(l.asnumpy()), 5.0 / 3.0, rtol=1e-6)
+    np.testing.assert_allclose(p.grad.asnumpy(),
+                               2.0 / 3.0 * np.array([1.0, 0.0, -2.0]),
+                               rtol=1e-5)
+
+
+def test_multi_output_function():
+    topk = th.function(lambda t: torch.topk(t, 2).values)
+    x = mx.nd.array(np.array([3.0, 1.0, 2.0], dtype=np.float32))
+    np.testing.assert_array_equal(topk(x).asnumpy(), [3.0, 2.0])
+
+
+def test_multi_output_with_int_indices_backward():
+    """Non-differentiable outputs (topk indices) must be filtered in
+    backward, and a second backward over the retained tape must work."""
+    f = th.function(lambda t: tuple(torch.topk(t, 2)))
+    v = np.array([3.0, 1.0, 2.0], dtype=np.float32)
+    x = mx.nd.array(v)
+    x.attach_grad()
+    with autograd.record():
+        vals, idx = f(x)
+        z = (vals * vals).sum()
+    z.backward(retain_graph=True)
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 0.0, 4.0], rtol=1e-6)
+    z.backward()  # second traversal over the same torch graph
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 0.0, 4.0], rtol=1e-6)
