@@ -326,6 +326,13 @@ def _build_replay_scalar(heads, variables, head_grads):
         keep.append(node)
         needed.update((id(a), v) for a, v in node.inputs)
     tape = list(reversed(keep))
+    if st.freed and (needed & st.freed):
+        # same guard as _run_backward: a freed shared subgraph would become
+        # a silent constant here instead of contributing gradient
+        raise MXNetError(
+            "create_graph backward reached part of the graph that was "
+            "freed by a previous backward call. Use retain_graph=True on "
+            "the earlier backward.")
 
     produced = set()
     for node in tape:
@@ -385,7 +392,6 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         scalar_fn, leaf_arrays = _build_replay_scalar(heads, variables,
                                                       head_grads)
         op = _ReplayGradFn(scalar_fn, n_vars=len(variables))
-        op.save_for_backward(*variables, *leaf_arrays)
         outs = op(*variables, *leaf_arrays)
         return list(outs)
     retain = True if retain_graph is None else retain_graph
@@ -460,29 +466,29 @@ class _ReplayGradFn(Function):
 
     def __init__(self, scalar_fn, n_vars):
         super().__init__()
-        self._scalar_fn = scalar_fn
-        self._n_vars = n_vars
-
-    def _grad_fn(self):
-        """d scalar / d variables, as a function of (vars + leaves)."""
         import jax
 
-        return jax.grad(self._scalar_fn,
-                        argnums=tuple(range(self._n_vars)))
+        # derived once per node (not per forward/backward call); cross-call
+        # caching is impossible — each grad() records a fresh tape
+        self._grad_fn = jax.grad(scalar_fn, argnums=tuple(range(n_vars)))
+        self._n_vars = n_vars
+        self._vals = None
 
     def forward(self, *all_nds):
         from .ndarray.ndarray import NDArray
 
-        vals = [v._data for v in all_nds]
-        gvals = self._grad_fn()(*vals)
+        # snapshot call-time buffers: later in-place mutation of a variable
+        # (optimizer step) must not change what the HVP differentiates
+        self._vals = [v._data for v in all_nds]
+        gvals = self._grad_fn(*self._vals)
         return tuple(NDArray(g.astype(v._data.dtype), ctx=v._ctx)
                      for g, v in zip(gvals, all_nds[:self._n_vars]))
 
     def backward(self, *ograds):
         import jax
 
-        vals = [v._data for v in self.saved_tensors]
-        _, pull = jax.vjp(self._grad_fn(), *vals)
+        vals = self._vals
+        _, pull = jax.vjp(self._grad_fn, *vals)
         cots = pull(tuple(o._data.astype(vals[i].dtype)
                           for i, o in enumerate(ograds)))
         # raw jax values (float0 for int leaves); _run_backward's

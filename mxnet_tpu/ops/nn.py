@@ -27,6 +27,7 @@ import math
 import numpy as _np
 
 from . import register
+from ..base import MXNetError
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +40,9 @@ from jax import lax
 
 @register("FullyConnected")
 def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
+    if data.ndim < 1:
+        raise MXNetError("FullyConnected: data must have at least 1 "
+                         "dimension, got shape %s" % (data.shape,))
     if flatten:
         x = data.reshape((data.shape[0], -1))
     else:
@@ -74,6 +78,18 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
                 num_filter=0, num_group=1, no_bias=False, cudnn_tune=None,
                 cudnn_off=False, workspace=1024, layout=None):
     nsp = data.ndim - 2
+    # the kernel attr is redundant with the weight's spatial dims; a
+    # mismatch is a user error the reference's shape inference rejects
+    # (conv shape check, src/operator/nn/convolution.cc InferShape).
+    # Validate only when the attr is a clean int sequence — scalar or
+    # string forms (foreign-JSON attrs) skip the check rather than crash.
+    try:
+        kt = tuple(int(k) for k in kernel) if kernel else ()
+    except (TypeError, ValueError):
+        kt = ()
+    if kt and kt != tuple(weight.shape[2:]):
+        raise MXNetError("Convolution: kernel attr %s != weight spatial "
+                         "shape %s" % (kt, tuple(weight.shape[2:])))
     stride = _tup(stride, nsp)
     dilate = _tup(dilate, nsp)
     pad = _tup(pad if pad != () else 0, nsp)

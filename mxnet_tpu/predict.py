@@ -19,7 +19,7 @@ from . import symbol as sym_mod
 from .base import MXNetError
 from .context import current_context
 
-__all__ = ["Predictor", "load_ndarray_file"]
+__all__ = ["Predictor", "CompiledPredictor", "load_ndarray_file"]
 
 
 def load_ndarray_file(nd_bytes_or_file):
@@ -140,6 +140,151 @@ class Predictor:
         buffers are garbage-collected)."""
         self._exe = None
         self._outputs = None
+
+    def export_compiled(self, path=None):
+        """Build the AOT deployment artifact (TensorRT-engine analogue —
+        see CompiledPredictor above): serialize the full forward as
+        StableHLO with parameters frozen in as constants. Returns the
+        bytes; writes them to `path` when given. Reload with
+        `CompiledPredictor.load` (or raw jax.export.deserialize)."""
+        import json as _json
+
+        import jax
+        import jax.export
+        import jax.numpy as jnp
+
+        names = sorted(self._input_shapes)
+        consts = {k: v._data for k, v in self._args.items()
+                  if k not in self._input_shapes}
+        consts.update({k: v.as_in_context(self._ctx)._data
+                       for k, v in self._aux_params.items()})
+
+        def fwd(*data_vals):
+            vals = dict(consts)
+            vals.update(zip(names, data_vals))
+            outs, _ = self._symbol._interpret(vals, is_train=False)
+            return tuple(outs)
+
+        structs = [jax.ShapeDtypeStruct(self._input_shapes[n], jnp.float32)
+                   for n in names]
+        exported = jax.export.export(
+            jax.jit(fwd), platforms=_export_platforms())(*structs)
+        out_shapes = [tuple(a.shape) for a in exported.out_avals]
+        header = _json.dumps({
+            "input_names": names,
+            "input_shapes": {n: list(self._input_shapes[n]) for n in names},
+            "output_shapes": [list(s) for s in out_shapes],
+            "platforms": list(exported.platforms),
+        }).encode()
+        blob = (_MXC_MAGIC + len(header).to_bytes(8, "little") + header
+                + bytes(exported.serialize()))
+        if path is not None:
+            with open(path, "wb") as f:
+                f.write(blob)
+        return blob
+
+
+# ---------------------------------------------------------------------------
+# AOT-compiled deployment artifacts (the TensorRT-integration analogue).
+#
+# The reference partitions inference graphs into TensorRT engines —
+# ahead-of-time optimized, weights frozen, loadable without the training
+# framework (src/executor/trt_graph_executor.cc:81, onnx_to_tensorrt.cc).
+# The TPU-native equivalent is jax.export: the whole bound forward is
+# lowered to StableHLO with parameters baked in as constants (XLA plays
+# TensorRT's role as the optimizing engine), serialized to one portable
+# artifact targeting cpu+tpu, and reloadable by `CompiledPredictor` — or by
+# plain jax.export.deserialize, no model code needed.
+# ---------------------------------------------------------------------------
+
+_MXC_MAGIC = b"MXTPUAOT1\n"
+
+
+def _export_platforms():
+    """cpu + tpu so an artifact built on a CPU host runs on the chip."""
+    import jax
+
+    plats = {"cpu", "tpu"}
+    plats.add(jax.default_backend())
+    return tuple(sorted(plats))
+
+
+class CompiledPredictor:
+    """A deserialized AOT artifact with the Predictor calling surface
+    (set_input/forward/get_output — the predict-API shape, c_predict_api.h),
+    minus reshape: like a TensorRT engine, geometry is frozen at build."""
+
+    def __init__(self, exported, input_names, input_shapes, output_shapes):
+        self._exported = exported
+        self._input_names = list(input_names)
+        self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        self._output_shapes = [tuple(s) for s in output_shapes]
+        self._inputs = {}
+        self._outputs = None
+
+    @staticmethod
+    def load(path_or_bytes):
+        import json as _json
+
+        import jax.export
+
+        raw = path_or_bytes
+        if isinstance(raw, str):
+            with open(raw, "rb") as f:
+                raw = f.read()
+        if not raw.startswith(_MXC_MAGIC):
+            raise MXNetError("not a compiled predictor artifact (bad magic)")
+        raw = raw[len(_MXC_MAGIC):]
+        hlen = int.from_bytes(raw[:8], "little")
+        header = _json.loads(raw[8:8 + hlen].decode())
+        exported = jax.export.deserialize(bytearray(raw[8 + hlen:]))
+        return CompiledPredictor(exported, header["input_names"],
+                                 header["input_shapes"],
+                                 header["output_shapes"])
+
+    def set_input(self, name, data):
+        if name not in self._input_shapes:
+            raise MXNetError("'%s' is not an input (inputs: %s)"
+                             % (name, self._input_names))
+        arr = _np.asarray(data.asnumpy() if hasattr(data, "asnumpy")
+                          else data, dtype=_np.float32)
+        if tuple(arr.shape) != self._input_shapes[name]:
+            raise MXNetError("input '%s' shape %s != frozen %s (AOT "
+                             "artifacts have TensorRT-engine semantics: "
+                             "rebuild for new geometry)"
+                             % (name, arr.shape, self._input_shapes[name]))
+        self._inputs[name] = arr
+        return self
+
+    def forward(self, **kwargs):
+        from . import ndarray as nd
+
+        for k, v in kwargs.items():
+            self.set_input(k, v)
+        missing = [n for n in self._input_names if n not in self._inputs]
+        if missing:
+            raise MXNetError("inputs not set: %s" % missing)
+        outs = self._exported.call(*[self._inputs[n]
+                                     for n in self._input_names])
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        self._outputs = [nd.array(_np.asarray(o)) for o in outs]
+        return self
+
+    def get_output(self, index=0):
+        if self._outputs is None:
+            raise MXNetError("forward() has not been called")
+        return self._outputs[index]
+
+    @property
+    def num_outputs(self):
+        return len(self._output_shapes)
+
+    def get_output_shape(self, index=0):
+        return self._output_shapes[index]
+
+    @property
+    def platforms(self):
+        return self._exported.platforms
 
 
 # ---------------------------------------------------------------------------

@@ -73,8 +73,10 @@ class _TorchFn(Function):
 
     def forward(self, *inputs):
         torch = _torch()
-        self._tin = [to_torch(a).detach().clone().requires_grad_(True)
-                     for a in inputs]
+        # int inputs (embedding indices, masks) cannot require grad
+        tins = [to_torch(a).detach().clone() for a in inputs]
+        self._tin = [t.requires_grad_(bool(t.is_floating_point()))
+                     for t in tins]
         with torch.enable_grad():
             out = self._fn(*self._tin, **self._kwargs)
         self._tout = out if isinstance(out, (tuple, list)) else (out,)

@@ -250,3 +250,33 @@ def test_grad_create_graph_through_custom_function_raises():
         z = (y * y).sum()
         with pytest.raises(MXNetError, match="custom Function"):
             autograd.grad([z], [x], create_graph=True)
+
+
+def test_grad_create_graph_freed_graph_raises():
+    """create_graph over a subgraph freed by an earlier backward must
+    raise like the eager path, not silently return zeros."""
+    x = mx.nd.array(np.ones((2,), dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z1 = (y * 2).sum()
+        z1.backward()  # consumes the x*x subgraph
+        z2 = (y * 3).sum()
+        with pytest.raises(MXNetError, match="freed"):
+            autograd.grad([z2], [x], create_graph=True)
+
+
+def test_grad_create_graph_snapshot_survives_mutation():
+    """HVP must differentiate the call-time values even if the variable is
+    mutated in place before the second backward (optimizer-step idiom)."""
+    x = mx.nd.array(np.array([1.0, 2.0], dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        gx = autograd.grad([y], [x], create_graph=True)[0]  # 3x^2
+        z = gx.sum()
+    x._set_data(mx.nd.array(np.array([10.0, 10.0],
+                                     dtype=np.float32))._data)
+    z.backward()
+    # d/dx sum(3x^2) = 6x at the ORIGINAL x = [1, 2]
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 12.0], rtol=1e-5)

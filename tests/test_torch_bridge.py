@@ -68,3 +68,14 @@ def test_multi_output_with_int_indices_backward():
     np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 0.0, 4.0], rtol=1e-6)
     z.backward()  # second traversal over the same torch graph
     np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 0.0, 4.0], rtol=1e-6)
+
+
+def test_int_input_inference():
+    """Integer inputs (embedding indices) must not require grad
+    (regression: requires_grad_(True) crashed on int tensors)."""
+    emb = th.function(torch.nn.functional.embedding)
+    idx = mx.nd.array(np.array([0, 2, 1], dtype=np.int32), dtype="int32")
+    w = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    out = emb(idx, w)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  w.asnumpy()[[0, 2, 1]])
