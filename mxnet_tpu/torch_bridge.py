@@ -95,8 +95,15 @@ class _TorchFn(Function):
             return tuple(from_torch(torch.zeros_like(t))
                          for t in self._tin)
         outs, seeds = zip(*pairs)
-        gins = torch.autograd.grad(outs, self._tin, seeds,
-                                   allow_unused=True, retain_graph=True)
+        # differentiate only wrt the floating inputs (int indices have
+        # requires_grad=False and make torch.autograd.grad raise)
+        diff_idx = [i for i, t in enumerate(self._tin) if t.requires_grad]
+        gdiff = torch.autograd.grad(outs, [self._tin[i] for i in diff_idx],
+                                    seeds, allow_unused=True,
+                                    retain_graph=True)
+        gins = [None] * len(self._tin)
+        for i, g in zip(diff_idx, gdiff):
+            gins[i] = g
         return tuple(
             from_torch(g) if g is not None
             else from_torch(torch.zeros_like(t))

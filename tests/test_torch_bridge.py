@@ -79,3 +79,20 @@ def test_int_input_inference():
     out = emb(idx, w)
     np.testing.assert_array_equal(out.asnumpy(),
                                   w.asnumpy()[[0, 2, 1]])
+
+
+def test_int_input_training_backward():
+    """Backward through a bridged op with an int input (embedding): grads
+    flow to the float weight, zeros for the index tensor (regression:
+    torch.autograd.grad raised on the non-requires-grad int input)."""
+    emb = th.function(torch.nn.functional.embedding)
+    idx = mx.nd.array(np.array([0, 2, 2], dtype=np.int32), dtype="int32")
+    w = mx.nd.array(np.arange(8, dtype=np.float32).reshape(4, 2))
+    w.attach_grad()
+    with autograd.record():
+        z = (emb(idx, w) ** 2).sum()
+    z.backward()
+    want = np.zeros((4, 2), np.float32)
+    want[0] = 2 * w.asnumpy()[0]
+    want[2] = 2 * 2 * w.asnumpy()[2]
+    np.testing.assert_allclose(w.grad.asnumpy(), want, rtol=1e-6)

@@ -1,0 +1,319 @@
+"""Cross-backend numerical oracle: ops vs torch (values AND gradients).
+
+The reference's main correctness oracle is `check_consistency` — the same
+op run on independent backends (CPU vs GPU vs MKLDNN) must agree
+(python/mxnet/test_utils.py:1391, tests/python/gpu/test_operator_gpu.py).
+This file plays that role with torch-cpu as the independent implementation:
+each case runs the mxnet_tpu op (XLA) and the torch equivalent on identical
+inputs/weights and compares forward outputs and input/weight gradients.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+torch = pytest.importorskip("torch")
+F = torch.nn.functional
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def _mx_grads(fn, arrays):
+    nds = [mx.nd.array(a) for a in arrays]
+    for n in nds:
+        n.attach_grad()
+    with autograd.record():
+        out = fn(*nds)
+        s = out.sum()
+    s.backward()
+    return out.asnumpy(), [n.grad.asnumpy() for n in nds]
+
+
+def _torch_grads(fn, arrays):
+    ts = [torch.tensor(a, requires_grad=True) for a in arrays]
+    out = fn(*ts)
+    out.sum().backward()
+    return out.detach().numpy(), [t.grad.numpy() for t in ts]
+
+
+def _compare(mx_fn, torch_fn, arrays, rtol=RTOL, atol=ATOL):
+    mo, mg = _mx_grads(mx_fn, arrays)
+    to, tg = _torch_grads(torch_fn, arrays)
+    np.testing.assert_allclose(mo, to, rtol=rtol, atol=atol)
+    for i, (a, b) in enumerate(zip(mg, tg)):
+        np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                                   err_msg="grad of arg %d" % i)
+
+
+def test_dense_vs_linear():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 7).astype(np.float32)
+    w = rng.randn(5, 7).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    _compare(lambda x_, w_, b_: mx.nd.FullyConnected(x_, w_, b_, num_hidden=5),
+             lambda x_, w_, b_: F.linear(x_, w_, b_), [x, w, b])
+
+
+@pytest.mark.parametrize("stride,pad,dilate,groups", [
+    ((1, 1), (0, 0), (1, 1), 1),
+    ((2, 2), (1, 1), (1, 1), 1),
+    ((1, 1), (2, 1), (2, 2), 1),
+    ((1, 1), (1, 1), (1, 1), 2),
+])
+def test_conv2d(stride, pad, dilate, groups):
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 9, 9).astype(np.float32)
+    w = rng.randn(6, 4 // groups, 3, 3).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    _compare(
+        lambda x_, w_, b_: mx.nd.Convolution(
+            x_, w_, b_, kernel=(3, 3), num_filter=6, stride=stride,
+            pad=pad, dilate=dilate, num_group=groups),
+        lambda x_, w_, b_: F.conv2d(x_, w_, b_, stride=stride, padding=pad,
+                                    dilation=dilate, groups=groups),
+        [x, w, b])
+
+
+def test_deconv2d():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 4, 5, 5).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    _compare(
+        lambda x_, w_: mx.nd.Deconvolution(
+            x_, w_, kernel=(3, 3), num_filter=3, stride=(2, 2),
+            pad=(1, 1), no_bias=True),
+        lambda x_, w_: F.conv_transpose2d(x_, w_, stride=2, padding=1),
+        [x, w])
+
+
+@pytest.mark.parametrize("pool,tfn", [
+    ("max", lambda t: F.max_pool2d(t, 2, 2)),
+    ("avg", lambda t: F.avg_pool2d(t, 2, 2)),
+])
+def test_pooling(pool, tfn):
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    _compare(lambda x_: mx.nd.Pooling(x_, kernel=(2, 2), stride=(2, 2),
+                                      pool_type=pool),
+             tfn, [x])
+
+
+def test_batchnorm_train_and_eval():
+    rng = np.random.RandomState(4)
+    x = rng.randn(6, 5, 4, 4).astype(np.float32)
+    gamma = rng.rand(5).astype(np.float32) + 0.5
+    beta = rng.randn(5).astype(np.float32)
+    rmean = rng.randn(5).astype(np.float32)
+    rvar = rng.rand(5).astype(np.float32) + 0.5
+
+    # train mode: normalized by batch stats
+    def mx_bn(x_, g_, b_):
+        return mx.nd.BatchNorm(x_, g_, b_,
+                               mx.nd.array(rmean.copy()),
+                               mx.nd.array(rvar.copy()),
+                               fix_gamma=False, momentum=0.9, eps=1e-5)
+
+    def t_bn(x_, g_, b_):
+        return F.batch_norm(x_, torch.tensor(rmean.copy()),
+                            torch.tensor(rvar.copy()), g_, b_,
+                            training=True, momentum=0.1, eps=1e-5)
+
+    nds = [mx.nd.array(a) for a in (x, gamma, beta)]
+    for n in nds:
+        n.attach_grad()
+    with autograd.record():
+        out = mx_bn(*nds)
+        out.sum().backward()
+    ts = [torch.tensor(a, requires_grad=True) for a in (x, gamma, beta)]
+    tout = t_bn(*ts)
+    tout.sum().backward()
+    np.testing.assert_allclose(out.asnumpy(), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(nds[0].grad.asnumpy(), ts[0].grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(nds[1].grad.asnumpy(), ts[1].grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+    # eval mode: normalized by running stats
+    # note: the mx default eps is the reference's 1e-3 (batch_norm.cc);
+    # torch defaults to 1e-5, so pin it for the comparison
+    ev = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                         mx.nd.array(beta), mx.nd.array(rmean.copy()),
+                         mx.nd.array(rvar.copy()), fix_gamma=False,
+                         eps=1e-5)
+    tev = F.batch_norm(torch.tensor(x), torch.tensor(rmean),
+                       torch.tensor(rvar), torch.tensor(gamma),
+                       torch.tensor(beta), training=False, eps=1e-5)
+    np.testing.assert_allclose(ev.asnumpy(), tev.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_layernorm():
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 4, 6).astype(np.float32)
+    g = rng.rand(6).astype(np.float32) + 0.5
+    b = rng.randn(6).astype(np.float32)
+    _compare(lambda x_, g_, b_: mx.nd.LayerNorm(x_, g_, b_, axis=-1,
+                                                eps=1e-5),
+             lambda x_, g_, b_: F.layer_norm(x_, (6,), g_, b_, eps=1e-5),
+             [x, g, b], rtol=1e-3, atol=1e-4)
+
+
+def test_softmax_families():
+    rng = np.random.RandomState(6)
+    x = rng.randn(4, 9).astype(np.float32)
+    _compare(lambda x_: mx.nd.softmax(x_, axis=-1),
+             lambda x_: F.softmax(x_, dim=-1), [x])
+    _compare(lambda x_: mx.nd.log_softmax(x_, axis=-1),
+             lambda x_: F.log_softmax(x_, dim=-1), [x])
+
+
+def test_cross_entropy_loss():
+    from mxnet_tpu import gluon
+
+    rng = np.random.RandomState(7)
+    p = rng.randn(8, 5).astype(np.float32)
+    y = rng.randint(0, 5, (8,)).astype(np.int64)
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    pn = mx.nd.array(p)
+    pn.attach_grad()
+    with autograd.record():
+        l = lossfn(pn, mx.nd.array(y.astype(np.float32))).mean()
+    l.backward()
+    tp = torch.tensor(p, requires_grad=True)
+    tl = F.cross_entropy(tp, torch.tensor(y))
+    tl.backward()
+    np.testing.assert_allclose(float(l.asnumpy()), tl.item(), rtol=1e-5)
+    np.testing.assert_allclose(pn.grad.asnumpy(), tp.grad.numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("act,tfn", [
+    ("relu", F.relu), ("sigmoid", torch.sigmoid), ("tanh", torch.tanh),
+    ("softrelu", F.softplus),
+])
+def test_activations(act, tfn):
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    _compare(lambda x_: mx.nd.Activation(x_, act_type=act), tfn, [x])
+
+
+def test_embedding_grad():
+    rng = np.random.RandomState(8)
+    w = rng.randn(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 3, 7], dtype=np.float32)
+    wn = mx.nd.array(w)
+    wn.attach_grad()
+    with autograd.record():
+        out = mx.nd.Embedding(mx.nd.array(idx), wn, input_dim=10,
+                              output_dim=4)
+        out.sum().backward()
+    tw = torch.tensor(w, requires_grad=True)
+    tout = F.embedding(torch.tensor(idx.astype(np.int64)), tw)
+    tout.sum().backward()
+    np.testing.assert_allclose(out.asnumpy(), tout.detach().numpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(wn.grad.asnumpy(), tw.grad.numpy(),
+                               rtol=1e-6)
+
+
+def _pack_lstm_params(tl, layers, dirs):
+    """torch LSTM/GRU weights -> the fused RNN op's cuDNN-style packing
+    (all Wx,Wh per layer/dir, then all bx,bh; gate order matches torch)."""
+    ws, bs = [], []
+    for layer in range(layers):
+        for d in range(dirs):
+            sfx = "_l%d%s" % (layer, "_reverse" if d else "")
+            ws.append(getattr(tl, "weight_ih" + sfx).detach().numpy().ravel())
+            ws.append(getattr(tl, "weight_hh" + sfx).detach().numpy().ravel())
+    for layer in range(layers):
+        for d in range(dirs):
+            sfx = "_l%d%s" % (layer, "_reverse" if d else "")
+            bs.append(getattr(tl, "bias_ih" + sfx).detach().numpy().ravel())
+            bs.append(getattr(tl, "bias_hh" + sfx).detach().numpy().ravel())
+    return np.concatenate(ws + bs).astype(np.float32)
+
+
+@pytest.mark.parametrize("mode,layers,bidir", [
+    ("lstm", 1, False), ("lstm", 2, False), ("lstm", 1, True),
+    ("gru", 1, False), ("gru", 2, True),
+])
+def test_fused_rnn_vs_torch(mode, layers, bidir):
+    T, B, I, H = 5, 3, 4, 6
+    rng = np.random.RandomState(9)
+    x = rng.randn(T, B, I).astype(np.float32)
+    dirs = 2 if bidir else 1
+
+    tcls = torch.nn.LSTM if mode == "lstm" else torch.nn.GRU
+    tl = tcls(I, H, num_layers=layers, bidirectional=bidir)
+    params = _pack_lstm_params(tl, layers, dirs)
+
+    h0 = np.zeros((layers * dirs, B, H), np.float32)
+    args = [mx.nd.array(x), mx.nd.array(params), mx.nd.array(h0)]
+    kwargs = dict(state_size=H, num_layers=layers, mode=mode,
+                  bidirectional=bidir)
+    if mode == "lstm":
+        args.append(mx.nd.array(h0.copy()))
+    out = mx.nd.RNN(*args, **kwargs)
+    out0 = (out[0] if isinstance(out, (list, tuple)) else out).asnumpy()
+
+    tout, _ = tl(torch.tensor(x))
+    np.testing.assert_allclose(out0, tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mx_opt,mx_kw,t_cls,t_kw", [
+    ("sgd", {"learning_rate": 0.1, "wd": 0.01},
+     lambda p: torch.optim.SGD(p, lr=0.1, weight_decay=0.01), {}),
+    ("adam", {"learning_rate": 1e-2},
+     lambda p: torch.optim.Adam(p, lr=1e-2), {}),
+    ("adagrad", {"learning_rate": 0.05, "eps": 1e-7},
+     lambda p: torch.optim.Adagrad(p, lr=0.05, eps=1e-7,
+                                   initial_accumulator_value=0.0), {}),
+])
+def test_optimizer_updates_vs_torch(mx_opt, mx_kw, t_cls, t_kw):
+    """Optimizer update math vs torch.optim over several steps (the
+    reference validates optimizers against python reference impls,
+    test_optimizer.py; torch is our independent oracle). Only optimizers
+    with identical formulations are compared (mx sgd folds lr into the
+    momentum buffer, torch doesn't — so sgd is compared without
+    momentum)."""
+    import mxnet_tpu.optimizer as opt
+
+    rng = np.random.RandomState(11)
+    w0 = rng.randn(12).astype(np.float32)
+    grads = [rng.randn(12).astype(np.float32) for _ in range(5)]
+
+    o = opt.create(mx_opt, **mx_kw)
+    updater = opt.get_updater(o)
+    w_mx = mx.nd.array(w0.copy())
+    for g in grads:
+        updater(0, mx.nd.array(g), w_mx)
+
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = t_cls([tw])
+    for g in grads:
+        topt.zero_grad()
+        tw.grad = torch.tensor(g)
+        topt.step()
+
+    np.testing.assert_allclose(w_mx.asnumpy(), tw.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_vs_torch_sdpa(causal):
+    """Pallas flash attention (interpret mode on CPU) vs
+    torch.scaled_dot_product_attention — values and q/k/v grads."""
+    rng = np.random.RandomState(12)
+    B, L, D = 2, 16, 8
+    q = rng.randn(B, L, D).astype(np.float32)
+    k = rng.randn(B, L, D).astype(np.float32)
+    v = rng.randn(B, L, D).astype(np.float32)
+
+    def t_sdpa(q_, k_, v_):
+        return F.scaled_dot_product_attention(q_, k_, v_, is_causal=causal)
+
+    _compare(lambda q_, k_, v_: mx.nd.contrib.flash_attention(
+                 q_, k_, v_, causal=causal),
+             t_sdpa, [q, k, v], rtol=2e-4, atol=2e-5)
