@@ -408,6 +408,50 @@ def bench_lstm():
     print(json.dumps(out))
 
 
+def _device_watchdog(timeout_s=240):
+    """Fail fast (with a diagnosable JSON line) when the accelerator tunnel
+    is unreachable: jax.devices() on a wedged PJRT tunnel blocks forever,
+    which would make the whole bench time out with no output. The probe
+    runs in a daemon thread; on timeout we print the failure as JSON and
+    exit non-zero so the captured artifact explains itself."""
+    import threading
+
+    done = threading.Event()
+    err = []
+
+    def probe():
+        try:
+            import jax
+
+            jax.devices()
+        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+            err.append(str(e))
+        done.set()
+
+    metric = {"score": "resnet50_score_bs32_imgs_per_sec",
+              "bert": "bert_base_train_tokens_per_sec",
+              "lstm": "lstm_word_lm_train_tokens_per_sec"}.get(
+                  MODE, "resnet50_train_bs32_imgs_per_sec")
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        print(json.dumps({
+            "metric": metric,
+            "value": None, "unit": None, "vs_baseline": None,
+            "error": "accelerator tunnel unreachable: jax.devices() still "
+                     "blocked after %ds (axon PJRT dial hang); bench "
+                     "aborted rather than timing out silently" % timeout_s,
+        }), flush=True)
+        os._exit(1)
+    if err:
+        print(json.dumps({
+            "metric": metric,
+            "value": None, "unit": None, "vs_baseline": None,
+            "error": "jax backend init failed: %s" % err[0][:500],
+        }), flush=True)
+        os._exit(1)
+
+
 def main():
     # a sitecustomize PJRT hook force-overrides jax_platforms at interpreter
     # start; re-assert the env's explicit choice so JAX_PLATFORMS=cpu smoke
@@ -416,6 +460,7 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    _device_watchdog()
     if MODE == "score":
         bench_score()
     elif MODE == "bert":

@@ -206,7 +206,12 @@ def _run_backward(heads, head_grads, retain_graph=False):
         if not any(k in cot for k in node.out_keys):
             continue
         consumed.add(id(node))
-        if not any(_is_float(a.dtype) for a in node.in_arrays):
+        # sparse inputs carry component dicts; their float-ness is the
+        # value component's (custom Function nodes with sparse args)
+        if not any(_is_float(a.dtype) if hasattr(a, "dtype")
+                   else _is_float(a["data"].dtype) if isinstance(a, dict)
+                   and "data" in a else False
+                   for a in node.in_arrays):
             continue
         if node.py_backward is not None:
             all_cots = []
@@ -238,8 +243,12 @@ def _run_backward(heads, head_grads, retain_graph=False):
             cot[key] = cot[key] + c if key in cot else c
             touched[id(arr)] = arr
 
-    # write accumulated grads into attached buffers
-    for aid, arr in list(touched.items()) + [(id(h), h) for h in heads]:
+    # write accumulated grads into attached buffers (dedup: an array that
+    # is both a head and an interior input must be written once, or
+    # grad_req='add' double-accumulates)
+    targets = dict(touched)
+    targets.update((id(h), h) for h in heads)
+    for aid, arr in targets.items():
         if arr._grad is None or arr._grad_req == "null":
             continue
         total = None
@@ -248,7 +257,22 @@ def _run_backward(heads, head_grads, retain_graph=False):
                 total = c if total is None else total + c
         if total is None:
             continue
-        if arr._grad_req == "add":
+        if getattr(arr, "_grad_stype", "default") == "row_sparse":
+            # sparse grad buffer (attach_grad(stype='row_sparse')): cast the
+            # dense tape gradient to row_sparse at write-back so sparse
+            # optimizer kernels see indices (gluon Trainer does the same
+            # for Parameter grad_stype)
+            from .ndarray.ndarray import NDArray
+
+            dense = total.astype(arr._grad.dtype)
+            if arr._grad_req == "add":
+                prev = arr._grad
+                prev_dense = prev.tostype("default")._data \
+                    if getattr(prev, "stype", "default") != "default" \
+                    else prev._data
+                dense = dense + prev_dense
+            arr._grad = NDArray(dense, ctx=arr._ctx).tostype("row_sparse")
+        elif arr._grad_req == "add":
             arr._grad._set_data(arr._grad._data + total.astype(arr._grad.dtype))
         else:
             arr._grad._set_data(total.astype(arr._grad.dtype))

@@ -38,7 +38,8 @@ __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
 class NDArray:
     """Multi-dimensional array on a device (reference: ndarray.h:82)."""
 
-    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_version", "_fresh_grad")
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_grad_stype",
+                 "_version", "_fresh_grad")
 
     def __init__(self, data, ctx=None):
         self._data = data  # jax.Array
@@ -162,11 +163,18 @@ class NDArray:
     # -- autograd ---------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
         """Allocate a gradient buffer (reference: python ndarray.py attach_grad
-        -> MXAutogradMarkVariables c_api_ndarray.cc:257)."""
+        -> MXAutogradMarkVariables c_api_ndarray.cc:257). With
+        stype='row_sparse' the tape's (dense) accumulated gradient is cast
+        to row_sparse at write-back, so `.grad` feeds sparse optimizer
+        kernels — same stance as gluon Parameter grad_stype."""
         import jax.numpy as jnp
 
+        if (stype or "default") not in ("default", "row_sparse"):
+            raise MXNetError("attach_grad: unsupported grad stype %r "
+                             "(default/row_sparse)" % (stype,))
         self._grad = NDArray(jnp.zeros(self.shape, self.dtype), ctx=self._ctx)
         self._grad_req = grad_req
+        self._grad_stype = stype or "default"
 
     def backward(self, out_grad=None, retain_graph=False, train_mode=True):
         from .. import autograd

@@ -185,10 +185,16 @@ class CSRNDArray(BaseSparseNDArray):
 
     def _row_ids(self):
         """nnz-length row id per element (host-computed from indptr; static
-        per instance, so downstream XLA segment ops see a constant)."""
+        per instance — memoized, so the differentiable dot's forward and
+        backward share one device->host sync)."""
+        cached = getattr(self, "_row_ids_cache", None)
+        if cached is not None:
+            return cached
         indptr = _np.asarray(self._data["indptr"])
         counts = _np.diff(indptr)
-        return _np.repeat(_np.arange(self._shape[0], dtype=_np.int32), counts)
+        out = _np.repeat(_np.arange(self._shape[0], dtype=_np.int32), counts)
+        self._row_ids_cache = out
+        return out
 
     def tostype(self, stype):
         import jax.numpy as jnp
@@ -376,39 +382,89 @@ def sparse_retain(arr, indices):
 retain = sparse_retain
 
 
+def _csr_dot_math(lhs, dense, transpose_a):
+    """The SpMM kernel: csr x dense (or csr.T x dense) via XLA
+    segment_sum / scatter-add. `dense` is an NDArray; returns NDArray."""
+    import jax
+    import jax.numpy as jnp
+
+    vec = dense.ndim == 1
+    rows = jnp.asarray(lhs._row_ids())
+    cols = lhs._data["indices"]
+    vals = lhs._data["data"]
+    if not transpose_a:
+        # out[m(, n)] = sum_k csr[m, k] * dense[k(, n)]
+        gathered = dense._data[cols]          # (nnz,) or (nnz, n)
+        prods = vals * gathered if vec else vals[:, None] * gathered
+        out = jax.ops.segment_sum(prods, rows, num_segments=lhs.shape[0])
+        return NDArray(out, ctx=dense.context)
+    # out[k(, n)] = sum_m csr[m, k] * dense[m(, n)]
+    g_rows = dense._data[rows]
+    prods = vals * g_rows if vec else vals[:, None] * g_rows
+    out_shape = (lhs.shape[1],) if vec else (lhs.shape[1], dense.shape[1])
+    out = jnp.zeros(out_shape, prods.dtype)
+    out = out.at[cols].add(prods)
+    return NDArray(out, ctx=dense.context)
+
+
+def _get_csr_dot_cls():
+    """Module-level Function subclass, created once (lazy: autograd imports
+    ndarray, so this module cannot import autograd at top level)."""
+    global _CSRDotFn
+    if _CSRDotFn is None:
+        from ..autograd import Function
+
+        class _CSRDot(Function):
+            def forward(self, rhs_nd):
+                d = rhs_nd.tostype("default") \
+                    if isinstance(rhs_nd, BaseSparseNDArray) else rhs_nd
+                return _csr_dot_math(self._lhs, d, self._transpose_a)
+
+            def backward(self, ograd):
+                return _csr_dot_math(self._lhs, ograd,
+                                     not self._transpose_a)
+
+        _CSRDotFn = _CSRDot
+    return _CSRDotFn
+
+
+_CSRDotFn = None
+
+
+def _csr_dot_fn(lhs, transpose_a):
+    """Tape node for dot(csr, w): forward densifies the rhs internally so
+    the recorded input is the weight itself (even a RowSparseNDArray);
+    backward is the transposed SpMM — the csr matrix is data, not a
+    differentiable input (reference: dot backward, dot-inl.h). Built on
+    autograd.Function so grads flow on the eager tape, and write-back
+    casts to the weight's attach_grad stype (row_sparse lazy updates)."""
+    fn = _get_csr_dot_cls()()
+    fn._lhs = lhs
+    fn._transpose_a = transpose_a
+    return fn
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse-aware dot (reference: src/operator/tensor/dot-inl.h —
     csr*dense and csr.T*dense paths; row_sparse via densify). Lowers to
-    XLA segment_sum / scatter-add, the TPU-native SpMM formulation."""
-    import jax
-    import jax.numpy as jnp
+    XLA segment_sum / scatter-add, the TPU-native SpMM formulation.
+    Differentiable wrt the dense/row_sparse rhs (the reference's sparse
+    linear-model training path, example/sparse/linear_classification)."""
+    from .. import autograd as _ag
 
     if isinstance(lhs, CSRNDArray):
         if transpose_b:
             raise MXNetError("dot(csr, dense, transpose_b=True) unsupported "
                              "(matches reference)")
-        dense = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
-        if dense.ndim not in (1, 2):
+        dense_ndim = rhs.ndim
+        if dense_ndim not in (1, 2):
             raise MXNetError("dot(csr, dense): rhs must be 1-D or 2-D, got %dD"
-                             % dense.ndim)
-        vec = dense.ndim == 1
-        rows = jnp.asarray(lhs._row_ids())
-        cols = lhs._data["indices"]
-        vals = lhs._data["data"]
-        gathered = dense._data[cols]          # (nnz,) or (nnz, n)
-        if not transpose_a:
-            # out[m(, n)] = sum_k csr[m, k] * dense[k(, n)]
-            prods = vals * gathered if vec else vals[:, None] * gathered
-            out = jax.ops.segment_sum(prods, rows,
-                                      num_segments=lhs.shape[0])
-            return NDArray(out, ctx=dense.context)
-        # out[k(, n)] = sum_m csr[m, k] * dense[m(, n)]
-        g_rows = dense._data[rows]
-        prods = vals * g_rows if vec else vals[:, None] * g_rows
-        out_shape = (lhs.shape[1],) if vec else (lhs.shape[1], dense.shape[1])
-        out = jnp.zeros(out_shape, prods.dtype)
-        out = out.at[cols].add(prods)
-        return NDArray(out, ctx=dense.context)
+                             % dense_ndim)
+        if _ag.is_recording():
+            return _csr_dot_fn(lhs, transpose_a)(rhs)
+        dense = rhs.tostype("default") \
+            if isinstance(rhs, BaseSparseNDArray) else rhs
+        return _csr_dot_math(lhs, dense, transpose_a)
     if isinstance(lhs, RowSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
         l = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
         r = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs

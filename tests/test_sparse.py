@@ -161,3 +161,62 @@ def test_embedding_sparse_grad_training():
     # only looked-up rows changed
     changed = np.where(np.any(w_before != w_after, axis=1))[0]
     np.testing.assert_array_equal(sorted(changed), [1, 3])
+
+
+def test_csr_dot_backward():
+    """dot(csr, w) is differentiable wrt the dense rhs (reference: dot
+    backward dot-inl.h — the sparse linear-classification training path);
+    grad = dot(csr.T, ograd)."""
+    from mxnet_tpu import autograd
+
+    dns = np.array([[0, 1.5, 0, 2.0],
+                    [3.0, 0, 0, 0],
+                    [0, 0, -1.0, 4.0]], dtype=np.float32)
+    x = sparse.csr_matrix(dns)
+    w = mx.nd.array(np.arange(8, dtype=np.float32).reshape(4, 2))
+    w.attach_grad()
+    with autograd.record():
+        out = sparse.dot(x, w)
+        s = (out * out).sum()
+    s.backward()
+    # numeric check vs dense math
+    tw = dns.T @ (2 * (dns @ w.asnumpy()))
+    np.testing.assert_allclose(w.grad.asnumpy(), tw, rtol=1e-5)
+    np.testing.assert_allclose(out.asnumpy(), dns @ w.asnumpy(), rtol=1e-6)
+
+
+def test_csr_dot_transpose_backward():
+    from mxnet_tpu import autograd
+
+    dns = np.array([[0, 2.0, 0], [1.0, 0, 3.0]], dtype=np.float32)
+    x = sparse.csr_matrix(dns)
+    w = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    w.attach_grad()
+    with autograd.record():
+        out = sparse.dot(x, w, transpose_a=True)  # (3, 3)
+        s = out.sum()
+    s.backward()
+    np.testing.assert_allclose(out.asnumpy(), dns.T @ w.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               dns @ np.ones((3, 3), np.float32), rtol=1e-6)
+
+
+def test_attach_grad_row_sparse_stype():
+    """attach_grad(stype='row_sparse'): the tape's dense grad arrives cast
+    to row_sparse at write-back (reference: sparse grad for lazy updates)."""
+    from mxnet_tpu import autograd
+
+    w = mx.nd.zeros((6, 2))
+    w.attach_grad(stype="row_sparse")
+    dns = np.zeros((2, 6), np.float32)
+    dns[0, 1] = 2.0
+    dns[1, 4] = 3.0
+    x = sparse.csr_matrix(dns)
+    with autograd.record():
+        out = sparse.dot(x, w + 1.0)
+        out.sum().backward()
+    g = w.grad
+    assert g.stype == "row_sparse"
+    got = g.tostype("default").asnumpy()
+    want = dns.T @ np.ones((2, 2), np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
