@@ -271,7 +271,16 @@ def _run_backward(heads, head_grads, retain_graph=False):
                     if getattr(prev, "stype", "default") != "default" \
                     else prev._data
                 dense = dense + prev_dense
-            arr._grad = NDArray(dense, ctx=arr._ctx).tostype("row_sparse")
+            rsp = NDArray(dense, ctx=arr._ctx).tostype("row_sparse")
+            g = arr._grad
+            if getattr(g, "stype", "default") == "row_sparse":
+                # preserve buffer identity: aliases taken before backward
+                # (mark_variables pairs, executor grad arrays) stay live
+                g._shape = rsp._shape
+                g._data = rsp._data
+                g._version += 1
+            else:
+                arr._grad = rsp
         elif arr._grad_req == "add":
             arr._grad._set_data(arr._grad._data + total.astype(arr._grad.dtype))
         else:

@@ -172,7 +172,17 @@ class NDArray:
         if (stype or "default") not in ("default", "row_sparse"):
             raise MXNetError("attach_grad: unsupported grad stype %r "
                              "(default/row_sparse)" % (stype,))
-        self._grad = NDArray(jnp.zeros(self.shape, self.dtype), ctx=self._ctx)
+        if stype == "row_sparse":
+            # the grad buffer is row_sparse from the start so aliases taken
+            # before backward stay valid (write-back mutates components)
+            from . import sparse as _sparse
+
+            self._grad = _sparse.zeros("row_sparse", self.shape,
+                                       ctx=self._ctx,
+                                       dtype=_np.dtype(self.dtype).name)
+        else:
+            self._grad = NDArray(jnp.zeros(self.shape, self.dtype),
+                                 ctx=self._ctx)
         self._grad_req = grad_req
         self._grad_stype = stype or "default"
 

@@ -110,6 +110,7 @@ class BaseSparseNDArray(NDArray):
             other._shape = self._shape
             other._data = {k: jax.device_put(v, other._ctx.jax_device())
                            for k, v in self._data.items()}
+            other._row_ids_cache = None  # derived cache follows components
             return other
         if isinstance(other, NDArray):
             # densify then reuse NDArray.copyto for the device transfer

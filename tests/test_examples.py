@@ -1,0 +1,42 @@
+"""Run every example as a real subprocess (reference CI runs example
+scripts in tutorial tests). Opt-in via MXTPU_TEST_EXAMPLES=1 — the full
+set takes several minutes, so default CI runs skip it:
+
+    MXTPU_TEST_EXAMPLES=1 python -m pytest tests/test_examples.py -q
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if not os.environ.get("MXTPU_TEST_EXAMPLES"):
+    pytest.skip("set MXTPU_TEST_EXAMPLES=1 to run the example scripts",
+                allow_module_level=True)
+
+EXAMPLES = [
+    ("image_classification/train_mnist.py", []),
+    ("rnn/word_lm.py", []),
+    ("ssd/train.py", []),
+    ("quantization/quantize_lenet.py", []),
+    ("profiler/profile_training.py", []),
+    ("distributed/train_dist.py", ["--tp", "2"]),
+    ("gan/dcgan.py", []),
+    ("sparse/linear_classification.py", []),
+]
+
+
+@pytest.mark.parametrize("script,args",
+                         EXAMPLES, ids=[s for s, _ in EXAMPLES])
+def test_example(script, args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script)] + args,
+        env=env, capture_output=True, text=True, timeout=900)
+    assert res.returncode == 0, "%s failed:\n%s" % (script,
+                                                    res.stderr[-3000:])

@@ -1,0 +1,170 @@
+"""Behavioral shape/edge sweep (reference: test_operator.py's broadcast /
+reduction / indexing batteries — e.g. test_broadcast_binary_op,
+test_reduce, test_take — which sweep shape combinations rather than single
+fixed cases). Seeded, numpy as the oracle, gradients via the tape where
+meaningful."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+RNG = np.random.RandomState(7)
+
+BCAST_SHAPES = [
+    ((2, 3, 4), (2, 3, 4)),
+    ((2, 3, 4), (1, 3, 1)),
+    ((2, 3, 4), (4,)),
+    ((1,), (5, 1)),
+    ((3, 1, 5), (1, 4, 1)),
+    ((2, 3), (1, 1)),
+]
+
+BINARY = [
+    ("broadcast_add", np.add),
+    ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply),
+    ("broadcast_maximum", np.maximum),
+    ("broadcast_minimum", np.minimum),
+    ("broadcast_power", None),  # positive base below
+]
+
+
+@pytest.mark.parametrize("opname,npop", BINARY)
+@pytest.mark.parametrize("sa,sb", BCAST_SHAPES)
+def test_broadcast_binary(opname, npop, sa, sb):
+    a = RNG.uniform(0.5, 2.0, sa).astype(np.float32)
+    b = RNG.uniform(0.5, 2.0, sb).astype(np.float32)
+    fn = getattr(mx.nd, opname)
+    an, bn = mx.nd.array(a), mx.nd.array(b)
+    an.attach_grad()
+    bn.attach_grad()
+    with autograd.record():
+        out = fn(an, bn)
+        out.sum().backward()
+    want = np.power(a, b) if npop is None else npop(a, b)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5, atol=1e-6)
+    # gradient shapes must match the inputs (the broadcast is summed back)
+    assert an.grad.shape == sa and bn.grad.shape == sb
+    assert np.all(np.isfinite(an.grad.asnumpy()))
+    assert np.all(np.isfinite(bn.grad.asnumpy()))
+
+
+REDUCE_AXES = [None, 0, 1, -1, (0, 1), (0, -1)]
+
+
+@pytest.mark.parametrize("opname,npfn", [
+    ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ("prod", np.prod),
+])
+@pytest.mark.parametrize("axis", REDUCE_AXES)
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_reductions(opname, npfn, axis, keepdims):
+    x = RNG.uniform(0.5, 1.5, (3, 4, 5)).astype(np.float32)
+    got = getattr(mx.nd, opname)(mx.nd.array(x), axis=axis,
+                                 keepdims=keepdims)
+    want = npfn(x, axis=axis, keepdims=keepdims)
+    np.testing.assert_allclose(got.asnumpy(), np.asarray(want,
+                                                         dtype=np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode,idx", [
+    ("clip", [0, 2, 3]),
+    ("clip", [-1, 5, 99]),      # out-of-range clips (reference take clip)
+    ("wrap", [-1, 4, 7]),       # wraps modulo axis size
+])
+def test_take_modes(mode, idx):
+    x = RNG.randn(4, 3).astype(np.float32)
+    got = mx.nd.take(mx.nd.array(x),
+                     mx.nd.array(np.array(idx, np.float32)),
+                     axis=0, mode=mode).asnumpy()
+    n = x.shape[0]
+    if mode == "clip":
+        ref_idx = np.clip(idx, 0, n - 1)
+    else:
+        ref_idx = np.mod(idx, n)
+    np.testing.assert_allclose(got, x[ref_idx], rtol=1e-6)
+
+
+@pytest.mark.parametrize("begin,end,step", [
+    ((0, 0), (2, 3), None),
+    ((1, None), (None, None), None),
+    ((0, 4), (4, 0), (1, -1)),   # negative step
+    ((-2, -3), (None, None), None),
+])
+def test_slice_semantics(begin, end, step):
+    x = RNG.randn(4, 5).astype(np.float32)
+    kwargs = {"begin": begin, "end": end}
+    if step is not None:
+        kwargs["step"] = step
+    got = mx.nd.slice(mx.nd.array(x), **kwargs).asnumpy()
+    sl = []
+    for i in range(2):
+        b = begin[i]
+        e = end[i] if end else None
+        s = step[i] if step else None
+        sl.append(slice(b, e, s))
+    np.testing.assert_allclose(got, x[tuple(sl)], rtol=1e-6)
+
+
+def test_broadcast_grad_values():
+    """Broadcast grads reduce correctly: d/db sum(a*b) with b broadcast
+    over axis 0 = sum_rows(a)."""
+    a = RNG.randn(6, 4).astype(np.float32)
+    b = RNG.randn(1, 4).astype(np.float32)
+    an, bn = mx.nd.array(a), mx.nd.array(b)
+    bn.attach_grad()
+    with autograd.record():
+        (mx.nd.broadcast_mul(an, bn)).sum().backward()
+    np.testing.assert_allclose(bn.grad.asnumpy(),
+                               a.sum(0, keepdims=True), rtol=1e-5)
+
+
+@pytest.mark.parametrize("shape,reps", [
+    ((2, 3), (2, 2)), ((3,), (4,)), ((2, 1, 2), (1, 3, 1)),
+])
+def test_tile_repeat(shape, reps):
+    x = RNG.randn(*shape).astype(np.float32)
+    np.testing.assert_allclose(
+        mx.nd.tile(mx.nd.array(x), reps=reps).asnumpy(),
+        np.tile(x, reps), rtol=1e-6)
+
+
+def test_where_and_clip_edges():
+    x = np.array([-np.inf, -2.0, 0.0, 3.0, np.inf], np.float32)
+    got = mx.nd.clip(mx.nd.array(x), a_min=-1.0, a_max=1.0).asnumpy()
+    np.testing.assert_allclose(got, np.clip(x, -1, 1), rtol=1e-6)
+    cond = np.array([1, 0, 1, 0, 1], np.float32)
+    a = np.arange(5, dtype=np.float32)
+    b = -a
+    got = mx.nd.where(mx.nd.array(cond), mx.nd.array(a),
+                      mx.nd.array(b)).asnumpy()
+    np.testing.assert_allclose(got, np.where(cond > 0, a, b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("k", [1, 3])
+@pytest.mark.parametrize("ret_typ", ["value", "indices"])
+def test_topk_semantics(k, ret_typ):
+    x = RNG.randn(3, 7).astype(np.float32)
+    got = mx.nd.topk(mx.nd.array(x), k=k, ret_typ=ret_typ,
+                     axis=-1).asnumpy()
+    order = np.argsort(-x, axis=-1)[:, :k]
+    if ret_typ == "indices":
+        np.testing.assert_array_equal(got.astype(np.int64), order)
+    else:
+        np.testing.assert_allclose(got, np.take_along_axis(x, order, -1),
+                                   rtol=1e-6)
+
+
+def test_concat_stack_split_roundtrip():
+    xs = [RNG.randn(2, 3).astype(np.float32) for _ in range(4)]
+    cat = mx.nd.concat(*[mx.nd.array(x) for x in xs], dim=0)
+    np.testing.assert_allclose(cat.asnumpy(), np.concatenate(xs, 0),
+                               rtol=1e-6)
+    st = mx.nd.stack(*[mx.nd.array(x) for x in xs], axis=0)
+    np.testing.assert_allclose(st.asnumpy(), np.stack(xs, 0), rtol=1e-6)
+    parts = mx.nd.split(mx.nd.array(np.concatenate(xs, 0)), num_outputs=4,
+                        axis=0)
+    for p, x in zip(parts, xs):
+        np.testing.assert_allclose(p.asnumpy(), x, rtol=1e-6)

@@ -220,3 +220,34 @@ def test_attach_grad_row_sparse_stype():
     got = g.tostype("default").asnumpy()
     want = dns.T @ np.ones((2, 2), np.float32)
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_csr_row_ids_cache_invalidated_by_copyto():
+    """copyto replaces components; the memoized row-id cache must follow
+    (regression: stale cache made subsequent SpMM silently wrong)."""
+    a = sparse.csr_matrix(np.array([[1, 0], [0, 2]], np.float32))
+    w = mx.nd.array(np.eye(2, dtype=np.float32))
+    sparse.dot(a, w)  # populates cache
+    b = sparse.csr_matrix(np.array([[1, 2], [0, 0]], np.float32))
+    b.copyto(a)
+    np.testing.assert_allclose(sparse.dot(a, w).asnumpy(),
+                               [[1, 2], [0, 0]], rtol=1e-6)
+
+
+def test_row_sparse_grad_alias_preserved():
+    """An alias to w.grad taken before backward must see the sparse
+    gradient (regression: write-back rebound a new object)."""
+    from mxnet_tpu import autograd
+
+    w = mx.nd.zeros((4, 2))
+    w.attach_grad(stype="row_sparse")
+    g = w.grad
+    assert g.stype == "row_sparse"
+    dns = np.zeros((1, 4), np.float32)
+    dns[0, 1] = 2.0
+    x = sparse.csr_matrix(dns)
+    with autograd.record():
+        sparse.dot(x, w + 1.0).sum().backward()
+    assert g is w.grad
+    np.testing.assert_allclose(g.tostype("default").asnumpy()[1],
+                               [2.0, 2.0], rtol=1e-6)
