@@ -32,7 +32,7 @@ _SUBMODULES = [
     "module", "io", "recordio", "image", "kvstore", "gluon", "callback",
     "model", "profiler", "runtime", "test_utils", "visualization", "monitor",
     "parallel", "attribute", "name", "operator", "contrib", "rtc",
-    "torch_bridge",
+    "torch_bridge", "registry", "log",
 ]
 import importlib as _importlib
 import os as _os

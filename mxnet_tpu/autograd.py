@@ -108,6 +108,9 @@ def mark_variables(variables, gradients, grad_reqs="write"):
     for v, g, req in zip(variables, gradients, grad_reqs):
         v._grad = g
         v._grad_req = req
+        # the paired buffer's storage decides the write-back path (a
+        # row_sparse buffer must not be overwritten by a dense _set_data)
+        v._grad_stype = getattr(g, "stype", "default")
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,79 @@
+"""Logging utilities (reference: python/mxnet/log.py — a color/level
+formatter and `get_logger` used across examples and tools)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
+           "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+PY3 = True
+
+
+class _Formatter(logging.Formatter):
+    """reference: log.py:37 — level-colored single-letter labels."""
+
+    def __init__(self, colored=True):
+        self.colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def _get_color(self, level):
+        if level >= ERROR:
+            return "\x1b[31m"
+        if level >= WARNING:
+            return "\x1b[33m"
+        return "\x1b[32m"
+
+    def _get_label(self, level):
+        if level == logging.CRITICAL:
+            return "C"
+        if level == ERROR:
+            return "E"
+        if level == WARNING:
+            return "W"
+        if level == INFO:
+            return "I"
+        if level == DEBUG:
+            return "D"
+        return "U"
+
+    def format(self, record):
+        fmt = ""
+        if self.colored and sys.stderr.isatty():
+            fmt += self._get_color(record.levelno)
+        fmt += self._get_label(record.levelno)
+        fmt += "%(asctime)s %(process)d %(pathname)s:%(funcName)s:%(lineno)d"
+        if self.colored and sys.stderr.isatty():
+            fmt += "\x1b[0m"
+        fmt += " %(message)s"
+        self._style._fmt = fmt
+        return super().format(record)
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """reference: log.py:80 (deprecated spelling, kept for parity)."""
+    return get_logger(name, filename, filemode, level)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """A logger with the mxnet formatter attached once (reference:
+    log.py:90)."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", False):
+        logger._init_done = True
+        if filename:
+            mode = filemode if filemode else "a"
+            hdlr = logging.FileHandler(filename, mode)
+        else:
+            hdlr = logging.StreamHandler()
+        hdlr.setFormatter(_Formatter(colored=not filename))
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger

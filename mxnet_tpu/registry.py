@@ -1,0 +1,108 @@
+"""Generic object registry factories (reference: python/mxnet/registry.py
+— get_register_func / get_alias_func / get_create_func, the machinery
+behind `@mx.optimizer.register`, metric lookup, initializer strings).
+
+The per-subsystem registries here are `base._Registry` instances; this
+module provides the reference's functional surface over the same storage,
+so third-party code written against `mx.registry` works unchanged —
+including string-spec creation ("adam", ("adam", {"learning_rate": 1e-3}),
+or a JSON '["adam", {...}]' spec, matching the reference's create())."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError, _Registry
+
+__all__ = ["get_registry", "get_register_func", "get_alias_func",
+           "get_create_func", "register", "alias", "create"]
+
+_REGISTRY = {}  # base_class -> _Registry
+
+
+def get_registry(base_class):
+    """The (class-keyed) registry dict for `base_class` (reference:
+    registry.py:32 — returns a copy of the name->class map)."""
+    reg = _REGISTRY.get(base_class)
+    return dict(reg._map) if reg is not None else {}
+
+
+def _reg_for(base_class, nickname):
+    reg = _REGISTRY.get(base_class)
+    if reg is None:
+        reg = _Registry(nickname)
+        _REGISTRY[base_class] = reg
+    return reg
+
+
+def get_register_func(base_class, nickname):
+    """reference: registry.py:49."""
+    reg = _reg_for(base_class, nickname)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError("can only register subclass of %s"
+                             % base_class.__name__)
+        reg.register(klass, name)
+        return klass
+
+    register.__doc__ = "Register %s to the %s factory" % (
+        base_class.__name__, nickname)
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """reference: registry.py:88."""
+    register_fn = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register_fn(klass, name)
+            return klass
+
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """reference: registry.py:115 — create from a name, a (name, kwargs)
+    pair, a JSON spec string, or pass through an existing instance."""
+    reg = _reg_for(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            if len(args) > 1 or kwargs:
+                raise MXNetError(
+                    "%s is already an instance; additional arguments are "
+                    "invalid" % nickname)
+            return args[0]
+        if args and isinstance(args[0], (list, tuple)):
+            spec = args[0]
+            return create(spec[0], **(spec[1] if len(spec) > 1 else {}))
+        if not args or not isinstance(args[0], str):
+            raise MXNetError("%s.create needs a name string, (name, kwargs) "
+                             "pair, or an instance" % nickname)
+        name = args[0]
+        if name.startswith("[") or name.startswith("{"):
+            spec = json.loads(name)
+            if isinstance(spec, dict):
+                return create(spec["name"], **spec.get("params", {}))
+            return create(spec[0], **(spec[1] if len(spec) > 1 else {}))
+        return reg.create(name, *args[1:], **kwargs)
+
+    create.__doc__ = "Create a %s instance from config" % nickname
+    return create
+
+
+# convenience single-registry aliases matching common reference usage
+def register(base_class, nickname="object"):
+    return get_register_func(base_class, nickname)
+
+
+def alias(base_class, nickname="object"):
+    return get_alias_func(base_class, nickname)
+
+
+def create(base_class, nickname="object"):
+    return get_create_func(base_class, nickname)

@@ -280,3 +280,19 @@ def test_grad_create_graph_snapshot_survives_mutation():
     z.backward()
     # d/dx sum(3x^2) = 6x at the ORIGINAL x = [1, 2]
     np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 12.0], rtol=1e-5)
+
+
+def test_mark_variables_row_sparse_buffer():
+    """mark_variables with a row_sparse gradient buffer takes the sparse
+    write-back path (regression: dense _set_data corrupted the component
+    dict)."""
+    from mxnet_tpu.ndarray import sparse
+
+    w = mx.nd.array(np.ones((4, 2), dtype=np.float32))
+    g = sparse.zeros("row_sparse", (4, 2))
+    autograd.mark_variables([w], [g])
+    with autograd.record():
+        (w * 2).sum().backward()
+    assert w.grad is g and g.stype == "row_sparse"
+    np.testing.assert_allclose(g.tostype("default").asnumpy(),
+                               2 * np.ones((4, 2)), rtol=1e-6)

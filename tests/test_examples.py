@@ -31,8 +31,10 @@ EXAMPLES = [
 @pytest.mark.parametrize("script,args",
                          EXAMPLES, ids=[s for s, _ in EXAMPLES])
 def test_example(script, args):
+    xla_flags = (os.environ.get("XLA_FLAGS", "") +
+                 " --xla_force_host_platform_device_count=8").strip()
     env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               XLA_FLAGS=xla_flags,
                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
                                                              ""))
     res = subprocess.run(

@@ -9,6 +9,7 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import test_utils as tu
+from mxnet_tpu.base import MXNetError
 
 
 def test_assert_almost_equal():
@@ -148,3 +149,46 @@ def test_with_seed_reproducible():
     a = draw()
     b = draw()
     np.testing.assert_allclose(a, b)
+
+
+def test_registry_module():
+    """mx.registry factory surface (reference: python/mxnet/registry.py)."""
+    from mxnet_tpu import registry
+
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+    reg = registry.get_register_func(Base, "thing")
+    alias = registry.get_alias_func(Base, "thing")
+    create = registry.get_create_func(Base, "thing")
+
+    @alias("myalias")
+    class Impl(Base):
+        pass
+
+    reg(Impl)
+    assert isinstance(create("impl"), Impl)
+    assert isinstance(create("myalias", x=5), Impl)
+    assert create("myalias", x=5).x == 5
+    # (name, kwargs) spec, JSON spec, instance pass-through
+    assert create(("impl", {"x": 3})).x == 3
+    assert create('["impl", {"x": 4}]').x == 4
+    inst = Impl()
+    assert create(inst) is inst
+    assert "impl" in registry.get_registry(Base)
+    with pytest.raises(MXNetError):
+        reg(int)  # not a subclass
+
+
+def test_log_module(tmp_path, capsys):
+    from mxnet_tpu import log
+
+    logger = log.get_logger("mxtpu_test_logger", level=log.INFO)
+    logger.info("hello-from-test")
+    f = str(tmp_path / "x.log")
+    flog = log.get_logger("mxtpu_file_logger", filename=f, level=log.DEBUG)
+    flog.debug("to-file")
+    for h in flog.handlers:
+        h.flush()
+    assert "to-file" in open(f).read()
