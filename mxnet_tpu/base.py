@@ -55,6 +55,9 @@ def np_dtype(dtype):
     return _np.dtype(dtype)
 
 
+_ALL_REGISTRIES = {}
+
+
 class _Registry:
     """Simple name->object registry with alias support
     (reference: python/mxnet/registry.py:30 `get_register_func`)."""
@@ -62,6 +65,10 @@ class _Registry:
     def __init__(self, kind):
         self.kind = kind
         self._map = {}
+        # kind-keyed directory so mx.registry's functional surface
+        # (registry.py) resolves onto the SAME storage as the subsystem
+        # registries (optimizer/metric/initializer) — first instance wins
+        _ALL_REGISTRIES.setdefault(kind, self)
 
     def register(self, obj, name=None, aliases=()):
         key = (name or getattr(obj, "__name__", str(obj))).lower()

@@ -42,11 +42,37 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
 @register("_contrib_BilinearResize2D", aliases=("BilinearResize2D", "bilinear_resize_2d"))
 def bilinear_resize_2d(data, height=1, width=1, scale_height=None, scale_width=None,
                        mode="size", align_corners=True):
+    """reference: bilinear_resize-inl.h — the default resize maps corners
+    to corners (align_corners=True, src = dst*(in-1)/(out-1)); with
+    align_corners=False it is the half-pixel convention, which is what
+    jax.image.resize implements."""
     n, c, h, w = data.shape
     if scale_height is not None:
         height = int(h * scale_height)
-        width = int(w * scale_width)
-    return jax.image.resize(data, (n, c, height, width), method="bilinear")
+        width = int(w * (scale_width if scale_width is not None
+                         else scale_height))
+    if not align_corners:
+        return jax.image.resize(data, (n, c, height, width), method="bilinear")
+
+    def axis_coords(in_sz, out_sz):
+        if out_sz == 1:
+            return jnp.zeros((1,))
+        return jnp.linspace(0.0, in_sz - 1.0, out_sz)
+
+    ys = axis_coords(h, height)
+    xs = axis_coords(w, width)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    wy = (ys - y0).astype(data.dtype).reshape((1, 1, height, 1))
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wx = (xs - x0).astype(data.dtype).reshape((1, 1, 1, width))
+    rows0 = jnp.take(data, y0, axis=2)
+    rows1 = jnp.take(data, y1, axis=2)
+    rowi = rows0 * (1 - wy) + rows1 * wy          # (n, c, height, w)
+    c0 = jnp.take(rowi, x0, axis=3)
+    c1 = jnp.take(rowi, x1, axis=3)
+    return c0 * (1 - wx) + c1 * wx
 
 
 @register("_contrib_AdaptiveAvgPooling2D", aliases=("AdaptiveAvgPooling2D",))

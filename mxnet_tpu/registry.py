@@ -27,9 +27,16 @@ def get_registry(base_class):
 
 
 def _reg_for(base_class, nickname):
+    from .base import _ALL_REGISTRIES
+
     reg = _REGISTRY.get(base_class)
     if reg is None:
-        reg = _Registry(nickname)
+        # resolve onto an existing subsystem registry by nickname (the
+        # reference keys by base class; our subsystem registries are
+        # kind-named _Registry instances — optimizer/metric/initializer)
+        reg = _ALL_REGISTRIES.get(nickname) \
+            or _ALL_REGISTRIES.get(base_class.__name__.lower()) \
+            or _Registry(nickname)
         _REGISTRY[base_class] = reg
     return reg
 
@@ -80,6 +87,10 @@ def get_create_func(base_class, nickname):
         if args and isinstance(args[0], (list, tuple)):
             spec = args[0]
             return create(spec[0], **(spec[1] if len(spec) > 1 else {}))
+        if not args and nickname in kwargs:
+            # reference form: create(optimizer='adam', learning_rate=0.1)
+            name = kwargs.pop(nickname)
+            return create(name, **kwargs)
         if not args or not isinstance(args[0], str):
             raise MXNetError("%s.create needs a name string, (name, kwargs) "
                              "pair, or an instance" % nickname)

@@ -192,3 +192,17 @@ def test_log_module(tmp_path, capsys):
     for h in flog.handlers:
         h.flush()
     assert "to-file" in open(f).read()
+
+
+def test_registry_shares_subsystem_storage():
+    """mx.registry resolves onto the SAME registries the subsystems use
+    (regression: a parallel empty store made create('adam') fail)."""
+    from mxnet_tpu import optimizer, registry
+
+    create = registry.get_create_func(optimizer.Optimizer, "optimizer")
+    o = create("adam", learning_rate=1e-3)
+    assert type(o).__name__ == "Adam" and o.lr == 1e-3
+    # reference keyword-name form
+    o2 = create(optimizer="sgd", learning_rate=0.5)
+    assert type(o2).__name__ == "SGD"
+    assert "adam" in registry.get_registry(optimizer.Optimizer)

@@ -317,3 +317,64 @@ def test_flash_attention_vs_torch_sdpa(causal):
     _compare(lambda q_, k_, v_: mx.nd.contrib.flash_attention(
                  q_, k_, v_, causal=causal),
              t_sdpa, [q, k, v], rtol=2e-4, atol=2e-5)
+
+
+def test_bilinear_resize_vs_interpolate():
+    """BilinearResize2D uses align_corners=True semantics (reference:
+    bilinear_resize-inl.h AreaPixelCompute)."""
+    rng = np.random.RandomState(13)
+    x = rng.randn(2, 3, 5, 7).astype(np.float32)
+    got = mx.nd.contrib.BilinearResize2D(mx.nd.array(x), height=9,
+                                         width=11).asnumpy()
+    want = F.interpolate(torch.tensor(x), size=(9, 11), mode="bilinear",
+                         align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # half-pixel convention too
+    got = mx.nd.contrib.BilinearResize2D(mx.nd.array(x), height=9, width=11,
+                                         align_corners=False).asnumpy()
+    want = F.interpolate(torch.tensor(x), size=(9, 11), mode="bilinear",
+                         align_corners=False).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_bilinear_sampler_vs_grid_sample():
+    """BilinearSampler == F.grid_sample(align_corners=True, zeros padding)
+    with the grid transposed from MXNet's (N,2,H,W) to torch's (N,H,W,2)
+    (reference: bilinear_sampler-inl.h)."""
+    rng = np.random.RandomState(14)
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    grid = rng.uniform(-1.2, 1.2, (2, 2, 5, 5)).astype(np.float32)
+    got = mx.nd.BilinearSampler(mx.nd.array(x), mx.nd.array(grid)).asnumpy()
+    tgrid = torch.tensor(grid).permute(0, 2, 3, 1)  # (N, H, W, 2)
+    want = F.grid_sample(torch.tensor(x), tgrid, mode="bilinear",
+                         padding_mode="zeros", align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_depth_to_space_dcr_ordering():
+    """depth_to_space follows ONNX DCR ordering (reference:
+    matrix_op.cc:1041 doc example) — deliberately NOT torch's
+    pixel_shuffle (CRD); emulate DCR in torch to compare."""
+    rng = np.random.RandomState(15)
+    B = 2
+    x = rng.randn(2, 8, 3, 3).astype(np.float32)
+    got = mx.nd.depth_to_space(mx.nd.array(x), block_size=B).asnumpy()
+    t = torch.tensor(x)
+    n, c, h, w = t.shape
+    want = (t.reshape(n, B, B, c // (B * B), h, w)
+            .permute(0, 3, 4, 1, 5, 2)
+            .reshape(n, c // (B * B), h * B, w * B)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # and space_to_depth inverts it
+    back = mx.nd.space_to_depth(mx.nd.array(got), block_size=B).asnumpy()
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+def test_im2col_vs_unfold():
+    rng = np.random.RandomState(16)
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    got = mx.nd.im2col(mx.nd.array(x), kernel=(3, 3), stride=(1, 1),
+                       pad=(1, 1)).asnumpy()
+    want = F.unfold(torch.tensor(x), kernel_size=3, stride=1,
+                    padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
