@@ -32,7 +32,8 @@ _SUBMODULES = [
     "module", "io", "recordio", "image", "kvstore", "gluon", "callback",
     "model", "profiler", "runtime", "test_utils", "visualization", "monitor",
     "parallel", "attribute", "name", "operator", "contrib", "rtc",
-    "torch_bridge", "registry", "log",
+    "torch_bridge", "registry", "log", "libinfo", "util",
+    "kvstore_server",
 ]
 import importlib as _importlib
 import os as _os

@@ -1,0 +1,67 @@
+"""Parameter-server server-role compatibility (reference:
+python/mxnet/kvstore_server.py — the main loop a `DMLC_ROLE=server`
+process runs, receiving ZPush/ZPull and applying the pickled optimizer
+server-side).
+
+Architecture note: this framework replaces the parameter server with XLA
+collectives inside the compiled step (SURVEY §5.8 — kvstore 'dist' is an
+allreduce over the jax.distributed rendezvous). Every process is a worker;
+there are no server processes to run, so `run()` returns immediately
+after logging what replaced it, and `_init_kvstore_server_module()` is a
+no-op for workers — launch scripts written for the reference (which start
+N servers alongside N workers) keep working: the server ranks simply exit
+cleanly instead of blocking in a receive loop."""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """reference: kvstore_server.py KVStoreServer."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def _controller(self):
+        """reference: the cmd-0 handler installs a pickled optimizer; our
+        store applies optimizers worker-side (set_optimizer), so the
+        controller just forwards."""
+
+        def server_controller(cmd_id, cmd_body, _=None):
+            if cmd_id == 0:
+                import pickle
+
+                self.kvstore.set_optimizer(pickle.loads(cmd_body))
+            else:
+                logging.warning("kvstore server: unknown command (%s)",
+                                cmd_id)
+
+        return server_controller
+
+    def run(self):
+        logging.info(
+            "kvstore server role: no PS loop to run — gradients aggregate "
+            "as XLA collectives inside the compiled step (kvstore 'dist' "
+            "over the jax.distributed rendezvous); exiting cleanly")
+
+
+def _init_kvstore_server_module():
+    """reference: kvstore_server.py:79 — block in the server loop when this
+    process was launched with a server role."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server":
+        # no kvstore is created: a store would join the worker rendezvous,
+        # and there is no PS traffic to serve — log the architecture note
+        # (KVStoreServer.run) and exit cleanly
+        KVStoreServer(None).run()
+        return True
+    if role == "scheduler":
+        # the jax.distributed coordinator plays the scheduler; rank 0's
+        # worker process hosts it, so a dedicated scheduler just exits
+        logging.info("kvstore scheduler role: coordinator is hosted by "
+                     "rank 0's worker process; exiting cleanly")
+        return True
+    return False

@@ -1,0 +1,39 @@
+"""Library/include path discovery (reference: python/mxnet/libinfo.py —
+find_lib_path locates libmxnet.so for ctypes consumers, find_include_path
+the C headers). Here the native artifacts are the lazily-built runtime
+libraries (lib/native.py) and the flat C predict ABI header."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+__version__ = "2.0.0.tpu"
+
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "lib")
+
+
+def find_lib_path():
+    """Paths of the native shared objects, built on demand (reference:
+    libinfo.py find_lib_path — raises if no library can be found)."""
+    from .lib import native
+
+    paths = []
+    if native.get() is not None:
+        paths.append(os.path.join(_LIB_DIR, "libmxtpu.so"))
+    if native.get_capi() is not None:
+        paths.append(os.path.join(_LIB_DIR, "libmxtpu_capi.so"))
+    if not paths:
+        raise RuntimeError(
+            "Cannot build/find the native libraries (g++ unavailable?). "
+            "The pure-Python paths still work; the C predict ABI does not.")
+    return paths
+
+
+def find_include_path():
+    """Directory of the C API headers (reference: libinfo.py
+    find_include_path)."""
+    inc = os.path.join(_LIB_DIR, "include")
+    if not os.path.isdir(inc):
+        raise RuntimeError("include directory missing: %s" % inc)
+    return inc

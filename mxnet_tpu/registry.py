@@ -22,8 +22,8 @@ _REGISTRY = {}  # base_class -> _Registry
 def get_registry(base_class):
     """The (class-keyed) registry dict for `base_class` (reference:
     registry.py:32 — returns a copy of the name->class map)."""
-    reg = _REGISTRY.get(base_class)
-    return dict(reg._map) if reg is not None else {}
+    reg = _reg_for(base_class, base_class.__name__.lower())
+    return dict(reg._map)
 
 
 def _reg_for(base_class, nickname):
@@ -33,10 +33,18 @@ def _reg_for(base_class, nickname):
     if reg is None:
         # resolve onto an existing subsystem registry by nickname (the
         # reference keys by base class; our subsystem registries are
-        # kind-named _Registry instances — optimizer/metric/initializer)
-        reg = _ALL_REGISTRIES.get(nickname) \
-            or _ALL_REGISTRIES.get(base_class.__name__.lower()) \
-            or _Registry(nickname)
+        # kind-named _Registry instances — optimizer/metric/initializer).
+        # ONLY framework base classes may claim a subsystem registry:
+        # a third-party class that happens to share a nickname gets its
+        # own isolated store (under a non-colliding kind, so it can't
+        # claim a subsystem slot in _ALL_REGISTRIES either)
+        if (base_class.__module__ or "").startswith("mxnet_tpu"):
+            reg = _ALL_REGISTRIES.get(nickname) \
+                or _ALL_REGISTRIES.get(base_class.__name__.lower())
+        else:
+            reg = None
+        if reg is None:
+            reg = _Registry("%s(%s)" % (nickname, base_class.__name__))
         _REGISTRY[base_class] = reg
     return reg
 

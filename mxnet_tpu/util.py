@@ -1,0 +1,27 @@
+"""General utilities (reference: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["makedirs", "get_gpu_count", "get_gpu_memory"]
+
+
+def makedirs(d):
+    """reference: util.py makedirs (py2 shim upstream; exist_ok here)."""
+    os.makedirs(d, exist_ok=True)
+
+
+def get_gpu_count():
+    """Number of accelerator devices visible (reference: util.py
+    get_gpu_count -> MXGetGPUCount; 'gpu' means 'accelerator' here)."""
+    from .context import num_gpus
+
+    return num_gpus()
+
+
+def get_gpu_memory(dev_id=0):
+    """(free, total) bytes on the accelerator (reference: util.py
+    get_gpu_memory -> MXGetGPUMemoryInformation64)."""
+    from .context import gpu_memory_info
+
+    return gpu_memory_info(dev_id)

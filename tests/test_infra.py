@@ -206,3 +206,60 @@ def test_registry_shares_subsystem_storage():
     o2 = create(optimizer="sgd", learning_rate=0.5)
     assert type(o2).__name__ == "SGD"
     assert "adam" in registry.get_registry(optimizer.Optimizer)
+
+
+def test_libinfo_util_kvstore_server(tmp_path):
+    """Small reference-module shims: libinfo paths, util helpers,
+    kvstore_server role handling (reference: libinfo.py/util.py/
+    kvstore_server.py)."""
+    import os
+
+    from mxnet_tpu import kvstore_server, libinfo, util
+
+    paths = libinfo.find_lib_path()
+    assert paths and all(os.path.exists(p) for p in paths)
+    inc = libinfo.find_include_path()
+    assert os.path.exists(os.path.join(inc, "mxtpu_c_predict_api.h"))
+    assert util.get_gpu_count() >= 0
+    d = str(tmp_path / "a" / "b")
+    util.makedirs(d)
+    assert os.path.isdir(d)
+    # worker role: no server loop
+    assert kvstore_server._init_kvstore_server_module() is False
+
+
+def test_registry_third_party_isolation():
+    """A third-party base class sharing a subsystem nickname must get its
+    own registry (regression: it claimed/polluted the optimizer store)."""
+    from mxnet_tpu import optimizer, registry
+
+    class MyBase:
+        pass
+
+    create = registry.get_create_func(MyBase, "optimizer")
+    with pytest.raises(MXNetError):
+        create("adam")  # NOT resolved onto the real optimizer registry
+    reg = registry.get_register_func(MyBase, "optimizer")
+
+    class Thing(MyBase):
+        pass
+
+    reg(Thing)
+    assert isinstance(create("thing"), Thing)
+    # and the real optimizer registry is untouched
+    assert "thing" not in registry.get_registry(optimizer.Optimizer)
+    assert isinstance(optimizer.create("adam"), optimizer.Adam)
+
+
+def test_kvstore_server_roles(monkeypatch):
+    from mxnet_tpu import kvstore_server
+
+    monkeypatch.setenv("DMLC_ROLE", "scheduler")
+    assert kvstore_server._init_kvstore_server_module() is True
+
+
+def test_kvstore_server_role_exits_cleanly(monkeypatch):
+    from mxnet_tpu import kvstore_server
+
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    assert kvstore_server._init_kvstore_server_module() is True
