@@ -33,7 +33,7 @@ _SUBMODULES = [
     "model", "profiler", "runtime", "test_utils", "visualization", "monitor",
     "parallel", "attribute", "name", "operator", "contrib", "rtc",
     "torch_bridge", "registry", "log", "libinfo", "util",
-    "kvstore_server",
+    "kvstore_server", "executor_manager",
 ]
 import importlib as _importlib
 import os as _os
@@ -42,6 +42,13 @@ for _m in _SUBMODULES:
     if _os.path.exists(_os.path.join(_os.path.dirname(__file__), _m + ".py")) or \
        _os.path.isdir(_os.path.join(_os.path.dirname(__file__), _m)):
         globals()[_m] = _importlib.import_module("." + _m, __name__)
+
+if "kvstore_server" in globals() and _os.environ.get("DMLC_ROLE") in (
+        "server", "scheduler"):
+    # reference parity: mxnet/__init__ runs the PS server loop for
+    # server-role processes; ours logs the collectives architecture note
+    # and exits so reference launch scripts keep a correct worker count
+    kvstore_server._maybe_exit_non_worker()  # noqa: F821
 
 if "symbol" in globals():
     sym = symbol  # noqa: F821

@@ -1,0 +1,182 @@
+"""Legacy multi-device executor manager (reference:
+python/mxnet/executor_manager.py — the pre-Module data-parallel helper
+that FeedForward used: slice the batch across contexts, one bound executor
+per slice, summed gradients).
+
+Functional here, not a stub: each slice binds a jit-compiled Executor
+(executor.py); forward/backward run per-slice and `update_metric`
+aggregates, mirroring DataParallelExecutorManager's surface. New code
+should use Module or gluon.Trainer (as the reference itself advises)."""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros as _nd_zeros
+
+__all__ = ["DataParallelExecutorManager", "_split_input_slice",
+           "_check_arguments"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """reference: executor_manager.py:31 — batch ranges per device,
+    proportional to work_load_list."""
+    total = sum(work_load_list)
+    if batch_size < len(work_load_list):
+        raise MXNetError("batch size %d smaller than device count %d"
+                         % (batch_size, len(work_load_list)))
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            end = batch_size
+        else:
+            end = start + int(round(batch_size * w / total))
+        if end <= start:
+            raise MXNetError("Too many slices. Some splits are empty.")
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+def _check_arguments(symbol):
+    """reference: executor_manager.py:68 — duplicate-name check."""
+    names = symbol.list_arguments() + symbol.list_auxiliary_states()
+    seen = set()
+    for n in names:
+        if n in seen:
+            raise MXNetError(
+                "Find duplicated argument name \"%s\"" % n)
+        seen.add(n)
+
+
+class DataParallelExecutorManager:
+    """reference: executor_manager.py:298. One executor per context; the
+    batch is sliced by `_split_input_slice`; `update_params`-style gradient
+    aggregation is the caller's job (FeedForward/optimizer), exposed via
+    `param_arrays`/`grad_arrays` lists-of-per-device-arrays, like the
+    reference."""
+
+    def __init__(self, symbol, ctx, train_data, arg_params=None,
+                 aux_params=None, param_names=None, arg_names=None,
+                 aux_names=None, work_load_list=None, logger=logging,
+                 sym_gen=None):
+        self.symbol = symbol
+        self.ctx = list(ctx)
+        if work_load_list is None:
+            work_load_list = [1] * len(self.ctx)
+        batch_size = train_data.batch_size
+        self.slices = _split_input_slice(batch_size, work_load_list)
+        _check_arguments(symbol)
+
+        self.arg_names = arg_names or symbol.list_arguments()
+        self.aux_names = aux_names or symbol.list_auxiliary_states()
+        # provide_data entries are DataDesc tuples (name, shape, dtype, ...)
+        data_shapes = {d[0]: tuple(d[1]) for d in train_data.provide_data}
+        label_shapes = {d[0]: tuple(d[1])
+                        for d in (train_data.provide_label or [])}
+        self._data_names = list(data_shapes)
+        self._label_names = list(label_shapes)
+        self.param_names = param_names or [
+            n for n in self.arg_names
+            if n not in data_shapes and n not in label_shapes]
+
+        arg_shapes, _, aux_shapes = symbol.infer_shape(
+            **data_shapes, **label_shapes)
+        # infer_shape returns shapes in the SYMBOL's argument order, which
+        # may differ from a caller-supplied arg_names ordering
+        shape_of = dict(zip(symbol.list_arguments(), arg_shapes))
+        aux_shape_of = dict(zip(symbol.list_auxiliary_states(), aux_shapes))
+
+        self.execs = []
+        self._slice_shapes = []
+        for dev, sl in zip(self.ctx, self.slices):
+            n = sl.stop - sl.start
+            args, grads = {}, {}
+            for name in self.arg_names:
+                if name in data_shapes:
+                    shp = (n,) + data_shapes[name][1:]
+                elif name in label_shapes:
+                    shp = (n,) + label_shapes[name][1:]
+                else:
+                    shp = shape_of[name]
+                args[name] = _nd_zeros(shp, ctx=dev)
+                if name in self.param_names:
+                    grads[name] = _nd_zeros(shp, ctx=dev)
+            aux = {name: _nd_zeros(aux_shape_of[name], ctx=dev)
+                   for name in self.aux_names}
+            from .executor import Executor
+
+            self.execs.append(Executor(symbol, dev, args, args_grad=grads,
+                                       grad_req="write", aux_states=aux))
+            self._slice_shapes.append(n)
+
+        if arg_params is not None:
+            self.set_params(arg_params, aux_params or {})
+        self._monitor = None
+
+    # -- reference surface -------------------------------------------------
+    @property
+    def param_arrays(self):
+        return [[e.arg_dict[name] for e in self.execs]
+                for name in self.param_names]
+
+    @property
+    def grad_arrays(self):
+        return [[e.grad_dict[name] for e in self.execs]
+                for name in self.param_names]
+
+    @property
+    def aux_arrays(self):
+        return [[e.aux_dict[name] for e in self.execs]
+                for name in self.aux_names]
+
+    def install_monitor(self, monitor):
+        for e in self.execs:
+            monitor.install(e)
+
+    def set_params(self, arg_params, aux_params):
+        for e in self.execs:
+            e.copy_params_from(arg_params, aux_params,
+                               allow_extra_params=True)
+
+    def copy_to(self, arg_params, aux_params):
+        """Average params over devices into the dicts (reference:
+        executor_manager.py copy_to)."""
+        for name in self.param_names:
+            vals = [e.arg_dict[name].asnumpy() for e in self.execs]
+            arg_params[name] = NDArray(
+                _np.mean(vals, axis=0).astype(vals[0].dtype))
+        for name in self.aux_names:
+            vals = [e.aux_dict[name].asnumpy() for e in self.execs]
+            aux_params[name] = NDArray(
+                _np.mean(vals, axis=0).astype(vals[0].dtype))
+
+    def load_data_batch(self, data_batch):
+        """Slice the batch across executors (reference: _load_data/_load_label)."""
+        import jax.numpy as jnp
+
+        for names, arrays in ((self._data_names, data_batch.data),
+                              (self._label_names,
+                               data_batch.label or [])):
+            for name, arr in zip(names, arrays):
+                full = arr.asnumpy() if hasattr(arr, "asnumpy") else \
+                    _np.asarray(arr)
+                for e, sl in zip(self.execs, self.slices):
+                    e.arg_dict[name]._set_data(jnp.asarray(full[sl]))
+
+    def forward(self, is_train=False):
+        for e in self.execs:
+            e.forward(is_train=is_train)
+
+    def backward(self):
+        for e in self.execs:
+            e.backward()
+
+    def update_metric(self, metric, labels, pre_sliced=False):
+        for i, (e, sl) in enumerate(zip(self.execs, self.slices)):
+            lab = labels[i] if pre_sliced else \
+                [l[sl.start:sl.stop] for l in labels]
+            metric.update(lab, e.outputs)

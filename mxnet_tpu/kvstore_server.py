@@ -65,3 +65,13 @@ def _init_kvstore_server_module():
                      "rank 0's worker process; exiting cleanly")
         return True
     return False
+
+
+def _maybe_exit_non_worker():
+    """Called from mxnet_tpu/__init__ (the reference calls
+    _init_kvstore_server_module at import): a reference launch script's
+    server/scheduler ranks never execute the training script body — they
+    block in the PS loop. Here they exit(0) instead, keeping the worker
+    world size correct."""
+    if _init_kvstore_server_module():
+        raise SystemExit(0)

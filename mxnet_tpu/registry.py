@@ -22,11 +22,12 @@ _REGISTRY = {}  # base_class -> _Registry
 def get_registry(base_class):
     """The (class-keyed) registry dict for `base_class` (reference:
     registry.py:32 — returns a copy of the name->class map)."""
-    reg = _reg_for(base_class, base_class.__name__.lower())
-    return dict(reg._map)
+    reg = _reg_for(base_class, base_class.__name__.lower(),
+                   create_if_missing=False)
+    return dict(reg._map) if reg is not None else {}
 
 
-def _reg_for(base_class, nickname):
+def _reg_for(base_class, nickname, create_if_missing=True):
     from .base import _ALL_REGISTRIES
 
     reg = _REGISTRY.get(base_class)
@@ -39,11 +40,23 @@ def _reg_for(base_class, nickname):
         # own isolated store (under a non-colliding kind, so it can't
         # claim a subsystem slot in _ALL_REGISTRIES either)
         if (base_class.__module__ or "").startswith("mxnet_tpu"):
-            reg = _ALL_REGISTRIES.get(nickname) \
-                or _ALL_REGISTRIES.get(base_class.__name__.lower())
+            cls_lower = base_class.__name__.lower()
+            for cand in (nickname, cls_lower):
+                reg = _ALL_REGISTRIES.get(cand)
+                if reg is not None:
+                    break
+            else:
+                # suffix match: EvalMetric -> 'metric' (the subsystem
+                # kinds are the trailing word of the base-class name)
+                for kind, r in _ALL_REGISTRIES.items():
+                    if cls_lower.endswith(kind):
+                        reg = r
+                        break
         else:
             reg = None
         if reg is None:
+            if not create_if_missing:
+                return None
             reg = _Registry("%s(%s)" % (nickname, base_class.__name__))
         _REGISTRY[base_class] = reg
     return reg

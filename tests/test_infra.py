@@ -263,3 +263,22 @@ def test_kvstore_server_role_exits_cleanly(monkeypatch):
 
     monkeypatch.setenv("DMLC_ROLE", "server")
     assert kvstore_server._init_kvstore_server_module() is True
+
+
+def test_get_registry_does_not_poison(monkeypatch):
+    """get_registry on a framework base whose name differs from the kind
+    (EvalMetric vs 'metric') resolves the subsystem store and must not
+    cache an isolated registry (regression)."""
+    from mxnet_tpu import metric, registry
+
+    m = registry.get_registry(metric.EvalMetric)
+    assert "accuracy" in m
+    # and registration after the read still lands in the real store
+    reg = registry.get_register_func(metric.EvalMetric, "metric")
+
+    class _ProbeMetric(metric.EvalMetric):
+        def __init__(self):
+            super().__init__("probe")
+
+    reg(_ProbeMetric, "probe_metric_xyz")
+    assert "probe_metric_xyz" in registry.get_registry(metric.EvalMetric)

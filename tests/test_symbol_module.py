@@ -216,3 +216,58 @@ class TestModule:
                        provide_label=[("softmax_label", (4,))])
         mod.forward(b2, is_train=False)
         assert out16 == mod.get_outputs()[0].shape
+
+
+def test_executor_manager_data_parallel():
+    """Legacy DataParallelExecutorManager (reference:
+    executor_manager.py:298): batch sliced over two cpu contexts, per-slice
+    executors, metric aggregation, param averaging via copy_to."""
+    from mxnet_tpu.executor_manager import (DataParallelExecutorManager,
+                                            _split_input_slice)
+    from mxnet_tpu.io import DataBatch, NDArrayIter
+
+    assert _split_input_slice(10, [1, 1]) == [slice(0, 5), slice(5, 10)]
+    assert _split_input_slice(9, [2, 1]) == [slice(0, 6), slice(6, 9)]
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 6).astype(np.float32)
+    Y = (X[:, :3].argmax(1)).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+
+    data = mx.sym.var("data")
+    h = mx.sym.relu(mx.sym.FullyConnected(data=data, num_hidden=8,
+                                          name="fc1"))
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data=h, num_hidden=3, name="fc2"),
+        mx.sym.var("softmax_label"), name="softmax")
+
+    mgr = DataParallelExecutorManager(
+        out, [mx.cpu(0), mx.cpu(1)], it,
+        param_names=["fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"])
+    # init params on every device
+    arg_params = {n: mx.nd.array(rng.uniform(-0.1, 0.1, s).astype(np.float32))
+                  for n, s in zip(
+                      out.list_arguments(),
+                      out.infer_shape(data=(8, 6),
+                                      softmax_label=(8,))[0])
+                  if n not in ("data", "softmax_label")}
+    mgr.set_params(arg_params, {})
+
+    metric = mx.metric.Accuracy()
+    it.reset()
+    for batch in it:
+        mgr.load_data_batch(batch)
+        mgr.forward(is_train=True)
+        mgr.backward()
+        mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] >= 0.0  # aggregated over slices without error
+    # grads exist per device per param
+    assert len(mgr.grad_arrays) == 4 and len(mgr.grad_arrays[0]) == 2
+    g = mgr.grad_arrays[0][0].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # param averaging
+    out_args, out_aux = {}, {}
+    mgr.copy_to(out_args, out_aux)
+    np.testing.assert_allclose(out_args["fc1_weight"].asnumpy(),
+                               arg_params["fc1_weight"].asnumpy(),
+                               rtol=1e-5)
