@@ -63,6 +63,10 @@ class DataParallelExecutorManager:
                  aux_params=None, param_names=None, arg_names=None,
                  aux_names=None, work_load_list=None, logger=logging,
                  sym_gen=None):
+        if sym_gen is not None:
+            raise MXNetError(
+                "sym_gen (bucketing) is not supported by this manager; "
+                "use BucketingModule (module/bucketing_module.py)")
         self.symbol = symbol
         self.ctx = list(ctx)
         if work_load_list is None:
@@ -109,8 +113,13 @@ class DataParallelExecutorManager:
                    for name in self.aux_names}
             from .executor import Executor
 
+            # grads only for params (Module nulls data/label reqs the
+            # same way, module/module.py) — labels are often int dtype and
+            # must not enter the VJP's wrt set
+            req = {name: ("write" if name in self.param_names else "null")
+                   for name in self.arg_names}
             self.execs.append(Executor(symbol, dev, args, args_grad=grads,
-                                       grad_req="write", aux_states=aux))
+                                       grad_req=req, aux_states=aux))
             self._slice_shapes.append(n)
 
         if arg_params is not None:
@@ -162,10 +171,13 @@ class DataParallelExecutorManager:
                               (self._label_names,
                                data_batch.label or [])):
             for name, arr in zip(names, arrays):
-                full = arr.asnumpy() if hasattr(arr, "asnumpy") else \
-                    _np.asarray(arr)
+                # device arrays slice on-device; only host sources copy
+                if isinstance(arr, NDArray):
+                    full = arr._data
+                else:
+                    full = jnp.asarray(_np.asarray(arr))
                 for e, sl in zip(self.execs, self.slices):
-                    e.arg_dict[name]._set_data(jnp.asarray(full[sl]))
+                    e.arg_dict[name]._set_data(full[sl])
 
     def forward(self, is_train=False):
         for e in self.execs:
