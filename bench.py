@@ -408,13 +408,23 @@ def bench_lstm():
     print(json.dumps(out))
 
 
-def _device_watchdog(timeout_s=240):
+def _device_watchdog(timeout_s=None):
     """Fail fast (with a diagnosable JSON line) when the accelerator tunnel
     is unreachable: jax.devices() on a wedged PJRT tunnel blocks forever,
     which would make the whole bench time out with no output. The probe
     runs in a daemon thread; on timeout we print the failure as JSON and
-    exit non-zero so the captured artifact explains itself."""
+    exit non-zero so the captured artifact explains itself.
+
+    A transiently-wedged tunnel at t=0 may come back — the dial is retried
+    (the probe thread stays blocked in the same jax.devices() call, which
+    completes whenever the tunnel answers; we just keep waiting) with a
+    progress note every 60s, up to MXTPU_BENCH_DIAL_RETRY_S total (default
+    900s) before declaring the device unreachable."""
+    import sys
     import threading
+
+    if timeout_s is None:
+        timeout_s = int(os.environ.get("MXTPU_BENCH_DIAL_RETRY_S", 900))
 
     done = threading.Event()
     err = []
@@ -434,7 +444,15 @@ def _device_watchdog(timeout_s=240):
                   MODE, "resnet50_train_bs32_imgs_per_sec")
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    if not done.wait(timeout_s):
+    waited = 0
+    ok = done.wait(min(60, timeout_s))
+    while not ok and waited + 60 < timeout_s:
+        waited += 60
+        print("bench: accelerator dial still blocked after %ds; retrying "
+              "(up to %ds, MXTPU_BENCH_DIAL_RETRY_S)" % (waited, timeout_s),
+              file=sys.stderr, flush=True)
+        ok = done.wait(min(60, timeout_s - waited))
+    if not ok:
         print(json.dumps({
             "metric": metric,
             "value": None, "unit": None, "vs_baseline": None,

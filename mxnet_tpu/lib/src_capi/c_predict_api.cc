@@ -213,24 +213,39 @@ int MXPredCreateMultiThread(const char *symbol_json_str,
                             const mx_uint *input_shape_indptr,
                             const mx_uint *input_shape_data, int num_threads,
                             PredictorHandle *out) {
-  // one independent predictor per thread (reference semantics: shared
-  // weights, private input/output buffers; XLA executables are shared via
-  // the process-wide compile cache, so the per-predictor cost is small)
-  for (int i = 0; i < num_threads; ++i) {
-    int rc = create_impl(symbol_json_str, param_bytes, param_size, dev_type,
-                         dev_id, num_input_nodes, input_keys,
-                         input_shape_indptr, input_shape_data, 0, nullptr,
-                         &out[i]);
-    if (rc != 0) {
-      for (int j = 0; j < i; ++j) {
-        Pred *h = static_cast<Pred *>(out[j]);
-        GIL gil;
-        Py_DECREF(h->obj);
-        delete h;
-        out[j] = nullptr;
-      }
-      return rc;
+  // reference semantics (c_predict_api.cc:216): ONE parse of param_bytes
+  // and one device copy of the weights, shared across every per-thread
+  // predictor; only input/output buffers are private. The first predictor
+  // is the prototype; the rest are shared-weight clones.
+  auto cleanup = [&](int upto) {
+    for (int j = 0; j < upto; ++j) {
+      Pred *h = static_cast<Pred *>(out[j]);
+      GIL gil;
+      Py_DECREF(h->obj);
+      delete h;
+      out[j] = nullptr;
     }
+  };
+  if (num_threads <= 0) return 0;
+  int rc = create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                       dev_id, num_input_nodes, input_keys,
+                       input_shape_indptr, input_shape_data, 0, nullptr,
+                       &out[0]);
+  if (rc != 0) return rc;
+  Pred *proto = static_cast<Pred *>(out[0]);
+  for (int i = 1; i < num_threads; ++i) {
+    GIL gil;
+    PyObject *args = Py_BuildValue("(O)", proto->obj);
+    PyObject *res = args ? call_bridge("_capi_clone_shared", args) : nullptr;
+    Py_XDECREF(args);
+    if (res == nullptr) {
+      set_error_from_python();
+      cleanup(i);
+      return -1;
+    }
+    Pred *nh = new Pred();
+    nh->obj = res;
+    out[i] = nh;
   }
   return 0;
 }

@@ -41,7 +41,7 @@ class Predictor:
     """
 
     def __init__(self, symbol_file, param_file=None, ctx=None,
-                 input_shapes=None, output_names=None):
+                 input_shapes=None, output_names=None, input_dtypes=None):
         self._ctx = ctx or current_context()
         if isinstance(symbol_file, sym_mod.Symbol):
             symbol = symbol_file
@@ -70,23 +70,44 @@ class Predictor:
         if not input_shapes:
             raise MXNetError("input_shapes is required (as in MXPredCreate)")
         self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        # declared input dtypes (default float32, the reference predict
+        # API's only dtype — c_predict_api.h mx_float); int inputs
+        # (embedding token ids) are declared here so the bound buffer,
+        # set_input casts and the AOT export contract all agree
+        self._input_dtypes = {k: _np.dtype(_np.float32)
+                              for k in self._input_shapes}
+        self._input_dtypes.update(
+            {k: _np.dtype(v) for k, v in (input_dtypes or {}).items()})
         self._inputs = {}
         self._outputs = None
         self._bind()
 
-    def _bind(self):
+    def _bind(self, shared=None):
+        """shared: another Predictor whose non-input device buffers
+        (weights + aux) this one reuses — the reference's
+        MXPredCreateMultiThread / MXPredReshape semantics
+        (c_predict_api.cc:216,347 share weights across executors;
+        only input/output buffers are private)."""
         args = {}
         for name in self._symbol.list_arguments():
             if name in self._input_shapes:
-                args[name] = nd.zeros(self._input_shapes[name], ctx=self._ctx)
+                args[name] = nd.zeros(self._input_shapes[name],
+                                      ctx=self._ctx,
+                                      dtype=self._input_dtypes[name])
+            elif shared is not None and name in shared._args:
+                args[name] = shared._args[name]
             elif name in self._arg_params:
                 args[name] = self._arg_params[name].as_in_context(self._ctx)
             else:
                 raise MXNetError(
                     "argument '%s' has neither a param nor an input shape"
                     % name)
-        aux = {k: v.as_in_context(self._ctx)
-               for k, v in self._aux_params.items()}
+        if shared is not None:
+            aux = shared._aux_bound
+        else:
+            aux = {k: v.as_in_context(self._ctx)
+                   for k, v in self._aux_params.items()}
+        self._aux_bound = aux
         self._exe = self._symbol.bind(self._ctx, args=args, grad_req="null",
                                       aux_states=aux)
         self._args = args
@@ -98,7 +119,8 @@ class Predictor:
             raise MXNetError("'%s' is not an input (inputs: %s)"
                              % (name, sorted(self._input_shapes)))
         arr = data if isinstance(data, nd.NDArray) else \
-            nd.array(_np.asarray(data, dtype=_np.float32), ctx=self._ctx)
+            nd.array(_np.asarray(data, dtype=self._input_dtypes[name]),
+                     ctx=self._ctx)
         if tuple(arr.shape) != self._input_shapes[name]:
             raise MXNetError("input '%s' shape %s != declared %s (use "
                              "reshape())" % (name, arr.shape,
@@ -151,7 +173,6 @@ class Predictor:
 
         import jax
         import jax.export
-        import jax.numpy as jnp
 
         names = sorted(self._input_shapes)
         consts = {k: v._data for k, v in self._args.items()
@@ -165,7 +186,12 @@ class Predictor:
             outs, _ = self._symbol._interpret(vals, is_train=False)
             return tuple(outs)
 
-        structs = [jax.ShapeDtypeStruct(self._input_shapes[n], jnp.float32)
+        # trace each input at its DECLARED dtype (int32 token ids for
+        # embedding models, not a blanket float32) so the AOT artifact's
+        # input contract matches the live Predictor's
+        in_dtypes = {n: self._input_dtypes[n].name for n in names}
+        structs = [jax.ShapeDtypeStruct(self._input_shapes[n],
+                                        _np.dtype(in_dtypes[n]))
                    for n in names]
         exported = jax.export.export(
             jax.jit(fwd), platforms=_export_platforms())(*structs)
@@ -173,6 +199,7 @@ class Predictor:
         header = _json.dumps({
             "input_names": names,
             "input_shapes": {n: list(self._input_shapes[n]) for n in names},
+            "input_dtypes": in_dtypes,
             "output_shapes": [list(s) for s in out_shapes],
             "platforms": list(exported.platforms),
         }).encode()
@@ -214,10 +241,13 @@ class CompiledPredictor:
     (set_input/forward/get_output — the predict-API shape, c_predict_api.h),
     minus reshape: like a TensorRT engine, geometry is frozen at build."""
 
-    def __init__(self, exported, input_names, input_shapes, output_shapes):
+    def __init__(self, exported, input_names, input_shapes, output_shapes,
+                 input_dtypes=None):
         self._exported = exported
         self._input_names = list(input_names)
         self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        self._input_dtypes = {k: _np.dtype(v)
+                              for k, v in (input_dtypes or {}).items()}
         self._output_shapes = [tuple(s) for s in output_shapes]
         self._inputs = {}
         self._outputs = None
@@ -240,14 +270,16 @@ class CompiledPredictor:
         exported = jax.export.deserialize(bytearray(raw[8 + hlen:]))
         return CompiledPredictor(exported, header["input_names"],
                                  header["input_shapes"],
-                                 header["output_shapes"])
+                                 header["output_shapes"],
+                                 header.get("input_dtypes"))
 
     def set_input(self, name, data):
         if name not in self._input_shapes:
             raise MXNetError("'%s' is not an input (inputs: %s)"
                              % (name, self._input_names))
         arr = _np.asarray(data.asnumpy() if hasattr(data, "asnumpy")
-                          else data, dtype=_np.float32)
+                          else data,
+                          dtype=self._input_dtypes.get(name, _np.float32))
         if tuple(arr.shape) != self._input_shapes[name]:
             raise MXNetError("input '%s' shape %s != frozen %s (AOT "
                              "artifacts have TensorRT-engine semantics: "
@@ -338,9 +370,40 @@ def _capi_output_shape(pred, index):
     return tuple(int(d) for d in pred.get_output_shape(int(index)))
 
 
+def _clone_with(pred, input_shapes, shared):
+    """New Predictor over the same symbol/params at `input_shapes`,
+    optionally sharing `shared`'s device weight buffers."""
+    new = Predictor.__new__(Predictor)
+    new._ctx = pred._ctx
+    new._symbol = pred._symbol
+    new._arg_params = pred._arg_params
+    new._aux_params = pred._aux_params
+    new._input_shapes = dict(input_shapes)
+    new._input_dtypes = dict(pred._input_dtypes)
+    new._inputs = {}
+    new._outputs = None
+    new._bind(shared=shared)
+    return new
+
+
 def _capi_reshape(pred, input_shapes):
-    pred.reshape(dict(input_shapes))
-    return pred
+    """reference: MXPredReshape (c_predict_api.cc:347) — builds a NEW
+    predictor at the new geometry sharing the original's weights; the
+    handle passed in stays valid at its old shapes."""
+    shapes = {k: tuple(v) for k, v in dict(input_shapes).items()}
+    unknown = set(shapes) - set(pred._input_shapes)
+    if unknown:
+        raise MXNetError("MXPredReshape: %s are not inputs (inputs: %s)"
+                         % (sorted(unknown), sorted(pred._input_shapes)))
+    merged = dict(pred._input_shapes)
+    merged.update(shapes)
+    return _clone_with(pred, merged, shared=pred)
+
+
+def _capi_clone_shared(pred):
+    """reference: MXPredCreateMultiThread (c_predict_api.cc:216) — per-
+    thread predictor sharing the prototype's weights; private IO buffers."""
+    return _clone_with(pred, pred._input_shapes, shared=pred)
 
 
 def _capi_ndlist(raw):

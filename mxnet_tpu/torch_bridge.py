@@ -47,8 +47,23 @@ def available():
 
 
 def to_torch(arr):
-    """NDArray -> torch.Tensor (host, zero-copy via DLPack where possible)."""
+    """NDArray -> torch.Tensor (host, zero-copy via DLPack where possible).
+
+    torch-cpu cannot import an accelerator DLPack capsule, so when the
+    buffer lives on a TPU/GPU device it is copied to the host first (the
+    documented host-sync of every bridged op); zero-copy only on CPU."""
     torch = _torch()
+    import numpy as _np
+
+    data = arr._data
+    try:
+        on_cpu = all(d.platform == "cpu" for d in data.devices())
+    except Exception:  # noqa: BLE001 — fall back to the safe host copy
+        on_cpu = False
+    if not on_cpu:
+        # np.asarray(jax_array) is read-only and numpy refuses DLPack
+        # export of read-only buffers; from_numpy on a fresh copy instead
+        return torch.from_numpy(_np.array(data, copy=True))
     return torch.from_dlpack(arr.to_dlpack_for_read())
 
 

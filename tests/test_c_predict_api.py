@@ -226,3 +226,80 @@ def test_c_ndlist(tmp_path):
                                   arrs["mean_img"].asnumpy())
     np.testing.assert_array_equal(seen["std"], arrs["std"].asnumpy())
     assert lib.MXNDListFree(handle) == 0
+
+
+def test_c_predict_reshape_leaves_original_valid(tmp_path):
+    """ADVICE r2: MXPredReshape must return a NEW predictor and leave the
+    handle passed in valid at its OLD geometry (reference
+    c_predict_api.cc:347 builds a new MXAPIPredictor)."""
+    lib = _capi()
+    sym_json, param_bytes, x, ref_out = _export_mlp(tmp_path)
+    handle = _create(lib, sym_json, param_bytes, x.shape)
+
+    new_shape = (7, x.shape[1])
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    sdata = (ctypes.c_uint * 2)(*new_shape)
+    out_h = ctypes.c_void_p()
+    rc = lib.MXPredReshape(1, keys, indptr, sdata, handle,
+                           ctypes.byref(out_h))
+    assert rc == 0, lib.MXGetLastError().decode()
+
+    # the ORIGINAL handle still runs at its old batch=2 geometry and
+    # produces the pre-reshape reference output
+    xb = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    assert lib.MXPredSetInput(handle, b"data",
+                              xb.ctypes.data_as(
+                                  ctypes.POINTER(ctypes.c_float)),
+                              xb.size) == 0, lib.MXGetLastError().decode()
+    assert lib.MXPredForward(handle) == 0
+    shape_ptr = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    lib.MXPredGetOutputShape(handle, 0, ctypes.byref(shape_ptr),
+                             ctypes.byref(ndim))
+    assert shape_ptr[0] == x.shape[0]
+    n = int(np.prod([shape_ptr[j] for j in range(ndim.value)]))
+    buf = np.empty(n, np.float32)
+    assert lib.MXPredGetOutput(handle, 0,
+                               buf.ctypes.data_as(
+                                   ctypes.POINTER(ctypes.c_float)),
+                               n) == 0
+    np.testing.assert_allclose(buf.reshape(ref_out.shape), ref_out,
+                               rtol=1e-5, atol=1e-6)
+    lib.MXPredFree(out_h)
+    lib.MXPredFree(handle)
+
+
+def test_c_predict_multithread(tmp_path):
+    """MXPredCreateMultiThread: every per-thread handle runs and agrees
+    with the in-process reference output (weights parsed once, shared —
+    reference c_predict_api.cc:216)."""
+    lib = _capi()
+    sym_json, param_bytes, x, ref_out = _export_mlp(tmp_path)
+    nthreads = 3
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, len(x.shape))
+    sdata = (ctypes.c_uint * len(x.shape))(*x.shape)
+    handles = (ctypes.c_void_p * nthreads)()
+    rc = lib.MXPredCreateMultiThread(
+        sym_json.encode(), param_bytes, len(param_bytes), 1, 0,
+        1, keys, indptr, sdata, nthreads, handles)
+    assert rc == 0, lib.MXGetLastError().decode()
+    xb = np.ascontiguousarray(x, dtype=np.float32).ravel()
+    for i in range(nthreads):
+        h = ctypes.c_void_p(handles[i])
+        assert lib.MXPredSetInput(h, b"data",
+                                  xb.ctypes.data_as(
+                                      ctypes.POINTER(ctypes.c_float)),
+                                  xb.size) == 0
+        assert lib.MXPredForward(h) == 0
+        n = int(np.prod(ref_out.shape))
+        buf = np.empty(n, np.float32)
+        assert lib.MXPredGetOutput(h, 0,
+                                   buf.ctypes.data_as(
+                                       ctypes.POINTER(ctypes.c_float)),
+                                   n) == 0
+        np.testing.assert_allclose(buf.reshape(ref_out.shape), ref_out,
+                                   rtol=1e-5, atol=1e-6)
+    for i in range(nthreads):
+        lib.MXPredFree(ctypes.c_void_p(handles[i]))

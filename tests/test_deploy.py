@@ -163,3 +163,56 @@ def test_aot_compiled_predictor_roundtrip(tmp_path):
     raw = je.deserialize(bytearray(blob[18 + hlen:]))
     np.testing.assert_allclose(np.asarray(raw.call(x.asnumpy())[0]), ref,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_multithread_clone_shares_weight_buffers(tmp_path):
+    """ADVICE r2: per-thread predictors share the prototype's device weight
+    buffers (no N-fold weight memory); only input buffers are private."""
+    from mxnet_tpu.predict import _capi_clone_shared
+
+    net = _make_net()
+    net.hybridize()
+    x = mx.nd.array(np.random.uniform(-1, 1, (2, 8)).astype(np.float32))
+    net(x)
+    prefix = str(tmp_path / "mt")
+    net.export(prefix, epoch=0)
+    proto = Predictor(prefix + "-symbol.json", prefix + "-0000.params",
+                      input_shapes={"data": (2, 8)})
+    clone = _capi_clone_shared(proto)
+    for name, buf in proto._args.items():
+        if name == "data":
+            assert clone._args[name] is not buf
+        else:
+            assert clone._args[name] is buf
+    ref = proto.forward(data=x).get_output(0).asnumpy()
+    got = clone.forward(data=x).get_output(0).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_export_compiled_preserves_input_dtype(tmp_path):
+    """ADVICE r2: AOT export traces inputs at their live dtype (int32
+    token ids for embedding models), not a blanket float32."""
+    from mxnet_tpu.predict import CompiledPredictor, Predictor
+
+    net = nn.HybridSequential(prefix="emb_")
+    with net.name_scope():
+        net.add(nn.Embedding(11, 6), nn.Dense(3, flatten=True))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    tok = mx.nd.array(np.array([[1, 4, 9], [0, 2, 7]], np.int32),
+                      dtype=np.int32)
+    net(tok)
+    prefix = str(tmp_path / "emb")
+    net.export(prefix, epoch=0)
+
+    pred = Predictor(prefix + "-symbol.json", prefix + "-0000.params",
+                     input_shapes={"data": (2, 3)},
+                     input_dtypes={"data": np.int32})
+    ref = pred.forward(data=tok.asnumpy()).get_output(0).asnumpy()
+
+    path = str(tmp_path / "emb.mxaot")
+    pred.export_compiled(path)
+    comp = CompiledPredictor.load(path)
+    assert comp._input_dtypes["data"] == np.dtype(np.int32)
+    got = comp.forward(data=tok.asnumpy()).get_output(0).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Opportunistic on-chip perf capture (VERDICT r2 "make perf evidence exist").
+#
+# Loops probing the accelerator tunnel (a wedged axon PJRT dial blocks
+# jax.devices() forever — each probe is a fresh subprocess under `timeout`).
+# The moment the chip answers, runs bench.py in all four modes plus the
+# real-chip smoke suite and writes the artifacts into the repo so a green
+# perf number exists regardless of tunnel luck at snapshot time.
+#
+# Usage: tools/bench_capture.sh [tag]       (default tag: local_r03)
+set -u
+cd "$(dirname "$0")/.."
+TAG="${1:-local_r03}"
+PROBE_TIMEOUT="${MXTPU_PROBE_TIMEOUT:-120}"
+SLEEP="${MXTPU_PROBE_INTERVAL:-60}"
+
+probe() {
+  timeout "$PROBE_TIMEOUT" python -c "
+import jax
+d = jax.devices()[0]
+print(d.platform, d.device_kind)
+" 2>/dev/null
+}
+
+echo "[bench_capture] probing accelerator every ${SLEEP}s..." >&2
+while true; do
+  KIND=$(probe) && [ -n "$KIND" ] && break
+  echo "[bench_capture] $(date -u +%H:%M:%S) probe failed/hung; retrying" >&2
+  sleep "$SLEEP"
+done
+echo "[bench_capture] device up: $KIND" >&2
+
+for MODE in train score bert lstm; do
+  OUT="BENCH_${TAG}_${MODE}.json"
+  echo "[bench_capture] running mode=$MODE -> $OUT" >&2
+  MXTPU_BENCH_MODE=$MODE MXTPU_BENCH_DIAL_RETRY_S=300 \
+    timeout 1800 python bench.py > "$OUT" 2> "BENCH_${TAG}_${MODE}.log"
+  echo "[bench_capture] $MODE rc=$? $(cat "$OUT" 2>/dev/null | head -c 300)" >&2
+done
+
+echo "[bench_capture] running tpu smoke suite" >&2
+MXTPU_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_smoke.py -v \
+  > "TPU_SMOKE_${TAG}.log" 2>&1
+echo "[bench_capture] smoke rc=$?" >&2
+echo "[bench_capture] done" >&2
