@@ -164,9 +164,11 @@ class BERTModel(HybridBlock):
                                               dropout=dropout)
             self.pooler = nn.Dense(units, activation="tanh", prefix="pooler_")
 
-    def hybrid_forward(self, F, inputs, token_types=None, valid_length=None):
-        """inputs: (B, L) int token ids. Returns (sequence_out (B, L, C),
-        pooled_out (B, C))."""
+    def _embed_prelude(self, F, inputs, token_types=None, valid_length=None):
+        """Embedding front: token+segment+position embed, norm, dropout and
+        the (B, Lq, Lk) 1/0 attention mask from per-sample valid lengths —
+        the single source of truth for both hybrid_forward and
+        pipeline_stages."""
         b, l = inputs.shape[0], inputs.shape[1]
         x = self.word_embed(inputs)
         if token_types is not None:
@@ -178,14 +180,36 @@ class BERTModel(HybridBlock):
             x = self.embed_dropout(x)
         mask = None
         if valid_length is not None:
-            # (B, Lq, Lk) 1/0 mask from per-sample valid lengths
             steps = F.arange(0, l)
             mask = (steps.expand_dims(0) <
                     valid_length.astype("float32").expand_dims(1)) \
                 .expand_dims(1).broadcast_to((b, l, l))
+        return x, mask
+
+    def _pool_postlude(self, seq):
+        """CLS-token pooler (the back end of the pipeline decomposition)."""
+        b = seq.shape[0]
+        return self.pooler(seq.slice_axis(1, 0, 1).reshape((b, self._units)))
+
+    def hybrid_forward(self, F, inputs, token_types=None, valid_length=None):
+        """inputs: (B, L) int token ids. Returns (sequence_out (B, L, C),
+        pooled_out (B, C))."""
+        x, mask = self._embed_prelude(F, inputs, token_types, valid_length)
         seq = self.encoder(x, mask)
-        pooled = self.pooler(seq.slice_axis(1, 0, 1).reshape((b, self._units)))
-        return seq, pooled
+        return seq, self._pool_postlude(seq)
+
+    def pipeline_stages(self):
+        """Decompose for parallel.PipelineTrainer: (prelude, cells,
+        postlude). prelude embeds tokens (replicated); cells are the
+        homogeneous encoder layers (pipelined over `pp`); postlude pools.
+        The pooled vector is returned as the prediction (sequence output
+        stays available by calling the model directly)."""
+        from ... import ndarray as F
+
+        def prelude(inputs, token_types=None, valid_length=None):
+            return self._embed_prelude(F, inputs, token_types, valid_length)
+
+        return prelude, list(self.encoder.cells), self._pool_postlude
 
 
 def bert_12_768_12(vocab_size=30522, **kwargs):

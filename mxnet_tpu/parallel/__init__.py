@@ -11,6 +11,8 @@ with mesh-sharded compiled steps:
                 sharded step with donated buffers
   ring_attention — exact sequence-parallel attention over the sp axis
   pipeline    — GPipe-style microbatch pipeline over the pp axis
+  pipeline_trainer — PipelineTrainer: pipeline a real Gluon model
+                (BERT encoder stack) end-to-end incl. optimizer
   (expert parallelism: gluon.contrib.moe.MoEFFN + the `ep` sharding rule)
 """
 from .mesh import (make_mesh, default_mesh, current_mesh, use_mesh,
@@ -23,6 +25,7 @@ from .collectives import (init_process_group, rank, num_workers, barrier,
 from .trainer import DistributedTrainer
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply, pipeline_stack_params
+from .pipeline_trainer import PipelineTrainer
 
 __all__ = [
     "make_mesh", "default_mesh", "current_mesh", "use_mesh", "local_devices",
@@ -31,5 +34,5 @@ __all__ = [
     "param_spec", "constraint", "collectives", "init_process_group", "rank",
     "num_workers", "barrier", "all_reduce_arrays", "DistributedTrainer",
     "ring_attention", "ring_attention_sharded",
-    "pipeline_apply", "pipeline_stack_params",
+    "pipeline_apply", "pipeline_stack_params", "PipelineTrainer",
 ]

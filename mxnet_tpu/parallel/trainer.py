@@ -42,6 +42,43 @@ def _tree_map(fn, *trees):
         is_leaf=lambda x: x is not None and not isinstance(x, (list, tuple, dict)))
 
 
+def _host_lr(optimizer):
+    """Current learning rate resolved on the host (scheduler included)."""
+    o = optimizer
+    return float(o.lr_scheduler(max(o.num_update, 1))) if o.lr_scheduler \
+        else o.lr
+
+
+def _traced_update(optimizer, ctx, keys, weights, grads, states, t, lr):
+    """Trace optimizer.update() for each weight key with the update count
+    and learning rate fed as device scalars, so ONE executable serves every
+    step (no per-step recompile from e.g. Adam's bias correction). The
+    optimizer's host-side counters/scheduler are stubbed out for the trace
+    and restored after. Shared by DistributedTrainer and PipelineTrainer."""
+    from ..ndarray import NDArray
+
+    o = optimizer
+    saved = (o._index_update_count.copy(), o.num_update, o.lr,
+             o.lr_scheduler, o._update_count)
+    try:
+        o._index_update_count = {i: t for i in keys}
+        o._update_count = lambda index: None
+        o.lr_scheduler = None
+        o.lr = lr
+        new_w, new_s = [], []
+        for k, i in enumerate(keys):
+            w = NDArray(weights[k], ctx=ctx)
+            g = NDArray(grads[k], ctx=ctx)
+            s = _tree_map(lambda a: NDArray(a, ctx=ctx), states[k])
+            o.update_multi_precision(i, w, g, s)
+            new_w.append(w._data)
+            new_s.append(_tree_map(lambda nd_: nd_._data, s))
+        return new_w, new_s
+    finally:
+        (o._index_update_count, o.num_update, o.lr, o.lr_scheduler,
+         o._update_count) = saved
+
+
 class DistributedTrainer:
     """Compiled sharded training over a mesh.
 
@@ -96,7 +133,13 @@ class DistributedTrainer:
         for name, p, nd_ in zip(self._param_names, self._params, self._param_nds):
             sh = self._rules.sharding_for(name, nd_.shape, self._mesh)
             self._shardings.append(sh)
-            self._arrays.append(jax.device_put(nd_._data, sh))
+            # fresh device-side copy: device_put may alias a matching
+            # shard with the block's live buffer, and step()'s donation
+            # would then delete the param out from under the block
+            import jax.numpy as jnp
+
+            self._arrays.append(jax.device_put(
+                jnp.array(nd_._data, copy=True), sh))
 
         # -- optimizer state pytree (sharded like its weight) --------------
         self._states = []
@@ -130,9 +173,7 @@ class DistributedTrainer:
         self._optimizer.set_learning_rate(lr)
 
     def _host_lr(self):
-        o = self._optimizer
-        return float(o.lr_scheduler(max(o.num_update, 1))) if o.lr_scheduler \
-            else o.lr
+        return _host_lr(self._optimizer)
 
     # ------------------------------------------------------------------
     def _trace_forward(self, batch_arrays, param_arrays, key, is_train):
@@ -165,32 +206,8 @@ class DistributedTrainer:
             _random.pop_trace_key(prev_key)
 
     def _traced_update(self, weights, grads, states, t, lr):
-        """Trace optimizer.update() for every trainable param with the update
-        count and learning rate fed as device scalars (one executable serves
-        all steps — no per-step recompile from Adam's bias correction)."""
-        from ..ndarray import NDArray
-
-        o = self._optimizer
-        ctx = self._params[0].list_ctx()[0]
-        saved = (o._index_update_count.copy(), o.num_update, o.lr,
-                 o.lr_scheduler, o._update_count)
-        try:
-            o._index_update_count = {i: t for i in self._trainable}
-            o._update_count = lambda index: None
-            o.lr_scheduler = None
-            o.lr = lr
-            new_w, new_s = [], []
-            for k, i in enumerate(self._trainable):
-                w = NDArray(weights[k], ctx=ctx)
-                g = NDArray(grads[k], ctx=ctx)
-                s = _tree_map(lambda a: NDArray(a, ctx=ctx), states[k])
-                o.update_multi_precision(i, w, g, s)
-                new_w.append(w._data)
-                new_s.append(_tree_map(lambda nd_: nd_._data, s))
-            return new_w, new_s
-        finally:
-            (o._index_update_count, o.num_update, o.lr, o.lr_scheduler,
-             o._update_count) = saved
+        return _traced_update(self._optimizer, self._params[0].list_ctx()[0],
+                              self._trainable, weights, grads, states, t, lr)
 
     def _build_step(self, batch_shapes):
         import jax
