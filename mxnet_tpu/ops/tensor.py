@@ -415,7 +415,8 @@ def stack(*args, axis=0, num_args=None):
     return jnp.stack(args, axis=axis)
 
 
-@register("SliceChannel", aliases=("split",), num_outputs=-1)
+@register("SliceChannel", aliases=("split",), num_outputs=-1,
+          num_outputs_fn=lambda attrs: int(attrs.get("num_outputs", 1)))
 def split(data, num_outputs=1, axis=1, squeeze_axis=False):
     parts = jnp.split(data, num_outputs, axis=axis)
     if squeeze_axis:

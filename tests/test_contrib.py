@@ -299,12 +299,10 @@ def test_svrg_trainer():
     assert losses[-1] < losses[0] * 0.2, losses[-1]
 
 
-def test_onnx_gated():
-    try:
-        import onnx  # noqa: F401
-
-        pytest.skip("onnx installed; gating test not applicable")
-    except ImportError:
-        pass
-    with pytest.raises(ImportError):
+def test_onnx_works_without_onnx_package():
+    """r3: ONNX interchange no longer hard-requires the onnx pip package —
+    the in-tree protobuf shim (contrib/onnx_proto.py) backs the translation
+    tables when it's absent, so import_model reaches real file IO instead
+    of raising ImportError at the gate."""
+    with pytest.raises((FileNotFoundError, OSError)):
         mx.contrib.onnx.import_model("nonexistent.onnx")
