@@ -55,6 +55,13 @@ def is_training():
 def set_recording(is_record):
     st = _st()
     prev = st.recording
+    if is_record and not prev:
+        # same lifecycle as _scope: a fresh outermost recording starts a
+        # new tape — without this, flag-style users (the C ABI's
+        # MXAutogradSetIsRecording loop) accumulate tape nodes and freed
+        # keys across iterations without bound
+        st.tape = []
+        st.freed = set()
     st.recording = is_record
     return prev
 

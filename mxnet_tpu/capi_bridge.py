@@ -1,0 +1,167 @@
+"""Python bridge for the imperative flat C ABI (libmxtpu_capi.so).
+
+Reference: src/c_api/c_api_ndarray.cc (`MXImperativeInvoke` :132) +
+c_api.cc NDArray create/copy/shape entry points + autograd control
+(c_api_ndarray.cc:257-281). The C layer (lib/src_capi/c_api.cc) owns the
+handle lifetime and marshals raw bytes/strings; every NDArray/op/autograd
+semantic lives here. Each `_capi_*` function takes/returns only
+plain-Python values (bytes, tuples, ints) plus NDArray objects whose
+references the C side holds.
+
+Attribute strings: the reference parses op params from strings via
+dmlc::Parameter reflection; here `ast.literal_eval` covers the same
+surface (numbers, bools, tuples), with plain words (e.g. pool_type
+values) passing through as strings.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+import numpy as _np
+
+from .base import MXNetError
+
+# A sitecustomize PJRT hook may force-override jax_platforms at interpreter
+# start (dialing accelerator hardware); in an EMBEDDED interpreter booted by
+# a plain-C host there is no conftest to re-assert the env's explicit
+# choice, so honor it here before any jax computation runs.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+# the reference's dtype enum (python/mxnet/base.py _DTYPE_MX_TO_NP order,
+# mirrored by include/mxnet/ndarray.h)
+_DTYPE_MX_TO_NP = {0: _np.float32, 1: _np.float64, 2: _np.float16,
+                   3: _np.uint8, 4: _np.int32, 5: _np.int8, 6: _np.int64}
+_DTYPE_NP_TO_MX = {_np.dtype(v).name: k for k, v in _DTYPE_MX_TO_NP.items()}
+
+_DEVTYPE = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+_DEVTYPE_TO_INT = {v: k for k, v in _DEVTYPE.items()}
+
+
+def _ctx(dev_type, dev_id):
+    from .context import Context
+
+    return Context(_DEVTYPE.get(int(dev_type), "cpu"), int(dev_id))
+
+
+def _capi_nd_create(shape, dev_type, dev_id, dtype):
+    from . import ndarray as nd
+
+    np_dt = _DTYPE_MX_TO_NP.get(int(dtype))
+    if np_dt is None:
+        raise MXNetError("unsupported dtype enum %d" % dtype)
+    return nd.zeros(tuple(int(s) for s in shape),
+                    ctx=_ctx(dev_type, dev_id), dtype=np_dt)
+
+
+def _capi_nd_sync_copy_from(arr, raw):
+    expected = int(_np.prod(arr.shape)) if arr.shape else 1
+    host = _np.frombuffer(bytes(raw), dtype=arr.dtype)
+    if host.size != expected:
+        raise MXNetError("SyncCopyFromCPU: got %d elements, NDArray holds "
+                         "%d" % (host.size, expected))
+    from . import ndarray as nd
+
+    arr._set_data(nd.array(host.reshape(arr.shape), ctx=arr.context,
+                           dtype=arr.dtype)._data)
+
+
+def _capi_nd_sync_copy_to(arr):
+    return _np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def _capi_nd_shape(arr):
+    return tuple(int(d) for d in arr.shape)
+
+
+def _capi_nd_dtype(arr):
+    name = _np.dtype(arr.dtype).name
+    if name not in _DTYPE_NP_TO_MX:
+        raise MXNetError("dtype %s has no reference enum value" % name)
+    return _DTYPE_NP_TO_MX[name]
+
+
+def _capi_nd_context(arr):
+    ctx = arr.context
+    return _DEVTYPE_TO_INT.get(ctx.device_type, 1), int(ctx.device_id)
+
+
+def _capi_nd_itemsize(arr):
+    """Element byte width — authoritative in ONE place (the C side must
+    not duplicate the dtype-enum table)."""
+    return int(_np.dtype(arr.dtype).itemsize)
+
+
+def _capi_list_ops():
+    from . import ops
+
+    return sorted(ops.list_ops())
+
+
+def _parse_attr(val):
+    """Reference semantics: op params arrive as strings and are parsed by
+    dmlc::Parameter; literal_eval covers numbers/bools/tuples, anything
+    else stays a string (enum-valued params like pool_type='max')."""
+    s = val.decode() if isinstance(val, bytes) else val
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def _capi_invoke(op_name, inputs, keys, vals):
+    """MXImperativeInvoke core: op by name, NDArray inputs, string attrs.
+    Returns a list of output NDArrays."""
+    from .ndarray import invoke
+
+    attrs = {k.decode() if isinstance(k, bytes) else k: _parse_attr(v)
+             for k, v in zip(keys, vals)}
+    out = invoke(op_name, tuple(inputs), attrs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def _capi_autograd_set_recording(flag):
+    from . import autograd
+
+    return 1 if autograd.set_recording(bool(flag)) else 0
+
+
+def _capi_autograd_set_training(flag):
+    from . import autograd
+
+    return 1 if autograd.set_training(bool(flag)) else 0
+
+
+_GRAD_REQ = {0: "null", 1: "write", 2: "add"}
+
+
+def _capi_mark_variables(variables, reqs, gradients):
+    from . import autograd
+
+    req_names = [_GRAD_REQ.get(int(r), "write") for r in reqs]
+    autograd.mark_variables(list(variables), list(gradients), req_names)
+
+
+def _capi_backward(outputs, ograds, retain_graph):
+    from . import autograd
+
+    heads = list(outputs)
+    head_grads = None if ograds is None else list(ograds)
+    autograd.backward(heads, head_grads, retain_graph=bool(retain_graph))
+
+
+def _capi_get_grad(arr):
+    return arr.grad  # None when no gradient buffer is attached
+
+
+def _capi_version():
+    from . import __version__
+
+    parts = (str(__version__).split("+")[0].split("."))
+    nums = [int("".join(c for c in p if c.isdigit()) or 0) for p in parts[:3]]
+    while len(nums) < 3:
+        nums.append(0)
+    return nums[0] * 10000 + nums[1] * 100 + nums[2]

@@ -1,0 +1,68 @@
+/*
+ * Minimal imperative flat C ABI (libmxtpu_capi.so) — the NDArray /
+ * invoke / autograd core of the reference's include/mxnet/c_api.h,
+ * proving non-Python bindings against the TPU-native runtime. Signatures
+ * mirror the reference; see mxnet_tpu/lib/src_capi/c_api.cc for the two
+ * documented divergences (creator handles are interned op-name strings;
+ * MXImperativeInvoke output spines are caller-freed via
+ * MXImperativeInvokeSpineFree).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stddef.h>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *AtomicSymbolCreator;
+
+const char *MXGetLastError();
+
+int MXGetVersion(int *out);
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name);
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals);
+int MXImperativeInvokeSpineFree(NDArrayHandle *outputs);
+
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles);
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

@@ -36,9 +36,10 @@ class _Loader:
         sources = sorted(glob.glob(os.path.join(self._src_dir, "*.cc")))
         if not sources:
             return None
+        deps = sources + glob.glob(os.path.join(self._src_dir, "*.h"))
         if os.path.exists(self._so_path):
             so_mtime = os.path.getmtime(self._so_path)
-            if all(os.path.getmtime(s) <= so_mtime for s in sources):
+            if all(os.path.getmtime(s) <= so_mtime for s in deps):
                 return self._so_path
         flags = []
         for f in self._extra_flags:

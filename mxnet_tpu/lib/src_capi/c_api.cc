@@ -1,0 +1,470 @@
+// Minimal imperative flat C ABI — the NDArray/invoke/autograd core of the
+// reference's include/mxnet/c_api.h (213 entry points; this implements the
+// ~16 that make non-Python bindings possible, mirroring
+// src/c_api/c_api_ndarray.cc MXImperativeInvoke :132 and the autograd
+// control surface :257-281). Signatures follow the reference so a C host
+// written against libmxnet's NDArray core recompiles unchanged.
+//
+// Handle model: every NDArrayHandle owns a strong reference to a Python
+// `mxnet_tpu.ndarray.NDArray`; ops are invoked by name through
+// mxnet_tpu/capi_bridge.py (the reference invokes via AtomicSymbolCreator
+// handles obtained from MXSymbolListAtomicSymbolCreators — here a creator
+// handle IS an interned op-name string, which
+// MXSymbolGetAtomicSymbolName reports, so the reference's
+// creator-discovery flow works verbatim).
+//
+// Build: compiled into libmxtpu_capi.so together with c_predict_api.cc
+// (see mxnet_tpu/lib/native.py get_capi()).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "capi_common.h"
+
+typedef void *NDArrayHandle;
+typedef void *AtomicSymbolCreator;
+
+namespace {
+
+using mxtpu_capi::GIL;
+using mxtpu_capi::g_last_error;
+using mxtpu_capi::set_error_from_python;
+
+PyObject *call_bridge(const char *fn, PyObject *args) {
+  return mxtpu_capi::call_module_fn("mxnet_tpu.capi_bridge", fn, args);
+}
+
+// call_bridge with a single-object argument, owning the argument tuple
+// (call_module_fn does NOT consume its args — without this the "(O)"
+// tuples leak a strong NDArray reference per call)
+PyObject *call_bridge1(const char *fn, PyObject *obj) {
+  PyObject *args = Py_BuildValue("(O)", obj);
+  if (args == nullptr) return nullptr;
+  PyObject *res = mxtpu_capi::call_module_fn("mxnet_tpu.capi_bridge", fn,
+                                             args);
+  Py_DECREF(args);
+  return res;
+}
+
+struct ND {
+  PyObject *obj;                     // mxnet_tpu.ndarray.NDArray
+  std::vector<mx_uint> shape;        // GetShape storage
+  std::string bytes;                 // SyncCopyToCPU staging
+};
+
+ND *nd(NDArrayHandle h) { return static_cast<ND *>(h); }
+
+// process-lifetime storage backing creator handles and ListAllOpNames
+std::vector<std::string> *g_op_names = nullptr;
+std::vector<const char *> *g_op_cstrs = nullptr;
+
+int ensure_op_names() {
+  // all checks under the GIL: a lock-free fast path would race the
+  // publication of g_op_cstrs (these calls are rare; the GIL is cheap)
+  GIL gil;
+  if (g_op_names != nullptr) return 0;
+  PyObject *res = call_bridge("_capi_list_ops", nullptr);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  auto *names = new std::vector<std::string>();
+  Py_ssize_t n = PyList_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    names->push_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
+  Py_DECREF(res);
+  auto *cstrs = new std::vector<const char *>();
+  for (const std::string &s : *names) cstrs->push_back(s.c_str());
+  g_op_cstrs = cstrs;
+  g_op_names = names;   // publish last
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXGetVersion(int *out) {
+  GIL gil;
+  PyObject *res = call_bridge("_capi_version", nullptr);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  (void)delay_alloc;  // XLA buffers allocate lazily anyway
+  *out = nullptr;
+  GIL gil;
+  PyObject *shp = PyTuple_New(ndim);
+  if (shp == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject *args = Py_BuildValue("(Oiii)", shp, dev_type, dev_id, dtype);
+  Py_DECREF(shp);
+  PyObject *res = args ? call_bridge("_capi_nd_create", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  ND *h = new ND();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc,
+                           /*dtype=*/0, out);
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  ND *h = nd(handle);
+  if (h == nullptr) return 0;
+  {
+    GIL gil;
+    Py_DECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  // reference semantics (c_api.cc): `size` counts ELEMENTS, not bytes;
+  // the byte width comes from the array's dtype (authoritative in
+  // capi_bridge._capi_nd_itemsize — no table duplicated here)
+  ND *h = nd(handle);
+  GIL gil;
+  PyObject *it = call_bridge1("_capi_nd_itemsize", h->obj);
+  if (it == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  size_t width = PyLong_AsSize_t(it);
+  Py_DECREF(it);
+  PyObject *args = Py_BuildValue("(Oy#)", h->obj,
+                                 static_cast<const char *>(data),
+                                 static_cast<Py_ssize_t>(size * width));
+  PyObject *res = args ? call_bridge("_capi_nd_sync_copy_from", args)
+                       : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  ND *h = nd(handle);
+  GIL gil;
+  PyObject *res = call_bridge1("_capi_nd_sync_copy_to", h->obj);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    Py_DECREF(res);
+    set_error_from_python();
+    return -1;
+  }
+  size_t total = static_cast<size_t>(len);
+  // `size` counts elements (reference semantics): cap the copy at
+  // size * itemsize
+  size_t copy = total;
+  if (size > 0) {
+    PyObject *it = call_bridge1("_capi_nd_itemsize", h->obj);
+    if (it == nullptr) {
+      Py_DECREF(res);
+      set_error_from_python();
+      return -1;
+    }
+    size_t width = PyLong_AsSize_t(it);
+    Py_DECREF(it);
+    if (size * width < copy) copy = size * width;
+  }
+  std::memcpy(data, buf, copy);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  ND *h = nd(handle);
+  GIL gil;
+  PyObject *res = call_bridge1("_capi_nd_shape", h->obj);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  h->shape.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(res); ++i)
+    h->shape.push_back(
+        static_cast<mx_uint>(PyLong_AsUnsignedLong(PyTuple_GetItem(res, i))));
+  Py_DECREF(res);
+  *out_dim = static_cast<mx_uint>(h->shape.size());
+  *out_pdata = h->shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  ND *h = nd(handle);
+  GIL gil;
+  PyObject *res = call_bridge1("_capi_nd_dtype", h->obj);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_dtype = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  ND *h = nd(handle);
+  GIL gil;
+  PyObject *res = call_bridge1("_capi_nd_context", h->obj);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  if (ensure_op_names() != 0) return -1;
+  *out_size = static_cast<mx_uint>(g_op_cstrs->size());
+  *out_array = g_op_cstrs->data();
+  return 0;
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  // creator handle == interned op-name string (stable for process life)
+  if (ensure_op_names() != 0) return -1;
+  *out_size = static_cast<mx_uint>(g_op_cstrs->size());
+  *out_array = reinterpret_cast<AtomicSymbolCreator *>(
+      const_cast<char **>(g_op_cstrs->data()));
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  *name = static_cast<const char *>(creator);
+  return 0;
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  const char *op_name = static_cast<const char *>(creator);
+  GIL gil;
+  PyObject *ins = PyList_New(num_inputs);
+  PyObject *keys = PyList_New(num_params);
+  PyObject *vals = PyList_New(num_params);
+  if (ins == nullptr || keys == nullptr || vals == nullptr) {
+    Py_XDECREF(ins);
+    Py_XDECREF(keys);
+    Py_XDECREF(vals);
+    set_error_from_python();
+    return -1;
+  }
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = nd(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject *args = Py_BuildValue("(sOOO)", op_name, ins, keys, vals);
+  Py_DECREF(ins);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  PyObject *res = args ? call_bridge("_capi_invoke", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(res);
+  // caller-provided output buffers (in-place `out=`) are not supported;
+  // always allocate fresh handles (the reference allows both)
+  auto **outs = new NDArrayHandle[n];
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    ND *h = new ND();
+    h->obj = PyList_GetItem(res, i);
+    Py_INCREF(h->obj);
+    outs[i] = h;
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(n);
+  *outputs = outs;  // caller frees each handle (MXNDArrayFree) and may
+                    // leak the spine; reference stores it in thread-local
+                    // ret space — documented divergence (use
+                    // MXImperativeInvokeSpineFree)
+  return 0;
+}
+
+int MXImperativeInvokeSpineFree(NDArrayHandle *outputs) {
+  delete[] outputs;
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(i)", is_recording);
+  PyObject *res = args ? call_bridge("_capi_autograd_set_recording", args)
+                       : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(i)", is_training);
+  PyObject *res = args ? call_bridge("_capi_autograd_set_training", args)
+                       : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles) {
+  GIL gil;
+  PyObject *vars = PyList_New(num_var);
+  PyObject *reqs = PyList_New(num_var);
+  PyObject *grads = PyList_New(num_var);
+  if (vars == nullptr || reqs == nullptr || grads == nullptr) {
+    Py_XDECREF(vars);
+    Py_XDECREF(reqs);
+    Py_XDECREF(grads);
+    set_error_from_python();
+    return -1;
+  }
+  for (mx_uint i = 0; i < num_var; ++i) {
+    PyObject *v = nd(var_handles[i])->obj;
+    PyObject *g = nd(grad_handles[i])->obj;
+    Py_INCREF(v);
+    Py_INCREF(g);
+    PyList_SET_ITEM(vars, i, v);
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+    PyList_SET_ITEM(grads, i, g);
+  }
+  PyObject *args = Py_BuildValue("(OOO)", vars, reqs, grads);
+  Py_DECREF(vars);
+  Py_DECREF(reqs);
+  Py_DECREF(grads);
+  PyObject *res = args ? call_bridge("_capi_mark_variables", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph) {
+  GIL gil;
+  PyObject *outs = PyList_New(num_output);
+  if (outs == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  for (mx_uint i = 0; i < num_output; ++i) {
+    PyObject *o = nd(output_handles[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(outs, i, o);
+  }
+  PyObject *ograds = Py_None;
+  if (ograd_handles != nullptr) {
+    ograds = PyList_New(num_output);
+    if (ograds == nullptr) {
+      Py_DECREF(outs);
+      set_error_from_python();
+      return -1;
+    }
+    for (mx_uint i = 0; i < num_output; ++i) {
+      // a NULL entry means "default (ones) head gradient" in the
+      // reference ABI; map it to None for the bridge
+      PyObject *o = ograd_handles[i] != nullptr
+                        ? nd(ograd_handles[i])->obj : Py_None;
+      Py_INCREF(o);
+      PyList_SET_ITEM(ograds, i, o);
+    }
+  } else {
+    Py_INCREF(Py_None);
+  }
+  PyObject *args = Py_BuildValue("(OOi)", outs, ograds, retain_graph);
+  Py_DECREF(outs);
+  Py_DECREF(ograds);
+  PyObject *res = args ? call_bridge("_capi_backward", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  *out = nullptr;
+  ND *h = nd(handle);
+  GIL gil;
+  PyObject *res = call_bridge1("_capi_get_grad", h->obj);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  if (res == Py_None) {
+    Py_DECREF(res);
+    return 0;  // no grad attached: *out stays null (reference behavior)
+  }
+  ND *g = new ND();
+  g->obj = res;
+  *out = g;
+  return 0;
+}
+
+}  // extern "C"

@@ -16,78 +16,27 @@
 // Build: see mxnet_tpu/lib/native.py get_capi() — compiled separately from
 // libmxtpu.so because only this library links libpython.
 
+#define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
 #include <cstring>
-#include <mutex>
 #include <string>
 #include <vector>
 
-typedef unsigned int mx_uint;
-typedef float mx_float;
+#include "capi_common.h"
+
 typedef void *PredictorHandle;
 typedef void *NDListHandle;
 
 namespace {
 
-thread_local std::string g_last_error;
-
-void ensure_python() {
-  static std::once_flag once;
-  std::call_once(once, []() {
-    if (!Py_IsInitialized()) {
-      // plain-C host: bring up an interpreter and release the GIL so the
-      // per-call PyGILState_Ensure below works from any thread
-      Py_InitializeEx(0);
-      PyEval_SaveThread();
-    }
-  });
-}
-
-struct GIL {
-  PyGILState_STATE st;
-  GIL() {
-    ensure_python();
-    st = PyGILState_Ensure();
-  }
-  ~GIL() { PyGILState_Release(st); }
-};
-
-// capture the pending Python exception into the thread-local error ring
-// (reference: c_api_error.cc MXAPISetLastError)
-void set_error_from_python() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  g_last_error = "unknown error";
-  if (value != nullptr) {
-    PyObject *s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char *msg = PyUnicode_AsUTF8(s);
-      if (msg != nullptr) g_last_error = msg;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-}
-
-PyObject *predict_module() {
-  PyObject *mod = PyImport_ImportModule("mxnet_tpu.predict");
-  return mod;  // nullptr on failure with exception set
-}
+using mxtpu_capi::GIL;
+using mxtpu_capi::g_last_error;
+using mxtpu_capi::set_error_from_python;
 
 // call mxnet_tpu.predict.<fn>(*args) -> new ref or nullptr (exception set)
 PyObject *call_bridge(const char *fn, PyObject *args) {
-  PyObject *mod = predict_module();
-  if (mod == nullptr) return nullptr;
-  PyObject *f = PyObject_GetAttrString(mod, fn);
-  Py_DECREF(mod);
-  if (f == nullptr) return nullptr;
-  PyObject *res = PyObject_CallObject(f, args);
-  Py_DECREF(f);
-  return res;
+  return mxtpu_capi::call_module_fn("mxnet_tpu.predict", fn, args);
 }
 
 struct Pred {

@@ -1,0 +1,88 @@
+// Shared plumbing for the flat C ABI translation units (predict +
+// imperative). Embeds CPython: when the library is loaded from a Python
+// process (ctypes) it attaches to the running interpreter; from a plain C
+// host it initializes one. C++17 inline variables give every TU the same
+// thread-local error slot, so MXGetLastError covers both API surfaces.
+#ifndef MXTPU_CAPI_COMMON_H_
+#define MXTPU_CAPI_COMMON_H_
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+
+namespace mxtpu_capi {
+
+inline thread_local std::string g_last_error;
+
+inline void ensure_python() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      // plain-C host: bring up an interpreter and release the GIL so the
+      // per-call PyGILState_Ensure below works from any thread
+      Py_InitializeEx(0);
+      // a sitecustomize PJRT hook may force jax onto accelerator hardware
+      // at interpreter start; in an embedded interpreter no conftest can
+      // re-assert the env's explicit JAX_PLATFORMS choice, and importing
+      // the framework would dial (and potentially hang on) the tunnel —
+      // honor the env var before anything imports jax-dependent modules
+      PyRun_SimpleString(
+          "import os\n"
+          "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
+          "    import jax\n"
+          "    jax.config.update('jax_platforms', 'cpu')\n");
+      PyEval_SaveThread();
+    }
+  });
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() {
+    ensure_python();
+    st = PyGILState_Ensure();
+  }
+  ~GIL() { PyGILState_Release(st); }
+};
+
+// capture the pending Python exception into the thread-local error slot
+// (reference: c_api_error.cc MXAPISetLastError)
+inline void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != nullptr) g_last_error = msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// call <module>.<fn>(*args) -> new ref or nullptr (exception set)
+inline PyObject *call_module_fn(const char *module, const char *fn,
+                                PyObject *args) {
+  PyObject *mod = PyImport_ImportModule(module);
+  if (mod == nullptr) return nullptr;
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) return nullptr;
+  PyObject *res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return res;
+}
+
+}  // namespace mxtpu_capi
+
+#endif  // MXTPU_CAPI_COMMON_H_
