@@ -53,15 +53,13 @@ def is_training():
 
 
 def set_recording(is_record):
+    """Flag-style recording control (reference: MXAutogradSetIsRecording).
+    Unlike the record() scope this must NOT reset the tape: the reference
+    pause/resume idiom (pause-scope exit calls set_recording(prev)) resumes
+    recording onto the SAME graph. Tape/freed cleanup instead happens when
+    a backward fully drains the tape (_run_backward)."""
     st = _st()
     prev = st.recording
-    if is_record and not prev:
-        # same lifecycle as _scope: a fresh outermost recording starts a
-        # new tape — without this, flag-style users (the C ABI's
-        # MXAutogradSetIsRecording loop) accumulate tape nodes and freed
-        # keys across iterations without bound
-        st.tape = []
-        st.freed = set()
     st.recording = is_record
     return prev
 
@@ -319,6 +317,13 @@ def _run_backward(heads, head_grads, retain_graph=False):
         keep = {kid for n in st.tape for (kid, _) in n.out_keys}
         for aid in [a for a in _LIVE if a not in keep]:
             del _LIVE[aid]
+        if not st.tape and not st.recording:
+            # graph fully drained outside any recording: the freed-key set
+            # has nothing left to guard (nothing on the tape can reach a
+            # freed node) — reset it so flag-style training loops (the C
+            # ABI's SetIsRecording idiom) don't grow it without bound, and
+            # so recycled object ids can't spuriously match stale keys
+            st.freed = set()
     return cot
 
 

@@ -112,14 +112,16 @@ def _parse_attr(val):
         return s
 
 
-def _capi_invoke(op_name, inputs, keys, vals):
+def _capi_invoke(op_name, inputs, keys, vals, outs=None):
     """MXImperativeInvoke core: op by name, NDArray inputs, string attrs.
-    Returns a list of output NDArrays."""
+    With `outs` (the reference's in-place contract) results are written
+    into the given arrays; returns a list of output NDArrays either way."""
     from .ndarray import invoke
 
     attrs = {k.decode() if isinstance(k, bytes) else k: _parse_attr(v)
              for k, v in zip(keys, vals)}
-    out = invoke(op_name, tuple(inputs), attrs)
+    out = invoke(op_name, tuple(inputs), attrs,
+                 out=list(outs) if outs is not None else None)
     return list(out) if isinstance(out, (list, tuple)) else [out]
 
 

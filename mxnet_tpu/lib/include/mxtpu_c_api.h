@@ -47,6 +47,10 @@ int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
 int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
                                 const char **name);
 
+/* Set *outputs = NULL / *num_outputs = 0 for fresh output allocation
+ * (free the spine with MXImperativeInvokeSpineFree). A non-NULL *outputs
+ * with *num_outputs > 0 is the reference's in-place contract: results are
+ * written into the caller's preallocated arrays. */
 int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                        NDArrayHandle *inputs, int *num_outputs,
                        NDArrayHandle **outputs, int num_params,

@@ -188,21 +188,25 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
     return -1;
   }
   size_t total = static_cast<size_t>(len);
-  // `size` counts elements (reference semantics): cap the copy at
-  // size * itemsize
-  size_t copy = total;
-  if (size > 0) {
-    PyObject *it = call_bridge1("_capi_nd_itemsize", h->obj);
-    if (it == nullptr) {
-      Py_DECREF(res);
-      set_error_from_python();
-      return -1;
-    }
-    size_t width = PyLong_AsSize_t(it);
-    Py_DECREF(it);
-    if (size * width < copy) copy = size * width;
+  // `size` counts elements and must match the array exactly — the
+  // reference CHECKs the size instead of silently truncating (a smaller
+  // `size` would hide bugs; size==0 on a non-empty array would overflow
+  // the caller's buffer if treated as "copy all")
+  PyObject *it = call_bridge1("_capi_nd_itemsize", h->obj);
+  if (it == nullptr) {
+    Py_DECREF(res);
+    set_error_from_python();
+    return -1;
   }
-  std::memcpy(data, buf, copy);
+  size_t width = PyLong_AsSize_t(it);
+  Py_DECREF(it);
+  if (width == 0 || size * width != total) {
+    Py_DECREF(res);
+    g_last_error = "MXNDArraySyncCopyToCPU: size (elements) does not "
+                   "match the array";
+    return -1;
+  }
+  std::memcpy(data, buf, total);
   Py_DECREF(res);
   return 0;
 }
@@ -302,19 +306,46 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
     PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
     PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
   }
-  PyObject *args = Py_BuildValue("(sOOO)", op_name, ins, keys, vals);
+  // reference in-place contract: a non-null *outputs with *num_outputs>0
+  // means the caller provides preallocated arrays the op writes into
+  // (the sgd_update-on-weight idiom); pass them through as `out=`
+  bool inplace = (*outputs != nullptr && *num_outputs > 0);
+  PyObject *given = Py_None;
+  if (inplace) {
+    given = PyList_New(*num_outputs);
+    if (given == nullptr) {
+      Py_DECREF(ins);
+      Py_DECREF(keys);
+      Py_DECREF(vals);
+      set_error_from_python();
+      return -1;
+    }
+    for (int i = 0; i < *num_outputs; ++i) {
+      PyObject *o = nd((*outputs)[i])->obj;
+      Py_INCREF(o);
+      PyList_SET_ITEM(given, i, o);
+    }
+  } else {
+    Py_INCREF(Py_None);
+  }
+  PyObject *args = Py_BuildValue("(sOOOO)", op_name, ins, keys, vals,
+                                 given);
   Py_DECREF(ins);
   Py_DECREF(keys);
   Py_DECREF(vals);
+  Py_DECREF(given);
   PyObject *res = args ? call_bridge("_capi_invoke", args) : nullptr;
   Py_XDECREF(args);
   if (res == nullptr) {
     set_error_from_python();
     return -1;
   }
+  if (inplace) {
+    // outputs written in place; caller's handles/spine stay untouched
+    Py_DECREF(res);
+    return 0;
+  }
   Py_ssize_t n = PyList_Size(res);
-  // caller-provided output buffers (in-place `out=`) are not supported;
-  // always allocate fresh handles (the reference allows both)
   auto **outs = new NDArrayHandle[n];
   for (Py_ssize_t i = 0; i < n; ++i) {
     ND *h = new ND();
@@ -324,10 +355,10 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
   }
   Py_DECREF(res);
   *num_outputs = static_cast<int>(n);
-  *outputs = outs;  // caller frees each handle (MXNDArrayFree) and may
-                    // leak the spine; reference stores it in thread-local
-                    // ret space — documented divergence (use
-                    // MXImperativeInvokeSpineFree)
+  *outputs = outs;  // caller frees each handle (MXNDArrayFree) and the
+                    // spine via MXImperativeInvokeSpineFree (reference
+                    // stores the spine in thread-local ret space —
+                    // documented divergence)
   return 0;
 }
 

@@ -513,6 +513,20 @@ def invoke(op_name, inputs, attrs, out=None):
     attrs = {k: v for k, v in attrs.items() if v is not None or k in ("axis",)}
     attrs.pop("name", None)
     attrs.pop("dtype_np", None)
+    if opdef.host:
+        # host-side op (reference CPU-only FComputeEx analogue): fn
+        # takes/returns NDArray-level objects eagerly — never jitted,
+        # never on the tape (the reference registers no gradient either)
+        from .. import profiler as _profiler
+
+        hargs = ((_random.next_key(),) if opdef.needs_rng else ()) \
+            + tuple(inputs)
+        results = _profiler.timed_call(op_name, lambda a: opdef.fn(*a, **attrs),
+                                       (hargs,))
+        if isinstance(results, (tuple, list)) and len(results) == 1:
+            return results[0]
+        return list(results) if isinstance(results, (tuple, list)) \
+            else results
     if _takes_is_train(opdef):
         attrs.setdefault("is_train", autograd.is_training())
 

@@ -36,6 +36,11 @@ class OpDef:
     num_outputs_fn: _t.Callable = None  # attrs -> output count, for variadic
                                         # ops whose arity depends on attrs
                                         # (e.g. Proposal output_score)
+    host: bool = False            # host-side op: fn takes/returns
+                                  # NDArray-level objects eagerly (never
+                                  # jitted, not on the tape) — the analogue
+                                  # of reference CPU-only FComputeEx ops
+                                  # (dgl graph sampling, dgl_graph.cc)
 
     @property
     def visible_outputs(self):
@@ -46,12 +51,12 @@ _REGISTRY: dict = {}
 
 
 def register(name, num_outputs=1, needs_rng=False, num_visible_outputs=None,
-             aliases=(), num_outputs_fn=None):
+             aliases=(), num_outputs_fn=None, host=False):
     """Decorator registering a pure-jax op function under `name`."""
 
     def deco(fn):
         op = OpDef(name, fn, num_outputs, needs_rng, num_visible_outputs,
-                   tuple(aliases), num_outputs_fn)
+                   tuple(aliases), num_outputs_fn, host)
         _REGISTRY[name] = op
         for a in aliases:
             _REGISTRY[a] = op
@@ -122,3 +127,4 @@ from . import rnn as _rnn  # noqa: E402,F401
 from . import contrib as _contrib  # noqa: E402,F401
 from . import linalg as _linalg  # noqa: E402,F401
 from . import quantization as _quantization  # noqa: E402,F401
+from . import dgl as _dgl  # noqa: E402,F401

@@ -1,0 +1,293 @@
+"""DGL graph operators (reference: src/operator/contrib/dgl_graph.cc).
+
+Graph-sampling preprocessing for DGL-style GNN training: csr neighbor
+sampling (uniform + weighted), vertex-induced subgraphs, subgraph
+compaction, edge-id lookup and adjacency conversion.
+
+These are HOST ops (`host=True`): the reference implements them CPU-only
+(`FComputeEx<cpu>` — dgl_graph.cc:800,:1172) because they are inherently
+data-dependent pointer-chasing over CSR structures that feed the data
+pipeline, not accelerator compute. Here they run as eager numpy over the
+CSRNDArray components, with the sampled minibatch graphs then moving to
+the device for the actual GNN math. RNG flows from the framework key
+chain (seed()-reproducible).
+
+Layout conventions (mirroring the reference docstrings):
+- sampled vertex arrays are (max_num_vertices+1,) int64, front-packed
+  sorted ids with the LAST element holding the actual count;
+- sampled subgraph CSRs are (max_num_vertices, max_num_vertices): row i
+  holds the sampled out-edges of the i-th sampled vertex (position
+  space), columns are ORIGINAL vertex ids, values are the parent edge
+  values ("empty rows at the end and many empty columns" — the state
+  dgl_graph_compact exists to clean up, dgl_graph.cc:1551);
+- layer arrays give the BFS hop at which each vertex entered the sample.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import register
+
+__all__ = []
+
+
+def _np_csr(csr):
+    return (_np.asarray(csr.data.asnumpy()),
+            _np.asarray(csr.indices.asnumpy()).astype(_np.int64),
+            _np.asarray(csr.indptr.asnumpy()).astype(_np.int64),
+            tuple(csr.shape))
+
+
+def _mk_csr(data, indptr, indices, shape, like, dtype=None):
+    from ..ndarray import sparse
+
+    return sparse.csr_matrix(
+        (data, indices, indptr), shape=shape, ctx=like.context,
+        dtype=dtype if dtype is not None else data.dtype)
+
+
+def _mk_nd(arr, like):
+    from .. import ndarray as nd
+
+    return nd.array(arr, ctx=like.context, dtype=arr.dtype)
+
+
+def _rng_from_key(key):
+    import jax
+
+    try:
+        raw = _np.asarray(jax.random.key_data(key))
+    except Exception:  # noqa: BLE001 — raw uint32 key arrays
+        raw = _np.asarray(key)
+    return _np.random.default_rng(int(raw.astype(_np.uint64).sum()))
+
+
+def _neighbor_sample(rs, data, indices, indptr, seeds, prob, num_hops,
+                     num_neighbor, max_num_vertices):
+    """BFS from `seeds`; each expanded vertex keeps `num_neighbor`
+    sampled out-edges. Returns (sorted vertex ids, {vid: hop},
+    {vid: [(col, value)]})."""
+    layer = {}
+    for v in seeds:
+        v = int(v)
+        if len(layer) >= max_num_vertices:
+            break
+        layer.setdefault(v, 0)
+    frontier = list(layer)
+    edges = {}
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for v in frontier:
+            row = indices[indptr[v]:indptr[v + 1]]
+            vals = data[indptr[v]:indptr[v + 1]]
+            if row.size == 0:
+                continue
+            k = min(num_neighbor, row.size)
+            if prob is None:
+                pick = rs.choice(row.size, size=k, replace=False)
+            else:
+                p = _np.asarray(prob[row], dtype=_np.float64)
+                s = p.sum()
+                p = p / s if s > 0 else None
+                pick = rs.choice(row.size, size=k, replace=False, p=p)
+            chosen = []
+            for j in sorted(int(i) for i in pick):
+                u = int(row[j])
+                if u not in layer and len(layer) >= max_num_vertices:
+                    continue  # vertex budget exhausted: drop the edge
+                chosen.append((u, vals[j]))
+                if u not in layer:
+                    layer[u] = hop
+                    nxt.append(u)
+            edges[v] = chosen
+        frontier = nxt
+    return sorted(layer), layer, edges
+
+
+def _pack_sample(verts, layer, edges, parent_dtype, max_num_vertices,
+                 like, prob=None):
+    n = len(verts)
+    out_verts = _np.zeros(max_num_vertices + 1, _np.int64)
+    out_verts[:n] = verts
+    out_verts[-1] = n
+    pos = {v: i for i, v in enumerate(verts)}
+    rows, cols, vals = [], [], []
+    for v in verts:
+        for (u, val) in edges.get(v, ()):
+            rows.append(pos[v])
+            cols.append(u)
+            vals.append(val)
+    order = _np.lexsort((cols, rows)) if rows else _np.array([], _np.int64)
+    rows = _np.asarray(rows, _np.int64)[order]
+    cols = _np.asarray(cols, _np.int64)[order]
+    vals = _np.asarray(vals, parent_dtype)[order]
+    indptr = _np.zeros(max_num_vertices + 1, _np.int64)
+    _np.add.at(indptr[1:], rows, 1)
+    indptr = _np.cumsum(indptr)
+    sub = _mk_csr(vals, indptr, cols,
+                  (max_num_vertices, max_num_vertices), like)
+    out_layer = _np.full(max_num_vertices, -1, _np.int64)
+    out_layer[:n] = [layer[v] for v in verts]
+    outs = [_mk_nd(out_verts, like), sub]
+    if prob is not None:
+        out_prob = _np.zeros(max_num_vertices, _np.float32)
+        out_prob[:n] = prob[_np.asarray(verts, _np.int64)]
+        outs.append(_mk_nd(out_prob, like))
+    outs.append(_mk_nd(out_layer, like))
+    return outs
+
+
+@register("_contrib_dgl_csr_neighbor_uniform_sample",
+          aliases=("dgl_csr_neighbor_uniform_sample",), host=True,
+          needs_rng=True, num_outputs=-1,
+          num_outputs_fn=lambda attrs: 3 * (int(attrs.get("num_args", 2)) - 1))
+def dgl_csr_neighbor_uniform_sample(key, csr, *seeds, num_args=None,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100):
+    """reference: dgl_graph.cc:744 — per seed array: (vertices, sampled
+    csr, layer)."""
+    rs = _rng_from_key(key)
+    data, indices, indptr, _ = _np_csr(csr)
+    outs = [[], [], []]
+    for seed in seeds:
+        sv = _np.asarray(seed.asnumpy()).astype(_np.int64).ravel()
+        verts, layer, edges = _neighbor_sample(
+            rs, data, indices, indptr, sv, None, int(num_hops),
+            int(num_neighbor), int(max_num_vertices))
+        packed = _pack_sample(verts, layer, edges, data.dtype,
+                              int(max_num_vertices), csr)
+        for o, p in zip(outs, packed):
+            o.append(p)
+    return tuple(outs[0] + outs[1] + outs[2])
+
+
+@register("_contrib_dgl_csr_neighbor_non_uniform_sample",
+          aliases=("dgl_csr_neighbor_non_uniform_sample",), host=True,
+          needs_rng=True, num_outputs=-1,
+          num_outputs_fn=lambda attrs: 4 * (int(attrs.get("num_args", 3)) - 2))
+def dgl_csr_neighbor_non_uniform_sample(key, csr, prob, *seeds,
+                                        num_args=None, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100):
+    """reference: dgl_graph.cc:838 — weighted sampling; adds a
+    per-vertex probability output set."""
+    rs = _rng_from_key(key)
+    data, indices, indptr, _ = _np_csr(csr)
+    pv = _np.asarray(prob.asnumpy()).astype(_np.float64).ravel()
+    outs = [[], [], [], []]
+    for seed in seeds:
+        sv = _np.asarray(seed.asnumpy()).astype(_np.int64).ravel()
+        verts, layer, edges = _neighbor_sample(
+            rs, data, indices, indptr, sv, pv, int(num_hops),
+            int(num_neighbor), int(max_num_vertices))
+        packed = _pack_sample(verts, layer, edges, data.dtype,
+                              int(max_num_vertices), csr, prob=pv)
+        for o, p in zip(outs, packed):
+            o.append(p)
+    return tuple(outs[0] + outs[1] + outs[2] + outs[3])
+
+
+@register("_contrib_dgl_subgraph", aliases=("dgl_subgraph",), host=True,
+          num_outputs=-1,
+          num_outputs_fn=lambda attrs: (
+              (int(attrs.get("num_args", 2)) - 1)
+              * (2 if attrs.get("return_mapping") in (True, "True", 1)
+                 else 1)))
+def dgl_subgraph(graph, *varrays, num_args=None, return_mapping=False):
+    """reference: dgl_graph.cc:1115 — vertex-induced subgraph per vertex
+    array; with return_mapping the second set holds original edge ids."""
+    data, indices, indptr, shape = _np_csr(graph)
+    return_mapping = return_mapping in (True, "True", 1)
+    new_set, map_set = [], []
+    for varr in varrays:
+        vids = _np.asarray(varr.asnumpy()).astype(_np.int64).ravel()
+        pos = {int(v): i for i, v in enumerate(vids)}
+        n = len(vids)
+        rows, cols, olds = [], [], []
+        for i, v in enumerate(vids):
+            for j in range(indptr[v], indptr[v + 1]):
+                u = int(indices[j])
+                if u in pos:
+                    rows.append(i)
+                    cols.append(pos[u])
+                    olds.append(data[j])
+        order = _np.lexsort((cols, rows)) if rows else \
+            _np.array([], _np.int64)
+        rows = _np.asarray(rows, _np.int64)[order]
+        cols = _np.asarray(cols, _np.int64)[order]
+        olds = _np.asarray(olds, data.dtype)[order]
+        # new edge ids number 1..nnz in row-major order (reference example)
+        news = _np.arange(1, len(rows) + 1, dtype=data.dtype)
+        indptr_out = _np.zeros(n + 1, _np.int64)
+        _np.add.at(indptr_out[1:], rows, 1)
+        indptr_out = _np.cumsum(indptr_out)
+        new_set.append(_mk_csr(news, indptr_out, cols, (n, n), graph))
+        map_set.append(_mk_csr(olds, indptr_out, cols, (n, n), graph))
+    return tuple(new_set + map_set) if return_mapping else tuple(new_set)
+
+
+@register("_contrib_edge_id", aliases=("edge_id",), host=True)
+def edge_id(data, u, v):
+    """reference: dgl_graph.cc:1300 — out[i] = csr[u[i], v[i]] or -1."""
+    d, indices, indptr, _ = _np_csr(data)
+    uu = _np.asarray(u.asnumpy()).astype(_np.int64).ravel()
+    vv = _np.asarray(v.asnumpy()).astype(_np.int64).ravel()
+    out = _np.full(uu.shape, -1, _np.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        row = indices[indptr[a]:indptr[a + 1]]
+        hit = _np.nonzero(row == b)[0]
+        if hit.size:
+            out[i] = d[indptr[a] + hit[0]]
+    return _mk_nd(out, u)
+
+
+@register("_contrib_dgl_adjacency", aliases=("dgl_adjacency",), host=True)
+def dgl_adjacency(data):
+    """reference: dgl_graph.cc:1376 — edge-id csr -> adjacency csr of
+    float32 ones."""
+    d, indices, indptr, shape = _np_csr(data)
+    return _mk_csr(_np.ones(d.shape, _np.float32), indptr, indices, shape,
+                   data, dtype=_np.float32)
+
+
+@register("_contrib_dgl_graph_compact", aliases=("dgl_graph_compact",),
+          host=True, num_outputs=-1,
+          num_outputs_fn=lambda attrs: (
+              (int(attrs.get("num_args", 2)) // 2)
+              * (2 if attrs.get("return_mapping") in (True, "True", 1)
+                 else 1)))
+def dgl_graph_compact(*args, num_args=None, return_mapping=False,
+                      graph_sizes=()):
+    """reference: dgl_graph.cc:1551 — remove the trailing empty rows and
+    map columns from original vertex ids to subgraph positions, using the
+    vertex arrays produced by the samplers."""
+    return_mapping = return_mapping in (True, "True", 1)
+    if isinstance(graph_sizes, (int, float)):
+        graph_sizes = (int(graph_sizes),)
+    graph_sizes = tuple(int(s) for s in graph_sizes)
+    n_graphs = len(args) // 2
+    graphs, varrs = args[:n_graphs], args[n_graphs:]
+    outs, maps = [], []
+    for g, varr, size in zip(graphs, varrs, graph_sizes):
+        d, indices, indptr, _ = _np_csr(g)
+        verts = _np.asarray(varr.asnumpy()).astype(_np.int64).ravel()
+        pos = {int(v): i for i, v in enumerate(verts[:size])}
+        rows, cols, vals = [], [], []
+        for i in range(size):
+            for j in range(indptr[i], indptr[i + 1]):
+                u = int(indices[j])
+                if u in pos:
+                    rows.append(i)
+                    cols.append(pos[u])
+                    vals.append(d[j])
+        order = _np.lexsort((cols, rows)) if rows else \
+            _np.array([], _np.int64)
+        rows = _np.asarray(rows, _np.int64)[order]
+        cols = _np.asarray(cols, _np.int64)[order]
+        vals = _np.asarray(vals, d.dtype)[order]
+        indptr_out = _np.zeros(size + 1, _np.int64)
+        _np.add.at(indptr_out[1:], rows, 1)
+        indptr_out = _np.cumsum(indptr_out)
+        outs.append(_mk_csr(vals, indptr_out, cols, (size, size), g))
+        maps.append(_mk_csr(vals.copy(), indptr_out, cols, (size, size), g))
+    return tuple(outs + maps) if return_mapping else tuple(outs)

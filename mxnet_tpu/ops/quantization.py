@@ -135,3 +135,68 @@ def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
         acc = acc + bq.reshape((1, -1, 1, 1))
     out_max = _INT8_MAX / out_scale
     return acc, (-out_max).reshape((1,)), out_max.reshape((1,))
+
+
+@register("_contrib_quantized_pooling", num_outputs=3,
+          aliases=("quantized_pooling",))
+def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                      global_pool=False, stride=(), pad=(),
+                      pooling_convention="valid", count_include_pad=True,
+                      layout=None):
+    """int8 pooling (reference: quantized_pooling.cc) — pooling runs in
+    the quantized domain and the input range passes through unchanged
+    (pooling is range-preserving: max picks an existing int8 value; avg
+    stays within [min, max]). Closes the r2 gap where a quantized CNN
+    fell back to dequantize->fp32->requantize around every pool."""
+    import jax.numpy as jnp
+
+    from .nn import pooling
+
+    if pool_type == "max":
+        out = pooling(data, kernel=kernel, pool_type="max",
+                      global_pool=global_pool, stride=stride, pad=pad,
+                      pooling_convention=pooling_convention)
+    elif pool_type == "avg":
+        # accumulate in float32 (exact for int8 sums), round back to the
+        # quantized grid — the reference's integer-average behavior
+        acc = pooling(data.astype(jnp.float32), kernel=kernel,
+                      pool_type="avg", global_pool=global_pool,
+                      stride=stride, pad=pad,
+                      pooling_convention=pooling_convention,
+                      count_include_pad=count_include_pad)
+        out = jnp.clip(jnp.round(acc), -127, 127).astype(data.dtype)
+    else:
+        from ..base import MXNetError
+
+        raise MXNetError("quantized_pooling: pool_type=%r not supported"
+                         % pool_type)
+    return out, min_data.reshape((1,)), max_data.reshape((1,))
+
+
+@register("_contrib_quantized_concat", num_outputs=3,
+          aliases=("quantized_concat",))
+def quantized_concat(*args, num_args=None, dim=1):
+    """int8 concat (reference: quantized_concat.cc) — inputs are n data
+    arrays followed by (min_i, max_i) pairs; every input is rescaled to
+    the widest [min, max] range, concatenated, and that range is the
+    output's. Input order mirrors the reference FListInputNames
+    (arg0..argN-1, arg0_min, arg0_max, arg1_min, ...)."""
+    import jax.numpy as jnp
+
+    n = int(num_args) if num_args is not None else len(args) // 3
+    datas = args[:n]
+    mins = [args[n + 2 * i].reshape(()) for i in range(n)]
+    maxs = [args[n + 2 * i + 1].reshape(()) for i in range(n)]
+    # widest symmetric range wins (reference: "rescaled by using largest
+    # [min, max] pairs")
+    out_min = jnp.minimum(jnp.stack(mins).min(), 0.0)
+    out_max = jnp.stack(maxs).max()
+    out_scale = _range_scale(out_min, out_max)
+    parts = []
+    for d, mn, mx in zip(datas, mins, maxs):
+        s = _range_scale(mn, mx)
+        # value = q / s; requantized q' = round(value * out_scale)
+        q = jnp.round(d.astype(jnp.float32) * (out_scale / s))
+        parts.append(jnp.clip(q, -127, 127).astype(jnp.int8))
+    out = jnp.concatenate(parts, axis=int(dim))
+    return out, out_min.reshape((1,)), out_max.reshape((1,))

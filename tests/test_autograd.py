@@ -296,3 +296,24 @@ def test_mark_variables_row_sparse_buffer():
     assert w.grad is g and g.stype == "row_sparse"
     np.testing.assert_allclose(g.tostype("default").asnumpy(),
                                2 * np.ones((4, 2)), rtol=1e-6)
+
+
+def test_flag_style_pause_resume_keeps_graph():
+    """Review find (r3): set_recording(False) then set_recording(True) —
+    the reference pause idiom — must resume onto the SAME graph, not wipe
+    previously recorded ops."""
+    from mxnet_tpu import autograd
+
+    x = mx.nd.array(np.array([2.0, 3.0], np.float32))
+    x.attach_grad()
+    autograd.set_recording(True)
+    try:
+        y = x * x           # recorded
+        autograd.set_recording(False)
+        _ = x + 1           # paused: not recorded
+        autograd.set_recording(True)
+        z = y * 3.0         # resumed: same graph
+    finally:
+        autograd.set_recording(False)
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6.0 * x.asnumpy())

@@ -361,3 +361,38 @@ def test_repeated_recording_cycles_do_not_accumulate_tape():
     assert sizes[0] == sizes[-1], sizes  # no growth across cycles
     lib.MXNDArrayFree(hx)
     lib.MXNDArrayFree(hg)
+
+
+def test_imperative_invoke_inplace_outputs():
+    """Review find: the reference in-place contract — caller-provided
+    *outputs are written into (the sgd_update-on-weight idiom)."""
+    lib = _capi()
+    w = np.array([1.0, 2.0, 3.0], np.float32)
+    hx = _create(lib, w)
+    hout = _create(lib, np.zeros_like(w))
+    sq = _creator(lib, "square")
+    ins = (ctypes.c_void_p * 1)(hx.value)
+    given = (ctypes.c_void_p * 1)(hout.value)
+    outs_ptr = ctypes.cast(given, ctypes.POINTER(ctypes.c_void_p))
+    n_out = ctypes.c_int(1)
+    rc = lib.MXImperativeInvoke(sq, 1, ins, ctypes.byref(n_out),
+                                ctypes.byref(outs_ptr), 0, None, None)
+    assert rc == 0, lib.MXGetLastError().decode()
+    assert n_out.value == 1
+    # the CALLER's handle now holds the result; no new handle allocated
+    np.testing.assert_allclose(_to_numpy(lib, hout, (3,)), w * w)
+    lib.MXNDArrayFree(hx)
+    lib.MXNDArrayFree(hout)
+
+
+def test_sync_copy_to_cpu_size_validated():
+    """Review find: size (elements) must match the array — no silent
+    truncation, no size==0 'copy everything' overflow."""
+    lib = _capi()
+    h = _create(lib, np.ones((2, 3), np.float32))
+    buf = np.empty(6, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(h, buf.ctypes.data, 3) != 0
+    assert b"size" in lib.MXGetLastError()
+    assert lib.MXNDArraySyncCopyToCPU(h, buf.ctypes.data, 0) != 0
+    assert lib.MXNDArraySyncCopyToCPU(h, buf.ctypes.data, 6) == 0
+    lib.MXNDArrayFree(h)

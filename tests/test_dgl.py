@@ -1,0 +1,170 @@
+"""DGL graph op family (VERDICT r2 #6; reference:
+src/operator/contrib/dgl_graph.cc). Examples mirror the reference
+docstrings; sampling tests check structural invariants (sampling is
+stochastic) plus exact results where num_neighbor >= degree makes the
+sample deterministic."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _k5():
+    """The reference docstring graph: complete K5 digraph, edge ids 1..20."""
+    data = np.arange(1, 21, dtype=np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                        0, 1, 2, 4, 0, 1, 2, 3], dtype=np.int64)
+    indptr = np.array([0, 4, 8, 12, 16, 20], dtype=np.int64)
+    return nd.sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def test_registered():
+    from mxnet_tpu import ops
+
+    names = set(ops.list_ops())
+    assert {"_contrib_dgl_csr_neighbor_uniform_sample",
+            "_contrib_dgl_csr_neighbor_non_uniform_sample",
+            "_contrib_dgl_subgraph", "_contrib_edge_id",
+            "_contrib_dgl_adjacency",
+            "_contrib_dgl_graph_compact"} <= names
+
+
+def test_edge_id():
+    """reference docstring (dgl_graph.cc:1300)."""
+    data = np.array([1, 2, 3], np.int64)
+    indices = np.array([0, 1, 2], np.int64)
+    indptr = np.array([0, 1, 2, 3], np.int64)
+    x = nd.sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+    u = nd.array(np.array([0, 0, 1, 1, 2, 2], np.int64), dtype=np.int64)
+    v = nd.array(np.array([0, 1, 1, 2, 0, 2], np.int64), dtype=np.int64)
+    out = nd.contrib.edge_id(x, u, v)
+    np.testing.assert_array_equal(out.asnumpy(), [1, -1, 2, -1, -1, 3])
+
+
+def test_dgl_adjacency():
+    x = _k5()
+    adj = nd.contrib.dgl_adjacency(x)
+    assert adj.stype == "csr"
+    dense = adj.tostype("default").asnumpy()
+    expect = (x.tostype("default").asnumpy() != 0).astype(np.float32)
+    np.testing.assert_array_equal(dense, expect)
+    assert dense.dtype == np.float32
+
+
+def _csr_from_dense(x_dense):
+    rows, cols = np.nonzero(x_dense)
+    data = x_dense[rows, cols]
+    indptr = np.zeros(x_dense.shape[0] + 1, np.int64)
+    np.add.at(indptr[1:], rows, 1)
+    indptr = np.cumsum(indptr)
+    return nd.sparse.csr_matrix((data, cols.astype(np.int64), indptr),
+                                shape=x_dense.shape)
+
+
+def test_dgl_subgraph_example():
+    """reference docstring (dgl_graph.cc:1115)."""
+    x_dense = np.array([[1, 0, 0, 2],
+                        [3, 0, 4, 0],
+                        [0, 5, 0, 0],
+                        [0, 6, 7, 0]], np.int64)
+    x = _csr_from_dense(x_dense)
+    v = nd.array(np.array([0, 1, 2], np.int64), dtype=np.int64)
+    new_g, old_g = nd.contrib.dgl_subgraph(x, v, num_args=2,
+                                           return_mapping=True)
+    np.testing.assert_array_equal(
+        new_g.tostype("default").asnumpy(),
+        [[1, 0, 0], [2, 0, 3], [0, 4, 0]])
+    np.testing.assert_array_equal(
+        old_g.tostype("default").asnumpy(),
+        [[1, 0, 0], [3, 0, 4], [0, 5, 0]])
+
+
+def test_uniform_sample_structure():
+    mx.random.seed(7)
+    a = _k5()
+    seed = nd.array(np.array([0, 1], np.int64), dtype=np.int64)
+    verts, subg, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    v = verts.asnumpy()
+    n = int(v[-1])
+    assert 2 <= n <= 5
+    ids = v[:n]
+    assert sorted(ids) == list(ids)          # sorted ascending
+    assert {0, 1} <= set(ids)                # seeds present
+    lay = layer.asnumpy()
+    assert lay[0] == 0 and lay[1] == 0       # seeds at hop 0
+    assert all(l in (0, 1) for l in lay[:n])
+    dense = subg.tostype("default").asnumpy()
+    assert dense.shape == (5, 5)
+    # every sampled edge exists in the parent with the parent's edge value
+    parent = a.tostype("default").asnumpy()
+    for i in range(n):
+        row = dense[i]
+        nz = np.nonzero(row)[0]
+        assert len(nz) <= 2 or ids[i] not in (0, 1)
+        for c in nz:
+            assert parent[ids[i], c] == row[c]
+
+
+def test_uniform_sample_deterministic_when_k_covers_degree():
+    """num_neighbor >= degree keeps the full neighborhood: output equals
+    the parent restricted to sampled rows (deterministic)."""
+    a = _k5()
+    seed = nd.array(np.arange(5, dtype=np.int64), dtype=np.int64)
+    verts, subg, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=4,
+        max_num_vertices=5)
+    np.testing.assert_array_equal(verts.asnumpy(), [0, 1, 2, 3, 4, 5])
+    np.testing.assert_array_equal(subg.tostype("default").asnumpy(),
+                                  a.tostype("default").asnumpy())
+    np.testing.assert_array_equal(layer.asnumpy(), np.zeros(5))
+
+
+def test_non_uniform_sample_prob_output():
+    mx.random.seed(3)
+    a = _k5()
+    prob = nd.array(np.array([0.9, 0.8, 0.2, 0.4, 0.1], np.float32))
+    seed = nd.array(np.arange(5, dtype=np.int64), dtype=np.int64)
+    verts, subg, p_out, layer = \
+        nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            a, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+            max_num_vertices=5)
+    np.testing.assert_array_equal(verts.asnumpy(), [0, 1, 2, 3, 4, 5])
+    np.testing.assert_allclose(p_out.asnumpy(),
+                               [0.9, 0.8, 0.2, 0.4, 0.1], rtol=1e-6)
+    dense = subg.tostype("default").asnumpy()
+    assert (np.count_nonzero(dense, axis=1) == 2).all()
+
+
+def test_graph_compact():
+    """reference docstring flow (dgl_graph.cc:1551): sample with slack
+    max_num_vertices, then compact to the true size."""
+    a = _k5()
+    seed = nd.array(np.arange(5, dtype=np.int64), dtype=np.int64)
+    verts, subg, _ = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=4,
+        max_num_vertices=6)
+    n = int(verts.asnumpy()[-1])
+    assert n == 5 and subg.shape == (6, 6)
+    compact = nd.contrib.dgl_graph_compact(
+        subg, verts, num_args=2, return_mapping=False, graph_sizes=(n,))
+    assert compact.shape == (5, 5)
+    # K5 with full neighborhoods compacts back to the parent graph
+    np.testing.assert_array_equal(compact.tostype("default").asnumpy(),
+                                  a.tostype("default").asnumpy())
+
+
+def test_sampling_reproducible_under_seed():
+    a = _k5()
+    seed = nd.array(np.array([0], np.int64), dtype=np.int64)
+
+    def run():
+        mx.random.seed(42)
+        _, subg, _ = nd.contrib.dgl_csr_neighbor_uniform_sample(
+            a, seed, num_args=2, num_hops=2, num_neighbor=2,
+            max_num_vertices=5)
+        return subg.tostype("default").asnumpy()
+
+    np.testing.assert_array_equal(run(), run())
