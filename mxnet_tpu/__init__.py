@@ -33,7 +33,7 @@ _SUBMODULES = [
     "model", "profiler", "runtime", "test_utils", "visualization", "monitor",
     "parallel", "attribute", "name", "operator", "contrib", "rtc",
     "torch_bridge", "registry", "log", "libinfo", "util",
-    "kvstore_server", "executor_manager",
+    "kvstore_server", "executor_manager", "rnn",
 ]
 import importlib as _importlib
 import os as _os

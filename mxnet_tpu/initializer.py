@@ -13,7 +13,7 @@ from . import random as _random
 
 __all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Zero", "One",
            "Constant", "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear",
-           "LSTMBias", "Load", "Mixed", "register", "create"]
+           "LSTMBias", "FusedRNN", "Load", "Mixed", "register", "create"]
 
 _REG = _Registry("initializer")
 
@@ -29,6 +29,13 @@ def create(init, **kwargs):
     if isinstance(init, Initializer):
         return init
     if isinstance(init, str):
+        if init.startswith("["):
+            # dumps() form: json [name, kwargs] (reference initializer.py
+            # round-trips symbol __init__ attrs this way)
+            import json
+
+            name, kw = json.loads(init)
+            return _REG.create(name, **kw)
         return _REG.create(init, **kwargs)
     raise TypeError("cannot create initializer from %r" % (init,))
 
@@ -48,6 +55,14 @@ class Initializer:
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
+
+    def dumps(self):
+        """json [name, kwargs] string form, stored in symbol `__init__`
+        attrs and round-tripped by create() (reference: initializer.py
+        dumps)."""
+        import json
+
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
 
     def __call__(self, desc, arr):
         if not isinstance(desc, InitDesc):
@@ -285,6 +300,54 @@ class Mixed(Initializer):
         raise ValueError("no matching initializer pattern for %s" % str(desc))
 
 
+@register
+class FusedRNN(Initializer):
+    """Initialize a FusedRNNCell's flat parameter vector (reference:
+    initializer.py:702): unpack into per-gate matrices, apply `init` (or
+    the global initializer) to each, force the lstm forget-gate bias,
+    repack."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        import json
+
+        if isinstance(init, str):
+            name, kw = json.loads(init)
+            init = _REG.create(name, **kw)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from . import ndarray as nd
+        from .rnn import rnn_cell
+
+        cell = rnn_cell.FusedRNNCell(
+            self._num_hidden, self._num_layers, self._mode,
+            self._bidirectional, forget_bias=self._forget_bias, prefix="")
+        args = cell.unpack_weights(
+            {"parameters": nd.array(_np.asarray(arr, dtype=_np.float32))})
+        for name in args:
+            sub = _np.array(args[name].asnumpy(), copy=True)
+            if self._mode == "lstm" and name.endswith("_f_bias"):
+                sub[:] = self._forget_bias
+            else:
+                inner = self._init if self._init is not None else \
+                    (desc.global_init if getattr(desc, "global_init", None)
+                     else Uniform())
+                inner(InitDesc(name, global_init=getattr(
+                    desc, "global_init", None)), sub)
+            args[name] = nd.array(sub)
+        arr[:] = cell.pack_weights(args)["parameters"].asnumpy()
+
+
 # convenience namespace mirroring mx.init.*
 class init:
     Uniform = Uniform
@@ -297,6 +360,7 @@ class init:
     Orthogonal = Orthogonal
     Bilinear = Bilinear
     LSTMBias = LSTMBias
+    FusedRNN = FusedRNN
     Load = Load
     Mixed = Mixed
     Initializer = Initializer

@@ -206,3 +206,23 @@ def rnn(rng, data, parameters, state, state_cell=None, state_size=0, num_layers=
         cN = jnp.stack(c_finals, axis=0)
         return (out, hN, cN)
     return (out, hN)
+
+
+@register("_rnn_state_zeros")
+def rnn_state_zeros(data, state_shape=()):
+    """Zero initial RNN state with the batch dim taken from `data`
+    (symbolic begin_state support: the reference writes shape (0, H) and
+    lets nnvm shape inference fill the batch — here the batch rides the
+    data symbol so jax.eval_shape can infer it; mx.rnn BaseRNNCell)."""
+    return jnp.zeros((data.shape[0],) + tuple(state_shape), data.dtype)
+
+
+@register("_rnn_fused_state_zeros")
+def rnn_fused_state_zeros(data, num_directions_layers=1, state_size=0,
+                          batch_axis=1):
+    """Zero fused-RNN state (L*dirs, B, H); B comes from `data` at
+    `batch_axis` — 1 for the merged (T, B, I) unroll input, 0 when the
+    reference is a per-step (B, C) symbol (mx.rnn FusedRNNCell inside a
+    SequentialRNNCell, whose begin_state runs before the fused merge)."""
+    return jnp.zeros((num_directions_layers, data.shape[batch_axis],
+                      state_size), data.dtype)

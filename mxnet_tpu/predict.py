@@ -183,7 +183,11 @@ class Predictor:
         def fwd(*data_vals):
             vals = dict(consts)
             vals.update(zip(names, data_vals))
-            outs, _ = self._symbol._interpret(vals, is_train=False)
+            # fixed key: inference graphs must not split the global RNG
+            # chain inside the export trace (tracer leak), and an AOT
+            # artifact should be deterministic anyway
+            outs, _ = self._symbol._interpret(
+                vals, is_train=False, rng_key=jax.random.PRNGKey(0))
             return tuple(outs)
 
         # trace each input at its DECLARED dtype (int32 token ids for

@@ -1,0 +1,22 @@
+"""mx.rnn — the legacy symbolic RNN cell API + bucketing iterator.
+
+Reference: python/mxnet/rnn/ (rnn_cell.py symbolic cells, io.py
+BucketSentenceIter, rnn.py checkpoint helpers) — the API behind
+example/rnn/bucketing. Gluon users should prefer mxnet_tpu.gluon.rnn;
+this namespace exists so reference RNN training scripts port with only
+the import line changed.
+"""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, DropoutCell,
+                       ModifierCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell)
+from .io import encode_sentences, BucketSentenceIter
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint)
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "DropoutCell",
+           "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell", "encode_sentences", "BucketSentenceIter",
+           "save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]

@@ -407,8 +407,14 @@ class Symbol:
         try:
             structs = {n: jax.ShapeDtypeStruct(shapes[n], jnp.float32)
                        for n in shapes}
+            # abstract eval only: pass a concrete dummy key so RNG ops
+            # don't split the GLOBAL key chain inside the trace (that
+            # would store a tracer in random's thread state — leak)
+            dummy_key = jax.ShapeDtypeStruct((2,), jnp.uint32)
             out_struct = jax.eval_shape(
-                lambda vals: self._interpret(vals, is_train=True)[0], structs)
+                lambda vals, k: self._interpret(vals, is_train=True,
+                                                rng_key=k)[0],
+                structs, dummy_key)
             out_shapes = [tuple(o.shape) for o in out_struct]
         except Exception:
             if partial:

@@ -19,6 +19,7 @@ if not os.environ.get("MXTPU_TEST_EXAMPLES"):
 EXAMPLES = [
     ("image_classification/train_mnist.py", []),
     ("rnn/word_lm.py", []),
+    ("rnn/lstm_bucketing.py", ["--num-epochs", "1"]),
     ("ssd/train.py", []),
     ("quantization/quantize_lenet.py", []),
     ("profiler/profile_training.py", []),

@@ -1,0 +1,331 @@
+"""mx.rnn legacy symbolic cell API (VERDICT r2 #7; reference:
+python/mxnet/rnn/rnn_cell.py + io.py). Cells are checked against manual
+numpy recurrences, FusedRNNCell against its unfused stack, and the
+BucketSentenceIter against the reference's documented batch layout."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _bind_forward(sym, feeds, seed=0, train=False):
+    rs = np.random.RandomState(seed)
+    args = {}
+    shapes, _, _ = sym.infer_shape(
+        **{k: v.shape for k, v in feeds.items()})
+    for name, shp in zip(sym.list_arguments(), shapes):
+        if name in feeds:
+            args[name] = mx.nd.array(feeds[name])
+        else:
+            args[name] = mx.nd.array(
+                rs.uniform(-0.2, 0.2, shp).astype(np.float32))
+    exe = sym.bind(mx.cpu(), args=args, grad_req="null")
+    return exe.forward(is_train=train), args
+
+
+def test_rnn_cell_matches_numpy():
+    cell = mx.rnn.RNNCell(num_hidden=4, activation="tanh", prefix="r_")
+    x = mx.sym.Variable("x")
+    out, states = cell.unroll(3, inputs=x, layout="NTC",
+                              merge_outputs=True)
+    feeds = {"x": np.random.RandomState(1)
+             .uniform(-1, 1, (2, 3, 5)).astype(np.float32)}
+    (res,), args = _bind_forward(out, feeds)
+    iw = args["r_i2h_weight"].asnumpy()
+    ib = args["r_i2h_bias"].asnumpy()
+    hw = args["r_h2h_weight"].asnumpy()
+    hb = args["r_h2h_bias"].asnumpy()
+    h = np.zeros((2, 4), np.float32)
+    expect = []
+    for t in range(3):
+        h = np.tanh(feeds["x"][:, t] @ iw.T + ib + h @ hw.T + hb)
+        expect.append(h)
+    np.testing.assert_allclose(res.asnumpy(),
+                               np.stack(expect, axis=1), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_lstm_cell_matches_numpy():
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="l_")
+    x = mx.sym.Variable("x")
+    out, states = cell.unroll(3, inputs=x, merge_outputs=True)
+    feeds = {"x": np.random.RandomState(2)
+             .uniform(-1, 1, (2, 3, 5)).astype(np.float32)}
+    (res,), args = _bind_forward(out, feeds)
+
+    def sig(a):
+        return 1.0 / (1.0 + np.exp(-a))
+
+    iw = args["l_i2h_weight"].asnumpy()
+    ib = args["l_i2h_bias"].asnumpy()
+    hw = args["l_h2h_weight"].asnumpy()
+    hb = args["l_h2h_bias"].asnumpy()
+    h = np.zeros((2, 4), np.float32)
+    c = np.zeros((2, 4), np.float32)
+    expect = []
+    for t in range(3):
+        g = feeds["x"][:, t] @ iw.T + ib + h @ hw.T + hb
+        i, f, gg, o = np.split(g, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        expect.append(h)
+    np.testing.assert_allclose(res.asnumpy(), np.stack(expect, axis=1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gru_cell_matches_numpy():
+    cell = mx.rnn.GRUCell(num_hidden=4, prefix="g_")
+    x = mx.sym.Variable("x")
+    out, _ = cell.unroll(3, inputs=x, merge_outputs=True)
+    feeds = {"x": np.random.RandomState(3)
+             .uniform(-1, 1, (2, 3, 5)).astype(np.float32)}
+    (res,), args = _bind_forward(out, feeds)
+
+    def sig(a):
+        return 1.0 / (1.0 + np.exp(-a))
+
+    iw = args["g_i2h_weight"].asnumpy()
+    ib = args["g_i2h_bias"].asnumpy()
+    hw = args["g_h2h_weight"].asnumpy()
+    hb = args["g_h2h_bias"].asnumpy()
+    h = np.zeros((2, 4), np.float32)
+    expect = []
+    for t in range(3):
+        gi = feeds["x"][:, t] @ iw.T + ib
+        gh = h @ hw.T + hb
+        ir, iz, inn = np.split(gi, 3, axis=1)
+        hr, hz, hn = np.split(gh, 3, axis=1)
+        r, z = sig(ir + hr), sig(iz + hz)
+        n = np.tanh(inn + r * hn)
+        h = (1 - z) * n + z * h
+        expect.append(h)
+    np.testing.assert_allclose(res.asnumpy(), np.stack(expect, axis=1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_matches_unfused():
+    """FusedRNNCell (the RNN op) and its unfuse() stack compute the same
+    function given the packed <-> per-cell weight mapping."""
+    fused = mx.rnn.FusedRNNCell(num_hidden=4, num_layers=2, mode="lstm",
+                                prefix="f_")
+    x = mx.sym.Variable("x")
+    fout, _ = fused.unroll(5, inputs=x, layout="NTC", merge_outputs=True)
+    feeds = {"x": np.random.RandomState(4)
+             .uniform(-1, 1, (3, 5, 6)).astype(np.float32)}
+    (fres,), fargs = _bind_forward(fout, feeds)
+
+    # unpack the packed vector into per-layer weights and run the
+    # unfused stack with them
+    unpacked = fused.unpack_weights({k: v for k, v in fargs.items()
+                                     if k == "f_parameters"})
+    stack = fused.unfuse()
+    uout, _ = stack.unroll(5, inputs=x, layout="NTC", merge_outputs=True)
+    uargs = {"x": mx.nd.array(feeds["x"])}
+    for name in uout.list_arguments():
+        if name == "x":
+            continue
+        # unfused cells expect fused i2h/h2h names packed per layer
+        packed = stack.pack_weights(unpacked)
+        uargs[name] = packed[name]
+    exe = uout.bind(mx.cpu(), args=uargs, grad_req="null")
+    ures = exe.forward(is_train=False)[0]
+    np.testing.assert_allclose(ures.asnumpy(), fres.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_and_residual_and_dropout():
+    bi = mx.rnn.BidirectionalCell(
+        mx.rnn.GRUCell(4, prefix="fw_"), mx.rnn.GRUCell(4, prefix="bw_"))
+    x = mx.sym.Variable("x")
+    out, states = bi.unroll(4, inputs=x, merge_outputs=True)
+    feeds = {"x": np.random.RandomState(5)
+             .uniform(-1, 1, (2, 4, 3)).astype(np.float32)}
+    (res,), _ = _bind_forward(out, feeds)
+    assert res.shape == (2, 4, 8)     # fwd + bwd concat
+
+    res_cell = mx.rnn.ResidualCell(mx.rnn.RNNCell(3, prefix="rc_"))
+    out2, _ = res_cell.unroll(4, inputs=x, merge_outputs=True)
+    (r2,), args2 = _bind_forward(out2, feeds)
+    # residual: output - input must equal the inner cell's output range
+    inner = mx.rnn.RNNCell(3, prefix="rc_", params=res_cell.params)
+    assert r2.shape == (2, 4, 3)
+
+    seq = mx.rnn.SequentialRNNCell()
+    seq.add(mx.rnn.LSTMCell(4, prefix="s0_"))
+    seq.add(mx.rnn.DropoutCell(0.5, prefix="sd_"))
+    seq.add(mx.rnn.LSTMCell(4, prefix="s1_"))
+    out3, _ = seq.unroll(4, inputs=x, merge_outputs=True)
+    (r3a,), _ = _bind_forward(out3, feeds, train=False)
+    (r3b,), _ = _bind_forward(out3, feeds, train=False)
+    np.testing.assert_allclose(r3a.asnumpy(), r3b.asnumpy(), rtol=1e-6)
+
+
+def test_zoneout_runs():
+    z = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(4, prefix="z_"),
+                           zoneout_outputs=0.3, zoneout_states=0.3)
+    x = mx.sym.Variable("x")
+    out, _ = z.unroll(3, inputs=x, merge_outputs=True)
+    feeds = {"x": np.random.RandomState(6)
+             .uniform(-1, 1, (2, 3, 4)).astype(np.float32)}
+    (res,), _ = _bind_forward(out, feeds, train=True)
+    assert np.isfinite(res.asnumpy()).all()
+
+
+def test_pack_unpack_roundtrip():
+    cell = mx.rnn.LSTMCell(num_hidden=3, prefix="pu_")
+    rs = np.random.RandomState(7)
+    args = {"pu_i2h_weight": mx.nd.array(rs.uniform(-1, 1, (12, 5))
+                                         .astype(np.float32)),
+            "pu_i2h_bias": mx.nd.array(rs.uniform(-1, 1, (12,))
+                                       .astype(np.float32)),
+            "pu_h2h_weight": mx.nd.array(rs.uniform(-1, 1, (12, 3))
+                                         .astype(np.float32)),
+            "pu_h2h_bias": mx.nd.array(rs.uniform(-1, 1, (12,))
+                                       .astype(np.float32))}
+    unpacked = cell.unpack_weights(args)
+    assert "pu_i2h_i_weight" in unpacked and \
+        unpacked["pu_i2h_i_weight"].shape == (3, 5)
+    packed = cell.pack_weights(unpacked)
+    for k, v in args.items():
+        np.testing.assert_allclose(packed[k].asnumpy(), v.asnumpy())
+
+
+def test_begin_state_requires_unroll_for_default():
+    cell = mx.rnn.LSTMCell(num_hidden=3, prefix="bs_")
+    with pytest.raises(MXNetError, match="unroll"):
+        cell.begin_state()
+    # explicit Variable states work without unroll (reference idiom)
+    states = cell.begin_state(func=mx.sym.var)
+    assert len(states) == 2
+
+
+def test_bucket_sentence_iter():
+    sents = [[1, 2, 3], [4, 5, 6, 7, 8], [1, 1], [2, 2, 2],
+             [3, 3, 3, 3], [5, 4, 3, 2, 1], [9, 8], [7, 7, 7]]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=2, buckets=[3, 5],
+                                   invalid_label=0)
+    assert it.default_bucket_key == 5
+    n_batches = 0
+    for batch in it:
+        n_batches += 1
+        assert batch.bucket_key in (3, 5)
+        data = batch.data[0].asnumpy()
+        label = batch.label[0].asnumpy()
+        assert data.shape == (2, batch.bucket_key)
+        # label is data shifted left with invalid_label padding
+        np.testing.assert_array_equal(label[:, :-1], data[:, 1:])
+        assert (label[:, -1] == 0).all()
+    assert n_batches >= 3
+    it.reset()
+    assert sum(1 for _ in it) == n_batches
+
+
+def test_encode_sentences():
+    sents, vocab = mx.rnn.encode_sentences(
+        [["a", "b"], ["b", "c"]], invalid_label=0, start_label=1)
+    assert sents[0][1] == sents[1][0]          # shared token id for 'b'
+    assert set(vocab.values()) >= {0, 1, 2, 3}
+    # reusing a vocab: known tokens encode; unknown without unknown_token
+    # assert (reference behavior)
+    more, _ = mx.rnn.encode_sentences([["b", "c"]], vocab=vocab,
+                                      invalid_label=0)
+    assert more[0] == [vocab["b"], vocab["c"]]
+    with pytest.raises(AssertionError, match="Unknown token"):
+        mx.rnn.encode_sentences([["zzz"]], vocab=vocab, invalid_label=0)
+    # with unknown_token, unknowns map to the shared symbol
+    u, vocab3 = mx.rnn.encode_sentences([["qqq", "b"]], vocab=dict(vocab),
+                                        unknown_token="<unk>",
+                                        invalid_label=0)
+    assert u[0][0] == vocab3["<unk>"]
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    cell = mx.rnn.LSTMCell(num_hidden=3, prefix="ck_")
+    x = mx.sym.Variable("x")
+    out, _ = cell.unroll(2, inputs=x, merge_outputs=True)
+    rs = np.random.RandomState(8)
+    args = {}
+    shapes, _, _ = out.infer_shape(x=(2, 2, 4))
+    for name, shp in zip(out.list_arguments(), shapes):
+        if name != "x":
+            args[name] = mx.nd.array(rs.uniform(-1, 1, shp)
+                                     .astype(np.float32))
+    prefix = str(tmp_path / "rnnck")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 3, out, args, {})
+    sym2, args2, _ = mx.rnn.load_rnn_checkpoint(cell, prefix, 3)
+    for k, v in args.items():
+        np.testing.assert_allclose(args2[k].asnumpy(), v.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_unroll_default_returns_step_list():
+    """Review find: merge_outputs=None keeps the per-step list (the
+    reference outputs[-1] last-hidden idiom)."""
+    cell = mx.rnn.GRUCell(num_hidden=4, prefix="dl_")
+    x = mx.sym.Variable("x")
+    outputs, _ = cell.unroll(3, inputs=x)
+    assert isinstance(outputs, list) and len(outputs) == 3
+    feeds = {"x": np.random.RandomState(9)
+             .uniform(-1, 1, (2, 3, 5)).astype(np.float32)}
+    (last,), _ = _bind_forward(outputs[-1], feeds)
+    assert last.shape == (2, 4)
+
+
+def test_sequential_with_fused_child():
+    """Review find: SequentialRNNCell delegates to child unroll, so
+    unroll-only cells (FusedRNNCell) compose."""
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.FusedRNNCell(num_hidden=4, num_layers=1, mode="gru",
+                                  prefix="sf_"))
+    stack.add(mx.rnn.LSTMCell(num_hidden=3, prefix="sl_"))
+    x = mx.sym.Variable("x")
+    out, _ = stack.unroll(4, inputs=x, merge_outputs=True)
+    feeds = {"x": np.random.RandomState(10)
+             .uniform(-1, 1, (2, 4, 5)).astype(np.float32)}
+    (res,), _ = _bind_forward(out, feeds)
+    assert res.shape == (2, 4, 3)
+
+
+def test_fused_pack_unpack_roundtrip():
+    """Review find: FusedRNNCell.pack_weights inverts unpack_weights."""
+    fused = mx.rnn.FusedRNNCell(num_hidden=3, num_layers=2, mode="lstm",
+                                prefix="fp_")
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    n = rnn_param_size(2, 5, 3, False, "lstm")
+    rs = np.random.RandomState(11)
+    params = {"fp_parameters": mx.nd.array(
+        rs.uniform(-1, 1, (n,)).astype(np.float32))}
+    unpacked = fused.unpack_weights(dict(params))
+    assert "fp_l0_i2h_i_weight" in unpacked
+    packed = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(packed["fp_parameters"].asnumpy(),
+                               params["fp_parameters"].asnumpy(),
+                               rtol=1e-6)
+
+
+def test_fused_rnn_initializer_forget_bias():
+    """Review find: the flat parameter vector initializes through
+    init.FusedRNN (Module.init_params path), with the lstm forget-gate
+    bias forced."""
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    fused = mx.rnn.FusedRNNCell(num_hidden=3, num_layers=1, mode="lstm",
+                                prefix="fi_", forget_bias=2.0)
+    x = mx.sym.Variable("x")
+    out, _ = fused.unroll(2, inputs=x, merge_outputs=True)
+    n = rnn_param_size(1, 4, 3, False, "lstm")
+    arr = mx.nd.zeros((n,))
+    desc = mx.init.InitDesc("fi_parameters",
+                            attrs={"__init__": mx.init.FusedRNN(
+                                mx.init.Uniform(0.1), 3, 1, "lstm",
+                                False, 2.0).dumps()})
+    mx.init.Xavier()(desc, arr)
+    unpacked = fused.unpack_weights({"fi_parameters": arr})
+    np.testing.assert_allclose(unpacked["fi_i2h_f_bias"]
+                               .asnumpy() if "fi_i2h_f_bias" in unpacked
+                               else unpacked["fi_l0_i2h_f_bias"].asnumpy(),
+                               2.0)
+    w = unpacked["fi_l0_i2h_i_weight"].asnumpy()
+    assert np.abs(w).max() <= 0.1 and np.abs(w).std() > 0
