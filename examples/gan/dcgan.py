@@ -54,6 +54,11 @@ def build_discriminator():
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    args = ap.parse_args()
     rng = np.random.RandomState(0)
     # "real" data: smooth blobs in [-1, 1]
     yy, xx = np.mgrid[0:16, 0:16].astype(np.float32)
@@ -74,7 +79,7 @@ def main():
     lossfn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
 
     B = 16
-    for step in range(40):
+    for step in range(args.steps):
         real = mx.nd.array(real_batch(B))
         z = mx.nd.array(rng.randn(B, Z, 1, 1).astype(np.float32))
         ones = mx.nd.ones((B,))

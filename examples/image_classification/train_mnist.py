@@ -57,11 +57,15 @@ def main():
     ap.add_argument("--optimizer", default="adam",
                     help="'sgd' + --lr 0.05 mirrors the reference defaults; "
                          "adam converges faster on the synthetic fallback set")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="cap the dataset size (CI smoke configs)")
     args = ap.parse_args()
 
     import mxnet_tpu as mx
 
     X, y = load_data(args.data_dir)
+    if args.limit:
+        X, y = X[:args.limit], y[:args.limit]
     n_val = max(len(X) // 10, args.batch_size)
     train_iter = mx.io.NDArrayIter(X[n_val:], y[n_val:], args.batch_size,
                                    shuffle=True)

@@ -26,6 +26,11 @@ from mxnet_tpu.gluon import nn
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
     trace_file = os.environ.get("MXTPU_PROFILE_OUT", "/tmp/mxtpu_profile.json")
     profiler.set_config(filename=trace_file, profile_all=True)
     profiler.set_state("run")
@@ -39,8 +44,8 @@ def main():
     lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
     rng = np.random.RandomState(0)
 
-    with profiler.Task("train-10-steps"):
-        for step in range(10):
+    with profiler.Task("train-steps"):
+        for step in range(args.steps):
             profiler.Marker("step-%d" % step).mark()
             x = mx.nd.array(rng.randn(32, 64).astype(np.float32))
             y = mx.nd.array(rng.randint(0, 10, (32,)).astype(np.float32))

@@ -40,12 +40,19 @@ def build_lenet():
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="1 epoch over 128 samples (CI smoke configs)")
+    args = ap.parse_args()
+    n, n_epochs = (256, 2) if args.smoke else (512, 3)
     rng = np.random.RandomState(0)
     # synthetic "MNIST": 10 gaussian class prototypes + noise
     protos = rng.uniform(-1, 1, (10, 1, 28, 28)).astype(np.float32)
     X = np.concatenate([protos[i % 10][None] + 0.1 * rng.randn(1, 1, 28, 28)
-                        for i in range(512)]).astype(np.float32)
-    Y = np.array([i % 10 for i in range(512)], dtype=np.float32)
+                        for i in range(n)]).astype(np.float32)
+    Y = np.array([i % 10 for i in range(n)], dtype=np.float32)
 
     net = build_lenet()
     net.initialize(mx.init.Xavier())
@@ -53,8 +60,8 @@ def main():
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 2e-3})
     lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
-    for epoch in range(3):
-        for i in range(0, 512, 64):
+    for epoch in range(n_epochs):
+        for i in range(0, n, 64):
             x = mx.nd.array(X[i:i + 64])
             y = mx.nd.array(Y[i:i + 64])
             with mx.autograd.record():
@@ -80,7 +87,7 @@ def main():
         calib_mode="entropy", calib_data=calib, num_calib_examples=128)
 
     mod = mx.module.Module(qsym, label_names=None, context=mx.cpu())
-    mod.bind(data_shapes=[("data", (512, 1, 28, 28))], for_training=False)
+    mod.bind(data_shapes=[("data", (n, 1, 28, 28))], for_training=False)
     mod.set_params(qarg, qaux, allow_missing=True)
 
     def q_fwd(x):
@@ -90,7 +97,9 @@ def main():
     int8_acc = accuracy(q_fwd)
     print("fp32 accuracy: %.3f   int8 accuracy: %.3f   drop: %.3f"
           % (fp32_acc, int8_acc, fp32_acc - int8_acc))
-    assert int8_acc > fp32_acc - 0.02, "int8 accuracy dropped >2%"
+    tol = 0.06 if args.smoke else 0.02   # 1-2 epoch accuracies are noisy
+    assert int8_acc > fp32_acc - tol, \
+        "int8 accuracy dropped >%.0f%%" % (tol * 100)
 
 
 if __name__ == "__main__":

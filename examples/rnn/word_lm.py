@@ -31,6 +31,8 @@ def main():
     ap.add_argument("--bptt", type=int, default=32)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--limit-batches", type=int, default=0,
+                    help="cap bptt windows per epoch (CI smoke configs)")
     args = ap.parse_args()
 
     import mxnet_tpu as mx
@@ -48,7 +50,10 @@ def main():
     for epoch in range(args.epochs):
         total, count = 0.0, 0
         state = model.begin_state(args.batch_size)
-        for t in range(0, corpus.shape[0] - args.bptt - 1, args.bptt):
+        steps = range(0, corpus.shape[0] - args.bptt - 1, args.bptt)
+        if args.limit_batches:
+            steps = list(steps)[:args.limit_batches]
+        for t in steps:
             # TNC layout: (T, B) ids, next-token targets
             x = mx.nd.array(corpus[t:t + args.bptt], dtype="int32")
             y = mx.nd.array(corpus[t + 1:t + args.bptt + 1]

@@ -43,6 +43,11 @@ def synth_batch(rng, w_true):
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    args = ap.parse_args()
     rng = np.random.RandomState(0)
     w_true = rng.randn(DIM).astype(np.float32)
 
@@ -59,8 +64,8 @@ def main():
     kv.init(0, weight)
 
     correct = total = 0
-    for step in range(150):
-        if step == 120:
+    for step in range(args.steps):
+        if step == max(args.steps - 30, args.steps * 4 // 5):
             correct = total = 0  # measure post-convergence accuracy
         x, y = synth_batch(rng, w_true)
         with autograd.record():
@@ -86,7 +91,8 @@ def main():
     kv.row_sparse_pull(0, out=out, row_ids=rows)
     acc = correct / total
     print("final accuracy %.3f" % acc)
-    assert acc > 0.8, "sparse linear model failed to learn (acc %.3f)" % acc
+    bar = 0.8 if args.steps >= 150 else 0.6   # smoke runs train less
+    assert acc > bar, "sparse linear model failed to learn (acc %.3f)" % acc
 
 
 if __name__ == "__main__":

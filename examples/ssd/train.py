@@ -40,6 +40,8 @@ def main():
     ap.add_argument("--lr", type=float, default=2e-3)
     ap.add_argument("--network", default="tiny",
                     choices=["tiny", "resnet50_v1"])
+    ap.add_argument("--samples", type=int, default=32,
+                    help="synthetic dataset size (CI smoke configs)")
     args = ap.parse_args()
 
     import mxnet_tpu as mx
@@ -50,7 +52,7 @@ def main():
     it = mx.image.ImageDetIter(
         batch_size=args.batch_size, data_shape=(3, args.data_shape,
                                                 args.data_shape),
-        imglist=synth_dataset(), path_root="", rand_mirror=True)
+        imglist=synth_dataset(n=args.samples), path_root="", rand_mirror=True)
 
     net = ssd_test_tiny(num_classes=2) if args.network == "tiny" else \
         get_ssd(args.network, args.data_shape, num_classes=2)

@@ -596,6 +596,20 @@ def array(source, ctx=None, dtype=None):
         # (python/mxnet/ndarray/ndarray.py `array`)
         dtype = "float32"
     npa = _np.asarray(source, dtype=np_dtype(dtype))
+    if npa.dtype in (_np.int64, _np.uint64) and npa.size and \
+            not jax.config.jax_enable_x64:
+        # int64 policy (README divergences): device integers are int32
+        # (XLA's native index type) under default config. Narrowing is
+        # silent for in-range values; out-of-range values would corrupt
+        # silently, so raise with the escape hatch instead.
+        lo, hi = int(npa.min()), int(npa.max())
+        if lo < -2 ** 31 or hi >= 2 ** 31:
+            raise MXNetError(
+                "int64 values out of int32 range (%d..%d): device arrays "
+                "narrow to int32 under default JAX config; set "
+                "JAX_ENABLE_X64=1 for true int64, or keep large ids on "
+                "host-side paths (recordio keys, dgl graph ops)"
+                % (lo, hi))
     return NDArray(jax.device_put(jnp.asarray(npa), ctx.jax_device()), ctx=ctx)
 
 

@@ -120,7 +120,8 @@ def index_array(data, axes=None):
         shape1[a] = data.shape[a]
         comps.append(jnp.broadcast_to(
             jnp.arange(data.shape[a]).reshape(shape1), data.shape))
-    return jnp.stack(comps, axis=-1).astype(jnp.int64)
+    # int32 (int64 policy): avoids the per-call x64 truncation warning
+    return jnp.stack(comps, axis=-1).astype(jnp.int32)
 
 
 @register("_contrib_fft", aliases=("fft",))

@@ -757,5 +757,8 @@ def histogram(data, bin_cnt=10, range=None):
     edges = lo + (hi - lo) * jnp.arange(bin_cnt + 1, dtype=flat.dtype) / bin_cnt
     scaled = (flat - lo) / jnp.maximum(hi - lo, jnp.asarray(1e-12, flat.dtype)) * bin_cnt
     idx = jnp.clip(scaled.astype(jnp.int32), 0, bin_cnt - 1)
-    cnt = jnp.zeros((bin_cnt,), jnp.int64).at[idx].add(1)
+    # int32 counts (int64 policy, README divergences): the reference emits
+    # int64, but device integers are int32 under default JAX config and
+    # requesting int64 here only produced a truncation warning per call
+    cnt = jnp.zeros((bin_cnt,), jnp.int32).at[idx].add(1)
     return cnt, edges

@@ -1147,6 +1147,9 @@ EXCLUDED = {
     "dgl_adjacency": "dgl suite (test_dgl.py)",
     "_contrib_dgl_graph_compact": "dgl suite (test_dgl.py)",
     "dgl_graph_compact": "dgl suite (test_dgl.py)",
+    "_rnn_state_zeros": "mx.rnn begin_state plumbing (test_rnn_cells.py)",
+    "_rnn_fused_state_zeros": "mx.rnn begin_state plumbing "
+                              "(test_rnn_cells.py)",
     "_contrib_quantized_fully_connected": "quantized dense roundtrip test "
                                           "below",
     "_contrib_adamw_update": "alias of adamw_update (swept)",
