@@ -666,27 +666,77 @@ def switch_moe(data, gate_weight, expert_w_in, expert_w_out,
                capacity_factor=1.25):
     """data (..., d); gate_weight (E, d); expert tables (E, d, h)/(E, h, d).
     Returns (output (..., d), aux_loss ()) — aux is the Switch load-balance
-    loss E * sum_e(frac_tokens_e * frac_probs_e)."""
+    loss E * sum_e(frac_tokens_e * frac_probs_e). Exactly `topk_moe` at
+    k=1 with unnormalized gates (one shared dispatch body; the router
+    z-loss output is dropped — XLA dead-code-eliminates it under jit)."""
+    out, lb, _z = topk_moe(data, gate_weight, expert_w_in, expert_w_out,
+                           k=1, capacity_factor=capacity_factor,
+                           normalize_gates=False)
+    return out, lb
+
+
+@register("_contrib_topk_moe", num_outputs=3, num_visible_outputs=3,
+          aliases=("topk_moe",))
+def topk_moe(data, gate_weight, expert_w_in, expert_w_out, k=2,
+             capacity_factor=1.25, normalize_gates=True):
+    """Top-k MoE routing (GShard/Mixtral-style generalization of
+    `switch_moe`; k=1 reproduces Switch). data (..., d); gate_weight (E, d);
+    expert tables (E, d, h)/(E, h, d). Returns
+
+      (output (..., d), lb_loss (), z_loss ())
+
+    - lb_loss: load-balance loss E * sum_e(frac_tokens_e * frac_probs_e),
+      with frac_tokens counting all k assignments (each token contributes
+      1/k per choice so a balanced router scores 1.0, as at k=1).
+    - z_loss: router z-loss mean_t(logsumexp(logits_t)^2) (ST-MoE) — keeps
+      router logits small; scale with your own coefficient (~1e-3).
+
+    Capacity is `capacity_factor * k * T / E` slots per expert, shared
+    across choices in priority order (choice 0 claims slots before choice 1,
+    matching the GShard dispatch priority); overflow tokens drop that
+    choice. The dispatch/combine einsums are the GSPMD formulation: with an
+    `ep` mesh axis the (E, C, d) activations shard over `ep` and XLA lowers
+    the resharding to ICI all_to_alls, exactly as in `switch_moe`."""
+    k = int(k)
+    if k < 1:
+        raise ValueError("topk_moe: k must be >= 1")
     lead = data.shape[:-1]
     d = data.shape[-1]
     tokens = data.reshape(-1, d)
     t = tokens.shape[0]
     e = gate_weight.shape[0]
-    cap = max(1, int(capacity_factor * t / e))
+    if k > e:
+        raise ValueError("topk_moe: k=%d > num_experts=%d" % (k, e))
+    cap = max(1, int(capacity_factor * k * t / e))
 
     logits = jnp.einsum("td,ed->te", tokens, gate_weight,
                         preferred_element_type=jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                   # (T,)
-    gate_val = jnp.max(probs, axis=-1)
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (T, E)
-    # position of each token within its expert's queue; overflow drops
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # (T, E)
-    keep = (pos < cap) & (onehot > 0)
-    slot = jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), cap,
-                          dtype=jnp.float32)               # (T, C)
-    dispatch = keep.astype(jnp.float32)[:, :, None] * slot[:, None, :]
-    # (T, E, C) -> gather tokens into (E, C, d): the ep resharding point
+    gate_vals, experts = jax.lax.top_k(probs, k)           # (T, k)
+    if normalize_gates and k > 1:
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(axis=-1, keepdims=True), 1e-9)
+
+    # Per-choice dispatch with capacity shared across choices: choice j's
+    # queue positions start after every earlier choice's claims (k is a
+    # small static int, so this Python loop unrolls under trace).
+    counts = jnp.zeros((e,), jnp.float32)
+    combine = jnp.zeros((t, e, cap), jnp.float32)
+    dispatch = jnp.zeros((t, e, cap), jnp.float32)
+    onehot_sum = jnp.zeros((t, e), jnp.float32)
+    for j in range(k):
+        onehot = jax.nn.one_hot(experts[:, j], e, dtype=jnp.float32)
+        onehot_sum = onehot_sum + onehot
+        pos = ((jnp.cumsum(onehot, axis=0) - 1.0) + counts[None, :]) * onehot
+        keep = (pos < cap) & (onehot > 0)
+        slot = jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), cap,
+                              dtype=jnp.float32)
+        dispatch_j = keep.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + dispatch_j
+        combine = combine + dispatch_j * gate_vals[:, j].astype(
+            jnp.float32)[:, None, None]
+        counts = counts + onehot.sum(axis=0)
+
     xe = jnp.einsum("tec,td->ecd", dispatch, tokens,
                     preferred_element_type=jnp.float32).astype(data.dtype)
     he = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, expert_w_in,
@@ -694,11 +744,14 @@ def switch_moe(data, gate_weight, expert_w_in, expert_w_out,
                      .astype(data.dtype))
     ye = jnp.einsum("ech,ehd->ecd", he, expert_w_out,
                     preferred_element_type=jnp.float32).astype(data.dtype)
-    combine = dispatch * gate_val[:, None, None]
+    # combine stays float32 into the mixed-dtype contraction: gates keep
+    # their full softmax precision even for bf16 activations
     out = jnp.einsum("tec,ecd->td", combine, ye,
                      preferred_element_type=jnp.float32).astype(data.dtype)
-    # Switch aux loss (load balancing): E * sum_e mean_t(route_e)*mean_t(p_e)
-    frac_tokens = onehot.mean(axis=0)
+
+    frac_tokens = onehot_sum.mean(axis=0) / k
     frac_probs = probs.mean(axis=0)
-    aux = (frac_tokens * frac_probs).sum() * e
-    return out.reshape(lead + (d,)), aux.astype(jnp.float32)
+    lb = (frac_tokens * frac_probs).sum() * e
+    z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    return (out.reshape(lead + (d,)), lb.astype(jnp.float32),
+            z.astype(jnp.float32))

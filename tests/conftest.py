@@ -10,6 +10,13 @@ _ON_TPU = os.environ.get("MXTPU_TEST_TPU") == "1"
 
 if not _ON_TPU:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Drop the accelerator-tunnel sitecustomize trigger from the inherited
+    # env so every subprocess a test spawns (examples, dist-kvstore workers,
+    # dryrun re-execs) starts as a plain CPU interpreter. Without this a
+    # wedged tunnel blocks the child's first jax op even under
+    # JAX_PLATFORMS=cpu (the tunnel hook force-overrides jax_platforms at
+    # the config level at interpreter start).
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = \

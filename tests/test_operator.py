@@ -1100,6 +1100,29 @@ case("_contrib_switch_moe", _moe_tok, _moe_gw, _moe_wi, _moe_wo,
      or pytest.fail("switch_moe mismatch vs dense routing reference"))
 
 
+def _topk_moe_ref(tok, gw, wi, wo, k=2):
+    """dense top-k routing at unbounded capacity, normalized gates"""
+    logits = tok @ gw.T
+    p = np.exp(logits - logits.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    out = np.zeros_like(tok)
+    for i in range(len(tok)):
+        top = np.argsort(-p[i])[:k]
+        gv = p[i][top] / p[i][top].sum()
+        for g, e in zip(gv, top):
+            out[i] += g * (np.maximum(tok[i] @ wi[e], 0) @ wo[e])
+    return out
+
+
+case("_contrib_topk_moe", _moe_tok, _moe_gw, _moe_wi, _moe_wo,
+     attrs={"k": 2, "capacity_factor": 8.0}, grad=[0, 2, 3], naive=True,
+     check=lambda outs, c: (np.allclose(
+         outs[0], _topk_moe_ref(*c.arrays), atol=1e-4)
+         and outs[1].shape == () and outs[1] >= 1.0 - 1e-5
+         and outs[2].shape == () and outs[2] >= 0.0)
+     or pytest.fail("topk_moe mismatch vs dense top-2 routing reference"))
+
+
 # ---------------------------------------------------------------------------
 # exclusions (name -> reason). Every registry op must be swept or listed.
 # ---------------------------------------------------------------------------

@@ -452,3 +452,63 @@ def test_moe_aux_loss_channels():
     assert out2.shape == x.shape and aux2.shape == ()
     # hybridized attribute must NOT hold a stale tracer
     assert moe2.aux_loss is None or hasattr(moe2.aux_loss, "asnumpy")
+
+
+def test_moe_topk_routing():
+    """num_experts_per_token=2 + z_loss_coef routes through topk_moe: output
+    differs from top-1 routing on the same weights, aux folds in the z-loss,
+    and gradients reach every expert table."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.contrib.moe import MoEFFN
+
+    np.random.seed(1)
+    x = mx.nd.array(np.random.normal(size=(2, 6, 16)).astype(np.float32))
+
+    top1 = MoEFFN(units=16, hidden_size=8, num_experts=4, return_aux=True)
+    top2 = MoEFFN(units=16, hidden_size=8, num_experts=4, return_aux=True,
+                  num_experts_per_token=2, z_loss_coef=1e-3,
+                  capacity_factor=4.0)
+    top1.initialize(mx.init.Xavier())
+    top2.initialize(mx.init.Xavier())
+    # same weights in both blocks
+    for p1, p2 in zip(top1.collect_params().values(),
+                      top2.collect_params().values()):
+        p2.set_data(p1.data())
+
+    o1, a1 = top1(x)
+    with autograd.record():
+        o2, a2 = top2(x)
+        L = (o2 * o2).mean() + 0.01 * a2
+    L.backward()
+    assert o2.shape == x.shape and a2.shape == ()
+    # top-2 blends a second expert in -> outputs must differ from top-1
+    assert not np.allclose(o1.asnumpy(), o2.asnumpy(), atol=1e-5)
+    # z-loss actually folds in: identical weights with z_loss_coef=0 must
+    # report a strictly smaller aux
+    top2_noz = MoEFFN(units=16, hidden_size=8, num_experts=4,
+                      return_aux=True, num_experts_per_token=2,
+                      capacity_factor=4.0)
+    top2_noz.initialize(mx.init.Xavier())
+    for p1, p2 in zip(top2.collect_params().values(),
+                      top2_noz.collect_params().values()):
+        p2.set_data(p1.data())
+    _, a2_noz = top2_noz(x)
+    assert float(a2.asnumpy()) > float(a2_noz.asnumpy())
+    for p in top2.collect_params().values():
+        g = p.grad().asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    # hybridized path compiles and agrees with eager
+    top2h = MoEFFN(units=16, hidden_size=8, num_experts=4, return_aux=True,
+                   num_experts_per_token=2, z_loss_coef=1e-3,
+                   capacity_factor=4.0)
+    top2h.initialize(mx.init.Xavier())
+    for p1, p2 in zip(top2.collect_params().values(),
+                      top2h.collect_params().values()):
+        p2.set_data(p1.data())
+    top2h.hybridize()
+    oh, ah = top2h(x)
+    np.testing.assert_allclose(oh.asnumpy(), o2.asnumpy(), atol=1e-5)
