@@ -63,10 +63,20 @@ class Trainer:
         self._updaters = [opt.get_updater(self._optimizer)
                           for _ in self._contexts]
 
+    def _is_dist_kvstore(self):
+        """Rank-spanning kvstore? (needs grad sync even with ONE local
+        device — the reference's standard 1-GPU-per-worker mode,
+        trainer.py:169 `'dist' in kvstore.type`)."""
+        kt = self._kvstore_type
+        if isinstance(kt, str):
+            return "dist" in kt
+        return getattr(kt, "num_workers", 1) > 1
+
     def _init_kvstore(self):
         """Lazily create the kvstore (reference: trainer.py:169)."""
         self._kv_initialized = True
-        if not self._kvstore_type or len(self._contexts) < 2:
+        if not self._kvstore_type or (len(self._contexts) < 2
+                                      and not self._is_dist_kvstore()):
             self._kvstore = None
             return
         from .. import kvstore as kvs
@@ -74,9 +84,14 @@ class Trainer:
         kv = kvs.create(self._kvstore_type) if isinstance(self._kvstore_type, str) \
             else self._kvstore_type
         self._kvstore = kv
+        dist = self._is_dist_kvstore()
         for i, param in enumerate(self._params):
             if param._data is not None:
                 kv.init(i, param.list_data()[0])
+                if dist:
+                    # adopt the group-authoritative (rank 0) initial value
+                    # so every rank trains the same replica
+                    kv.pull(i, out=param.list_data())
 
     @property
     def learning_rate(self):
@@ -100,7 +115,7 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self):
-        if len(self._contexts) < 2:
+        if len(self._contexts) < 2 and self._kvstore is None:
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":

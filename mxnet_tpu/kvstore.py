@@ -263,6 +263,21 @@ class _DistKVStore(KVStore):
     uses a tiny jitted psum over a 1-axis process mesh — DCN-aware via XLA.
     """
 
+    def init(self, key, value):
+        super().init(key, value)
+        if self.num_workers > 1:
+            # rank 0's value is authoritative (reference ps-lite semantics:
+            # worker 0's init lands in the server store and a pull
+            # broadcasts it) — without this, ranks that initialize with
+            # different random draws would train permanently-diverged
+            # replicas (grad sums are identical, so the offset never decays)
+            from jax.experimental import multihost_utils
+            keys, _ = _key_list(key)
+            for k in keys:
+                arr = self._store[k]
+                arr._set_data(
+                    multihost_utils.broadcast_one_to_all(arr._data))
+
     def push(self, key, value, priority=0):
         super().push(key, value, priority=priority)
         if self.num_workers > 1:

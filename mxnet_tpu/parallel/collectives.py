@@ -146,24 +146,50 @@ def init_process_group(coordinator_address=None, num_processes=None,
 
     import jax
 
+    def _env_int(*names):
+        for n in names:
+            v = os.environ.get(n)
+            if v is not None:
+                return int(v)
+        return None
+
+    # Size/rank resolution order: our protocol, the reference's DMLC
+    # protocol, then whatever process manager actually spawned us — OpenMPI
+    # (tools/launch.py --launcher mpi), generic PMI, slurm (srun on a TPU
+    # pod plays dmlc-tracker's role). The scheduler vars are chosen to only
+    # exist on processes the manager really fanned out: OMPI_*/PMI_* appear
+    # only under mpirun/mpiexec, and SLURM_STEP_NUM_TASKS is per-srun-step
+    # (an sbatch batch script sees SLURM_NTASKS for the *allocation* but its
+    # own step is a single task — sniffing SLURM_NTASKS would deadlock a
+    # lone `python train.py` inside `sbatch --ntasks=4`).
     if num_processes is None:
-        num_processes = int(os.environ.get(
-            "MXTPU_NUM_WORKERS",
-            os.environ.get("MXNET_TPU_NUM_WORKERS",
-                           os.environ.get("DMLC_NUM_WORKER", "1"))))
+        num_processes = _env_int("MXTPU_NUM_WORKERS", "MXNET_TPU_NUM_WORKERS",
+                                 "DMLC_NUM_WORKER", "OMPI_COMM_WORLD_SIZE",
+                                 "PMI_SIZE", "SLURM_STEP_NUM_TASKS") or 1
     if num_processes <= 1:
         return
     if coordinator_address is None:
         coordinator_address = os.environ.get("MXTPU_COORDINATOR")
     if process_id is None:
-        pid = os.environ.get("MXTPU_PROCESS_ID",
-                             os.environ.get("DMLC_WORKER_ID"))
-        process_id = int(pid) if pid is not None else None
+        process_id = _env_int("MXTPU_PROCESS_ID", "DMLC_WORKER_ID",
+                              "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                              "SLURM_PROCID")
     if jax.distributed.is_initialized():
         return  # idempotent re-entry
     # NOTE: must run before the first jax computation — the backend snapshots
     # the process group at creation (call this before importing anything
     # that touches jax arrays, or at worker start; tools/launch.py pattern)
+    if coordinator_address is None:
+        if os.environ.get("SLURM_STEP_NUM_TASKS"):
+            # bare `srun python train.py` with no launcher: jax's own slurm
+            # cluster detection derives the coordinator from the step's
+            # nodelist — hand it the whole rendezvous
+            jax.distributed.initialize()
+            return
+        raise RuntimeError(
+            "init_process_group: %d processes detected (scheduler env) but "
+            "no coordinator address — set MXTPU_COORDINATOR=host:port (the "
+            "tools/launch.py modes export it automatically)" % num_processes)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

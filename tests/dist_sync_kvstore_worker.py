@@ -14,18 +14,16 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")  # axon sitecustomize override
 
-# the process group must exist before the first jax computation (importing
-# mxnet_tpu touches jax) — initialize straight from the launcher's env
-if int(os.environ.get("MXTPU_NUM_WORKERS", "1")) > 1:
-    jax.distributed.initialize(
-        coordinator_address=os.environ["MXTPU_COORDINATOR"],
-        num_processes=int(os.environ["MXTPU_NUM_WORKERS"]),
-        process_id=int(os.environ["MXTPU_PROCESS_ID"]))
+# the process group must exist before the first jax computation (package
+# import is computation-free) — init_process_group resolves rank/size from
+# whichever launcher spawned us (MXTPU_*, DMLC_*, OMPI_*/PMI_*, SLURM_*)
+from mxnet_tpu.parallel import collectives  # noqa: E402
+
+collectives.init_process_group()
 
 import numpy as np  # noqa: E402
 
 import mxnet_tpu as mx  # noqa: E402
-from mxnet_tpu.parallel import collectives  # noqa: E402
 
 
 def main():

@@ -38,3 +38,106 @@ def test_launch_cli_propagates_failure():
          "-n", "2", "--", sys.executable, "-c", "import sys; sys.exit(3)"],
         env=env, capture_output=True, text=True, timeout=120)
     assert proc.returncode != 0
+
+
+def test_dist_trainer_single_device_syncs():
+    """gluon.Trainer + dist_sync kvstore + ONE local device per rank must
+    allreduce grads across ranks (regression: the kvstore was discarded
+    whenever len(contexts) < 2, silently training each rank independently).
+    Ranks train on different shards; identical weight checksums prove the
+    sync happened."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--",
+         sys.executable,
+         os.path.join(_ROOT, "tests", "dist_trainer_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    import re
+    found = dict(re.findall(r"DIST_TRAINER_OK rank=(\d)/2 wsum=(-?[\d.]+)",
+                            out))
+    assert set(found) == {"0", "1"}, out[-4000:]
+    assert len(set(found.values())) == 1, "ranks diverged: %s" % found
+
+
+def test_launch_ssh_mode(tmp_path):
+    """--launcher ssh through a local ssh shim (the dmlc-tracker test
+    pattern — no sshd in CI): the shim drops the host argument and runs the
+    remote command locally, so the full dist-kvstore worker group rendezvous
+    through the ssh code path (hostfile parsing, per-rank env protocol,
+    remote command quoting)."""
+    shim = tmp_path / "fake-ssh"
+    shim.write_text("#!/bin/sh\n# $1=host, $2=remote command string\n"
+                    "shift\nexec /bin/sh -c \"$1\"\n")
+    shim.chmod(0o755)
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("# two slots on one 'machine'\n127.0.0.1:2\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    # the shim runs everything locally, so probe a known-free local port
+    # instead of letting ssh mode pick a random unverifiable one
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "ssh", "-H", str(hostfile),
+         "--port", str(port),
+         "--ssh-cmd", str(shim), "--",
+         sys.executable,
+         os.path.join(_ROOT, "tests", "dist_sync_kvstore_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    for r in range(2):
+        assert ("DIST_KV_OK rank=%d/2" % r) in out, out[-4000:]
+
+
+def test_launch_mpi_mode(tmp_path):
+    """--launcher mpi through a local mpirun shim: the shim spawns -np
+    copies with OMPI_COMM_WORLD_RANK/SIZE set (exactly what a real mpirun
+    does), and rank/size resolve inside init_process_group from the OMPI
+    envs — no MXTPU_PROCESS_ID anywhere."""
+    shim = tmp_path / "fake-mpirun"
+    shim.write_text("""#!/usr/bin/env python3
+import os, subprocess, sys
+args = sys.argv[1:]
+np = 0
+cmd = []
+i = 0
+while i < len(args):
+    if args[i] == "-np":
+        np = int(args[i + 1]); i += 2
+    elif args[i] in ("-x", "--hostfile"):
+        i += 2  # env already inherited; placement is local
+    else:
+        cmd = args[i:]; break
+procs = []
+for r in range(np):
+    env = dict(os.environ)
+    env["OMPI_COMM_WORLD_RANK"] = str(r)
+    env["OMPI_COMM_WORLD_SIZE"] = str(np)
+    procs.append(subprocess.Popen(cmd, env=env))
+sys.exit(max(p.wait() for p in procs))
+""")
+    shim.chmod(0o755)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "mpi", "--mpi-cmd", str(shim),
+         "--coordinator-host", "127.0.0.1", "--",
+         sys.executable,
+         os.path.join(_ROOT, "tests", "dist_sync_kvstore_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    for r in range(2):
+        assert ("DIST_KV_OK rank=%d/2" % r) in out, out[-4000:]

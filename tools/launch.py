@@ -1,31 +1,52 @@
 """Distributed job launcher (reference: tools/launch.py — the dmlc-tracker
-front-end that spawned scheduler/server/worker processes over ssh/mpi/yarn).
+front-end that spawned scheduler/server/worker processes over
+ssh/mpi/yarn/sge).
 
 TPU-native: there are no parameter servers; every process is a worker in a
 synchronous `jax.distributed` group (the coordinator service replaces the
-ps-lite scheduler rendezvous — SURVEY §5.8). This launcher covers the
-`local` cluster type (N processes on this host — the reference's nightly
-dist tests pattern, tests/nightly/test_all.sh:55) and emits the standard
-env-var protocol so `mxnet_tpu.kv.create('dist_sync')` works unmodified:
+ps-lite scheduler rendezvous — SURVEY §5.8). Launch modes:
+
+  --launcher local   N processes on this host (the reference's nightly dist
+                     tests pattern, tests/nightly/test_all.sh:55)
+  --launcher ssh     one process per hostfile slot over ssh (reference
+                     dmlc-tracker/ssh.py); requires -H/--hostfile with
+                     `host` or `host:slots` lines; rank 0's host serves the
+                     coordinator, so its address must be reachable from all
+                     hosts
+  --launcher mpi     delegates process placement to mpirun/mpiexec
+                     (reference dmlc-tracker/mpi.py); ranks resolve via
+                     OMPI_COMM_WORLD_RANK/PMI_RANK inside
+                     `init_process_group`, so the command needs no wrapper
+
+yarn/sge submission is a documented divergence: on TPU fleets the cluster
+scheduler (k8s/slurm) owns placement, and `init_process_group` reads
+SLURM_PROCID/SLURM_STEP_NUM_TASKS directly — `srun python train.py` on a
+pod is the whole launch story (parallel/collectives.py:init_process_group).
+
+Every mode emits the standard env protocol so
+`mxnet_tpu.kv.create('dist_sync')` works unmodified:
 
   MXTPU_COORDINATOR     host:port of process 0's coordinator service
   MXTPU_NUM_WORKERS     group size        (alias: DMLC_NUM_WORKER)
   MXTPU_PROCESS_ID      this process rank (alias: DMLC_WORKER_ID)
 
-For multi-host, run the same command on each host with MXTPU_PROCESS_ID
-set per host and MXTPU_COORDINATOR pointing at host 0 (ssh/mpi orchestration
-is left to the cluster scheduler — slurm/k8s do what dmlc-tracker did).
-
-Usage: python tools/launch.py -n 4 [--port 52321] python train.py ...
+Usage:
+  python tools/launch.py -n 4 python train.py ...
+  python tools/launch.py -n 8 --launcher ssh -H hosts.txt python train.py ...
+  python tools/launch.py -n 16 --launcher mpi --hostfile hosts.txt -- \
+      python train.py ...
 """
 from __future__ import annotations
 
 import argparse
 import os
+import random
+import shlex
 import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port():
@@ -36,16 +57,184 @@ def _free_port():
     return port
 
 
+def _remote_port():
+    """Coordinator port for a REMOTE rank-0 host. Nothing can be verified
+    from here, so pick from a band below Linux's default ephemeral range
+    (32768+) to minimise collision odds; pass --port to pin one that is
+    known-free on the rank-0 host."""
+    return random.randint(10000, 29999)
+
+
+def _protocol_env(n, coord, extra, rank=None):
+    """The env-var protocol workers see. rank=None yields only the
+    rank-independent half (mpi mode: the process manager assigns ranks)."""
+    env = {
+        "MXTPU_COORDINATOR": coord,
+        "MXTPU_NUM_WORKERS": str(n),
+        # reference-compatible aliases (DMLC_* protocol, launch.py:29)
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_ROLE": "worker",
+    }
+    if rank is not None:
+        env["MXTPU_PROCESS_ID"] = str(rank)
+        env["DMLC_WORKER_ID"] = str(rank)
+    for kv in extra:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    return env
+
+
+def _parse_hostfile(path):
+    """`host` or `host:slots` per line (dmlc hostfile format); '#' comments.
+    Returns one host entry per slot: ["a", "a", "b", ...]."""
+    slots = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            host, _, n = line.partition(":")
+            slots.extend([host.strip()] * (int(n) if n else 1))
+    return slots
+
+
+def _spawn_and_wait(cmds):
+    """Spawn every (argv, env) and supervise the group by polling: the
+    FIRST failure — a spawn error partway through the list, or any worker
+    exiting nonzero — SIGTERMs the survivors, so one crashed rank never
+    leaves the rest parked in the rendezvous waiting for it. Workers that
+    exit 0 simply leave the others to finish. (ssh mode: the SIGTERM hits
+    the local ssh client; sshd tears the remote command down with the
+    connection.)"""
+    procs = []
+    try:
+        for argv, env in cmds:
+            procs.append(subprocess.Popen(argv, env=env))
+        pending = list(procs)
+        rc = 0
+        while pending and not rc:
+            for p in list(pending):
+                r = p.poll()
+                if r is not None:
+                    pending.remove(p)
+                    rc = rc or r
+            if pending and not rc:
+                time.sleep(0.1)
+        return rc  # nonzero -> finally SIGTERMs the stragglers
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+def _launch_local(args):
+    port = args.port or _free_port()
+    coord = "127.0.0.1:%d" % port
+    cmds = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(_protocol_env(args.num_workers, coord, args.env, rank))
+        cmds.append((args.command, env))
+    return _spawn_and_wait(cmds)
+
+
+def _launch_ssh(args):
+    """One ssh session per rank (reference dmlc-tracker/ssh.py): env rides
+    inline `env K=V` prefixes because sshd filters most SendEnv vars, and
+    the remote cwd mirrors the local one (the dmlc assumption: a shared
+    filesystem or identical checkouts)."""
+    if not args.hostfile:
+        raise SystemExit("--launcher ssh requires -H/--hostfile")
+    slots = _parse_hostfile(args.hostfile)
+    if len(slots) < args.num_workers:
+        raise SystemExit("hostfile provides %d slots < -n %d"
+                         % (len(slots), args.num_workers))
+    port = args.port or _remote_port()
+    coord = "%s:%d" % (slots[0], port)
+    cwd = os.getcwd()
+    ssh = shlex.split(args.ssh_cmd)
+    cmds = []
+    for rank in range(args.num_workers):
+        host = slots[rank]
+        env = _protocol_env(args.num_workers, coord, args.env, rank)
+        # PYTHONPATH travels so `python tools/launch.py` from a checkout
+        # works without install on the remote side
+        if os.environ.get("PYTHONPATH"):
+            env.setdefault("PYTHONPATH", os.environ["PYTHONPATH"])
+        envs = " ".join("%s=%s" % (k, shlex.quote(v))
+                        for k, v in sorted(env.items()))
+        remote = "cd %s && env %s %s" % (
+            shlex.quote(cwd), envs,
+            " ".join(shlex.quote(c) for c in args.command))
+        cmds.append((ssh + [host, remote], dict(os.environ)))
+    return _spawn_and_wait(cmds)
+
+
+# per-flavor syntax for exporting one env var through the mpi launcher
+_MPI_ENV_FLAG = {
+    "openmpi": lambda k, v: ["-x", k],          # value from mpirun's env
+    "mpich": lambda k, v: ["-genv", k, v],      # mpiexec/hydra, Intel MPI
+    "none": lambda k, v: [],                    # cluster forwards env itself
+}
+
+
+def _launch_mpi(args):
+    """Delegate placement to mpirun (reference dmlc-tracker/mpi.py). Rank
+    and size are NOT passed per-process — `init_process_group` reads
+    OMPI_COMM_WORLD_RANK/PMI_RANK in each worker, so one mpirun command
+    covers every rank. The coordinator must be reachable from all hosts:
+    default is this host's address (mpirun is typically run from a job's
+    head node, matching the dmlc-tracker assumption)."""
+    port = args.port or _free_port()
+    host = args.coordinator_host or socket.getfqdn()
+    coord = "%s:%d" % (host, port)
+    proto = _protocol_env(args.num_workers, coord, args.env)
+    env = dict(os.environ)
+    env.update(proto)
+    cmd = shlex.split(args.mpi_cmd) + ["-np", str(args.num_workers)]
+    if args.hostfile:
+        cmd += ["--hostfile", args.hostfile]
+    flag = _MPI_ENV_FLAG[args.mpi_flavor]
+    export = set(proto)
+    if "PYTHONPATH" in env:
+        export.add("PYTHONPATH")
+    for var in sorted(export):
+        cmd += flag(var, env[var])
+    return _spawn_and_wait([(cmd + args.command, env)])
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
-        description="Launch a distributed job (local cluster)")
+        description="Launch a distributed job (local/ssh/mpi)")
     parser.add_argument("-n", "--num-workers", required=True, type=int)
     parser.add_argument("--launcher", default="local",
-                        choices=["local"],
-                        help="only 'local' is built in; use your cluster "
-                             "scheduler for multi-host (see module doc)")
+                        choices=["local", "ssh", "mpi"],
+                        help="process placement: local spawns on this host; "
+                             "ssh uses -H/--hostfile; mpi delegates to "
+                             "mpirun (yarn/sge: use your cluster scheduler "
+                             "— see module doc)")
+    parser.add_argument("-H", "--hostfile",
+                        help="hosts, one `host` or `host:slots` per line "
+                             "(ssh: required; mpi: forwarded to mpirun)")
     parser.add_argument("--port", type=int, default=0,
-                        help="coordinator port (default: pick a free one)")
+                        help="coordinator port (default: a free local port "
+                             "for local/mpi; a random 10000-29999 port for "
+                             "ssh, where rank 0 is remote and can't be "
+                             "probed — pin this if it might collide)")
+    parser.add_argument("--coordinator-host", default=None,
+                        help="mpi: address workers dial for rank-0 "
+                             "rendezvous (default: this host's fqdn)")
+    parser.add_argument("--ssh-cmd", default="ssh -o StrictHostKeyChecking=no",
+                        help="ssh client command (tests substitute a local "
+                             "shim)")
+    parser.add_argument("--mpi-cmd", default="mpirun",
+                        help="mpi launcher command (tests substitute a "
+                             "local shim)")
+    parser.add_argument("--mpi-flavor", default="openmpi",
+                        choices=sorted(_MPI_ENV_FLAG),
+                        help="env-export syntax: openmpi uses `-x VAR`, "
+                             "mpich/Intel uses `-genv VAR VAL`, none skips "
+                             "env flags (scheduler forwards the env)")
     parser.add_argument("--env", action="append", default=[],
                         help="extra KEY=VAL for every worker")
     parser.add_argument("command", nargs=argparse.REMAINDER)
@@ -55,32 +244,9 @@ def main(argv=None):
     if not args.command:
         parser.error("no command given")
 
-    port = args.port or _free_port()
-    coord = "127.0.0.1:%d" % port
-    procs = []
-    try:
-        for rank in range(args.num_workers):
-            env = dict(os.environ)
-            env["MXTPU_COORDINATOR"] = coord
-            env["MXTPU_NUM_WORKERS"] = str(args.num_workers)
-            env["MXTPU_PROCESS_ID"] = str(rank)
-            # reference-compatible aliases (DMLC_* protocol, launch.py:29)
-            env["DMLC_NUM_WORKER"] = str(args.num_workers)
-            env["DMLC_WORKER_ID"] = str(rank)
-            env["DMLC_ROLE"] = "worker"
-            for kv in args.env:
-                k, _, v = kv.partition("=")
-                env[k] = v
-            procs.append(subprocess.Popen(args.command, env=env))
-        rc = 0
-        for p in procs:
-            p.wait()
-            rc = rc or p.returncode
-        return rc
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
+    return {"local": _launch_local,
+            "ssh": _launch_ssh,
+            "mpi": _launch_mpi}[args.launcher](args)
 
 
 if __name__ == "__main__":
