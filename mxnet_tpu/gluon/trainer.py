@@ -69,7 +69,8 @@ class Trainer:
         trainer.py:169 `'dist' in kvstore.type`)."""
         kt = self._kvstore_type
         if isinstance(kt, str):
-            return "dist" in kt
+            # every name kvstore.create() maps to _DistKVStore
+            return "dist" in kt or kt in ("horovod", "tpu")
         return getattr(kt, "num_workers", 1) > 1
 
     def _init_kvstore(self):
@@ -84,6 +85,8 @@ class Trainer:
         kv = kvs.create(self._kvstore_type) if isinstance(self._kvstore_type, str) \
             else self._kvstore_type
         self._kvstore = kv
+        if self._compression_params:
+            kv.set_gradient_compression(self._compression_params)
         dist = self._is_dist_kvstore()
         for i, param in enumerate(self._params):
             if param._data is not None:

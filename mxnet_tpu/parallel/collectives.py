@@ -180,16 +180,13 @@ def init_process_group(coordinator_address=None, num_processes=None,
     # the process group at creation (call this before importing anything
     # that touches jax arrays, or at worker start; tools/launch.py pattern)
     if coordinator_address is None:
-        if os.environ.get("SLURM_STEP_NUM_TASKS"):
-            # bare `srun python train.py` with no launcher: jax's own slurm
-            # cluster detection derives the coordinator from the step's
-            # nodelist — hand it the whole rendezvous
-            jax.distributed.initialize()
-            return
-        raise RuntimeError(
-            "init_process_group: %d processes detected (scheduler env) but "
-            "no coordinator address — set MXTPU_COORDINATOR=host:port (the "
-            "tools/launch.py modes export it automatically)" % num_processes)
+        # no launcher-provided coordinator: hand jax the whole rendezvous —
+        # its cluster auto-detection covers slurm (srun nodelist), OpenMPI,
+        # and Cloud TPU pod metadata, and fails with its own clear error
+        # when nothing can resolve. Do NOT pass size/rank: auto-detection
+        # derives them from the same source as the coordinator.
+        jax.distributed.initialize()
+        return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

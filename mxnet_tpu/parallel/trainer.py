@@ -91,16 +91,25 @@ class DistributedTrainer:
     loss : gluon loss Block / callable(pred, label) -> per-sample loss.
     mesh : jax.sharding.Mesh (default: parallel.current_mesh())
     rules : ShardingRules for parameter layout (default heuristics).
+    loss_inputs : what a multi-output model feeds the loss —
+        "pred" (first output only), "outputs" (the full output tuple, for
+        auxiliary terms like MoE load-balance/z-loss), or None (default):
+        gluon loss Blocks get "pred", plain callables get "outputs" when
+        the model returns several values. Single-output models always
+        behave as "pred".
     """
 
     def __init__(self, block, optimizer, optimizer_params=None, loss=None,
-                 mesh=None, rules=None, amp_dtype=None):
+                 mesh=None, rules=None, amp_dtype=None, loss_inputs=None):
         import jax
 
         self._block = block
         self._mesh = mesh or current_mesh()
         self._rules = rules or ShardingRules()
         self._loss = loss
+        if loss_inputs not in (None, "pred", "outputs"):
+            raise MXNetError("loss_inputs must be None, 'pred' or 'outputs'")
+        self._loss_inputs = loss_inputs
         # mixed precision: compute forward/backward in `amp_dtype`
         # (bfloat16 — the MXU's native dtype) while parameters, gradients
         # as accumulated through the cast's vjp, and the optimizer update
@@ -243,7 +252,22 @@ class DistributedTrainer:
                 if loss_blk is not None:
                     label_nd = pred.__class__(batch[-1],
                                               ctx=self._params[0].list_ctx()[0])
-                    l = loss_blk(pred, label_nd)
+                    mode = self._loss_inputs
+                    if mode is None:
+                        # default: gluon loss Blocks keep the (pred, label)
+                        # contract; plain callables see the whole output so
+                        # auxiliary terms (MoE load-balance/z-loss, deep
+                        # supervision heads) can fold into the objective.
+                        # Pass loss_inputs="pred" to pin the old behavior.
+                        from ..gluon.loss import Loss as _GluonLoss
+                        mode = ("pred" if isinstance(loss_blk, _GluonLoss)
+                                else "outputs")
+                    if (mode == "outputs"
+                            and isinstance(out, (list, tuple))
+                            and len(out) > 1):
+                        l = loss_blk(tuple(out), label_nd)
+                    else:
+                        l = loss_blk(pred, label_nd)
                     lval = jnp.mean(l._data.astype(jnp.float32))
                 else:
                     lval = jnp.mean(pred._data.astype(jnp.float32))

@@ -130,10 +130,14 @@ sys.exit(max(p.wait() for p in procs))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
          "-n", "2", "--launcher", "mpi", "--mpi-cmd", str(shim),
-         "--coordinator-host", "127.0.0.1", "--",
+         "--coordinator-host", "127.0.0.1", "--port", str(port), "--",
          sys.executable,
          os.path.join(_ROOT, "tests", "dist_sync_kvstore_worker.py")],
         env=env, capture_output=True, text=True, timeout=600)

@@ -27,6 +27,7 @@ EXAMPLES = [
     ("profiler/profile_training.py", ["--steps", "4"], []),
     ("distributed/train_dist.py", ["--tp", "2", "--steps", "4"],
      ["--tp", "2"]),
+    ("moe/train_moe.py", ["--steps", "8"], []),
     ("gan/dcgan.py", ["--steps", "6"], []),
     ("sparse/linear_classification.py", ["--steps", "60"], []),
 ]

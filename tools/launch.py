@@ -182,11 +182,20 @@ def _launch_mpi(args):
     """Delegate placement to mpirun (reference dmlc-tracker/mpi.py). Rank
     and size are NOT passed per-process — `init_process_group` reads
     OMPI_COMM_WORLD_RANK/PMI_RANK in each worker, so one mpirun command
-    covers every rank. The coordinator must be reachable from all hosts:
-    default is this host's address (mpirun is typically run from a job's
-    head node, matching the dmlc-tracker assumption)."""
-    port = args.port or _free_port()
-    host = args.coordinator_host or socket.getfqdn()
+    covers every rank. The coordinator is bound by worker rank 0, so its
+    default address follows the placement: the hostfile's first host when
+    one is given (mpirun fills hosts in order), else this host (purely
+    local mpirun). --coordinator-host/--port override both."""
+    if args.coordinator_host:
+        host = args.coordinator_host
+        port = args.port or _remote_port()
+    elif args.hostfile:
+        host = _parse_hostfile(args.hostfile)[0]
+        # rank 0 is remote: no local probe can verify its ports
+        port = args.port or _remote_port()
+    else:
+        host = "127.0.0.1"
+        port = args.port or _free_port()
     coord = "%s:%d" % (host, port)
     proto = _protocol_env(args.num_workers, coord, args.env)
     env = dict(os.environ)
