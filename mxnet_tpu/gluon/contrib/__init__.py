@@ -3,3 +3,4 @@ VariationalDropoutCell, etc.)."""
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
 from . import moe  # noqa: F401
+from . import data  # noqa: F401

@@ -1,11 +1,13 @@
 """Contrib layers (reference: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
 from __future__ import annotations
 
-from ..nn.basic_layers import BatchNorm, HybridBlock
+from ...base import MXNetError
+from ..nn.basic_layers import BatchNorm, Embedding, HybridBlock
 from ... import ndarray as nd
 
 __all__ = ["SyncBatchNorm", "Concurrent", "HybridConcurrent", "Identity",
-           "PixelShuffle2D"]
+           "SparseEmbedding", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
 
 
 class SyncBatchNorm(BatchNorm):
@@ -45,10 +47,86 @@ class Identity(HybridBlock):
         return x
 
 
-class PixelShuffle2D(HybridBlock):
+class SparseEmbedding(Embedding):
+    """Embedding whose weight gradient is row_sparse (reference: contrib
+    basic_layers.py:118 SparseEmbedding, whose point was the
+    sparse-storage weight + kvstore row_sparse_pull path). The TPU build's
+    nn.Embedding already supports `sparse_grad=True` — this subclass pins
+    it on for API parity; the Trainer's lazy row-update path does the rest
+    (see nn.Embedding docstring)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        if not kwargs.pop("sparse_grad", True):
+            raise MXNetError("SparseEmbedding is sparse_grad by definition; "
+                             "use nn.Embedding for a dense gradient")
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)
+
+
+def _pixel_shuffle(F, x, factors, dims):
+    """(N, C*prod(f), *S) -> (N, C, *(s_i * f_i)): split the factor axes
+    out of channels, interleave each next to its spatial axis, merge. Uses
+    the reference's reshape codes (0=copy, -1=infer, -4=split, -3=merge —
+    basic_layers.py:292) so the graph stays shape-polymorphic: the same
+    code traces eagerly, under hybridize, and through the Symbol export
+    path; XLA fuses the reshape/transpose chain into neighbors."""
+    if dims == 1:
+        (f,) = factors
+        x = F.reshape(x, shape=(0, -4, -1, f, 0))         # (N, C, f, W)
+        x = F.transpose(x, axes=(0, 1, 3, 2))             # (N, C, W, f)
+        return F.reshape(x, shape=(0, 0, -3))             # (N, C, W*f)
+    if dims == 2:
+        f1, f2 = factors
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2, 0, 0))  # (N,C,f1,f2,H,W)
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))       # (N,C,H,f1,W,f2)
+        return F.reshape(x, shape=(0, 0, -3, -3))
+    f1, f2, f3 = factors
+    x = F.reshape(x, shape=(0, -4, -1, f1 * f2 * f3, 0, 0, 0))
+    x = F.reshape(x, shape=(0, 0, -4, f1, f2 * f3, 0, 0, 0))
+    x = F.reshape(x, shape=(0, 0, 0, -4, f2, f3, 0, 0, 0))
+    x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))     # interleave
+    return F.reshape(x, shape=(0, 0, -3, -3, -3))
+
+
+class PixelShuffle1D(HybridBlock):
+    """(N, C*f, W) -> (N, C, W*f) (reference: contrib basic_layers.py:244)."""
+
     def __init__(self, factor):
         super().__init__()
-        self._factor = int(factor)
+        self._factors = (int(factor),)
 
     def hybrid_forward(self, F, x):
-        return F.depth_to_space(x, block_size=self._factor)
+        return _pixel_shuffle(F, x, self._factors, 1)
+
+
+class PixelShuffle2D(HybridBlock):
+    """(N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2); scalar or (f1, f2) factor
+    (reference: contrib basic_layers.py:292)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        f = factor if isinstance(factor, (tuple, list)) else (factor,) * 2
+        self._factors = tuple(int(v) for v in f)
+
+    def hybrid_forward(self, F, x):
+        # NOT depth_to_space: that op splits channels as (f1, f2, C) — DCR,
+        # matching the reference's op — while PixelShuffle splits (C, f1,
+        # f2), matching the reference layer (basic_layers.py:292). The old
+        # fast path silently permuted channels.
+        return _pixel_shuffle(F, x, self._factors, 2)
+
+
+class PixelShuffle3D(HybridBlock):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3) (reference:
+    contrib basic_layers.py:354)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        f = factor if isinstance(factor, (tuple, list)) else (factor,) * 3
+        self._factors = tuple(int(v) for v in f)
+
+    def hybrid_forward(self, F, x):
+        return _pixel_shuffle(F, x, self._factors, 3)
