@@ -63,22 +63,30 @@ class LSTMPCell(RecurrentCell):
     r = h2r(next_h) after every step — cuts h2h FLOPs/params for large
     hidden sizes. States: [r (B, proj), c (B, hidden)]."""
 
-    def __init__(self, hidden_size, projection_size, prefix=None, params=None):
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
         super().__init__(prefix=prefix, params=params)
         self._hidden_size = int(hidden_size)
         self._projection_size = int(projection_size)
         with self.name_scope():
             self.i2h_weight = self.params.get(
-                "i2h_weight", shape=(4 * hidden_size, 0),
-                allow_deferred_init=True)
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
             self.h2h_weight = self.params.get(
-                "h2h_weight", shape=(4 * hidden_size, projection_size))
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer)
             self.h2r_weight = self.params.get(
-                "h2r_weight", shape=(projection_size, hidden_size))
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer)
             self.i2h_bias = self.params.get(
-                "i2h_bias", shape=(4 * hidden_size,), init="zeros")
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer)
             self.h2h_bias = self.params.get(
-                "h2h_bias", shape=(4 * hidden_size,), init="zeros")
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer)
 
     def state_info(self, batch_size=0):
         return [{"shape": (batch_size, self._projection_size),
@@ -159,6 +167,8 @@ class _BaseConvRNNCell(RecurrentCell):
 
     def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
                  i2h_pad=0, i2h_dilate=1, h2h_dilate=1, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
                  prefix=None, params=None, conv_layout="NCHW"):
         super().__init__(prefix=prefix, params=params)
         dims = len(input_shape) - 1
@@ -184,13 +194,18 @@ class _BaseConvRNNCell(RecurrentCell):
         ng = self._num_gates
         with self.name_scope():
             self.i2h_weight = self.params.get(
-                "i2h_weight", shape=(ng * self._hc, in_c) + self._i2h_kernel)
+                "i2h_weight", shape=(ng * self._hc, in_c) + self._i2h_kernel,
+                init=i2h_weight_initializer)
             self.h2h_weight = self.params.get(
-                "h2h_weight", shape=(ng * self._hc, self._hc) + self._h2h_kernel)
+                "h2h_weight",
+                shape=(ng * self._hc, self._hc) + self._h2h_kernel,
+                init=h2h_weight_initializer)
             self.i2h_bias = self.params.get(
-                "i2h_bias", shape=(ng * self._hc,), init="zeros")
+                "i2h_bias", shape=(ng * self._hc,),
+                init=i2h_bias_initializer)
             self.h2h_bias = self.params.get(
-                "h2h_bias", shape=(ng * self._hc,), init="zeros")
+                "h2h_bias", shape=(ng * self._hc,),
+                init=h2h_bias_initializer)
 
     def _state_shape(self, batch_size):
         spatial = self._input_shape[1:]
@@ -286,8 +301,7 @@ class _ConvGRUCell(_BaseConvRNNCell):
 def _make_cell(base, dims, name, doc):
     def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
                  i2h_pad=0, i2h_dilate=1, h2h_dilate=1, activation="tanh",
-                 prefix=None, params=None,
-                 conv_layout="NC" + "DHW"[3 - dims:]):
+                 conv_layout="NC" + "DHW"[3 - dims:], **kwargs):
         if len(input_shape) != dims + 1:
             raise MXNetError("%s expects input_shape (C%s), got %s"
                              % (name, ", " + ", ".join("DHW"[3 - dims:]),
@@ -295,7 +309,7 @@ def _make_cell(base, dims, name, doc):
         base.__init__(self, input_shape, hidden_channels, i2h_kernel,
                       h2h_kernel, i2h_pad=i2h_pad, i2h_dilate=i2h_dilate,
                       h2h_dilate=h2h_dilate, activation=activation,
-                      prefix=prefix, params=params, conv_layout=conv_layout)
+                      conv_layout=conv_layout, **kwargs)
 
     return type(name, (base,), {"__init__": __init__, "__doc__": doc})
 

@@ -9,8 +9,10 @@ from ...block import Block, HybridBlock
 from ...nn import Sequential, HybridSequential
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
-           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
-           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+           "CropResize", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting"]
 
 
 class Compose(Sequential):
@@ -150,3 +152,103 @@ class RandomSaturation(Block):
         coef = nd.array(_np.array([0.299, 0.587, 0.114], _np.float32).reshape(1, 1, 3))
         gray = (xf * coef).sum(axis=2, keepdims=True)
         return (xf * alpha + gray * (1.0 - alpha)).clip(0, 255)
+
+
+class CropResize(Block):
+    """Fixed crop at (x, y, width, height) then optional resize (reference:
+    transforms.py:231; out-of-bounds crops raise like the reference's
+    image.crop rather than silently truncating)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._x, self._y = int(x), int(y)
+        self._w, self._h = int(width), int(height)
+        self._size = ((size, size) if isinstance(size, int) else size) \
+            if size is not None else None
+        self._interp = interpolation
+
+    def forward(self, data):
+        h, w = data.shape[0], data.shape[1]
+        if self._x < 0 or self._y < 0 or self._x + self._w > w \
+                or self._y + self._h > h:
+            raise MXNetError(
+                "CropResize: crop (x=%d, y=%d, w=%d, h=%d) exceeds image "
+                "(%dx%d)" % (self._x, self._y, self._w, self._h, w, h))
+        crop = data[self._y:self._y + self._h, self._x:self._x + self._w]
+        if self._size is None:
+            return crop
+        from .... import image
+
+        return image.imresize(crop, self._size[0], self._size[1],
+                              interp=self._interp)
+
+
+class RandomHue(Block):
+    """Rotate hue by a random angle in [-delta, delta]*pi via the YIQ
+    linear approximation the reference's image.random_hue uses
+    (transforms.py:483)."""
+
+    _T_YIQ = _np.array([[0.299, 0.587, 0.114],
+                        [0.596, -0.274, -0.321],
+                        [0.211, -0.523, 0.311]], _np.float32)
+    _T_RGB = _np.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], _np.float32)
+
+    def __init__(self, hue):
+        super().__init__()
+        self._delta = hue
+
+    def forward(self, x):
+        alpha = _np.random.uniform(-self._delta, self._delta)
+        theta = alpha * _np.pi
+        u, w = _np.cos(theta), _np.sin(theta)
+        rot = _np.array([[1.0, 0.0, 0.0],
+                         [0.0, u, -w],
+                         [0.0, w, u]], _np.float32)
+        m = self._T_RGB @ rot @ self._T_YIQ         # rgb -> rgb
+        xf = x.astype("float32")
+        out = nd.dot(xf, nd.array(m.T.copy()))
+        return out.clip(0, 255)
+
+
+class RandomColorJitter(Block):
+    """Randomly-ordered brightness/contrast/saturation/hue jitter
+    (reference: transforms.py:508)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        for i in _np.random.permutation(len(self._ts)):
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference: transforms.py:542):
+    per-image normal draws scaled by the ImageNet RGB eigen-decomposition."""
+
+    _EIGVAL = _np.array([55.46, 4.794, 1.148], _np.float32)
+    _EIGVEC = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        draws = _np.random.normal(0, self._alpha, 3).astype(_np.float32)
+        rgb = self._EIGVEC @ (self._EIGVAL * draws)
+        return (x.astype("float32") + nd.array(rgb.reshape(1, 1, 3))) \
+            .clip(0, 255)

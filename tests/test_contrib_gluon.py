@@ -76,7 +76,7 @@ def test_conv_gru_reset_gate_semantics():
     h0 = cell.begin_state(batch_size=1, func=mx.nd.ones)
     out, _ = cell(x, h0)
 
-    p = {k.split("_", 1)[-1] if False else k: v.data().asnumpy()
+    p = {k: v.data().asnumpy()
          for k, v in cell.collect_params().items()}
     (i2h_w,) = [v for k, v in p.items() if "i2h_weight" in k]
     (h2h_w,) = [v for k, v in p.items() if "h2h_weight" in k]

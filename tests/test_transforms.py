@@ -1,0 +1,104 @@
+"""gluon.data.vision.transforms (reference: gluon/data/vision/transforms.py;
+reference tests: tests/python/unittest/test_gluon_data_vision.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.data.vision import transforms as T
+
+
+def _img(h=8, w=10, c=3, seed=0):
+    return mx.nd.array(np.random.RandomState(seed)
+                       .randint(0, 256, (h, w, c)).astype(np.uint8),
+                       dtype="uint8")
+
+
+def test_to_tensor_and_normalize():
+    x = _img()
+    t = T.ToTensor()(x)
+    assert t.shape == (3, 8, 10) and t.dtype == np.float32
+    assert float(t.max().asnumpy()) <= 1.0
+    n = T.Normalize(mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25))(t)
+    np.testing.assert_allclose(
+        n.asnumpy(), (t.asnumpy() - 0.5) / 0.25, atol=1e-6)
+
+
+def test_compose_and_cast():
+    out = T.Compose([T.ToTensor(), T.Cast("float32")])(_img())
+    assert out.shape == (3, 8, 10)
+
+
+def test_center_crop_and_crop_resize():
+    x = _img(10, 12)
+    c = T.CenterCrop(6)(x)
+    assert c.shape == (6, 6, 3)
+    np.testing.assert_array_equal(c.asnumpy(), x.asnumpy()[2:8, 3:9])
+    cr = T.CropResize(x=2, y=1, width=5, height=4)(x)
+    np.testing.assert_array_equal(cr.asnumpy(), x.asnumpy()[1:5, 2:7])
+    cr2 = T.CropResize(x=2, y=1, width=5, height=4, size=(8, 8))(x)
+    assert cr2.shape == (8, 8, 3)
+
+
+def test_resize_and_random_resized_crop():
+    assert T.Resize(16)(_img()).shape == (16, 16, 3)
+    out = T.RandomResizedCrop(7)(_img(20, 20))
+    assert out.shape == (7, 7, 3)
+
+
+def test_flips_cover_both_branches():
+    x = _img()
+    np.random.seed(0)
+    seen = {T.RandomFlipLeftRight()(x).asnumpy().tobytes()
+            for _ in range(20)}
+    assert len(seen) == 2  # identity + flipped both observed
+    flipped = x.asnumpy()[:, ::-1]
+    assert flipped.tobytes() in seen
+
+
+def test_color_jitters_stay_in_range_and_vary():
+    x = _img()
+    np.random.seed(1)
+    for t in (T.RandomBrightness(0.5), T.RandomContrast(0.5),
+              T.RandomSaturation(0.5), T.RandomHue(0.5),
+              T.RandomLighting(0.3),
+              T.RandomColorJitter(0.3, 0.3, 0.3, 0.3)):
+        outs = [t(x).asnumpy() for _ in range(3)]
+        for o in outs:
+            assert o.min() >= 0.0 and o.max() <= 255.0, type(t).__name__
+        assert any(not np.array_equal(outs[0], o) for o in outs[1:]), \
+            "%s never varied" % type(t).__name__
+
+
+def test_random_hue_zero_delta_is_identity():
+    x = _img()
+    out = T.RandomHue(0.0)(x).asnumpy()
+    # the YIQ round-trip matrices compose to identity within ~1.4e-3 per
+    # coefficient, i.e. under one grey level at uint8 scale
+    np.testing.assert_allclose(out, x.asnumpy().astype(np.float32),
+                               atol=1.0)
+
+
+def test_random_lighting_zero_alpha_is_identity():
+    x = _img()
+    out = T.RandomLighting(0.0)(x).asnumpy()
+    np.testing.assert_allclose(out, x.asnumpy().astype(np.float32),
+                               atol=1e-5)
+
+
+def test_transforms_in_dataloader():
+    """transform_first through a DataLoader — the reference's standard
+    train-pipeline composition."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    imgs = np.random.RandomState(2).randint(
+        0, 256, (8, 8, 10, 3)).astype(np.uint8)
+    labels = np.arange(8).astype(np.float32)
+    ds = ArrayDataset(mx.nd.array(imgs, dtype="uint8"),
+                      mx.nd.array(labels))
+    tf = T.Compose([T.ToTensor(),
+                    T.Normalize((0.5,) * 3, (0.5,) * 3)])
+    loader = DataLoader(ds.transform_first(tf), batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 2
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3, 8, 10)
