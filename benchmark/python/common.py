@@ -1,0 +1,34 @@
+"""Shared harness plumbing for benchmark/python scripts: CPU-platform
+pinning (must run before the first jax op — the axon sitecustomize hook
+overrides jax_platforms at config level) and one timeit used by every
+script."""
+from __future__ import annotations
+
+import os
+import time
+
+
+def pin_cpu_if_requested():
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def timeit(fn, iters, warmup):
+    """Mean seconds per call; warms up, then times `iters` free-running
+    calls with one sync at the end (async dispatch pipelines the loop)."""
+    import jax
+
+    def _sync(v):
+        jax.block_until_ready(getattr(v, "_data", v))
+
+    for _ in range(warmup):
+        fn()
+    _sync(fn())
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn()
+    _sync(out)
+    return (time.perf_counter() - t0) / iters

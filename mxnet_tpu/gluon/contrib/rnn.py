@@ -299,9 +299,15 @@ class _ConvGRUCell(_BaseConvRNNCell):
 
 
 def _make_cell(base, dims, name, doc):
+    # positional order matches the reference cells exactly
+    # (conv_rnn_cell.py Conv1DRNNCell.__init__ et al.), so reference-
+    # positional construction binds every argument correctly
     def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
-                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1, activation="tanh",
-                 conv_layout="NC" + "DHW"[3 - dims:], **kwargs):
+                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 conv_layout="NC" + "DHW"[3 - dims:], activation="tanh",
+                 prefix=None, params=None):
         if len(input_shape) != dims + 1:
             raise MXNetError("%s expects input_shape (C%s), got %s"
                              % (name, ", " + ", ".join("DHW"[3 - dims:]),
@@ -309,7 +315,11 @@ def _make_cell(base, dims, name, doc):
         base.__init__(self, input_shape, hidden_channels, i2h_kernel,
                       h2h_kernel, i2h_pad=i2h_pad, i2h_dilate=i2h_dilate,
                       h2h_dilate=h2h_dilate, activation=activation,
-                      conv_layout=conv_layout, **kwargs)
+                      i2h_weight_initializer=i2h_weight_initializer,
+                      h2h_weight_initializer=h2h_weight_initializer,
+                      i2h_bias_initializer=i2h_bias_initializer,
+                      h2h_bias_initializer=h2h_bias_initializer,
+                      conv_layout=conv_layout, prefix=prefix, params=params)
 
     return type(name, (base,), {"__init__": __init__, "__doc__": doc})
 

@@ -168,17 +168,26 @@ class CropResize(Block):
         self._interp = interpolation
 
     def forward(self, data):
-        h, w = data.shape[0], data.shape[1]
+        batched = data.ndim == 4  # (N, H, W, C), like the reference's crop
+        hax = 1 if batched else 0
+        h, w = data.shape[hax], data.shape[hax + 1]
         if self._x < 0 or self._y < 0 or self._x + self._w > w \
                 or self._y + self._h > h:
             raise MXNetError(
                 "CropResize: crop (x=%d, y=%d, w=%d, h=%d) exceeds image "
                 "(%dx%d)" % (self._x, self._y, self._w, self._h, w, h))
-        crop = data[self._y:self._y + self._h, self._x:self._x + self._w]
+        ys = slice(self._y, self._y + self._h)
+        xs = slice(self._x, self._x + self._w)
+        crop = data[:, ys, xs] if batched else data[ys, xs]
         if self._size is None:
             return crop
         from .... import image
 
+        if batched:
+            return nd.stack(*[image.imresize(crop[i], self._size[0],
+                                             self._size[1],
+                                             interp=self._interp)
+                              for i in range(crop.shape[0])], axis=0)
         return image.imresize(crop, self._size[0], self._size[1],
                               interp=self._interp)
 
