@@ -133,17 +133,19 @@ def bench_train():
         loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh,
         amp_dtype=AMP_DTYPE)
 
-    for _ in range(WARMUP):
-        trainer.step(x, label)
-    trainer.step(x, label).asnumpy()  # drain dispatch before timed region
+    def timed_train(xb, yb, batch):
+        """warmup -> drain -> free-running timed loop (async dispatch
+        pipelines host & device) -> imgs/sec."""
+        for _ in range(WARMUP):
+            trainer.step(xb, yb)
+        trainer.step(xb, yb).asnumpy()  # drain dispatch before timed region
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = trainer.step(xb, yb)
+        loss.asnumpy()
+        return batch * ITERS / (time.perf_counter() - t0)
 
-    # throughput: free-running (async dispatch pipelines host & device)
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        loss = trainer.step(x, label)
-    loss.asnumpy()
-    dt = time.perf_counter() - t0
-    imgs_per_sec = BATCH * ITERS / dt
+    imgs_per_sec = timed_train(x, label, BATCH)
 
     # step-time distribution: each step synced
     step_ms = []
@@ -171,6 +173,38 @@ def bench_train():
         "mfu": round(mfu, 4) if mfu is not None else None,
     }
     out.update(_percentiles(step_ms))
+
+    # Large-batch segment: the bs=32 headline matches the reference's
+    # configuration, but MFU at that batch is input-bound; a second timed
+    # run at MXTPU_BENCH_SWEEP_BATCH (default 256) shows how close the
+    # compiled step gets to the chip's ceiling (BASELINE.json >=60% MFU
+    # target). Extra fields only — the driver's one-JSON-line headline
+    # contract (metric/value/unit/vs_baseline) is untouched: everything
+    # here is best-effort inside the try, and the sweep is skipped
+    # entirely on the CPU-fallback path (26 extra ResNet-50 steps at
+    # bs=256 on a CPU would stall the artifact for hours). Set
+    # MXTPU_BENCH_SWEEP_BATCH=0 to disable on TPU too.
+    try:
+        sweep_batch = int(os.environ.get("MXTPU_BENCH_SWEEP_BATCH") or 256)
+        if (sweep_batch and sweep_batch != BATCH
+                and getattr(dev, "platform", "cpu") != "cpu"):
+            import numpy as _np
+
+            rng = _np.random.RandomState(1)
+            with ctx:
+                xl = mx.nd.array(rng.uniform(
+                    -1, 1, (sweep_batch, 3, 224, 224)).astype(_np.float32),
+                    ctx=ctx)
+                yl = mx.nd.array(rng.randint(
+                    0, 1000, (sweep_batch,)).astype(_np.float32), ctx=ctx)
+            big_ips = timed_train(xl, yl, sweep_batch)
+            out["sweep_batch"] = sweep_batch
+            out["sweep_imgs_per_sec"] = round(big_ips, 2)
+            if peak:
+                out["sweep_mfu"] = round(
+                    big_ips * flops_per_img / (peak * 1e12), 4)
+    except Exception as e:  # noqa: BLE001 — sweep is best-effort extra
+        out["sweep_error"] = str(e)[:200]
     print(json.dumps(out))
 
 

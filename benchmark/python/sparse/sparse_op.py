@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Sparse op microbenchmarks (reference: benchmark/python/sparse/{dot,
-cast_storage,sparse_op}.py — csr dot / cast_storage / elementwise
-throughput at given densities).
+"""Sparse op microbenchmarks (reference: benchmark/python/sparse/dot.py
+and cast_storage.py — csr dot and storage-cast throughput at given
+densities).
 
 One JSON line per (op, shape, density) config with GB/s effective
 throughput (bytes of the DENSE-equivalent operands over time — the
@@ -16,7 +16,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as np
 

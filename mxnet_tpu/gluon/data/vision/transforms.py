@@ -184,10 +184,14 @@ class CropResize(Block):
         from .... import image
 
         if batched:
-            return nd.stack(*[image.imresize(crop[i], self._size[0],
-                                             self._size[1],
-                                             interp=self._interp)
-                              for i in range(crop.shape[0])], axis=0)
+            resized = [image.imresize(crop[i], self._size[0], self._size[1],
+                                      interp=self._interp)
+                       for i in range(crop.shape[0])]
+            if isinstance(resized[0], _np.ndarray):
+                # DataLoader workers run transforms in HOST_ARRAY_MODE
+                # (numpy in, numpy out — jax must not wake up post-fork)
+                return _np.stack(resized, axis=0)
+            return nd.stack(*resized, axis=0)
         return image.imresize(crop, self._size[0], self._size[1],
                               interp=self._interp)
 
