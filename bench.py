@@ -174,38 +174,52 @@ def bench_train():
     }
     out.update(_percentiles(step_ms))
 
-    # Large-batch segment: the bs=32 headline matches the reference's
-    # configuration, but MFU at that batch is input-bound; a second timed
-    # run at MXTPU_BENCH_SWEEP_BATCH (default 256) shows how close the
-    # compiled step gets to the chip's ceiling (BASELINE.json >=60% MFU
-    # target). Extra fields only — the driver's one-JSON-line headline
-    # contract (metric/value/unit/vs_baseline) is untouched: everything
-    # here is best-effort inside the try, and the sweep is skipped
-    # entirely on the CPU-fallback path (26 extra ResNet-50 steps at
-    # bs=256 on a CPU would stall the artifact for hours). Set
-    # MXTPU_BENCH_SWEEP_BATCH=0 to disable on TPU too.
+    _sweep_segment(out, dev, flops_per_img,
+                   lambda sb: timed_train(*_sweep_batch_arrays(ctx, sb), sb))
+    print(json.dumps(out))
+
+
+def _sweep_batch_arrays(ctx, sweep_batch):
+    """Fresh on-device (data, label) arrays at the sweep batch size."""
+    import numpy as _np
+
+    import mxnet_tpu as mx
+
+    rng = _np.random.RandomState(1)
+    with ctx:
+        xl = mx.nd.array(rng.uniform(
+            -1, 1, (sweep_batch, 3, 224, 224)).astype(_np.float32), ctx=ctx)
+        yl = mx.nd.array(rng.randint(
+            0, 1000, (sweep_batch,)).astype(_np.float32), ctx=ctx)
+    return xl, yl
+
+
+def _sweep_segment(out, dev, flops_per_img, run):
+    """Large-batch segment shared by train and score modes: the bs=32
+    headline matches the reference's configuration, but MFU at that batch
+    is input-bound; a second timed run at MXTPU_BENCH_SWEEP_BATCH (default
+    256) shows how close the compiled step gets to the chip's ceiling
+    (BASELINE.json >=60% MFU target). Extra fields only — the driver's
+    one-JSON-line headline contract (metric/value/unit/vs_baseline) is
+    untouched: everything here is best-effort inside the try, and the
+    sweep is skipped entirely on the CPU-fallback path (extra ResNet-50
+    steps at bs>=256 on a CPU would stall the artifact for hours). Set
+    MXTPU_BENCH_SWEEP_BATCH=0 to disable on TPU too.
+
+    `run(sweep_batch)` -> imgs/sec at that batch."""
     try:
         sweep_batch = int(os.environ.get("MXTPU_BENCH_SWEEP_BATCH") or 256)
         if (sweep_batch and sweep_batch != BATCH
                 and getattr(dev, "platform", "cpu") != "cpu"):
-            import numpy as _np
-
-            rng = _np.random.RandomState(1)
-            with ctx:
-                xl = mx.nd.array(rng.uniform(
-                    -1, 1, (sweep_batch, 3, 224, 224)).astype(_np.float32),
-                    ctx=ctx)
-                yl = mx.nd.array(rng.randint(
-                    0, 1000, (sweep_batch,)).astype(_np.float32), ctx=ctx)
-            big_ips = timed_train(xl, yl, sweep_batch)
+            big_ips = run(sweep_batch)
             out["sweep_batch"] = sweep_batch
             out["sweep_imgs_per_sec"] = round(big_ips, 2)
+            peak = _chip_peak_tflops(dev)
             if peak:
                 out["sweep_mfu"] = round(
                     big_ips * flops_per_img / (peak * 1e12), 4)
     except Exception as e:  # noqa: BLE001 — sweep is best-effort extra
         out["sweep_error"] = str(e)[:200]
-    print(json.dumps(out))
 
 
 def bench_score():
@@ -229,23 +243,27 @@ def bench_score():
     xb = x._data.astype(dtype)
 
     jitted = jax.jit(fwd)
-    jitted(xb).block_until_ready()  # compile
-    for _ in range(WARMUP):
-        jitted(xb)
-    jitted(xb).block_until_ready()
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        out = jitted(xb)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-    imgs_per_sec = BATCH * ITERS / dt
+    def timed_score(xl, batch):
+        """compile/warm -> drain -> free-running timed loop -> imgs/sec."""
+        jitted(xl).block_until_ready()
+        for _ in range(WARMUP):
+            jitted(xl)
+        jitted(xl).block_until_ready()
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(ITERS):
+            o = jitted(xl)
+        o.block_until_ready()
+        return batch * ITERS / (time.perf_counter() - t0)
+
+    imgs_per_sec = timed_score(xb, BATCH)
 
     base = BASELINE_SCORE_FP16 if AMP_DTYPE else BASELINE_SCORE_FP32
     peak = _chip_peak_tflops(dev)
     mfu = (imgs_per_sec * RESNET50_FWD_FLOPS_PER_IMG / (peak * 1e12)) \
         if peak else None
-    print(json.dumps({
+    out = {
         "metric": "resnet50_score_bs32_imgs_per_sec",
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec",
@@ -259,7 +277,16 @@ def bench_score():
         "flops_per_img": RESNET50_FWD_FLOPS_PER_IMG,
         "peak_bf16_tflops": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,
-    }))
+    }
+    def run_score_sweep(sweep_batch):
+        rng = np.random.RandomState(1)
+        xl = jnp.asarray(rng.uniform(
+            -1, 1, (sweep_batch, 3, 224, 224)).astype(np.float32)
+            ).astype(dtype)
+        return timed_score(xl, sweep_batch)
+
+    _sweep_segment(out, dev, RESNET50_FWD_FLOPS_PER_IMG, run_score_sweep)
+    print(json.dumps(out))
 
 
 def bench_bert():

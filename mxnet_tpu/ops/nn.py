@@ -262,17 +262,40 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if is_train and not use_global_stats:
+        # single-pass batch stats: both reductions consume the SAME read of
+        # x (XLA fuses them into one HBM pass; jnp.var's mean-subtracted
+        # two-pass re-reads the activation tensor — GBs per BN layer at
+        # train bs>=256). Raw E[x^2]-E[x]^2 cancels catastrophically for
+        # large-mean/small-spread channels, so shift by a per-channel proxy
+        # of the batch mean first: the mean over ONE slice of the leading
+        # reduced dim (an O(1/N) read), which sits within ~std/sqrt(HW) of
+        # the true channel mean for any input — including step 0, where a
+        # moving_mean-based shift would still be cold. stop_gradient keeps
+        # autodiff clean; mean/var are shift-invariant, so treating the
+        # proxy as constant yields the exact gradients.
         x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=red)
-        var = jnp.var(x32, axis=red)
+        lead = red[0]  # first reduced dim (batch unless axis==0)
+        proxy = lax.stop_gradient(jnp.mean(
+            lax.slice_in_dim(x32, 0, 1, axis=lead), axis=red, keepdims=True))
+        d = x32 - proxy
+        s1 = jnp.mean(d, axis=red)
+        s2 = jnp.mean(jnp.square(d), axis=red)
+        mean = proxy.reshape(s1.shape) + s1
+        var = jnp.maximum(s2 - jnp.square(s1), 0.0)
         new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
         new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:
         mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
         new_mm, new_mv = moving_mean, moving_var
+    # normalize in per-channel affine form: out = x*scale + shift. scale/
+    # shift are computed in fp32 on C-sized vectors (cheap, accurate); the
+    # big-tensor math is ONE fused multiply-add. The x->fp32 cast stays so
+    # the cast vjp hands fp32 cotangents to the channel reductions in
+    # backward (bf16-accumulated dgamma/dbeta would lose precision).
     inv = lax.rsqrt(var + eps)
-    out = (data.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
-    out = out * g.astype(jnp.float32).reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
+    scale = g.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - mean * scale
+    out = data.astype(jnp.float32) * scale.reshape(bshape) + shift.reshape(bshape)
     return out.astype(data.dtype), new_mm, new_mv
 
 

@@ -1,0 +1,91 @@
+"""Per-shape conv backward probe: measure fwd / dgrad / wgrad TFLOP/s for
+the ResNet-50 conv shapes in NCHW vs NHWC dimension numbers on the real
+chip, to find where backward MFU goes and whether logical layout matters.
+"""
+import json
+import os
+import time
+from functools import partial
+
+BATCH = int(os.environ.get("MXTPU_PROBE_BATCH", 256))
+ITERS = int(os.environ.get("MXTPU_PROBE_ITERS", 20))
+
+# (cin, cout, hw, k, stride) — representative ResNet-50 bulk shapes
+SHAPES = [
+    (3, 64, 224, 7, 2),     # stem
+    (64, 64, 56, 3, 1),     # layer1 3x3
+    (64, 256, 56, 1, 1),    # layer1 expand
+    (128, 128, 28, 3, 1),   # layer2 3x3
+    (256, 256, 14, 3, 1),   # layer3 3x3 (deepest bulk)
+    (512, 512, 7, 3, 1),    # layer4 3x3
+    (256, 512, 28, 1, 2),   # downsample 1x1/2
+]
+
+
+def timed(fn, *args, n=ITERS):
+    import jax
+    jax.block_until_ready(fn(*args))
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    results = []
+    for (cin, cout, hw, k, s) in SHAPES:
+        pad = k // 2
+        ho = hw // s
+        flops = 2 * BATCH * cout * ho * ho * cin * k * k
+        row = {"cin": cin, "cout": cout, "hw": hw, "k": k, "s": s,
+               "gflops": round(flops / 1e9, 1)}
+        for layout, (lhs_spec, out_spec) in {
+                "NCHW": ("NCHW", "NCHW"), "NHWC": ("NHWC", "NHWC")}.items():
+            dn = lax.conv_dimension_numbers(
+                (1, 1, 1, 1), (1, 1, 1, 1), (lhs_spec, "OIHW", out_spec))
+            if layout == "NCHW":
+                xs = (BATCH, cin, hw, hw)
+            else:
+                xs = (BATCH, hw, hw, cin)
+            key = jax.random.PRNGKey(0)
+            x = jax.random.normal(key, xs, jnp.float32).astype(jnp.bfloat16)
+            w = jax.random.normal(jax.random.PRNGKey(1), (cout, cin, k, k),
+                                  jnp.float32).astype(jnp.bfloat16)
+
+            def conv(xx, ww, dn=dn):
+                return lax.conv_general_dilated(
+                    xx, ww, window_strides=(s, s),
+                    padding=[(pad, pad), (pad, pad)],
+                    dimension_numbers=dn)
+
+            fwd = jax.jit(conv)
+            dt_f = timed(fwd, x, w)
+
+            dgrad = jax.jit(jax.grad(
+                lambda xx, ww: conv(xx, ww).astype(jnp.float32).sum(),
+                argnums=0))
+            dt_d = timed(dgrad, x, w)
+
+            wgrad = jax.jit(jax.grad(
+                lambda xx, ww: conv(xx, ww).astype(jnp.float32).sum(),
+                argnums=1))
+            dt_w = timed(wgrad, x, w)
+
+            row[layout] = {
+                "fwd_tflops": round(flops / dt_f / 1e12, 1),
+                "dgrad_tflops": round(flops / dt_d / 1e12, 1),
+                "wgrad_tflops": round(flops / dt_w / 1e12, 1),
+            }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

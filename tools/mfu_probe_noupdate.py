@@ -1,0 +1,63 @@
+"""Probe 2 (single-trainer): train step with optimizer update replaced by
+identity, at MXTPU_PROBE_BATCH (default 256). Compare against the full-step
+number from bench.py / probe 1 to isolate the optimizer-update cost from
+the train-mode-BN + loss cost."""
+import json
+import os
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("MXTPU_PROBE_BATCH", 256))
+ITERS = int(os.environ.get("MXTPU_PROBE_ITERS", 10))
+TRAIN_FLOPS = 3 * 8.178e9
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import DistributedTrainer, make_mesh
+
+    dev = jax.devices()[0]
+    peak = 197.0 if "v5 lite" in getattr(dev, "device_kind", "") else None
+    out = {"device": getattr(dev, "device_kind", str(dev)), "batch": BATCH,
+           "segment": "noupdate_step"}
+
+    ctx = mx.tpu()
+    with ctx:
+        net = vision.resnet50_v1()
+        net.initialize(ctx=ctx)
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.uniform(-1, 1, (BATCH, 3, 224, 224))
+                        .astype(np.float32), ctx=ctx)
+        y = mx.nd.array(rng.randint(0, 1000, (BATCH,)).astype(np.float32),
+                        ctx=ctx)
+        net(x)
+
+    mesh = make_mesh([("dp", 1)], devices=[dev])
+    tr = DistributedTrainer(
+        net, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh,
+        amp_dtype="bfloat16")
+    tr._traced_update = lambda weights, grads, states, t, lr: \
+        (list(weights), list(states))
+    tr.step(x, y).asnumpy()
+    for _ in range(3):
+        tr.step(x, y)
+    tr.step(x, y).asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = tr.step(x, y)
+    loss.asnumpy()
+    dt = (time.perf_counter() - t0) / ITERS
+    out["step_ms"] = round(dt * 1e3, 2)
+    if peak:
+        out["mfu"] = round(BATCH * TRAIN_FLOPS / dt / 1e12 / peak, 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
