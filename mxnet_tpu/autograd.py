@@ -146,19 +146,13 @@ def _record(opdef, attrs, rng, inputs, in_arrays, out_nd, all_results):
     st = _st()
     nd_inputs = [(i, i._version) for i in inputs if isinstance(i, NDArray)]
     attr_key = tuple(sorted((k, _ops._hashable(v)) for k, v in attrs.items()))
-    out_keys = [(id(o), o._version) for o in out_nd]
+    out_keys = [(o._uid, o._version) for o in out_nd]
     # aux outputs (written back into trailing inputs) count too: their
     # cotangents are zero but the vjp needs seeds of the right shape
     out_shapes = [r.shape for r in all_results]
     out_dtypes = [r.dtype for r in all_results]
     st.tape.append(_Node(opdef, attr_key, rng, nd_inputs, in_arrays, out_keys,
                          out_shapes, out_dtypes))
-    # remember the arrays so backward can resolve ids
-    for o in out_nd:
-        _LIVE[id(o)] = o
-
-
-_LIVE = {}
 
 
 def _is_float(dt):
@@ -201,12 +195,11 @@ def _run_backward(heads, head_grads, retain_graph=False):
     st = _st()
     cot = {}
     for h, hg in zip(heads, head_grads):
-        key = (id(h), h._version)
+        key = (h._uid, h._version)
         seed = hg if hg is not None else jnp.ones(h.shape, h.dtype)
         if hasattr(seed, "_data"):
             seed = seed._data
         cot[key] = cot[key] + seed if key in cot else seed
-        _LIVE[id(h)] = h
 
     touched = {}
     consumed = set()
@@ -247,15 +240,15 @@ def _run_backward(heads, head_grads, retain_graph=False):
         for (arr, ver), c in zip(node.inputs, in_cots):
             if c is None or (hasattr(c, "dtype") and str(c.dtype) == "float0"):
                 continue
-            key = (id(arr), ver)
+            key = (arr._uid, ver)
             cot[key] = cot[key] + c if key in cot else c
-            touched[id(arr)] = arr
+            touched[arr._uid] = arr
 
     # write accumulated grads into attached buffers (dedup: an array that
     # is both a head and an interior input must be written once, or
     # grad_req='add' double-accumulates)
     targets = dict(touched)
-    targets.update((id(h), h) for h in heads)
+    targets.update((h._uid, h) for h in heads)
     for aid, arr in targets.items():
         if arr._grad is None or arr._grad_req == "null":
             continue
@@ -314,9 +307,6 @@ def _run_backward(heads, head_grads, retain_graph=False):
             else:
                 remaining.append(n)
         st.tape = remaining
-        keep = {kid for n in st.tape for (kid, _) in n.out_keys}
-        for aid in [a for a in _LIVE if a not in keep]:
-            del _LIVE[aid]
         if not st.tape and not st.recording:
             # graph fully drained outside any recording: the freed-key set
             # has nothing left to guard (nothing on the tape can reach a
@@ -353,8 +343,8 @@ def _build_replay_scalar(heads, variables, head_grads):
 
     st = _st()
     tape = list(st.tape)
-    var_keys = [(id(v), v._version) for v in variables]
-    head_keys = [(id(h), h._version) for h in heads]
+    var_keys = [(v._uid, v._version) for v in variables]
+    head_keys = [(h._uid, h._version) for h in heads]
     hgs = [None if hg is None else
            (hg._data if hasattr(hg, "_data") else jnp.asarray(hg))
            for hg in head_grads]
@@ -372,7 +362,7 @@ def _build_replay_scalar(heads, variables, head_grads):
                 "Function / bridged op in the heads' graph (its forward is "
                 "not re-traceable); compute that grad without create_graph")
         keep.append(node)
-        needed.update((id(a), v) for a, v in node.inputs)
+        needed.update((a._uid, v) for a, v in node.inputs)
     tape = list(reversed(keep))
     if st.freed and (needed & st.freed):
         # same guard as _run_backward: a freed shared subgraph would become
@@ -388,7 +378,7 @@ def _build_replay_scalar(heads, variables, head_grads):
     leaf_info = {}
     for node in tape:
         for (arr, ver), const in zip(node.inputs, node.in_arrays):
-            k = (id(arr), ver)
+            k = (arr._uid, ver)
             if k not in produced and k not in var_keys \
                     and k not in leaf_info:
                 leaf_info[k] = arr
@@ -399,7 +389,7 @@ def _build_replay_scalar(heads, variables, head_grads):
     def scalar_fn(*vals):
         env = dict(zip(var_keys + leaf_keys, vals))
         for node in tape:
-            ins = [env.get((id(a), v), const)
+            ins = [env.get((a._uid, v), const)
                    for (a, v), const in zip(node.inputs, node.in_arrays)]
             kwargs = dict(node.attr_key)
             call = ((node.rng,) + tuple(ins) if node.opdef.needs_rng
@@ -448,7 +438,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     for v in variables:
         total = None
         for (kid, ver), c in cot.items():
-            if kid == id(v):
+            if kid == v._uid:
                 total = c if total is None else total + c
         if total is None:
             import jax.numpy as jnp
@@ -497,13 +487,11 @@ class Function:
             node_inputs = [(i, i._version) for i in inputs if isinstance(i, NDArray)]
             node = _Node(None, (), None, node_inputs,
                          tuple(i._data for i in inputs if isinstance(i, NDArray)),
-                         [(id(o), o._version) for o in outs],
+                         [(o._uid, o._version) for o in outs],
                          [o.shape for o in outs], [o.dtype for o in outs])
             node.py_backward = lambda cots: fn_self.backward(
                 *[NDArray(c) for c in cots])
             st.tape.append(node)
-            for o in outs:
-                _LIVE[id(o)] = o
         return outputs
 
 

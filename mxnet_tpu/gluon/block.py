@@ -459,7 +459,7 @@ class HybridBlock(Block):
             None, (), None,
             [(i, i._version) for i in inputs],
             tuple(arg_arrays) + tuple(param_arrays),
-            [(id(o), o._version) for o in out_nds],
+            [(o._uid, o._version) for o in out_nds],
             [o.shape for o in out_nds], [o.dtype for o in out_nds])
         n_args = len(arg_arrays)
 
@@ -470,8 +470,6 @@ class HybridBlock(Block):
 
         node.py_backward = py_backward
         autograd._st().tape.append(node)
-        for o in out_nds:
-            autograd._LIVE[id(o)] = o
 
     # -- export ------------------------------------------------------------
     def export(self, path, epoch=0, n_inputs=1, input_names=None):

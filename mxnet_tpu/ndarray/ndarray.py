@@ -24,12 +24,34 @@ outputs, write back aux outputs, and record the call on the autograd tape.
 from __future__ import annotations
 
 import inspect
+import itertools
 
 import numpy as _np
 
 from .. import ops as _ops
 from ..base import MXNetError, np_dtype, numeric_types
 from ..context import Context, current_context
+
+_uid_counter = itertools.count(1)
+
+_INT32_MAX = 2**31 - 1
+
+
+def _x64_if_large(*shapes):
+    """Large-tensor mode (reference: int64 TShape arithmetic exercised by
+    tests/nightly/test_large_array.py). A dimension past int32-max makes
+    JAX's default-int32 index arithmetic truncate silently, so ops touching
+    such arrays run under a scoped x64 config: gather/scatter positions and
+    index-valued outputs (argmax/argsort/...) become int64, exactly where
+    int64 is semantically required. Everywhere else the documented
+    x64-off policy (README "int64") stands."""
+    import contextlib
+
+    if any(d > _INT32_MAX for shape in shapes for d in shape):
+        import jax
+
+        return jax.enable_x64(True)
+    return contextlib.nullcontext()
 
 __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
            "arange", "concat", "save", "load", "waitall", "from_jax"]
@@ -39,7 +61,15 @@ class NDArray:
     """Multi-dimensional array on a device (reference: ndarray.h:82)."""
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_grad_stype",
-                 "_version", "_fresh_grad")
+                 "_version", "_fresh_grad", "_uid")
+
+    def __new__(cls, *args, **kwargs):
+        # process-unique id for autograd tape keys: unlike id(), a uid is
+        # never recycled after the array dies, so keys held past an array's
+        # lifetime (autograd's freed-graph set) can't collide with new arrays
+        self = super().__new__(cls)
+        self._uid = next(_uid_counter)
+        return self
 
     def __init__(self, data, ctx=None):
         self._data = data  # jax.Array
@@ -446,10 +476,16 @@ class NDArray:
         return self
 
     # -- indexing ---------------------------------------------------------
+    def _index_dtype(self):
+        # int64 index arrays when any dim exceeds int32-max (cast must
+        # happen inside the x64 scope or astype itself truncates)
+        return "int64" if any(d > _INT32_MAX for d in self.shape) else "int32"
+
     def __getitem__(self, key):
-        if isinstance(key, NDArray):
-            key = key._data.astype("int32")
-        out = self._data[key]
+        with _x64_if_large(self.shape):
+            if isinstance(key, NDArray):
+                key = key._data.astype(self._index_dtype())
+            out = self._data[key]
         return NDArray(out, ctx=self._ctx)
 
     def __setitem__(self, key, value):
@@ -459,14 +495,15 @@ class NDArray:
             value = value._data
         elif isinstance(value, _np.ndarray):
             value = jnp.asarray(value, dtype=self.dtype)
-        if isinstance(key, NDArray):
-            key = key._data.astype("int32")
         if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
             if not hasattr(value, "shape") or value.shape != self.shape:
                 value = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype), self.shape)
             self._set_data(jnp.asarray(value, dtype=self.dtype))
         else:
-            self._set_data(self._data.at[key].set(value))
+            with _x64_if_large(self.shape):
+                if isinstance(key, NDArray):
+                    key = key._data.astype(self._index_dtype())
+                self._set_data(self._data.at[key].set(value))
 
     def __iter__(self):
         for i in range(self.shape[0]):
@@ -538,8 +575,9 @@ def invoke(op_name, inputs, attrs, out=None):
 
     # the ProfileOperator hook (reference: graph_executor.cc:1309 wraps each
     # pushed op when profiling is enabled)
-    results = _profiler.timed_call(op_name, _ops.invoke_jax,
-                                   (op_name, call_arrays, attrs))
+    with _x64_if_large(*(a.shape for a in in_arrays if hasattr(a, "shape"))):
+        results = _profiler.timed_call(op_name, _ops.invoke_jax,
+                                       (op_name, call_arrays, attrs))
     multi = isinstance(results, (tuple, list))
     results = tuple(results) if multi else (results,)
 

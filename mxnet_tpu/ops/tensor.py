@@ -297,12 +297,22 @@ def norm(data, ord=2, axis=None, keepdims=False):
     return jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=keepdims))
 
 
+def _arg_index_dtype():
+    """Reference argmax/argmin emit float32 positions. float32 is exact only
+    to 2^24; in large-tensor mode (dim > int32-max runs under scoped x64 —
+    see ndarray._x64_if_large) positions can exceed that, so widen to
+    float64 exactly when x64 is live."""
+    import jax
+
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
 @register("argmax")
 def argmax(data, axis=None, keepdims=False):
     out = jnp.argmax(data, axis=axis)
     if keepdims and axis is not None:
         out = jnp.expand_dims(out, axis)
-    return out.astype(jnp.float32)
+    return out.astype(_arg_index_dtype())
 
 
 @register("argmin")
@@ -310,12 +320,12 @@ def argmin(data, axis=None, keepdims=False):
     out = jnp.argmin(data, axis=axis)
     if keepdims and axis is not None:
         out = jnp.expand_dims(out, axis)
-    return out.astype(jnp.float32)
+    return out.astype(_arg_index_dtype())
 
 
 @register("argmax_channel")
 def argmax_channel(data):
-    return jnp.argmax(data, axis=1).astype(jnp.float32)
+    return jnp.argmax(data, axis=1).astype(_arg_index_dtype())
 
 
 # --------------------------------------------------------------------------

@@ -1,14 +1,28 @@
 """Per-shape conv backward probe: measure fwd / dgrad / wgrad TFLOP/s for
 the ResNet-50 conv shapes in NCHW vs NHWC dimension numbers on the real
 chip, to find where backward MFU goes and whether logical layout matters.
+
+Timing methodology: each measurement runs ITERS kernel executions inside a
+single jitted `lax.fori_loop` whose carry feeds a numerically-negligible
+scalar (scaled 1e-30; exact *0 would constant-fold) from each iteration's
+output into one of the next iteration's operands. The data dependency
+stops XLA from overlapping/hoisting iterations, so one wall-clock
+measurement of the loop divides into per-iteration time. A free-running
+Python loop (the previous version) measured only dispatch throughput over
+the remote-PJRT tunnel and reported impossible TFLOP/s.
+
+Which operand carries the chain matters:
+- fwd / dgrad chain through the *weight* (tiny, free to perturb);
+- wgrad's operands are the input and the cotangent, so the chain goes
+  through a freshly-filled cotangent; the fill costs one HBM pass over
+  the output, measured separately (`fill` loop) and subtracted.
 """
 import json
 import os
 import time
-from functools import partial
 
 BATCH = int(os.environ.get("MXTPU_PROBE_BATCH", 256))
-ITERS = int(os.environ.get("MXTPU_PROBE_ITERS", 20))
+ITERS = int(os.environ.get("MXTPU_PROBE_ITERS", 400))
 
 # (cin, cout, hw, k, stride) — representative ResNet-50 bulk shapes
 SHAPES = [
@@ -22,16 +36,38 @@ SHAPES = [
 ]
 
 
-def timed(fn, *args, n=ITERS):
-    import jax
-    jax.block_until_ready(fn(*args))
-    jax.block_until_ready(fn(*args))
+_RTT = None
+
+
+def _rtt():
+    """One dispatch+fetch round trip over the remote-PJRT tunnel. On axon,
+    block_until_ready does not wait for remote execution — only fetching a
+    value to host does — so every timing below fetches its carry scalar and
+    subtracts this baseline."""
+    global _RTT
+    if _RTT is None:
+        import jax
+        import jax.numpy as jnp
+
+        tiny = jax.jit(lambda v: v + 1.0)
+        z = jnp.zeros((), jnp.float32)
+        float(tiny(z))
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(tiny(z))
+            samples.append(time.perf_counter() - t0)
+        _RTT = min(samples)
+        print(json.dumps({"rtt_ms": round(_RTT * 1e3, 3)}), flush=True)
+    return _RTT
+
+
+def _timed(loop, *args):
+    float(loop(*args))  # compile + warm; fetch forces real completion
     t0 = time.perf_counter()
-    out = None
-    for _ in range(n):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n
+    float(loop(*args))
+    dt = time.perf_counter() - t0
+    return max(dt - _rtt(), 1e-9) / ITERS
 
 
 def main():
@@ -39,23 +75,32 @@ def main():
     import jax.numpy as jnp
     from jax import lax
 
-    results = []
+    def chain(val):
+        # full reduce: every output element feeds the carry, so XLA cannot
+        # narrow the producing kernel to a single-element slice (a [0]
+        # element chain let the simplifier collapse each conv to one
+        # output-pixel dot product). The reduce fuses into the kernel's
+        # epilogue; *1e-30 keeps the perturbation numerically nil without
+        # the exact-zero constant fold.
+        return jnp.sum(val, dtype=jnp.float32) * 1e-30
+
     for (cin, cout, hw, k, s) in SHAPES:
         pad = k // 2
         ho = hw // s
         flops = 2 * BATCH * cout * ho * ho * cin * k * k
         row = {"cin": cin, "cout": cout, "hw": hw, "k": k, "s": s,
                "gflops": round(flops / 1e9, 1)}
-        for layout, (lhs_spec, out_spec) in {
-                "NCHW": ("NCHW", "NCHW"), "NHWC": ("NHWC", "NHWC")}.items():
+        for layout, lhs_spec in {"NCHW": "NCHW", "NHWC": "NHWC"}.items():
             dn = lax.conv_dimension_numbers(
-                (1, 1, 1, 1), (1, 1, 1, 1), (lhs_spec, "OIHW", out_spec))
+                (1, 1, 1, 1), (1, 1, 1, 1), (lhs_spec, "OIHW", lhs_spec))
             if layout == "NCHW":
                 xs = (BATCH, cin, hw, hw)
+                os_ = (BATCH, cout, ho, ho)
             else:
                 xs = (BATCH, hw, hw, cin)
-            key = jax.random.PRNGKey(0)
-            x = jax.random.normal(key, xs, jnp.float32).astype(jnp.bfloat16)
+                os_ = (BATCH, ho, ho, cout)
+            x = jax.random.normal(jax.random.PRNGKey(0), xs,
+                                  jnp.float32).astype(jnp.bfloat16)
             w = jax.random.normal(jax.random.PRNGKey(1), (cout, cin, k, k),
                                   jnp.float32).astype(jnp.bfloat16)
 
@@ -65,25 +110,57 @@ def main():
                     padding=[(pad, pad), (pad, pad)],
                     dimension_numbers=dn)
 
-            fwd = jax.jit(conv)
-            dt_f = timed(fwd, x, w)
+            @jax.jit
+            def fwd_loop(x, w):
+                def body(_, c):
+                    return chain(conv(x, w + c.astype(w.dtype)))
+                return lax.fori_loop(0, ITERS, body, jnp.zeros((), jnp.float32))
 
-            dgrad = jax.jit(jax.grad(
-                lambda xx, ww: conv(xx, ww).astype(jnp.float32).sum(),
-                argnums=0))
-            dt_d = timed(dgrad, x, w)
+            @jax.jit
+            def dgrad_loop(x, w):
+                # d/dx of sum(conv): cotangent is constant ones (hoisted);
+                # the dgrad conv runs with the chained weight each iteration
+                # and the unused forward conv is DCE'd — this times dgrad
+                # alone.
+                def body(_, c):
+                    g = jax.grad(
+                        lambda xx: conv(xx, w + c.astype(w.dtype))
+                        .astype(jnp.float32).sum())(x)
+                    return chain(g)
+                return lax.fori_loop(0, ITERS, body, jnp.zeros((), jnp.float32))
 
-            wgrad = jax.jit(jax.grad(
-                lambda xx, ww: conv(xx, ww).astype(jnp.float32).sum(),
-                argnums=1))
-            dt_w = timed(wgrad, x, w)
+            @jax.jit
+            def wgrad_loop(x, w):
+                # wgrad contracts input with cotangent; the chain must ride
+                # the cotangent (input is loop-invariant, weight is not an
+                # operand). Fill cost measured by fill_loop and subtracted.
+                def body(_, c):
+                    ct = jnp.full(os_, 1, jnp.bfloat16) + c.astype(jnp.bfloat16)
+                    _, pull = jax.vjp(lambda ww: conv(x, ww), w)
+                    gw, = pull(ct)
+                    return chain(gw)
+                return lax.fori_loop(0, ITERS, body, jnp.zeros((), jnp.float32))
 
+            @jax.jit
+            def fill_loop(x, w):
+                def body(_, c):
+                    ct = jnp.full(os_, 1, jnp.bfloat16) + c.astype(jnp.bfloat16)
+                    return chain(ct)
+                return lax.fori_loop(0, ITERS, body, jnp.zeros((), jnp.float32))
+
+            dt_f = _timed(fwd_loop, x, w)
+            dt_d = _timed(dgrad_loop, x, w)
+            dt_fill = _timed(fill_loop, x, w)
+            dt_w = max(_timed(wgrad_loop, x, w) - dt_fill, 1e-9)
             row[layout] = {
                 "fwd_tflops": round(flops / dt_f / 1e12, 1),
                 "dgrad_tflops": round(flops / dt_d / 1e12, 1),
                 "wgrad_tflops": round(flops / dt_w / 1e12, 1),
+                "fwd_ms": round(dt_f * 1e3, 3),
+                "dgrad_ms": round(dt_d * 1e3, 3),
+                "wgrad_ms": round(dt_w * 1e3, 3),
+                "fill_ms": round(dt_fill * 1e3, 3),
             }
-        results.append(row)
         print(json.dumps(row), flush=True)
 
 

@@ -6,6 +6,10 @@ import json
 import os
 import time
 
+import sys
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+from bench import _chip_peak_tflops
+
 import numpy as np
 
 BATCH = int(os.environ.get("MXTPU_PROBE_BATCH", 256))
@@ -22,7 +26,7 @@ def main():
     from mxnet_tpu.parallel import DistributedTrainer, make_mesh
 
     dev = jax.devices()[0]
-    peak = 197.0 if "v5 lite" in getattr(dev, "device_kind", "") else None
+    peak = _chip_peak_tflops(dev)  # bench.py maintains the per-chip table
     out = {"device": getattr(dev, "device_kind", str(dev)), "batch": BATCH,
            "segment": "noupdate_step"}
 
