@@ -50,6 +50,8 @@ BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", 32))
 WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", 5))
 ITERS = int(os.environ.get("MXTPU_BENCH_ITERS", 20))
 MODE = os.environ.get("MXTPU_BENCH_MODE", "train")
+# NCHW (reference layout, default) or NHWC (MXU-preferred channels-last)
+LAYOUT = os.environ.get("MXTPU_BENCH_LAYOUT", "NCHW").upper()
 # bf16 compute + fp32 master weights is the TPU-native training precision
 AMP_DTYPE = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16")
 if AMP_DTYPE in ("float32", "fp32", "none"):
@@ -100,17 +102,26 @@ def _percentiles(ms):
 
 def _build(ctx):
     import mxnet_tpu as mx
+    from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo import vision
 
+    batch = BATCH
     with ctx:
-        net = vision.resnet50_v1()
+        if LAYOUT == "NHWC":
+            # channels-last build (MXU-preferred): layout_scope flips the
+            # default conv/pool layout + BN axis for the whole zoo model
+            with gluon.nn.layout_scope():
+                net = vision.resnet50_v1()
+            xshape = (batch, 224, 224, 3)
+        else:
+            net = vision.resnet50_v1()
+            xshape = (batch, 3, 224, 224)
         net.initialize(ctx=ctx)
         rng = np.random.RandomState(0)
         # data lives on-device: a real input pipeline double-buffers batches
         # to HBM; the timed loop must not pay host->device transfer per step
-        x = mx.nd.array(rng.uniform(-1, 1, (BATCH, 3, 224, 224))
-                        .astype(np.float32), ctx=ctx)
-        label = mx.nd.array(rng.randint(0, 1000, (BATCH,))
+        x = mx.nd.array(rng.uniform(-1, 1, xshape).astype(np.float32), ctx=ctx)
+        label = mx.nd.array(rng.randint(0, 1000, (batch,))
                             .astype(np.float32), ctx=ctx)
         net(x)  # finish deferred init
     return net, x, label
@@ -186,9 +197,10 @@ def _sweep_batch_arrays(ctx, sweep_batch):
     import mxnet_tpu as mx
 
     rng = _np.random.RandomState(1)
+    shape = (sweep_batch, 224, 224, 3) if LAYOUT == "NHWC" \
+        else (sweep_batch, 3, 224, 224)
     with ctx:
-        xl = mx.nd.array(rng.uniform(
-            -1, 1, (sweep_batch, 3, 224, 224)).astype(_np.float32), ctx=ctx)
+        xl = mx.nd.array(rng.uniform(-1, 1, shape).astype(_np.float32), ctx=ctx)
         yl = mx.nd.array(rng.randint(
             0, 1000, (sweep_batch,)).astype(_np.float32), ctx=ctx)
     return xl, yl
@@ -245,16 +257,19 @@ def bench_score():
     jitted = jax.jit(fwd)
 
     def timed_score(xl, batch):
-        """compile/warm -> drain -> free-running timed loop -> imgs/sec."""
-        jitted(xl).block_until_ready()
+        """compile/warm -> drain -> free-running timed loop -> imgs/sec.
+        Drains via device_get (host fetch): on the remote-PJRT tunnel
+        block_until_ready can return before remote execution completes, so
+        only a value fetch reliably bounds the timed region."""
+        jax.device_get(jitted(xl))
         for _ in range(WARMUP):
             jitted(xl)
-        jitted(xl).block_until_ready()
+        jax.device_get(jitted(xl))
         t0 = time.perf_counter()
         o = None
         for _ in range(ITERS):
             o = jitted(xl)
-        o.block_until_ready()
+        jax.device_get(o)
         return batch * ITERS / (time.perf_counter() - t0)
 
     imgs_per_sec = timed_score(xb, BATCH)

@@ -19,10 +19,12 @@ class _DenseLayer(HybridBlock):
         self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1, use_bias=False))
         if dropout:
             self.body.add(nn.Dropout(dropout))
+        # channel axis captured at construction (layout_scope-aware)
+        self._c_axis = -1 if nn.in_channels_last_scope() else 1
 
     def hybrid_forward(self, F, x):
         out = self.body(x)
-        return F.concat(x, out, dim=1)
+        return F.concat(x, out, dim=self._c_axis)
 
 
 def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):

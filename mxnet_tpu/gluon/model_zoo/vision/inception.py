@@ -18,12 +18,15 @@ def _make_basic_conv(**kwargs):
 class _Concurrent(HybridBlock):
     def __init__(self, prefix=None):
         super().__init__(prefix=prefix)
+        # channel axis captured at construction (layout_scope-aware)
+        self._c_axis = -1 if nn.in_channels_last_scope() else 1
 
     def add(self, block):
         self.register_child(block)
 
     def hybrid_forward(self, F, x):
-        return F.concat(*[block(x) for block in self._children.values()], dim=1)
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self._c_axis)
 
 
 def _make_branch(use_pool, *conv_settings):
@@ -101,15 +104,17 @@ class _InceptionE(HybridBlock):
         self.branch3_b = _make_basic_conv(channels=384, kernel_size=(3, 1),
                                           padding=(1, 0))
         self.branch4 = _make_branch("avg", (192, 1, None, None))
+        self._c_axis = -1 if nn.in_channels_last_scope() else 1
 
     def hybrid_forward(self, F, x):
+        c = self._c_axis
         b1 = self.branch1(x)
         s2 = self.branch2_stem(x)
-        b2 = F.concat(self.branch2_a(s2), self.branch2_b(s2), dim=1)
+        b2 = F.concat(self.branch2_a(s2), self.branch2_b(s2), dim=c)
         s3 = self.branch3_stem(x)
-        b3 = F.concat(self.branch3_a(s3), self.branch3_b(s3), dim=1)
+        b3 = F.concat(self.branch3_a(s3), self.branch3_b(s3), dim=c)
         b4 = self.branch4(x)
-        return F.concat(b1, b2, b3, b4, dim=1)
+        return F.concat(b1, b2, b3, b4, dim=c)
 
 
 class Inception3(HybridBlock):

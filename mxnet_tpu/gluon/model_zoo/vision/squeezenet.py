@@ -16,10 +16,12 @@ class _Fire(HybridBlock):
         self.expand1x1 = nn.Conv2D(expand1x1_channels, kernel_size=1, activation="relu")
         self.expand3x3 = nn.Conv2D(expand3x3_channels, kernel_size=3, padding=1,
                                    activation="relu")
+        # channel axis captured at construction (layout_scope-aware)
+        self._c_axis = -1 if nn.in_channels_last_scope() else 1
 
     def hybrid_forward(self, F, x):
         x = self.squeeze(x)
-        return F.concat(self.expand1x1(x), self.expand3x3(x), dim=1)
+        return F.concat(self.expand1x1(x), self.expand3x3(x), dim=self._c_axis)
 
 
 class SqueezeNet(HybridBlock):

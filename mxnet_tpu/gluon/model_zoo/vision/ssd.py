@@ -66,6 +66,13 @@ class SSD(HybridBlock):
                  extra_filters=(512, 256, 256, 128), sizes=None, ratios=None,
                  anchor_clip=False, **kwargs):
         super().__init__(**kwargs)
+        if nn.in_channels_last_scope():
+            # the detection heads' reshapes and MultiBoxPrior's H/W reads
+            # are NCHW-specific; building under a channels-last scope would
+            # run without error but scramble predictions and anchors
+            raise ValueError(
+                "SSD does not support channels-last layout_scope; build it "
+                "outside the scope (its heads assume NCHW)")
         nscales = len(base_blocks) + num_extras
         sizes = sizes if sizes is not None else _SIZES_512[:nscales]
         ratios = ratios if ratios is not None else _RATIOS_6[:nscales]

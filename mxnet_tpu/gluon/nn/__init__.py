@@ -2,4 +2,5 @@
 from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403
 from .basic_layers import Sequential, HybridSequential  # noqa: F401
+from .conv_layers import layout_scope, in_channels_last_scope  # noqa: F401
 from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401

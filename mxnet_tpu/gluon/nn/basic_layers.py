@@ -196,11 +196,17 @@ class BatchNorm(HybridBlock):
     """Batch normalization (reference: basic_layers.py:310). Moving stats are
     aux parameters updated functionally (see ops/nn.py batch_norm)."""
 
-    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True, scale=True,
+    def __init__(self, axis=None, momentum=0.9, epsilon=1e-5, center=True, scale=True,
                  use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones", running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0, **kwargs):
         super().__init__(**kwargs)
+        if axis is None:
+            # reference default is the channels-first axis (1); inside a
+            # channels-last layout_scope the default follows the layout
+            from .conv_layers import in_channels_last_scope
+
+            axis = -1 if in_channels_last_scope() else 1
         self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
                         "fix_gamma": not scale, "use_global_stats": use_global_stats}
         self._axis = axis

@@ -20,6 +20,49 @@ def _pair(x, n):
     return (x,) * n
 
 
+# ---------------------------------------------------------------------------
+# construction-time default-layout scope (TPU extension)
+#
+# Channels-last is the MXU-preferred layout, but the reference zoo/API
+# defaults are channels-first. Instead of threading a layout kwarg through
+# every model builder, `with layout_scope(): net = vision.resnet50_v1()`
+# flips the *default* layout of conv/pool layers (and BatchNorm's default
+# axis, see basic_layers) while they are constructed. An explicit
+# layout=/axis= argument always wins; layers built outside the scope keep
+# reference (channels-first) defaults.
+# ---------------------------------------------------------------------------
+
+_LAYOUT_SCOPE = {"channels_last": False}
+
+_CHANNELS_LAST = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+
+
+class layout_scope:
+    def __init__(self, channels_last=True):
+        self._want = channels_last
+
+    def __enter__(self):
+        self._prev = _LAYOUT_SCOPE["channels_last"]
+        _LAYOUT_SCOPE["channels_last"] = self._want
+        return self
+
+    def __exit__(self, *exc):
+        _LAYOUT_SCOPE["channels_last"] = self._prev
+        return False
+
+
+def in_channels_last_scope():
+    return _LAYOUT_SCOPE["channels_last"]
+
+
+def _default_layout(nsp, explicit, channels_first):
+    if explicit is not None:
+        return explicit
+    if _LAYOUT_SCOPE["channels_last"]:
+        return _CHANNELS_LAST[nsp]
+    return channels_first
+
+
 class _Conv(HybridBlock):
     def __init__(self, channels, kernel_size, strides, padding, dilation, groups,
                  layout, in_channels=0, activation=None, use_bias=True,
@@ -30,16 +73,27 @@ class _Conv(HybridBlock):
             self._channels = channels
             self._in_channels = in_channels
             ndim = len(kernel_size)
+            self._layout = layout
+            # channels-last layouts (NWC/NHWC/NDHWC): weight carries the
+            # reference's ConvertLayout(OI*k -> layout) shape — (O, *k, I)
+            # for conv, (I, *k, O/g) for deconv (convolution.cc:158)
+            from ...ops.nn import _channels_last
+
+            ch_last = _channels_last(layout)
+            self._ch_axis = len(layout) - 1 if ch_last else 1
             self._kwargs = {
                 "kernel": kernel_size, "stride": strides, "dilate": dilation,
                 "pad": padding, "num_filter": channels, "num_group": groups,
-                "no_bias": not use_bias}
+                "no_bias": not use_bias, "layout": layout}
             if adj is not None:
                 self._kwargs["adj"] = adj
             self._op_name = op_name
+            in_cg = in_channels // groups if in_channels else 0
             if op_name == "Convolution":
-                wshape = (channels, in_channels // groups if in_channels else 0) + \
-                    tuple(kernel_size)
+                wshape = (channels,) + tuple(kernel_size) + (in_cg,) if ch_last \
+                    else (channels, in_cg) + tuple(kernel_size)
+            elif ch_last:  # Deconvolution channels-last
+                wshape = (in_channels,) + tuple(kernel_size) + (channels // groups,)
             else:  # Deconvolution: (in_c, out_c/g, *k)
                 wshape = (in_channels, channels // groups) + tuple(kernel_size)
             self.weight = self.params.get("weight", shape=wshape,
@@ -57,14 +111,19 @@ class _Conv(HybridBlock):
                 if activation is not None else None
 
     def _shape_hook(self, x):
-        c = x.shape[1]
+        c = x.shape[self._ch_axis]
         w = self.weight
-        if w.shape and (w.shape[0] == 0 or w.shape[1] == 0):
+        if w.shape and (0 in w.shape):
             g = self._kwargs["num_group"]
+            k = tuple(self._kwargs["kernel"])
+            ch_last = self._ch_axis != 1
             if self._op_name == "Convolution":
-                w.shape = (self._channels, c // g) + tuple(self._kwargs["kernel"])
+                w.shape = (self._channels,) + k + (c // g,) if ch_last \
+                    else (self._channels, c // g) + k
+            elif ch_last:
+                w.shape = (c,) + k + (self._channels // g,)
             else:
-                w.shape = (c, self._channels // g) + tuple(self._kwargs["kernel"])
+                w.shape = (c, self._channels // g) + k
 
     def hybrid_forward(self, F, x, weight, bias=None):
         op = getattr(F, self._op_name)
@@ -76,44 +135,48 @@ class _Conv(HybridBlock):
 
 class Conv1D(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
-                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 groups=1, layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", in_channels=0,
                  **kwargs):
         super().__init__(channels, _pair(kernel_size, 1), _pair(strides, 1),
-                         _pair(padding, 1), _pair(dilation, 1), groups, layout,
+                         _pair(padding, 1), _pair(dilation, 1), groups,
+                         _default_layout(1, layout, "NCW"),
                          in_channels, activation, use_bias, weight_initializer,
                          bias_initializer, **kwargs)
 
 
 class Conv2D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 dilation=(1, 1), groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         super().__init__(channels, _pair(kernel_size, 2), _pair(strides, 2),
-                         _pair(padding, 2), _pair(dilation, 2), groups, layout,
+                         _pair(padding, 2), _pair(dilation, 2), groups,
+                         _default_layout(2, layout, "NCHW"),
                          in_channels, activation, use_bias, weight_initializer,
                          bias_initializer, **kwargs)
 
 
 class Conv3D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
-                 dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
+                 dilation=(1, 1, 1), groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         super().__init__(channels, _pair(kernel_size, 3), _pair(strides, 3),
-                         _pair(padding, 3), _pair(dilation, 3), groups, layout,
+                         _pair(padding, 3), _pair(dilation, 3), groups,
+                         _default_layout(3, layout, "NCDHW"),
                          in_channels, activation, use_bias, weight_initializer,
                          bias_initializer, **kwargs)
 
 
 class Conv1DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0, output_padding=0,
-                 dilation=1, groups=1, layout="NCW", activation=None, use_bias=True,
+                 dilation=1, groups=1, layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", in_channels=0,
                  **kwargs):
         super().__init__(channels, _pair(kernel_size, 1), _pair(strides, 1),
-                         _pair(padding, 1), _pair(dilation, 1), groups, layout,
+                         _pair(padding, 1), _pair(dilation, 1), groups,
+                         _default_layout(1, layout, "NCW"),
                          in_channels, activation, use_bias, weight_initializer,
                          bias_initializer, op_name="Deconvolution",
                          adj=_pair(output_padding, 1), **kwargs)
@@ -121,11 +184,12 @@ class Conv1DTranspose(_Conv):
 
 class Conv2DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 output_padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
+                 output_padding=(0, 0), dilation=(1, 1), groups=1, layout=None,
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         super().__init__(channels, _pair(kernel_size, 2), _pair(strides, 2),
-                         _pair(padding, 2), _pair(dilation, 2), groups, layout,
+                         _pair(padding, 2), _pair(dilation, 2), groups,
+                         _default_layout(2, layout, "NCHW"),
                          in_channels, activation, use_bias, weight_initializer,
                          bias_initializer, op_name="Deconvolution",
                          adj=_pair(output_padding, 2), **kwargs)
@@ -134,11 +198,12 @@ class Conv2DTranspose(_Conv):
 class Conv3DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
                  output_padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", activation=None, use_bias=True,
+                 layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", in_channels=0,
                  **kwargs):
         super().__init__(channels, _pair(kernel_size, 3), _pair(strides, 3),
-                         _pair(padding, 3), _pair(dilation, 3), groups, layout,
+                         _pair(padding, 3), _pair(dilation, 3), groups,
+                         _default_layout(3, layout, "NCDHW"),
                          in_channels, activation, use_bias, weight_initializer,
                          bias_initializer, op_name="Deconvolution",
                          adj=_pair(output_padding, 3), **kwargs)
@@ -146,14 +211,15 @@ class Conv3DTranspose(_Conv):
 
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, count_include_pad=None, **kwargs):
+                 pool_type, count_include_pad=None, layout=None, **kwargs):
         super().__init__(**kwargs)
         if strides is None:
             strides = pool_size
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
-            "pooling_convention": "full" if ceil_mode else "valid"}
+            "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -162,78 +228,84 @@ class _Pooling(HybridBlock):
 
 
 class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 1), _pair(strides, 1) if strides is not None else None,
-                         _pair(padding, 1), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 1), ceil_mode, False, "max",
+                         layout=_default_layout(1, layout, "NCW"), **kwargs)
 
 
 class MaxPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 2), _pair(strides, 2) if strides is not None else None,
-                         _pair(padding, 2), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 2), ceil_mode, False, "max",
+                         layout=_default_layout(2, layout, "NCHW"), **kwargs)
 
 
 class MaxPool3D(_Pooling):
-    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW",
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 3), _pair(strides, 3) if strides is not None else None,
-                         _pair(padding, 3), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 3), ceil_mode, False, "max",
+                         layout=_default_layout(3, layout, "NCDHW"), **kwargs)
 
 
 class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_pair(pool_size, 1), _pair(strides, 1) if strides is not None else None,
                          _pair(padding, 1), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad,
+                         layout=_default_layout(1, layout, "NCW"), **kwargs)
 
 
 class AvgPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_pair(pool_size, 2), _pair(strides, 2) if strides is not None else None,
                          _pair(padding, 2), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad,
+                         layout=_default_layout(2, layout, "NCHW"), **kwargs)
 
 
 class AvgPool3D(_Pooling):
-    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW",
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_pair(pool_size, 3), _pair(strides, 3) if strides is not None else None,
                          _pair(padding, 3), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad,
+                         layout=_default_layout(3, layout, "NCDHW"), **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "max", **kwargs)
+    def __init__(self, layout=None, **kwargs):
+        super().__init__((1,), None, (0,), True, True, "max", layout=_default_layout(1, layout, "NCW"), **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "max", **kwargs)
+    def __init__(self, layout=None, **kwargs):
+        super().__init__((1, 1), None, (0, 0), True, True, "max", layout=_default_layout(2, layout, "NCHW"), **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max", **kwargs)
+    def __init__(self, layout=None, **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max", layout=_default_layout(3, layout, "NCDHW"), **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "avg", **kwargs)
+    def __init__(self, layout=None, **kwargs):
+        super().__init__((1,), None, (0,), True, True, "avg", layout=_default_layout(1, layout, "NCW"), **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "avg", **kwargs)
+    def __init__(self, layout=None, **kwargs):
+        super().__init__((1, 1), None, (0, 0), True, True, "avg", layout=_default_layout(2, layout, "NCHW"), **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg", **kwargs)
+    def __init__(self, layout=None, **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg", layout=_default_layout(3, layout, "NCDHW"), **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

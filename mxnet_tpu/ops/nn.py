@@ -57,12 +57,34 @@ def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, fla
 # Convolution / Deconvolution (reference: convolution.cc, deconvolution.cc)
 # --------------------------------------------------------------------------
 
-def _conv_dnums(ndim):
-    # NC + spatial; kernel OI + spatial
+def _norm_layout(ndim, layout):
+    """Resolve a conv/pool layout attr to its string form. None/empty means
+    the reference default (channels-first). Supported channels-last forms
+    mirror the reference's layout enum (convolution.cc:102 NHWC/NDHWC/NWC —
+    reference gates them to GPU; here they lower to XLA dnums directly,
+    and on TPU channels-last is the MXU-preferred layout)."""
     spatial = "DHW"[3 - (ndim - 2):]
+    if not layout:
+        return "NC" + spatial
+    layout = str(layout)
+    if len(layout) != ndim or set(layout) != set("NC" + spatial):
+        raise MXNetError("unsupported layout %r for %dd input" % (layout, ndim))
+    return layout
+
+
+def _channels_last(layout):
+    return layout is not None and str(layout).endswith("C") and len(str(layout)) > 2
+
+
+def _conv_dnums(ndim, layout=None):
+    lhs = _norm_layout(ndim, layout)
+    if lhs[1] == "C":
+        kspec = "OI" + lhs[2:]          # weight (O, I, *k)
+    else:
+        kspec = "O" + lhs[1:-1] + "I"   # weight (O, *k, I) — reference
+        # ConvertLayout(OIHW -> NHWC) convention (convolution.cc:158)
     return lax.conv_dimension_numbers(
-        (1,) * ndim, (1,) * ndim,
-        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+        (1,) * ndim, (1,) * ndim, (lhs, kspec, lhs))
 
 
 def _tup(v, n):
@@ -78,6 +100,8 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
                 num_filter=0, num_group=1, no_bias=False, cudnn_tune=None,
                 cudnn_off=False, workspace=1024, layout=None):
     nsp = data.ndim - 2
+    ch_last = _channels_last(layout)
+    w_spatial = tuple(weight.shape[1:-1] if ch_last else weight.shape[2:])
     # the kernel attr is redundant with the weight's spatial dims; a
     # mismatch is a user error the reference's shape inference rejects
     # (conv shape check, src/operator/nn/convolution.cc InferShape).
@@ -87,13 +111,13 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
         kt = tuple(int(k) for k in kernel) if kernel else ()
     except (TypeError, ValueError):
         kt = ()
-    if kt and kt != tuple(weight.shape[2:]):
+    if kt and kt != w_spatial:
         raise MXNetError("Convolution: kernel attr %s != weight spatial "
-                         "shape %s" % (kt, tuple(weight.shape[2:])))
+                         "shape %s" % (kt, w_spatial))
     stride = _tup(stride, nsp)
     dilate = _tup(dilate, nsp)
     pad = _tup(pad if pad != () else 0, nsp)
-    dn = _conv_dnums(data.ndim)
+    dn = _conv_dnums(data.ndim, layout)
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -107,7 +131,7 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
         # so bf16-in/bf16-out loses nothing.
     )
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nsp)
+        out = out + (bias if ch_last else bias.reshape((1, -1) + (1,) * nsp))
     return out
 
 
@@ -119,6 +143,18 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
     reference (deconvolution-inl.h); implemented as a fractionally-strided
     conv (lhs_dilation) so XLA lowers it onto the MXU like a regular conv."""
     nsp = data.ndim - 2
+    if _channels_last(layout):
+        # correctness path only (deconv is off the perf-critical layouts):
+        # run the channels-first math and let XLA fold the transposes
+        perm_in = (0, data.ndim - 1) + tuple(range(1, data.ndim - 1))
+        perm_w = (0, data.ndim - 1) + tuple(range(1, data.ndim - 1))
+        perm_out = (0,) + tuple(range(2, data.ndim)) + (1,)
+        out = deconvolution(
+            jnp.transpose(data, perm_in), jnp.transpose(weight, perm_w), bias,
+            kernel=kernel, stride=stride, dilate=dilate, pad=pad, adj=adj,
+            target_shape=target_shape, num_filter=num_filter,
+            num_group=num_group, no_bias=no_bias)
+        return jnp.transpose(out, perm_out)
     stride = _tup(stride, nsp)
     dilate = _tup(dilate, nsp)
     pad = _tup(pad if pad != () else 0, nsp)
@@ -175,13 +211,22 @@ def _patches_max(x, kernel, stride, pads):
 
 
 @functools.lru_cache(maxsize=None)
-def _float_max_pool(kernel, stride, pads):
+def _float_max_pool(kernel, stride, pads, ch_last=False):
     """Float max pooling: cheap `lax.reduce_window` forward, patches-based
     backward (reduce_window(max) has no linearization rule in jax 0.9, which
     breaks reverse-mode AD under jit — CachedOp backward)."""
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding = ((0, 0), (0, 0)) + pads
+    if ch_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding = ((0, 0),) + pads + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding = ((0, 0), (0, 0)) + pads
+
+    nsp = len(kernel)
+    to_ncfirst = (0, nsp + 1) + tuple(range(1, nsp + 1))
+    to_chlast = (0,) + tuple(range(2, nsp + 2)) + (1,)
 
     @jax.custom_vjp
     def mp(x):
@@ -192,7 +237,15 @@ def _float_max_pool(kernel, stride, pads):
         return mp(x), x
 
     def bwd(x, g):
-        _, pull = jax.vjp(lambda t: _patches_max(t, kernel, stride, pads), x)
+        def ref(t):
+            # _patches_max is channels-first; transposes fold into the
+            # gather conv under XLA
+            if ch_last:
+                t = jnp.transpose(t, to_ncfirst)
+            out = _patches_max(t, kernel, stride, pads)
+            return jnp.transpose(out, to_chlast) if ch_last else out
+
+        _, pull = jax.vjp(ref, x)
         return (pull(g)[0],)
 
     mp.defvjp(fwd, bwd)
@@ -204,8 +257,10 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
             pooling_convention="valid", count_include_pad=True, p_value=2,
             cudnn_off=False, layout=None):
     nsp = data.ndim - 2
+    ch_last = _channels_last(layout)
+    sp_off = 1 if ch_last else 2  # first spatial axis position
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = data.shape[sp_off:sp_off + nsp]
         stride = (1,) * nsp
         pad = (0,) * nsp
     kernel = _tup(kernel, nsp)
@@ -215,21 +270,26 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
     for i in range(nsp):
         lo = hi = pad[i]
         if pooling_convention == "full" and not global_pool:
-            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            size = data.shape[sp_off + i] + 2 * pad[i] - kernel[i]
             out_d = int(math.ceil(size / stride[i])) + 1
-            need = (out_d - 1) * stride[i] + kernel[i] - (data.shape[2 + i] + 2 * pad[i])
+            need = (out_d - 1) * stride[i] + kernel[i] - (data.shape[sp_off + i] + 2 * pad[i])
             hi += builtins.max(need, 0)
         pads.append((lo, hi))
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding = [(0, 0), (0, 0)] + pads
+    if ch_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding = [(0, 0)] + pads + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding = [(0, 0), (0, 0)] + pads
 
     if pool_type == "max":
         if not jnp.issubdtype(data.dtype, jnp.floating):
             init = jnp.iinfo(data.dtype).min
             return lax.reduce_window(data, _np.asarray(init, data.dtype), lax.max,
                                      window, strides, padding)
-        return _float_max_pool(kernel, stride, tuple(pads))(data)
+        return _float_max_pool(kernel, stride, tuple(pads), ch_last)(data)
     if pool_type == "lp":
         powed = jnp.power(jnp.abs(data), p_value)
         s = lax.reduce_window(powed, _np.zeros((), data.dtype), lax.add, window, strides, padding)

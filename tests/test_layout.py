@@ -1,0 +1,193 @@
+"""Channels-last (NHWC/NWC/NDHWC) layout support.
+
+The reference accepts `layout=` on conv/pool layers (convolution.cc:102
+NHWC enum, GPU-gated there); here channels-last lowers straight to XLA
+dimension numbers — on TPU it is the MXU-preferred layout. These tests pin
+NHWC == NCHW numerics (fwd and grads) through the public gluon API, with
+the reference's ConvertLayout weight convention: conv (O, *k, I), deconv
+(I, *k, O/g) (convolution.cc:158).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import nn
+
+NHWC_OF_NCHW = (0, 2, 3, 1)
+NCHW_OF_NHWC = (0, 3, 1, 2)
+
+
+def _data(shape=(2, 8, 9, 3), seed=0):
+    x = np.random.RandomState(seed).randn(*shape).astype(np.float32)
+    return x, np.transpose(x, NCHW_OF_NHWC)
+
+
+def test_conv2d_nhwc_matches_nchw():
+    x, xc = _data()
+    c1 = nn.Conv2D(5, 3, strides=2, padding=1, in_channels=3)
+    c1.initialize()
+    w = c1.weight.data().asnumpy()
+    c2 = nn.Conv2D(5, 3, strides=2, padding=1, layout="NHWC", in_channels=3)
+    c2.initialize()
+    c2.weight.set_data(mx.nd.array(np.transpose(w, (0, 2, 3, 1))))
+    c2.bias.set_data(c1.bias.data())
+
+    a1 = mx.nd.array(xc)
+    a2 = mx.nd.array(x)
+    a1.attach_grad()
+    a2.attach_grad()
+    with autograd.record():
+        l1 = (c1(a1) ** 2).sum()
+        l2 = (c2(a2) ** 2).sum()
+    np.testing.assert_allclose(l2.asscalar(), l1.asscalar(), rtol=1e-4)
+    autograd.backward([l1, l2])
+    np.testing.assert_allclose(np.transpose(a2.grad.asnumpy(), NCHW_OF_NHWC),
+                               a1.grad.asnumpy(), rtol=1e-3, atol=1e-4)
+    # weight grad follows the channels-last weight layout (O, kH, kW, I)
+    np.testing.assert_allclose(
+        np.transpose(c2.weight.grad().asnumpy(), (0, 3, 1, 2)),
+        c1.weight.grad().asnumpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_grouped_conv_nhwc():
+    x, xc = _data((2, 6, 6, 4))
+    c1 = nn.Conv2D(8, 3, padding=1, groups=2, in_channels=4)
+    c1.initialize()
+    w = c1.weight.data().asnumpy()  # (8, 2, 3, 3)
+    c2 = nn.Conv2D(8, 3, padding=1, groups=2, layout="NHWC", in_channels=4)
+    c2.initialize()
+    c2.weight.set_data(mx.nd.array(np.transpose(w, (0, 2, 3, 1))))
+    c2.bias.set_data(c1.bias.data())
+    o1 = c1(mx.nd.array(xc)).asnumpy()
+    o2 = c2(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(np.transpose(o2, NCHW_OF_NHWC), o1,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_nhwc_matches_nchw():
+    x, xc = _data()
+    for p1, p2 in [
+        (nn.MaxPool2D(2, 2), nn.MaxPool2D(2, 2, layout="NHWC")),
+        (nn.AvgPool2D(3, 2, 1), nn.AvgPool2D(3, 2, 1, layout="NHWC")),
+        (nn.MaxPool2D(2, 2, ceil_mode=True),
+         nn.MaxPool2D(2, 2, layout="NHWC", ceil_mode=True)),
+        (nn.GlobalAvgPool2D(), nn.GlobalAvgPool2D(layout="NHWC")),
+        (nn.GlobalMaxPool2D(), nn.GlobalMaxPool2D(layout="NHWC")),
+    ]:
+        a1 = mx.nd.array(xc)
+        a2 = mx.nd.array(x)
+        a1.attach_grad()
+        a2.attach_grad()
+        with autograd.record():
+            o1 = p1(a1)
+            o2 = p2(a2)
+        np.testing.assert_allclose(np.transpose(o2.asnumpy(), NCHW_OF_NHWC),
+                                   o1.asnumpy(), rtol=1e-6)
+        autograd.backward([o1, o2])
+        np.testing.assert_allclose(np.transpose(a2.grad.asnumpy(), NCHW_OF_NHWC),
+                                   a1.grad.asnumpy(), rtol=1e-6)
+
+
+def test_deconv_nhwc_matches_nchw():
+    x, xc = _data((2, 5, 5, 3))
+    d1 = nn.Conv2DTranspose(4, 3, strides=2, in_channels=3)
+    d1.initialize()
+    wd = d1.weight.data().asnumpy()  # (I, O, kH, kW)
+    d2 = nn.Conv2DTranspose(4, 3, strides=2, layout="NHWC", in_channels=3)
+    d2.initialize()
+    d2.weight.set_data(mx.nd.array(np.transpose(wd, (0, 2, 3, 1))))
+    d2.bias.set_data(d1.bias.data())
+    o1 = d1(mx.nd.array(xc)).asnumpy()
+    o2 = d2(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(np.transpose(o2, NCHW_OF_NHWC), o1,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv1d_nwc():
+    x = np.random.RandomState(1).randn(2, 10, 3).astype(np.float32)
+    xc = np.transpose(x, (0, 2, 1))
+    c1 = nn.Conv1D(4, 3, padding=1, in_channels=3)
+    c1.initialize()
+    w = c1.weight.data().asnumpy()
+    c2 = nn.Conv1D(4, 3, padding=1, layout="NWC", in_channels=3)
+    c2.initialize()
+    c2.weight.set_data(mx.nd.array(np.transpose(w, (0, 2, 1))))
+    c2.bias.set_data(c1.bias.data())
+    o1 = c1(mx.nd.array(xc)).asnumpy()
+    o2 = c2(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(np.transpose(o2, (0, 2, 1)), o1,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_deferred_init_infers_nhwc_weight_shape():
+    x, _ = _data()
+    c = nn.Conv2D(6, 3, padding=1, layout="NHWC")  # in_channels deferred
+    c.initialize()
+    out = c(mx.nd.array(x))
+    assert c.weight.shape == (6, 3, 3, 3)  # (O, kH, kW, I=3)
+    assert out.shape == (2, 8, 9, 6)
+
+
+def test_layout_scope_model_zoo_resnet():
+    """`with nn.layout_scope():` flips default conv/pool layout and BN axis
+    at construction, so any zoo model builds channels-last — outputs must
+    match the channels-first build exactly given transposed weights."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    x, xc = _data((1, 32, 32, 3), seed=3)
+    net_cf = vision.resnet18_v1()
+    net_cf.initialize()
+    net_cf(mx.nd.array(xc))
+    with nn.layout_scope():
+        net_cl = vision.resnet18_v1()
+    assert not nn.in_channels_last_scope()  # scope restored
+    net_cl.initialize()
+    net_cl(mx.nd.array(x))
+    for (_, v1), (k2, v2) in zip(sorted(net_cf.collect_params().items()),
+                                 sorted(net_cl.collect_params().items())):
+        a = v1.data().asnumpy()
+        if a.ndim == 4:
+            a = np.transpose(a, NHWC_OF_NCHW)
+        assert tuple(v2.shape) == a.shape, (k2, v2.shape, a.shape)
+        v2.set_data(mx.nd.array(a))
+    o_cf = net_cf(mx.nd.array(xc)).asnumpy()
+    o_cl = net_cl(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(o_cl, o_cf, rtol=1e-4, atol=1e-5)
+
+
+def test_layout_scope_concat_families():
+    """Zoo families with channel-axis concats (fire/dense/inception blocks)
+    capture the scope's channel axis at construction."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    x = np.random.RandomState(5).randn(1, 224, 224, 3).astype(np.float32)
+    with nn.layout_scope():
+        net = vision.squeezenet1_0()
+    net.initialize()
+    out = net(mx.nd.array(x))
+    assert out.shape == (1, 1000)
+
+
+def test_ssd_rejects_channels_last_scope():
+    """SSD heads are NCHW-specific; constructing one inside layout_scope
+    must raise rather than silently scramble predictions."""
+    import pytest
+
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    with nn.layout_scope():
+        with pytest.raises(ValueError, match="channels-last"):
+            vision.ssd_test_tiny(num_classes=3)
+
+
+def test_batchnorm_channels_last_axis():
+    x, xc = _data()
+    b1 = nn.BatchNorm(axis=1, in_channels=3)
+    b2 = nn.BatchNorm(axis=3, in_channels=3)
+    b1.initialize()
+    b2.initialize()
+    with autograd.record(train_mode=True):
+        o1 = b1(mx.nd.array(xc))
+        o2 = b2(mx.nd.array(x))
+    np.testing.assert_allclose(np.transpose(o2.asnumpy(), NCHW_OF_NHWC),
+                               o1.asnumpy(), rtol=1e-4, atol=1e-5)

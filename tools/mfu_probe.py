@@ -15,7 +15,8 @@ import os
 import time
 
 import sys
-sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import _chip_peak_tflops
 
 import numpy as np
@@ -40,8 +41,10 @@ def timed(fn, *args, n=ITERS):
 
 
 def _block(x):
+    # drain via host fetch: on the remote-PJRT tunnel block_until_ready can
+    # return before remote execution completes; device_get cannot
     import jax
-    jax.block_until_ready(x)
+    jax.device_get(jax.tree.leaves(x)[0] if not hasattr(x, "dtype") else x)
 
 
 def main():
