@@ -63,31 +63,7 @@ if AMP_DTYPE in ("float32", "fp32", "none"):
 RESNET50_FWD_FLOPS_PER_IMG = 2 * 4.089e9
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * RESNET50_FWD_FLOPS_PER_IMG
 
-# Peak dense-matmul TFLOPS per chip, bf16 (fp32 runs the MXU in multi-pass
-# mode at roughly 1/8 of bf16 peak on v4+; we report fp32 MFU against the
-# bf16 peak so the number is conservative and comparable across runs).
-_PEAK_BF16_TFLOPS = {
-    "TPU v2": 46.0,
-    "TPU v3": 123.0,
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,     # v5e
-    "TPU v5e": 197.0,
-    "TPU v5": 459.0,          # v5p
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,     # Trillium / v6e
-    "TPU v6e": 918.0,
-    "TPU7x": 4600.0,
-}
-
-
-def _chip_peak_tflops(device) -> float | None:
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    # longest table key first so "TPU v5 lite" wins over "TPU v5"
-    for name, peak in sorted(_PEAK_BF16_TFLOPS.items(),
-                             key=lambda kv: -len(kv[0])):
-        if kind.startswith(name.lower()):
-            return peak
-    return None
+from mxnet_tpu.runtime import chip_peak_tflops as _chip_peak_tflops  # noqa: E402
 
 
 def _percentiles(ms):

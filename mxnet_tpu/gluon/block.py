@@ -417,8 +417,13 @@ class HybridBlock(Block):
                 arg_it = iter(arg_arrays)
                 call_args = [a if a is not None else NDArray(next(arg_it), ctx=arg_ctx)
                              for a in static_args]
-                with autograd._scope(recording=False, training=is_train):
-                    out = block._eager_forward(*call_args)
+                # enter the args' ctx during the trace: fresh arrays created
+                # mid-forward (arange position ids, masks) must carry it, or
+                # sub-blocks fed by them fetch params on the ambient default
+                trace_ctx = arg_ctx if arg_ctx is not None else current_context()
+                with trace_ctx:
+                    with autograd._scope(recording=False, training=is_train):
+                        out = block._eager_forward(*call_args)
                 outs = out if isinstance(out, (list, tuple)) else (out,)
                 entry.single = not isinstance(out, (list, tuple))
                 entry.n_outputs = len(outs)

@@ -32,7 +32,9 @@ def _pair(x, n):
 # reference (channels-first) defaults.
 # ---------------------------------------------------------------------------
 
-_LAYOUT_SCOPE = {"channels_last": False}
+import threading
+
+_LAYOUT_SCOPE = threading.local()  # per-thread, like Context._stack
 
 _CHANNELS_LAST = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
 
@@ -42,23 +44,23 @@ class layout_scope:
         self._want = channels_last
 
     def __enter__(self):
-        self._prev = _LAYOUT_SCOPE["channels_last"]
-        _LAYOUT_SCOPE["channels_last"] = self._want
+        self._prev = getattr(_LAYOUT_SCOPE, "channels_last", False)
+        _LAYOUT_SCOPE.channels_last = self._want
         return self
 
     def __exit__(self, *exc):
-        _LAYOUT_SCOPE["channels_last"] = self._prev
+        _LAYOUT_SCOPE.channels_last = self._prev
         return False
 
 
 def in_channels_last_scope():
-    return _LAYOUT_SCOPE["channels_last"]
+    return getattr(_LAYOUT_SCOPE, "channels_last", False)
 
 
 def _default_layout(nsp, explicit, channels_first):
     if explicit is not None:
         return explicit
-    if _LAYOUT_SCOPE["channels_last"]:
+    if in_channels_last_scope():
         return _CHANNELS_LAST[nsp]
     return channels_first
 

@@ -76,6 +76,27 @@ def _channels_last(layout):
     return layout is not None and str(layout).endswith("C") and len(str(layout)) > 2
 
 
+def _to_ncfirst_perm(ndim):
+    """(N, *spatial, C) -> (N, C, *spatial)"""
+    return (0, ndim - 1) + tuple(range(1, ndim - 1))
+
+
+def _to_chlast_perm(ndim):
+    """(N, C, *spatial) -> (N, *spatial, C)"""
+    return (0,) + tuple(range(2, ndim)) + (1,)
+
+
+def _pool_window(kernel, stride, pads, ch_last):
+    """reduce_window (window, strides, padding) tuples for either layout."""
+    if ch_last:
+        return ((1,) + tuple(kernel) + (1,),
+                (1,) + tuple(stride) + (1,),
+                ((0, 0),) + tuple(pads) + ((0, 0),))
+    return ((1, 1) + tuple(kernel),
+            (1, 1) + tuple(stride),
+            ((0, 0), (0, 0)) + tuple(pads))
+
+
 def _conv_dnums(ndim, layout=None):
     lhs = _norm_layout(ndim, layout)
     if lhs[1] == "C":
@@ -146,11 +167,10 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
     if _channels_last(layout):
         # correctness path only (deconv is off the perf-critical layouts):
         # run the channels-first math and let XLA fold the transposes
-        perm_in = (0, data.ndim - 1) + tuple(range(1, data.ndim - 1))
-        perm_w = (0, data.ndim - 1) + tuple(range(1, data.ndim - 1))
-        perm_out = (0,) + tuple(range(2, data.ndim)) + (1,)
+        perm_in = _to_ncfirst_perm(data.ndim)
+        perm_out = _to_chlast_perm(data.ndim)
         out = deconvolution(
-            jnp.transpose(data, perm_in), jnp.transpose(weight, perm_w), bias,
+            jnp.transpose(data, perm_in), jnp.transpose(weight, perm_in), bias,
             kernel=kernel, stride=stride, dilate=dilate, pad=pad, adj=adj,
             target_shape=target_shape, num_filter=num_filter,
             num_group=num_group, no_bias=no_bias)
@@ -215,18 +235,11 @@ def _float_max_pool(kernel, stride, pads, ch_last=False):
     """Float max pooling: cheap `lax.reduce_window` forward, patches-based
     backward (reduce_window(max) has no linearization rule in jax 0.9, which
     breaks reverse-mode AD under jit — CachedOp backward)."""
-    if ch_last:
-        window = (1,) + kernel + (1,)
-        strides = (1,) + stride + (1,)
-        padding = ((0, 0),) + pads + ((0, 0),)
-    else:
-        window = (1, 1) + kernel
-        strides = (1, 1) + stride
-        padding = ((0, 0), (0, 0)) + pads
+    window, strides, padding = _pool_window(kernel, stride, pads, ch_last)
 
     nsp = len(kernel)
-    to_ncfirst = (0, nsp + 1) + tuple(range(1, nsp + 1))
-    to_chlast = (0,) + tuple(range(2, nsp + 2)) + (1,)
+    to_ncfirst = _to_ncfirst_perm(nsp + 2)
+    to_chlast = _to_chlast_perm(nsp + 2)
 
     @jax.custom_vjp
     def mp(x):
@@ -275,14 +288,7 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
             need = (out_d - 1) * stride[i] + kernel[i] - (data.shape[sp_off + i] + 2 * pad[i])
             hi += builtins.max(need, 0)
         pads.append((lo, hi))
-    if ch_last:
-        window = (1,) + kernel + (1,)
-        strides = (1,) + stride + (1,)
-        padding = [(0, 0)] + pads + [(0, 0)]
-    else:
-        window = (1, 1) + kernel
-        strides = (1, 1) + stride
-        padding = [(0, 0), (0, 0)] + pads
+    window, strides, padding = _pool_window(kernel, stride, tuple(pads), ch_last)
 
     if pool_type == "max":
         if not jnp.issubdtype(data.dtype, jnp.floating):

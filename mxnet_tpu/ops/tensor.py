@@ -508,9 +508,19 @@ def broadcast_axis(data, axis=(), size=()):
 # indexing (reference: indexing_op.cc)
 # --------------------------------------------------------------------------
 
+def _gather_index_dtype():
+    """Device index dtype for gather/scatter positions: int32 (XLA-native)
+    under the default config, int64 inside large-tensor mode (dim >
+    int32-max runs under scoped x64 — see ndarray._x64_if_large); a hard
+    int32 cast there would wrap positions past 2^31 negative and clip-mode
+    would silently return element 0."""
+    import jax as _jax
+
+    return jnp.int64 if _jax.config.jax_enable_x64 else jnp.int32
+
 @register("take")
 def take(a, indices, axis=0, mode="clip"):
-    idx = indices.astype(jnp.int32)
+    idx = indices.astype(_gather_index_dtype())
     if mode == "wrap":
         idx = jnp.mod(idx, a.shape[axis])
         mode = "clip"
@@ -519,7 +529,7 @@ def take(a, indices, axis=0, mode="clip"):
 
 @register("batch_take", aliases=("pick",))
 def pick(data, index, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    idx = jnp.clip(index.astype(_gather_index_dtype()), 0, data.shape[axis] - 1)
     out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis % data.ndim), axis=axis)
     if not keepdims:
         out = jnp.squeeze(out, axis=axis)
@@ -530,7 +540,7 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
 def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
     """reference: src/operator/tensor/indexing_op.cc (Embedding). Gather rows
     of `weight`; grad of weight is a scatter-add which XLA emits natively."""
-    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
+    return jnp.take(weight, data.astype(_gather_index_dtype()), axis=0, mode="clip")
 
 
 @register("one_hot")
@@ -543,20 +553,20 @@ def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
 
 @register("gather_nd")
 def gather_nd(data, indices):
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(indices.astype(_gather_index_dtype()))
     return data[idx]
 
 
 @register("scatter_nd")
 def scatter_nd(data, indices, shape=()):
     out = jnp.zeros(shape, dtype=data.dtype)
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(indices.astype(_gather_index_dtype()))
     return out.at[idx].set(data)
 
 
 @register("_scatter_set_nd")
 def scatter_set_nd(lhs, rhs, indices, shape=()):
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(indices.astype(_gather_index_dtype()))
     return lhs.at[idx].set(rhs)
 
 

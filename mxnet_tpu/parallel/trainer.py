@@ -200,8 +200,12 @@ class DistributedTrainer:
             for nd_, arr in zip(self._param_nds, param_arrays):
                 nd_._data = arr
             call_args = [NDArray(a, ctx=ctx) for a in batch_arrays]
-            with autograd._scope(recording=False, training=is_train):
-                out = self._block(*call_args)
+            # enter the params' ctx: ops that create fresh arrays mid-forward
+            # (arange position ids, masks) must land on the same ctx or
+            # sub-blocks fed by them request params on the ambient default
+            with ctx:
+                with autograd._scope(recording=False, training=is_train):
+                    out = self._block(*call_args)
             aux_updates = {}
             for i in self._aux:
                 if self._param_nds[i]._data is not param_arrays[i]:

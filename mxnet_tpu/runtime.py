@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import collections
 
-__all__ = ["Feature", "Features", "feature_list"]
+__all__ = ["Feature", "Features", "feature_list",
+           "PEAK_BF16_TFLOPS", "chip_peak_tflops"]
 
 Feature = collections.namedtuple("Feature", ["name", "enabled"])
 
@@ -89,3 +90,35 @@ class Features(collections.OrderedDict):
 def feature_list():
     """List of Feature tuples (reference: runtime.py:95 feature_list)."""
     return list(Features().values())
+
+
+# ---------------------------------------------------------------------------
+# chip peak FLOPs table (shared by bench.py and tools/mfu_probe*.py)
+# ---------------------------------------------------------------------------
+
+# Peak dense-matmul TFLOPS per chip, bf16 (fp32 runs the MXU in multi-pass
+# mode at roughly 1/8 of bf16 peak on v4+; callers report fp32 MFU against
+# the bf16 peak so numbers stay conservative and comparable).
+PEAK_BF16_TFLOPS = {
+    "TPU v2": 46.0,
+    "TPU v3": 123.0,
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,     # v5e
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,          # v5p
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,     # Trillium / v6e
+    "TPU v6e": 918.0,
+    "TPU7x": 4600.0,
+}
+
+
+def chip_peak_tflops(device):
+    """Peak bf16 TFLOP/s for a jax device, or None if unknown."""
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    # longest table key first so "TPU v5 lite" wins over "TPU v5"
+    for name, peak in sorted(PEAK_BF16_TFLOPS.items(),
+                             key=lambda kv: -len(kv[0])):
+        if kind.startswith(name.lower()):
+            return peak
+    return None

@@ -90,6 +90,21 @@ def test_large_2d_row_take():
         del a
 
 
+def test_take_with_large_index_array():
+    """take() with an index *array* holding a position past int32-max: the
+    gather index dtype must widen under large-tensor mode (a hard int32
+    cast wraps negative and clip-mode silently returns element 0)."""
+    a = mx.nd.zeros((LARGE,), dtype="int8")
+    try:
+        hi = INT32_MAX + 6
+        a[hi] = 5
+        idx = a.argmax(axis=0)  # float64 holding `hi` exactly
+        got = mx.nd.take(a, idx)
+        assert int(got.asscalar()) == 5
+    finally:
+        del a
+
+
 def test_int64_histogram_no_truncation_warning(recwarn):
     """Histogram (the op VERDICT r2 flagged for silent int64 truncation)
     emits int32 counts by documented policy — and must do so silently, not

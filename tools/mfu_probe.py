@@ -17,7 +17,7 @@ import time
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from bench import _chip_peak_tflops
+from mxnet_tpu.runtime import chip_peak_tflops as _chip_peak_tflops
 
 import numpy as np
 
