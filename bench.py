@@ -76,22 +76,23 @@ def _percentiles(ms):
     }
 
 
-def _build(ctx):
+def _build(ctx, factory="resnet50_v1", hw=224):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo import vision
 
     batch = BATCH
+    fac = getattr(vision, factory)
     with ctx:
         if LAYOUT == "NHWC":
             # channels-last build (MXU-preferred): layout_scope flips the
             # default conv/pool layout + BN axis for the whole zoo model
             with gluon.nn.layout_scope():
-                net = vision.resnet50_v1()
-            xshape = (batch, 224, 224, 3)
+                net = fac()
+            xshape = (batch, hw, hw, 3)
         else:
-            net = vision.resnet50_v1()
-            xshape = (batch, 3, 224, 224)
+            net = fac()
+            xshape = (batch, 3, hw, hw)
         net.initialize(ctx=ctx)
         rng = np.random.RandomState(0)
         # data lives on-device: a real input pipeline double-buffers batches
@@ -210,15 +211,32 @@ def _sweep_segment(out, dev, flops_per_img, run):
         out["sweep_error"] = str(e)[:200]
 
 
+# Scoring nets beyond the headline ResNet-50, mirroring the reference's
+# benchmark_score.py model list where BASELINE.md has V100 rows
+# (docs/faq/perf.md:176,190). (factory, input hw, fwd FLOPs/img,
+# fp32 V100 imgs/sec, fp16 V100 imgs/sec or None).
+_SCORE_NETS = {
+    "resnet50": ("resnet50_v1", 224, RESNET50_FWD_FLOPS_PER_IMG,
+                 BASELINE_SCORE_FP32, BASELINE_SCORE_FP16),
+    "resnet152": ("resnet152_v1", 224, 2 * 11.3e9, 451.82, 887.34),
+    "inception_v3": ("inception_v3", 299, 2 * 5.73e9, 814.59, None),
+}
+
+
 def bench_score():
-    """Inference scoring mode (reference benchmark_score.py analogue)."""
+    """Inference scoring mode (reference benchmark_score.py analogue).
+    MXTPU_BENCH_NET picks the model (resnet50 default / resnet152 /
+    inception_v3 — the BASELINE.md V100 scoring rows)."""
     import jax
     import jax.numpy as jnp
 
     import mxnet_tpu as mx
 
+    net_key = os.environ.get("MXTPU_BENCH_NET", "resnet50")
+    factory, hw, flops_per_img, base_fp32, base_fp16 = _SCORE_NETS[net_key]
+
     ctx = mx.tpu()
-    net, x, _ = _build(ctx)
+    net, x, _ = _build(ctx, factory=factory, hw=hw)
     dev = jax.devices()[0]
 
     dtype = jnp.bfloat16 if AMP_DTYPE else jnp.float32
@@ -250,33 +268,36 @@ def bench_score():
 
     imgs_per_sec = timed_score(xb, BATCH)
 
-    base = BASELINE_SCORE_FP16 if AMP_DTYPE else BASELINE_SCORE_FP32
+    # bf16 runs compare against the fp16 V100 row when the reference
+    # published one; otherwise against fp32 with the dtype recorded
+    if AMP_DTYPE and base_fp16 is not None:
+        base, base_dtype = base_fp16, "float16"
+    else:
+        base, base_dtype = base_fp32, "float32"
     peak = _chip_peak_tflops(dev)
-    mfu = (imgs_per_sec * RESNET50_FWD_FLOPS_PER_IMG / (peak * 1e12)) \
-        if peak else None
+    mfu = (imgs_per_sec * flops_per_img / (peak * 1e12)) if peak else None
     out = {
-        "metric": "resnet50_score_bs32_imgs_per_sec",
+        "metric": "%s_score_bs%d_imgs_per_sec" % (net_key, BATCH),
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec",
         "vs_baseline": round(imgs_per_sec / base, 3),
         "dtype": str(jnp.dtype(dtype)),
-        "baseline": {"value": base,
-                     "dtype": "float16" if AMP_DTYPE else "float32",
-                     "hw": "V100"},
+        "baseline": {"value": base, "dtype": base_dtype, "hw": "V100"},
         "batch": BATCH,
         "device": getattr(dev, "device_kind", str(dev)),
-        "flops_per_img": RESNET50_FWD_FLOPS_PER_IMG,
+        "flops_per_img": flops_per_img,
         "peak_bf16_tflops": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,
     }
     def run_score_sweep(sweep_batch):
         rng = np.random.RandomState(1)
-        xl = jnp.asarray(rng.uniform(
-            -1, 1, (sweep_batch, 3, 224, 224)).astype(np.float32)
-            ).astype(dtype)
+        shape = (sweep_batch, hw, hw, 3) if LAYOUT == "NHWC" \
+            else (sweep_batch, 3, hw, hw)
+        xl = jnp.asarray(rng.uniform(-1, 1, shape).astype(np.float32)
+                         ).astype(dtype)
         return timed_score(xl, sweep_batch)
 
-    _sweep_segment(out, dev, RESNET50_FWD_FLOPS_PER_IMG, run_score_sweep)
+    _sweep_segment(out, dev, flops_per_img, run_score_sweep)
     print(json.dumps(out))
 
 
@@ -490,7 +511,9 @@ def _device_watchdog(timeout_s=None):
             err.append(str(e))
         done.set()
 
-    metric = {"score": "resnet50_score_bs32_imgs_per_sec",
+    score_metric = "%s_score_bs%d_imgs_per_sec" % (
+        os.environ.get("MXTPU_BENCH_NET", "resnet50"), BATCH)
+    metric = {"score": score_metric,
               "bert": "bert_base_train_tokens_per_sec",
               "lstm": "lstm_word_lm_train_tokens_per_sec"}.get(
                   MODE, "resnet50_train_bs32_imgs_per_sec")

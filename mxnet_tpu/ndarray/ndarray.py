@@ -575,7 +575,14 @@ def invoke(op_name, inputs, attrs, out=None):
 
     # the ProfileOperator hook (reference: graph_executor.cc:1309 wraps each
     # pushed op when profiling is enabled)
-    with _x64_if_large(*(a.shape for a in in_arrays if hasattr(a, "shape"))):
+    # a `shape` attr can also demand large-tensor mode (scatter_nd / init
+    # ops whose *output* exceeds int32-max while every input is small)
+    attr_shape = attrs.get("shape", ())
+    if not (isinstance(attr_shape, (tuple, list))
+            and all(isinstance(d, int) for d in attr_shape)):
+        attr_shape = ()
+    with _x64_if_large(attr_shape,
+                       *(a.shape for a in in_arrays if hasattr(a, "shape"))):
         results = _profiler.timed_call(op_name, _ops.invoke_jax,
                                        (op_name, call_arrays, attrs))
     multi = isinstance(results, (tuple, list))

@@ -60,6 +60,63 @@ def test_bf16_conv_bn_dense_train_step():
     assert losses[-1] < losses[0], losses
 
 
+def test_bf16_nhwc_train_step_matches_nchw():
+    """Channels-last on the MXU: the same tiny conv net trained one step in
+    NCHW and NHWC (layout_scope) from identical weights must produce the
+    same loss — validates the NHWC lowering on real hardware, not just the
+    CPU-interpreter equivalence tests (tests/test_layout.py)."""
+    import jax
+
+    from mxnet_tpu.parallel import DistributedTrainer, make_mesh
+
+    rng = np.random.RandomState(0)
+    xs = rng.uniform(-1, 1, (16, 32, 32, 3)).astype(np.float32)  # NHWC
+    ys = rng.randint(0, 8, (16,)).astype(np.float32)
+
+    def build(channels_last):
+        with gluon.nn.layout_scope(channels_last):
+            net = gluon.nn.HybridSequential()
+            net.add(gluon.nn.Conv2D(16, 3, padding=1, use_bias=False),
+                    gluon.nn.BatchNorm(), gluon.nn.Activation("relu"),
+                    gluon.nn.MaxPool2D(2, 2),
+                    gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+                    gluon.nn.Dense(8))
+        ctx = mx.tpu()
+        with ctx:
+            net.initialize(mx.init.Xavier())
+            data = xs if channels_last else np.transpose(xs, (0, 3, 1, 2))
+            x = mx.nd.array(data, ctx=ctx)
+            y = mx.nd.array(ys, ctx=ctx)
+            net(x)
+        return net, x, y, ctx
+
+    net_cf, x_cf, y_cf, _ = build(False)
+    net_cl, x_cl, y_cl, _ = build(True)
+    # same weights: conv (O,I,kH,kW) -> (O,kH,kW,I), rest 1:1
+    for (_, v1), (_, v2) in zip(sorted(net_cf.collect_params().items()),
+                                sorted(net_cl.collect_params().items())):
+        a = v1.data().asnumpy()
+        if a.ndim == 4:
+            a = np.transpose(a, (0, 2, 3, 1))
+        v2.set_data(mx.nd.array(a))
+
+    import jax as _jax
+
+    losses = {}
+    for tag, (net, x, y) in {"nchw": (net_cf, x_cf, y_cf),
+                             "nhwc": (net_cl, x_cl, y_cl)}.items():
+        mesh = make_mesh([("dp", 1)], devices=[_jax.devices()[0]])
+        trainer = DistributedTrainer(
+            net, "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+            loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh,
+            amp_dtype="bfloat16")
+        losses[tag] = [float(trainer.step(x, y).asnumpy()) for _ in range(4)]
+    assert all(np.isfinite(losses["nhwc"])), losses
+    # bf16 rounding differs across layouts; losses must track closely
+    np.testing.assert_allclose(losses["nhwc"], losses["nchw"],
+                               rtol=0.05, atol=0.05)
+
+
 def test_flash_attention_real_lowering_fwd_bwd():
     """Pallas kernels in the real Mosaic lowering (not interpret): fwd and
     both backward kernels vs the XLA reference, f32 + bf16 + causal."""

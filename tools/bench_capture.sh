@@ -30,13 +30,23 @@ while true; do
 done
 echo "[bench_capture] device up: $KIND" >&2
 
-for MODE in train score bert lstm; do
-  OUT="BENCH_${TAG}_${MODE}.json"
-  echo "[bench_capture] running mode=$MODE -> $OUT" >&2
-  MXTPU_BENCH_MODE=$MODE MXTPU_BENCH_DIAL_RETRY_S=300 \
-    timeout 1800 python bench.py > "$OUT" 2> "BENCH_${TAG}_${MODE}.log"
-  echo "[bench_capture] $MODE rc=$? $(cat "$OUT" 2>/dev/null | head -c 300)" >&2
-done
+run_one() {  # run_one <suffix> [extra ENV=VAL ...]
+  local SUFFIX="$1"; shift
+  local OUT="BENCH_${TAG}_${SUFFIX}.json"
+  echo "[bench_capture] running $SUFFIX -> $OUT" >&2
+  env "$@" MXTPU_BENCH_DIAL_RETRY_S=300 \
+    timeout 1800 python bench.py > "$OUT" 2> "BENCH_${TAG}_${SUFFIX}.log"
+  echo "[bench_capture] $SUFFIX rc=$? $(cat "$OUT" 2>/dev/null | head -c 300)" >&2
+}
+
+run_one train           MXTPU_BENCH_MODE=train
+run_one train_nhwc      MXTPU_BENCH_MODE=train MXTPU_BENCH_LAYOUT=NHWC
+run_one score           MXTPU_BENCH_MODE=score
+run_one score_nhwc      MXTPU_BENCH_MODE=score MXTPU_BENCH_LAYOUT=NHWC
+run_one score_resnet152 MXTPU_BENCH_MODE=score MXTPU_BENCH_NET=resnet152
+run_one score_inception MXTPU_BENCH_MODE=score MXTPU_BENCH_NET=inception_v3
+run_one bert            MXTPU_BENCH_MODE=bert
+run_one lstm            MXTPU_BENCH_MODE=lstm
 
 echo "[bench_capture] running tpu smoke suite" >&2
 MXTPU_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_smoke.py -v \
