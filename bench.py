@@ -50,6 +50,9 @@ BATCH = int(os.environ.get("MXTPU_BENCH_BATCH", 32))
 WARMUP = int(os.environ.get("MXTPU_BENCH_WARMUP", 5))
 ITERS = int(os.environ.get("MXTPU_BENCH_ITERS", 20))
 MODE = os.environ.get("MXTPU_BENCH_MODE", "train")
+# model under test for train/score modes (validated against the mode's
+# net table in main() so a typo still yields a diagnosable JSON line)
+NET = os.environ.get("MXTPU_BENCH_NET", "resnet50")
 # NCHW (reference layout, default) or NHWC (MXU-preferred channels-last)
 LAYOUT = os.environ.get("MXTPU_BENCH_LAYOUT", "NCHW").upper()
 # bf16 compute + fp32 master weights is the TPU-native training precision
@@ -104,6 +107,17 @@ def _build(ctx, factory="resnet50_v1", hw=224):
     return net, x, label
 
 
+# Training nets beyond the headline ResNet-50, mirroring the reference's
+# train_imagenet.py rows in BASELINE.md (docs/faq/perf.md:233-236).
+# (factory, input hw, train FLOPs/img, V100 fp32 imgs/sec, ref batch).
+_TRAIN_NETS = {
+    "resnet50": ("resnet50_v1", 224, RESNET50_TRAIN_FLOPS_PER_IMG,
+                 BASELINE_TRAIN, 32),
+    "inception_v3": ("inception_v3", 299, 3 * 11.46e9, 253.68, 128),
+    "alexnet": ("alexnet", 224, 3 * 1.43e9, 2994.32, 256),
+}
+
+
 def bench_train():
     import jax
 
@@ -111,8 +125,11 @@ def bench_train():
     from mxnet_tpu import gluon
     from mxnet_tpu.parallel import DistributedTrainer, make_mesh
 
+    net_key = NET
+    factory, hw, flops_per_img, base, base_batch = _TRAIN_NETS[net_key]
+
     ctx = mx.tpu()  # resolves to the accelerator; falls back to cpu devices
-    net, x, label = _build(ctx)
+    net, x, label = _build(ctx, factory=factory, hw=hw)
     dev = jax.devices()[0]
 
     mesh = make_mesh([("dp", 1)], devices=[dev])
@@ -142,18 +159,17 @@ def bench_train():
         trainer.step(x, label).asnumpy()
         step_ms.append((time.perf_counter() - t1) * 1e3)
 
-    flops_per_img = RESNET50_TRAIN_FLOPS_PER_IMG
     peak = _chip_peak_tflops(dev)
     mfu = (imgs_per_sec * flops_per_img / (peak * 1e12)) if peak else None
 
     out = {
-        "metric": "resnet50_train_bs32_imgs_per_sec",
+        "metric": "%s_train_bs%d_imgs_per_sec" % (net_key, BATCH),
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec",
-        "vs_baseline": round(imgs_per_sec / BASELINE_TRAIN, 3),
+        "vs_baseline": round(imgs_per_sec / base, 3),
         "dtype": AMP_DTYPE or "float32",
-        "baseline": {"value": BASELINE_TRAIN, "dtype": "float32",
-                     "hw": "V100"},
+        "baseline": {"value": base, "dtype": "float32",
+                     "hw": "V100", "batch": base_batch},
         "batch": BATCH,
         "device": getattr(dev, "device_kind", str(dev)),
         "flops_per_img": flops_per_img,
@@ -163,19 +179,19 @@ def bench_train():
     out.update(_percentiles(step_ms))
 
     _sweep_segment(out, dev, flops_per_img,
-                   lambda sb: timed_train(*_sweep_batch_arrays(ctx, sb), sb))
+                   lambda sb: timed_train(*_sweep_batch_arrays(ctx, sb, hw), sb))
     print(json.dumps(out))
 
 
-def _sweep_batch_arrays(ctx, sweep_batch):
+def _sweep_batch_arrays(ctx, sweep_batch, hw=224):
     """Fresh on-device (data, label) arrays at the sweep batch size."""
     import numpy as _np
 
     import mxnet_tpu as mx
 
     rng = _np.random.RandomState(1)
-    shape = (sweep_batch, 224, 224, 3) if LAYOUT == "NHWC" \
-        else (sweep_batch, 3, 224, 224)
+    shape = (sweep_batch, hw, hw, 3) if LAYOUT == "NHWC" \
+        else (sweep_batch, 3, hw, hw)
     with ctx:
         xl = mx.nd.array(rng.uniform(-1, 1, shape).astype(_np.float32), ctx=ctx)
         yl = mx.nd.array(rng.randint(
@@ -232,7 +248,7 @@ def bench_score():
 
     import mxnet_tpu as mx
 
-    net_key = os.environ.get("MXTPU_BENCH_NET", "resnet50")
+    net_key = NET
     factory, hw, flops_per_img, base_fp32, base_fp16 = _SCORE_NETS[net_key]
 
     ctx = mx.tpu()
@@ -511,12 +527,10 @@ def _device_watchdog(timeout_s=None):
             err.append(str(e))
         done.set()
 
-    score_metric = "%s_score_bs%d_imgs_per_sec" % (
-        os.environ.get("MXTPU_BENCH_NET", "resnet50"), BATCH)
-    metric = {"score": score_metric,
+    metric = {"score": "%s_score_bs%d_imgs_per_sec" % (NET, BATCH),
               "bert": "bert_base_train_tokens_per_sec",
               "lstm": "lstm_word_lm_train_tokens_per_sec"}.get(
-                  MODE, "resnet50_train_bs32_imgs_per_sec")
+                  MODE, "%s_train_bs%d_imgs_per_sec" % (NET, BATCH))
     t = threading.Thread(target=probe, daemon=True)
     t.start()
     waited = 0
@@ -553,6 +567,16 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    # validate the net/mode pair up front so a typo still emits the
+    # one-JSON-line contract instead of a bare KeyError in the .log
+    tables = {"train": _TRAIN_NETS, "score": _SCORE_NETS}
+    if MODE in tables and NET not in tables[MODE]:
+        print(json.dumps({
+            "metric": "%s_%s_bs%d_imgs_per_sec" % (NET, MODE, BATCH),
+            "value": None, "unit": "imgs/sec", "vs_baseline": None,
+            "error": "unknown MXTPU_BENCH_NET %r for mode %r; valid: %s"
+                     % (NET, MODE, sorted(tables[MODE]))}))
+        raise SystemExit(1)
     _device_watchdog()
     if MODE == "score":
         bench_score()

@@ -579,7 +579,7 @@ def invoke(op_name, inputs, attrs, out=None):
     # ops whose *output* exceeds int32-max while every input is small)
     attr_shape = attrs.get("shape", ())
     if not (isinstance(attr_shape, (tuple, list))
-            and all(isinstance(d, int) for d in attr_shape)):
+            and all(isinstance(d, (int, _np.integer)) for d in attr_shape)):
         attr_shape = ()
     with _x64_if_large(attr_shape,
                        *(a.shape for a in in_arrays if hasattr(a, "shape"))):
