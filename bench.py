@@ -330,7 +330,7 @@ def bench_score_int8():
 
     import mxnet_tpu as mx
     from mxnet_tpu.contrib import quantization as q
-    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu.io import NDArrayIter
     from mxnet_tpu.model import load_checkpoint
     from mxnet_tpu.predict import Predictor
 
@@ -349,31 +349,12 @@ def bench_score_int8():
 
         xnp = np.asarray(x.asnumpy(), dtype=np.float32)
 
-        class _CalibIter:
-            def __init__(self):
-                self.provide_data = [DataDesc("data", xnp.shape, np.float32)]
-                self.provide_label = []
-                self._i = 0
-
-            def __iter__(self):
-                self._i = 0
-                return self
-
-            def __next__(self):
-                if self._i >= 2:
-                    raise StopIteration
-                self._i += 1
-                return DataBatch(data=[mx.nd.array(xnp)])
-
-            def reset(self):
-                self._i = 0
-
         # weights stay fp32 in the param dict (quantization is folded
         # in-graph), so the exported param file binds to the quantized
         # symbol unchanged
         qsym, _, _ = q.quantize_model(
             sym, arg_params, aux_params, calib_mode="naive",
-            calib_data=_CalibIter())
+            calib_data=NDArrayIter(xnp, batch_size=xnp.shape[0]))
         pred = Predictor(qsym, prefix + "-0000.params", ctx=ctx,
                          input_shapes={"data": tuple(xnp.shape)})
 

@@ -1,4 +1,7 @@
 """Contrib subsystems (reference: python/mxnet/contrib/*)."""
+from . import autograd  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import symbol  # noqa: F401
 from . import quantization  # noqa: F401
 from . import text  # noqa: F401
 from . import tensorboard  # noqa: F401

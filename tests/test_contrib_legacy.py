@@ -1,0 +1,65 @@
+"""Legacy contrib namespaces (reference: python/mxnet/contrib/{autograd,
+ndarray,symbol}.py — deprecated-era APIs old scripts still import)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import autograd as cag
+from mxnet_tpu.contrib import ndarray as cnd
+from mxnet_tpu.contrib import symbol as csym
+
+
+def test_contrib_op_namespace_aliases():
+    assert cnd.MultiBoxPrior is mx.nd.contrib.MultiBoxPrior
+    assert csym.MultiBoxPrior is mx.sym.contrib.MultiBoxPrior
+    assert "MultiBoxPrior" in dir(cnd)
+
+
+def test_grad_and_loss_and_grad():
+    def f(a, b):
+        return a * b + a
+
+    a = mx.nd.array(np.array([2.0, 3.0], np.float32))
+    b = mx.nd.array(np.array([4.0, 5.0], np.float32))
+    grads, loss = cag.grad_and_loss(f)(a, b)
+    np.testing.assert_allclose(grads[0].asnumpy(), b.asnumpy() + 1)
+    np.testing.assert_allclose(grads[1].asnumpy(), a.asnumpy())
+    np.testing.assert_allclose(loss.asnumpy(),
+                               a.asnumpy() * b.asnumpy() + a.asnumpy())
+    # argnum selects a subset
+    ga, = cag.grad(f, argnum=0)(a, b)
+    np.testing.assert_allclose(ga.asnumpy(), b.asnumpy() + 1)
+
+
+def test_train_test_sections():
+    with cag.train_section():
+        assert mx.autograd.is_training()
+        assert mx.autograd.is_recording()
+        with cag.test_section():
+            assert not mx.autograd.is_recording()
+        assert mx.autograd.is_recording()
+    assert not mx.autograd.is_recording()
+
+
+def test_scope_restores_diverged_flags():
+    """The legacy scope must restore recording and training independently:
+    inside modern train_mode() (training=True, recording=False), a
+    train_section round trip must not flip training off."""
+    with mx.autograd.train_mode():
+        assert mx.autograd.is_training() and not mx.autograd.is_recording()
+        with cag.train_section():
+            pass
+        assert mx.autograd.is_training()
+        assert not mx.autograd.is_recording()
+
+
+def test_mark_variables_and_compute_gradient():
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    g = mx.nd.zeros((2,))
+    cag.mark_variables([x], [g])
+    prev = cag.set_is_training(True)
+    try:
+        y = (x * x).sum()
+    finally:
+        cag.set_is_training(prev)
+    cag.compute_gradient([y])
+    np.testing.assert_allclose(g.asnumpy(), 2 * x.asnumpy())
