@@ -14,9 +14,15 @@ __all__ = ["set_is_training", "TrainingStateScope", "train_section",
 
 def set_is_training(is_train):
     """reference: contrib/autograd.py:32 — returns the previous state.
-    The legacy flag conflated recording with train mode; both follow."""
-    prev = _ag.set_recording(is_train)
-    _ag.set_training(is_train)
+    The legacy flag conflated recording with train mode; here both flags
+    follow, and the returned value is a restore token capturing them as a
+    pair (the legacy `set_is_training(prev)` idiom must not collapse a
+    diverged train_mode()/pause() scope onto one flag)."""
+    if isinstance(is_train, tuple):
+        rec, train = is_train
+    else:
+        rec = train = bool(is_train)
+    prev = (_ag.set_recording(rec), _ag.set_training(train))
     return prev
 
 

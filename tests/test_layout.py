@@ -180,6 +180,70 @@ def test_ssd_rejects_channels_last_scope():
             vision.ssd_test_tiny(num_classes=3)
 
 
+_CONV_GRID = [
+    # (kernel, stride, dilate, pad, groups)
+    ((1, 1), (1, 1), (1, 1), (0, 0), 1),
+    ((3, 3), (1, 1), (1, 1), (1, 1), 1),
+    ((3, 3), (2, 2), (1, 1), (1, 1), 1),
+    ((3, 3), (1, 1), (2, 2), (2, 2), 1),
+    ((5, 3), (2, 1), (1, 1), (2, 1), 1),
+    ((3, 3), (1, 1), (1, 1), (1, 1), 2),
+    ((3, 3), (2, 2), (1, 1), (0, 0), 4),
+    ((7, 7), (2, 2), (1, 1), (3, 3), 1),
+]
+
+
+def test_conv_grid_nhwc_matches_nchw():
+    """Cross-layout consistency sweep (the layout analogue of the
+    reference's cross-ctx check_consistency): every conv config computes
+    identical fwd values in NHWC and NCHW."""
+    rng = np.random.RandomState(7)
+    for kernel, stride, dilate, pad, groups in _CONV_GRID:
+        cin, cout, hw = 4 * groups, 8, 12
+        x = rng.randn(2, hw, hw, cin).astype(np.float32)
+        w = rng.randn(cout, cin // groups, *kernel).astype(np.float32)
+        b = rng.randn(cout).astype(np.float32)
+        o1 = mx.nd.Convolution(
+            mx.nd.array(np.transpose(x, NCHW_OF_NHWC)), mx.nd.array(w),
+            mx.nd.array(b), kernel=kernel, stride=stride, dilate=dilate,
+            pad=pad, num_filter=cout, num_group=groups).asnumpy()
+        o2 = mx.nd.Convolution(
+            mx.nd.array(x), mx.nd.array(np.transpose(w, (0, 2, 3, 1))),
+            mx.nd.array(b), kernel=kernel, stride=stride, dilate=dilate,
+            pad=pad, num_filter=cout, num_group=groups,
+            layout="NHWC").asnumpy()
+        np.testing.assert_allclose(
+            np.transpose(o2, NCHW_OF_NHWC), o1, rtol=1e-4, atol=1e-4,
+            err_msg="conv k=%s s=%s d=%s p=%s g=%d" % (kernel, stride,
+                                                       dilate, pad, groups))
+
+
+_POOL_GRID = [
+    # (pool_type, kernel, stride, pad, convention, count_include_pad)
+    ("max", (2, 2), (2, 2), (0, 0), "valid", True),
+    ("max", (3, 3), (2, 2), (1, 1), "full", True),
+    ("avg", (3, 3), (1, 1), (1, 1), "valid", True),
+    ("avg", (3, 3), (2, 2), (1, 1), "valid", False),
+    ("sum", (2, 2), (2, 2), (0, 0), "valid", True),
+    ("lp", (2, 2), (2, 2), (0, 0), "valid", True),
+]
+
+
+def test_pool_grid_nhwc_matches_nchw():
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 11, 13, 3).astype(np.float32)
+    xc = np.transpose(x, NCHW_OF_NHWC)
+    for ptype, kernel, stride, pad, conv_, cip in _POOL_GRID:
+        kw = dict(kernel=kernel, pool_type=ptype, stride=stride, pad=pad,
+                  pooling_convention=conv_, count_include_pad=cip, p_value=2)
+        o1 = mx.nd.Pooling(mx.nd.array(xc), **kw).asnumpy()
+        o2 = mx.nd.Pooling(mx.nd.array(x), layout="NHWC", **kw).asnumpy()
+        np.testing.assert_allclose(
+            np.transpose(o2, NCHW_OF_NHWC), o1, rtol=1e-5, atol=1e-5,
+            err_msg="pool %s k=%s s=%s p=%s %s cip=%s" % (
+                ptype, kernel, stride, pad, conv_, cip))
+
+
 def test_batchnorm_channels_last_axis():
     x, xc = _data()
     b1 = nn.BatchNorm(axis=1, in_channels=3)

@@ -1,0 +1,88 @@
+"""Developer-tool smoke tests (reference: tools/parse_log.py,
+tools/diagnose.py)."""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_log_markdown(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+
+    lines = [
+        "INFO:root:Epoch[0] Train-accuracy=0.412000\n",
+        "INFO:root:Epoch[0] Time cost=12.340\n",
+        "INFO:root:Epoch[0] Validation-accuracy=0.520000\n",
+        "INFO:root:Epoch[1] Train-accuracy=0.683000\n",
+        "INFO:root:Epoch[1] Validation-accuracy=0.707000\n",
+        "unrelated line\n",
+    ]
+    data = parse_log.parse(lines, ["accuracy"])
+    assert data[0] == {"train-accuracy": 0.412, "time": 12.34,
+                       "val-accuracy": 0.52}
+    assert data[1]["val-accuracy"] == 0.707
+    md = parse_log.to_markdown(data, ["accuracy"])
+    assert md.splitlines()[0].startswith("| epoch |")
+    assert "0.683" in md
+    # epoch 1 has no time entry -> empty cell, not a crash
+    assert md.splitlines()[-1].endswith("|  |")
+
+
+def test_parse_log_matches_fit_output(tmp_path):
+    """The parser consumes the exact lines module.fit() logs
+    (base_module.py:187-204)."""
+    import logging
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    log = tmp_path / "fit.log"
+    handler = logging.FileHandler(str(log))
+    logger = logging.getLogger("parse_log_fit_test")
+    logger.setLevel(logging.INFO)
+    logger.addHandler(handler)
+    try:
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 8).astype(np.float32)
+        Y = (X.sum(axis=1) > 0).astype(np.float32)
+        data = mx.io.NDArrayIter(X, Y, batch_size=16)
+        val = mx.io.NDArrayIter(X, Y, batch_size=16)
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2)
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, logger=logger)
+        mod.fit(data, eval_data=val, num_epoch=2,
+                optimizer_params={"learning_rate": 0.1})
+    finally:
+        handler.close()
+        logger.removeHandler(handler)
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    data = parse_log.parse(log.read_text().splitlines(), ["accuracy"])
+    assert 0 in data and 1 in data
+    assert "train-accuracy" in data[0]
+    assert "val-accuracy" in data[0]
+    assert "time" in data[0]
+
+
+def test_diagnose_runs_and_reports(monkeypatch):
+    """diagnose.py must terminate and report each section even when the
+    accelerator dial hangs (probes run in subprocesses under timeouts)."""
+    env = dict(os.environ, MXTPU_DIAG_TIMEOUT_S="10", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "diagnose.py")],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert out.returncode == 0, out.stderr[-500:]
+    for section in ("Python Info", "System Info", "Dependencies",
+                    "mxnet_tpu", "Accelerator"):
+        assert section in out.stdout
+    assert "import       : ok" in out.stdout

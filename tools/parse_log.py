@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown table (reference: tools/parse_log.py
+— same CLI and the same `Epoch[N] Train-<metric>=V` / `Validation-<metric>=V`
+/ `Time cost=T` line format that module.fit()/model.fit() emit here,
+mxnet_tpu/module/base_module.py:187-204)."""
+from __future__ import annotations
+
+import argparse
+import re
+
+
+def parse(lines, metric_names):
+    """Returns {epoch: {column: value}} for train/val metrics + time."""
+    pats = []
+    for s in metric_names:
+        pats.append(("train-" + s,
+                     re.compile(r".*Epoch\[(\d+)\] Train-" + re.escape(s)
+                                + r".*=([-+.eE\d]+)")))
+        pats.append(("val-" + s,
+                     re.compile(r".*Epoch\[(\d+)\] Validation-" + re.escape(s)
+                                + r".*=([-+.eE\d]+)")))
+    pats.append(("time", re.compile(r".*Epoch\[(\d+)\] Time.*=([-+.eE\d]+)")))
+
+    data = {}
+    for line in lines:
+        for col, pat in pats:
+            m = pat.match(line)
+            if m is not None:
+                try:
+                    epoch, val = int(m.group(1)), float(m.group(2))
+                except ValueError:
+                    continue  # malformed numeric (e.g. bare sign)
+                data.setdefault(epoch, {})[col] = val
+                break
+    return data
+
+
+def to_markdown(data, metric_names):
+    cols = []
+    for s in metric_names:
+        cols += ["train-" + s, "val-" + s]
+    cols.append("time")
+    out = ["| epoch | " + " | ".join(cols) + " |",
+           "| --- |" + " --- |" * len(cols)]
+    for epoch in sorted(data):
+        row = data[epoch]
+        out.append("| %d | %s |" % (
+            epoch, " | ".join("%.6g" % row[c] if c in row else ""
+                              for c in cols)))
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Parse training output log")
+    ap.add_argument("logfile", nargs=1, type=str)
+    ap.add_argument("--format", type=str, default="markdown",
+                    choices=["markdown", "none"])
+    ap.add_argument("--metric-names", type=str, nargs="+",
+                    default=["accuracy"])
+    args = ap.parse_args()
+    with open(args.logfile[0]) as f:
+        data = parse(f.readlines(), args.metric_names)
+    if args.format == "markdown":
+        print(to_markdown(data, args.metric_names))
+
+
+if __name__ == "__main__":
+    main()
