@@ -400,8 +400,19 @@ class ImageIter(DataIter):
             import os
 
             idx = os.path.splitext(path_imgrec)[0] + ".idx"
-            self.record = recordio.MXIndexedRecordIO(idx, path_imgrec, "r")
-            self.seq = list(self.record.keys)
+            if os.path.exists(idx):
+                self.record = recordio.MXIndexedRecordIO(idx, path_imgrec, "r")
+                self.seq = list(self.record.keys)
+            else:
+                # no index file: sequential read (reference image.py ImageIter
+                # uses plain MXRecordIO with seq=None when path_imgidx is not
+                # given; shuffle needs random access, hence the index)
+                if shuffle:
+                    raise MXNetError(
+                        "ImageIter: shuffle requires an index file (%s) — "
+                        "build one with tools/rec2idx.py" % idx)
+                self.record = recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
         elif path_imglist is not None or imglist is not None:
             items = []
             if path_imglist is not None:
@@ -420,7 +431,7 @@ class ImageIter(DataIter):
             raise MXNetError("ImageIter needs path_imgrec, path_imglist or imglist")
         self.shuffle = shuffle
         self.cur = 0
-        if shuffle:
+        if shuffle and self.seq is not None:
             _np.random.shuffle(self.seq)
 
     @property
@@ -435,10 +446,20 @@ class ImageIter(DataIter):
 
     def reset(self):
         self.cur = 0
-        if self.shuffle:
+        if self.seq is None:
+            self.record.reset()  # sequential mode: rewind the stream
+        elif self.shuffle:
             _np.random.shuffle(self.seq)
 
     def next_sample(self):
+        if self.seq is None:  # sequential (un-indexed) record stream
+            from . import recordio
+
+            s = self.record.read()
+            if s is None:
+                raise StopIteration
+            header, buf = recordio.unpack(s)
+            return header.label, imdecode(buf)
         if self.cur >= len(self.seq):
             raise StopIteration
         idx = self.seq[self.cur]
@@ -511,26 +532,40 @@ class ImageRecordIterPy(ImageIter):
 
     def _start(self):
         self._queue = queue.Queue(maxsize=self._buffer)
+        self._stop = threading.Event()
+        stop, q = self._stop, self._queue
 
         def run():
             try:
-                while True:
-                    self._queue.put(ImageIter.next(self))
+                while not stop.is_set():
+                    item = ImageIter.next(self)
+                    while not stop.is_set():
+                        try:  # bounded put that honors the stop signal
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
             except StopIteration:
-                self._queue.put(None)
-            except Exception as e:
-                self._queue.put(e)
+                q.put(None)
+            except Exception as e:  # surfaced to the consumer in next()
+                q.put(e)
 
         self._worker = threading.Thread(target=run, daemon=True)
         self._worker.start()
 
     def reset(self):
         if self._worker is not None:
+            # stop + join the producer BEFORE touching reader state: a live
+            # worker races super().reset()'s stream rewind (sequential mode
+            # closes/reopens the file) and would feed stale samples into
+            # the next epoch
+            self._stop.set()
             try:
                 while True:
                     self._queue.get_nowait()
             except queue.Empty:
                 pass
+            self._worker.join(timeout=30)
         super().reset()
         self._worker = None
 
@@ -859,6 +894,13 @@ class ImageDetIter(ImageIter):
         """Yield raw label vectors WITHOUT decoding images (the reference's
         label scan reads only recordio headers — decoding a whole COCO-scale
         .rec at construction would take minutes)."""
+        if self.seq is None:
+            # sequential (un-indexed) record: the label scan needs random
+            # access to rewind after it — require the index up front rather
+            # than silently mis-scanning
+            raise MXNetError(
+                "ImageDetIter needs an indexed .rec (an .idx beside it) for "
+                "its label-shape scan — build one with tools/rec2idx.py")
         if self.record is not None:
             from . import recordio
 

@@ -17,6 +17,10 @@ FULL = bool(os.environ.get("MXTPU_TEST_EXAMPLES_FULL"))
 EXAMPLES = [
     ("image_classification/train_mnist.py",
      ["--epochs", "1", "--limit", "512"], []),
+    ("image_classification/train_imagenet.py",
+     ["--network", "resnet18_v1", "--batch-size", "4", "--num-batches", "4",
+      "--num-classes", "10", "--image-shape", "3,32,32", "--layout", "NHWC"],
+     []),
     ("rnn/word_lm.py",
      ["--epochs", "1", "--vocab", "80", "--limit-batches", "8"], []),
     ("rnn/lstm_bucketing.py",

@@ -282,3 +282,38 @@ def test_get_registry_does_not_poison(monkeypatch):
 
     reg(_ProbeMetric, "probe_metric_xyz")
     assert "probe_metric_xyz" in registry.get_registry(metric.EvalMetric)
+
+
+def test_image_record_iter_unindexed_sequential(tmp_path):
+    """A .rec without its .idx must stream sequentially (reference
+    image.py ImageIter: plain MXRecordIO, seq=None) — it previously opened
+    an empty index and silently yielded zero batches; shuffle requires the
+    index and must say so."""
+    import io as _io
+
+    import pytest
+    from PIL import Image
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "plain.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        img = Image.fromarray(rng.randint(0, 255, (20, 20, 3), np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG")
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              buf.getvalue()))
+    w.close()
+
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 20, 20),
+                               batch_size=3)
+    assert sum(1 for _ in it) == 2
+    it.reset()
+    assert sum(1 for _ in it) == 2  # reset rewinds the stream
+
+    with pytest.raises(Exception, match="index"):
+        mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 20, 20),
+                              batch_size=3, shuffle=True)
