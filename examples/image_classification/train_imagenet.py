@@ -134,23 +134,19 @@ def main():
             if nhwc:
                 # device-side relayout; no host round trip
                 xb = mx.nd.transpose(xb, (0, 2, 3, 1))
-            yield xb, b.label[0]
+            yield xb, b.label[0], b.pad or 0
 
     def _evaluate(epoch):
         trainer.sync_params()  # copy mesh-trained values into the block
         metric.reset()
-        for xb, yb in _rec_batches(args.data_val, shuffle=False):
+        for xb, yb, pad in _rec_batches(args.data_val, shuffle=False):
             with mx.autograd.predict_mode():
                 out = net(xb.as_in_context(ctx))
-            metric.update([yb.as_in_context(ctx)], [out])
-        for name, val in zip(*_metric_get(metric)):
+            keep = xb.shape[0] - pad  # last batch pads by cycling samples;
+            metric.update([yb[:keep].as_in_context(ctx)],  # don't score dups
+                          [out[:keep]])
+        for name, val in metric.get_name_value():
             logging.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-
-    def _metric_get(m):
-        names, vals = m.get()
-        if not isinstance(names, list):
-            names, vals = [names], [vals]
-        return names, vals
 
     rng = np.random.RandomState(0)
     for epoch in range(args.num_epochs):
@@ -159,17 +155,19 @@ def main():
         if args.data_train:
             batches = _rec_batches(args.data_train, shuffle=True)
         else:
-            batches = ((mx.nd.array(x, ctx=ctx), mx.nd.array(y, ctx=ctx))
+            batches = ((mx.nd.array(x, ctx=ctx), mx.nd.array(y, ctx=ctx), 0)
                        for x, y in _synthetic_batches(args, shape, rng))
 
         tic = time.time()
         win_tic, win_n = time.time(), 0   # Speedometer-style window: the
         n = 0                             # first-batch compile cost only
-        for i, (xb, yb) in enumerate(batches):  # hits the first interval
+        for i, (xb, yb, pad) in enumerate(batches):  # hits first interval
+            # the padded tail still trains at the static batch shape
+            # (reference behavior); only the sample accounting excludes it
             loss = trainer.step(xb.as_in_context(ctx),
                                 yb.astype("float32").as_in_context(ctx))
-            n += xb.shape[0]
-            win_n += xb.shape[0]
+            n += xb.shape[0] - pad
+            win_n += xb.shape[0] - pad
             if (i + 1) % args.disp_batches == 0:
                 logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
                              "\tloss=%.4f", epoch, i + 1,

@@ -566,6 +566,12 @@ class ImageRecordIterPy(ImageIter):
             except queue.Empty:
                 pass
             self._worker.join(timeout=30)
+            if self._worker.is_alive():
+                # proceeding would rewind the stream under a live reader
+                # (sequential mode closes/reopens the file) — fail loudly
+                raise MXNetError(
+                    "ImageRecordIter.reset: prefetch worker did not stop "
+                    "within 30s (stalled read?); cannot safely rewind")
         super().reset()
         self._worker = None
 

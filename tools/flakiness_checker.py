@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Run a test many times with varying seeds to detect flakiness
+(reference: tools/flakiness_checker.py over nose; here over pytest).
+
+Usage:
+    python tools/flakiness_checker.py tests/test_operator.py::test_dropout \\
+        [--num-trials 50] [--seed N]
+
+One pytest process per trial so every trial gets a DISTINCT seed
+(pytest dedupes repeated node ids, and in-process repeats would share the
+env seed). Exit code is non-zero on the first failing trial; the failing
+seed is printed for replay via MXNET_TEST_SEED.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description="pytest flakiness checker")
+    ap.add_argument("test", help="pytest node id, e.g. tests/test_x.py::test_y")
+    ap.add_argument("--num-trials", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="fixed seed (default: a fresh seed per trial)")
+    args = ap.parse_args()
+
+    rng = random.Random()
+    for trial in range(1, args.num_trials + 1):
+        seed = args.seed if args.seed is not None else rng.randrange(2**31)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MXTPU_TEST_SEED=str(seed),
+                   MXNET_TEST_SEED=str(seed))
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", args.test],
+            env=env, capture_output=True, text=True)
+        if res.returncode != 0:
+            print(res.stdout[-2000:])
+            print("FLAKY: trial %d/%d failed (MXNET_TEST_SEED=%d)"
+                  % (trial, args.num_trials, seed))
+            return 1
+        print("trial %d/%d ok (seed %d)" % (trial, args.num_trials, seed))
+    print("stable: %d trials passed" % args.num_trials)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
