@@ -94,3 +94,13 @@ class _Registry:
 
     def keys(self):
         return list(self._map)
+
+def device_int_dtype():
+    """The documented int64 policy (README "int64") in one place: device
+    index/shape integers are int32 (XLA-native) under the default config,
+    int64 when large-tensor mode has scoped x64 live
+    (ndarray._x64_if_large)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32

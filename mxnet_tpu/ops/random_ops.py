@@ -12,7 +12,7 @@ from . import register
 import jax
 import jax.numpy as jnp
 
-from ..base import np_dtype
+from ..base import np_dtype, device_int_dtype as _device_int_dtype
 
 
 @register("_random_uniform", needs_rng=True, aliases=("uniform", "random_uniform"))
@@ -76,7 +76,7 @@ def sample_unique_zipfian(rng, range_max=1, shape=()):
         logp = jnp.log(jnp.log((classes + 2.0) / (classes + 1.0)))
         g = jax.random.gumbel(rng, (rows, range_max))
         _, idx = jax.lax.top_k(logp[None, :] + g, k)
-        return idx.reshape(shape).astype(jnp.int64)
+        return idx.reshape(shape).astype(_device_int_dtype())
     # Huge vocab (sampled-softmax scale, k << range_max): materializing
     # (rows, range_max) would be GBs. Oversample m = 4k+32 i.i.d. zipfian
     # draws via the inverse CDF, deduplicate per row (uniques compacted
@@ -87,14 +87,14 @@ def sample_unique_zipfian(rng, range_max=1, shape=()):
     # the reference's unbounded draw-until-unique loop).
     m = 4 * k + 32
     u = jax.random.uniform(rng, (rows, m))
-    draws = (jnp.exp(u * jnp.log(float(range_max + 1))) - 1.0).astype(jnp.int64)
+    draws = (jnp.exp(u * jnp.log(float(range_max + 1))) - 1.0).astype(_device_int_dtype())
     draws = jnp.clip(draws, 0, range_max - 1)
     s = jnp.sort(draws, axis=1)
     dup = jnp.concatenate(
         [jnp.zeros((rows, 1), bool), s[:, 1:] == s[:, :-1]], axis=1)
     order = jnp.argsort(dup, axis=1, stable=True)
     return jnp.take_along_axis(s, order, axis=1)[:, :k] \
-        .reshape(shape).astype(jnp.int64)
+        .reshape(shape).astype(_device_int_dtype())
 
 
 @register("_sample_multinomial", needs_rng=True, aliases=("sample_multinomial", "multinomial"))

@@ -508,15 +508,9 @@ def broadcast_axis(data, axis=(), size=()):
 # indexing (reference: indexing_op.cc)
 # --------------------------------------------------------------------------
 
-def _gather_index_dtype():
-    """Device index dtype for gather/scatter positions: int32 (XLA-native)
-    under the default config, int64 inside large-tensor mode (dim >
-    int32-max runs under scoped x64 — see ndarray._x64_if_large); a hard
-    int32 cast there would wrap positions past 2^31 negative and clip-mode
-    would silently return element 0."""
-    import jax as _jax
-
-    return jnp.int64 if _jax.config.jax_enable_x64 else jnp.int32
+from ..base import device_int_dtype as _gather_index_dtype  # gather/scatter
+# positions wrap negative past 2^31 under a hard int32 cast; the shared
+# helper widens them exactly when large-tensor mode has x64 live
 
 @register("take")
 def take(a, indices, axis=0, mode="clip"):
@@ -632,12 +626,13 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
 
 @register("shape_array")
 def shape_array(data):
-    return jnp.asarray(data.shape, dtype=jnp.int64)
+    # reference emits int64; see base.device_int_dtype for the policy
+    return jnp.asarray(data.shape, dtype=_gather_index_dtype())
 
 
 @register("size_array")
 def size_array(data):
-    return jnp.asarray([data.size], dtype=jnp.int64)
+    return jnp.asarray([data.size], dtype=_gather_index_dtype())
 
 
 @register("zeros_like")
