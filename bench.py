@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -151,6 +152,22 @@ def bench_train():
         return batch * ITERS / (time.perf_counter() - t0)
 
     imgs_per_sec = timed_train(x, label, BATCH)
+
+    if os.environ.get("MXTPU_BENCH_PROFILE"):
+        # capture an XLA (xplane) trace of a few steady-state steps next to
+        # the JSON artifact — the evidence docs/perf_notes.md's MFU gap
+        # analysis is built from
+        from mxnet_tpu import profiler as _prof
+
+        trace_dir = os.environ.get("MXTPU_BENCH_PROFILE_DIR",
+                                   "bench_trace_%s" % MODE)
+        _prof.start_xla_trace(trace_dir)
+        for _ in range(3):
+            trainer.step(x, label)
+        trainer.step(x, label).asnumpy()
+        _prof.stop_xla_trace()
+        # stderr: stdout carries exactly ONE JSON line (driver contract)
+        print("xla trace captured in %s" % trace_dir, file=sys.stderr)
 
     # step-time distribution: each step synced
     step_ms = []

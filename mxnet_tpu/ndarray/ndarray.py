@@ -39,15 +39,24 @@ _INT32_MAX = 2**31 - 1
 
 def _x64_if_large(*shapes):
     """Large-tensor mode (reference: int64 TShape arithmetic exercised by
-    tests/nightly/test_large_array.py). A dimension past int32-max makes
-    JAX's default-int32 index arithmetic truncate silently, so ops touching
-    such arrays run under a scoped x64 config: gather/scatter positions and
-    index-valued outputs (argmax/argsort/...) become int64, exactly where
-    int64 is semantically required. Everywhere else the documented
-    x64-off policy (README "int64") stands."""
+    tests/nightly/test_large_array.py). A dimension OR total element count
+    past int32-max makes JAX's default-int32 index arithmetic truncate
+    silently (flat positions — argmax(axis=None), size_array — overflow
+    even when every dim is small), so ops touching such arrays run under a
+    scoped x64 config: gather/scatter positions and index-valued outputs
+    become int64, exactly where int64 is semantically required. Everywhere
+    else the documented x64-off policy (README "int64") stands."""
     import contextlib
 
-    if any(d > _INT32_MAX for shape in shapes for d in shape):
+    for shape in shapes:
+        total = 1
+        for d in shape:
+            if d > _INT32_MAX:
+                break
+            total *= d
+        else:
+            if total <= _INT32_MAX:
+                continue
         import jax
 
         return jax.enable_x64(True)
@@ -575,13 +584,16 @@ def invoke(op_name, inputs, attrs, out=None):
 
     # the ProfileOperator hook (reference: graph_executor.cc:1309 wraps each
     # pushed op when profiling is enabled)
-    # a `shape` attr can also demand large-tensor mode (scatter_nd / init
-    # ops whose *output* exceeds int32-max while every input is small)
+    # numeric attrs can also demand large-tensor mode: a `shape` whose
+    # output exceeds int32-max (scatter_nd / init ops) or a scalar bound
+    # like `range_max` (sample ops over huge vocabularies)
     attr_shape = attrs.get("shape", ())
     if not (isinstance(attr_shape, (tuple, list))
             and all(isinstance(d, (int, _np.integer)) for d in attr_shape)):
         attr_shape = ()
-    with _x64_if_large(attr_shape,
+    bounds = tuple((int(attrs[k]),) for k in ("range_max",)
+                   if isinstance(attrs.get(k), (int, _np.integer)))
+    with _x64_if_large(attr_shape, *bounds,
                        *(a.shape for a in in_arrays if hasattr(a, "shape"))):
         results = _profiler.timed_call(op_name, _ops.invoke_jax,
                                        (op_name, call_arrays, attrs))

@@ -299,12 +299,11 @@ def norm(data, ord=2, axis=None, keepdims=False):
 
 def _arg_index_dtype():
     """Reference argmax/argmin emit float32 positions. float32 is exact only
-    to 2^24; in large-tensor mode (dim > int32-max runs under scoped x64 —
-    see ndarray._x64_if_large) positions can exceed that, so widen to
-    float64 exactly when x64 is live."""
-    import jax
+    to 2^24; in large-tensor mode positions can exceed that, so widen to
+    float64 exactly when the shared policy says device ints are int64."""
+    from ..base import device_int_dtype
 
-    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    return jnp.float64 if device_int_dtype() == jnp.int64 else jnp.float32
 
 
 @register("argmax")
