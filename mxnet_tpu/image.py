@@ -901,12 +901,21 @@ class ImageDetIter(ImageIter):
         label scan reads only recordio headers — decoding a whole COCO-scale
         .rec at construction would take minutes)."""
         if self.seq is None:
-            # sequential (un-indexed) record: the label scan needs random
-            # access to rewind after it — require the index up front rather
-            # than silently mis-scanning
-            raise MXNetError(
-                "ImageDetIter needs an indexed .rec (an .idx beside it) for "
-                "its label-shape scan — build one with tools/rec2idx.py")
+            # sequential (un-indexed) record: stream the headers once, then
+            # rewind so iteration starts from record 0 (finally: the rewind
+            # must happen even if a consumer stops early)
+            from . import recordio
+
+            try:
+                while True:
+                    s = self.record.read()
+                    if s is None:
+                        break
+                    header, _ = recordio.unpack(s)
+                    yield header.label
+            finally:
+                self.record.reset()
+            return
         if self.record is not None:
             from . import recordio
 

@@ -47,19 +47,14 @@ def _x64_if_large(*shapes):
     become int64, exactly where int64 is semantically required. Everywhere
     else the documented x64-off policy (README "int64") stands."""
     import contextlib
+    import math
 
     for shape in shapes:
-        total = 1
-        for d in shape:
-            if d > _INT32_MAX:
-                break
-            total *= d
-        else:
-            if total <= _INT32_MAX:
-                continue
-        import jax
+        if any(d > _INT32_MAX for d in shape) \
+                or math.prod(shape) > _INT32_MAX:
+            import jax
 
-        return jax.enable_x64(True)
+            return jax.enable_x64(True)
     return contextlib.nullcontext()
 
 __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",

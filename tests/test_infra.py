@@ -317,3 +317,32 @@ def test_image_record_iter_unindexed_sequential(tmp_path):
     with pytest.raises(Exception, match="index"):
         mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 20, 20),
                               batch_size=3, shuffle=True)
+
+
+def test_image_det_iter_unindexed_sequential(tmp_path):
+    """ImageDetIter over an un-indexed .rec: the label-shape scan streams
+    the headers and rewinds, then batches iterate from record 0."""
+    import io as _io
+
+    from PIL import Image
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "det.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        img = Image.fromarray(rng.randint(0, 255, (40, 40, 3), np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG")
+        label = np.array([2, 5, 0, 0.1, 0.1, 0.6, 0.6], np.float32)
+        w.write(recordio.pack(recordio.IRHeader(0, label, i, 0),
+                              buf.getvalue()))
+    w.close()
+
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                               path_imgrec=rec)
+    assert sum(1 for _ in it) == 3
+    it.reset()
+    assert sum(1 for _ in it) == 3
