@@ -42,3 +42,38 @@ def test_benchmark_harness(script, args):
     for ln in lines:
         rec = json.loads(ln)
         assert "error" not in rec, rec
+
+
+BENCH_MODES = [
+    ("train", {"MXTPU_BENCH_NET": "alexnet"}),
+    ("score", {}),
+    ("score_int8", {}),
+    ("bert", {"MXTPU_BENCH_SEQLEN": "64"}),
+    ("lstm", {}),
+]
+
+
+@pytest.mark.parametrize("mode,extra", BENCH_MODES,
+                         ids=[m for m, _ in BENCH_MODES])
+def test_bench_json_contract(mode, extra):
+    """bench.py must print exactly ONE JSON line on stdout with the
+    driver's required fields, in every mode (the artifact contract).
+    Only the fastest mode runs by default; the rest are FULL-gated."""
+    if mode != "train" and not os.environ.get("MXTPU_TEST_EXAMPLES_FULL"):
+        pytest.skip("slow mode — set MXTPU_TEST_EXAMPLES_FULL=1")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               MXTPU_BENCH_MODE=mode, MXTPU_BENCH_BATCH="2",
+               MXTPU_BENCH_WARMUP="1", MXTPU_BENCH_ITERS="1",
+               MXTPU_BENCH_NET="resnet50",  # pin: ambient env must not leak
+               MXTPU_BENCH_LAYOUT="NCHW")
+    env.update(extra)
+    res = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         env=env, capture_output=True, text=True, timeout=900)
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, "stdout must be ONE JSON line, got %r" % lines
+    out = json.loads(lines[0])
+    for field in ("metric", "value", "unit", "vs_baseline"):
+        assert field in out, field
+    assert out["value"] is None or out["value"] > 0
