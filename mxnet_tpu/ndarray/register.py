@@ -65,7 +65,13 @@ def _make_function(opdef):
         return result
 
     generated.__name__ = opdef.name
-    generated.__doc__ = (fn.__doc__ or "") + "\n\n(auto-generated from op '%s')" % opdef.name
+    # `params` already has the internal rng arg stripped (invoke injects the
+    # key); show the signature callers actually use, plus the wrapper extras
+    sig_str = "(%s)" % ", ".join(
+        [str(p) for p in params] + ["out=None", "name=None"]) \
+        if params else "(...)"
+    generated.__doc__ = "%s%s\n\n%s\n(auto-generated from op '%s')" % (
+        opdef.name, sig_str, (fn.__doc__ or "").strip(), opdef.name)
     return generated
 
 

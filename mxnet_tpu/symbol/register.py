@@ -168,8 +168,14 @@ def _make_symbol_function(opdef):
         return Symbol([(node, i) for i in range(max(1, nvis))])
 
     generated.__name__ = opdef.name
-    generated.__doc__ = (fn.__doc__ or "") + \
-        "\n\n(symbol function auto-generated from op '%s')" % opdef.name
+    # `params` already has the internal rng arg stripped (the key is
+    # injected at execution); show the caller-facing signature
+    sig_str = "(%s)" % ", ".join(
+        [str(p) for p in params] + ["name=None", "attr=None"]) \
+        if params else "(...)"
+    generated.__doc__ = "%s%s\n\n%s\n(symbol function auto-generated " \
+        "from op '%s')" % (opdef.name, sig_str,
+                           (opdef.fn.__doc__ or "").strip(), opdef.name)
     return generated
 
 
