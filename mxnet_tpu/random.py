@@ -39,6 +39,7 @@ def seed(seed_state, ctx="all"):
     st = _get()
     st.key = jax.random.PRNGKey(int(seed_state))
     st.seed_val = int(seed_state)
+    st.staged_ctr = 0
 
 
 def current_seed():
@@ -60,7 +61,29 @@ def next_key():
         key = jax.random.fold_in(trace[0], trace[1])
         trace[1] += 1
         return key
-    st.key, sub = jax.random.split(st.key)
+    new_key, sub = jax.random.split(st.key)
+    if isinstance(new_key, jax.core.Tracer):
+        # An eager op is being traced by an OUTER jit with no trace key
+        # pushed (e.g. a user jits an eager forward containing Dropout):
+        # under omnistaging the split is staged, and persisting its tracer
+        # result into the global chain poisons every later trace with a
+        # leaked-tracer error. Keep the chain's concrete position and
+        # derive in-trace keys by folding a local counter instead (still
+        # distinct per draw within the trace, reproducible under seed()).
+        ctr = getattr(st, "staged_ctr", 0)
+        st.staged_ctr = ctr + 1
+        if not getattr(st, "staged_warned", False):
+            st.staged_warned = True
+            import logging
+
+            logging.getLogger("mxnet_tpu").warning(
+                "random op traced under an outer jax.jit without a trace "
+                "key: the drawn key is baked into the executable as a "
+                "constant, so every call of the jitted function reuses the "
+                "same randomness. Use CachedOp/hybridize (which feeds the "
+                "key as a runtime input) for fresh draws per call.")
+        return jax.random.fold_in(st.key, ctr)
+    st.key = new_key
     return sub
 
 

@@ -77,3 +77,45 @@ def test_bench_json_contract(mode, extra):
     for field in ("metric", "value", "unit", "vs_baseline"):
         assert field in out, field
     assert out["value"] is None or out["value"] > 0
+
+
+def test_bench_train_mfu_segments():
+    """Train mode must be self-diagnosing: with segments forced on (they
+    are TPU-gated by default), the JSON carries the fwd / fwd+bwd /
+    matmul-ceiling decomposition fields next to the headline MFU."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               MXTPU_BENCH_MODE="train", MXTPU_BENCH_NET="alexnet",
+               MXTPU_BENCH_BATCH="2", MXTPU_BENCH_WARMUP="1",
+               MXTPU_BENCH_ITERS="1", MXTPU_BENCH_LAYOUT="NCHW",
+               MXTPU_BENCH_SEGMENTS="force", MXTPU_BENCH_SEG_MM_N="128")
+    res = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         env=env, capture_output=True, text=True, timeout=900)
+    assert res.returncode == 0, res.stderr[-2000:]
+    out = json.loads(res.stdout.strip())
+    assert "seg_error" not in out, out["seg_error"]
+    for field in ("seg_matmul_tflops", "seg_fwd_ms", "seg_fwd_dgrad_ms"):
+        assert out.get(field, 0) > 0, (field, out)
+
+
+def test_bench_unreachable_device_reports_stale_capture():
+    """When the accelerator dial fails, the one-JSON-line contract must
+    still carry real numbers: the newest committed BENCH_local_* capture,
+    stale-labelled with its source git SHA (the never-empty scoreboard)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               MXTPU_BENCH_MODE="train", MXTPU_BENCH_NET="resnet50",
+               MXTPU_BENCH_BATCH="32", MXTPU_BENCH_FORCE_DIAL_FAIL="1")
+    res = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         env=env, capture_output=True, text=True, timeout=120)
+    assert res.returncode != 0  # the failure is still a failure
+    lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    assert "error" in out
+    # the repo carries committed r03 train captures, so the fallback must
+    # have found one and surfaced its measured number
+    assert out["value"] and out["value"] > 0
+    assert out["stale"] is True
+    assert out["stale_source"].startswith("BENCH_local_")
+    assert len(out["stale_git_sha"]) == 40

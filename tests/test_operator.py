@@ -1312,6 +1312,36 @@ def test_seeded_rng_reproducible():
     _seeded_rng_reproducible()
 
 
+def test_rng_chain_survives_outer_jit():
+    """Tracing an eager rng-consuming op under an OUTER jax.jit (e.g.
+    jitting a model forward that contains Dropout) must not persist staged
+    tracers into the global key chain — regression: the poisoned chain made
+    every later trace fail with a leaked-tracer error."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import random as _rnd
+    from mxnet_tpu.ndarray import NDArray
+
+    mx.random.seed(7)
+
+    def f(x):
+        # inference-mode Dropout: identity output, but the invoke layer
+        # still draws a key for the rng-consuming opdef
+        return mx.nd.Dropout(NDArray(x), p=0.5)._data
+
+    xj = jnp.ones((4, 4), jnp.float32)
+    jax.jit(f)(xj)
+    assert not isinstance(_rnd._get().key, jax.core.Tracer)
+    jax.jit(lambda x: f(x) + 1.0)(xj)  # second trace used to raise
+    # the eager chain still works and stays reproducible
+    mx.random.seed(7)
+    a = mx.nd.random.uniform(shape=(3,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.nd.random.uniform(shape=(3,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
 def test_quantized_dense_roundtrip():
     _quantized_dense_roundtrip()
 

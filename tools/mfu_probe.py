@@ -1,25 +1,27 @@
 """On-chip MFU probe: locate where ResNet-50 train MFU is lost.
 
 Measures, on the real accelerator:
-  1. raw bf16 matmul ceiling (what the tunnel+chip can actually sustain)
-  2. ResNet-50 forward-only (pure bf16 inference jit) at a given batch
-  3. ResNet-50 fwd+bwd via jax.grad of the bf16 loss (no optimizer)
-  4. full DistributedTrainer step (fwd+bwd+SGD update, AMP master weights)
+  1. full DistributedTrainer step (fwd+bwd+SGD update, AMP master weights)
+  2. the segment decomposition shared with bench.py's train mode
+     (`bench._mfu_segments`): raw bf16 matmul ceiling, fwd-only, and
+     fwd + dgrad chain (grad w.r.t. input — ~2x fwd FLOPs, no wgrad)
 
 Prints one JSON line with achieved TFLOP/s and MFU vs the chip's bf16
 peak, so the gap analysis (docs/perf_notes.md) is grounded in measurements
-rather than guesses.
+rather than guesses. The segment harness lives in bench.py (one
+implementation — train bench artifacts and this probe must never compute
+segment MFU differently).
 """
 import json
 import os
+import sys
 import time
 
-import sys
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from mxnet_tpu.runtime import chip_peak_tflops as _chip_peak_tflops
+from bench import _mfu_segments  # noqa: E402 — shared segment harness
+from mxnet_tpu.runtime import chip_peak_tflops as _chip_peak_tflops  # noqa: E402
 
-import numpy as np
+import numpy as np  # noqa: E402
 
 BATCH = int(os.environ.get("MXTPU_PROBE_BATCH", 256))
 ITERS = int(os.environ.get("MXTPU_PROBE_ITERS", 10))
@@ -27,64 +29,19 @@ FWD_FLOPS = 8.178e9   # ResNet-50 224x224 fwd FLOPs/img (BASELINE.md)
 TRAIN_FLOPS = 3 * FWD_FLOPS
 
 
-def timed(fn, *args, n=ITERS):
-    fn(*args)  # compile
-    for _ in range(2):
-        fn(*args)
-    _block(fn(*args))
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(n):
-        out = fn(*args)
-    _block(out)
-    return (time.perf_counter() - t0) / n
-
-
-def _block(x):
-    # drain via host fetch: on the remote-PJRT tunnel block_until_ready can
-    # return before remote execution completes; device_get cannot
-    import jax
-    jax.device_get(jax.tree.leaves(x)[0] if not hasattr(x, "dtype") else x)
-
-
 def main():
     import jax
-    import jax.numpy as jnp
 
     dev = jax.devices()[0]
-    peak = _chip_peak_tflops(dev)  # bench.py maintains the per-chip table
+    peak = _chip_peak_tflops(dev)
     out = {"device": getattr(dev, "device_kind", str(dev)), "batch": BATCH,
            "peak_bf16_tflops": peak}
-
-    # ---- 1. raw matmul ceiling ------------------------------------------
-    # chain k dependent matmuls inside one jit so the device can't elide
-    # repeated identical dispatches (zeros-in/zeros-out with a constant
-    # operand measured 276x peak -> clearly shortcut somewhere); random
-    # data + a dependent chain forces real MXU work per iteration.
-    n = 8192
-    k = 8
-    key = jax.random.PRNGKey(0)
-    a = jax.random.normal(key, (n, n), jnp.float32).astype(jnp.bfloat16)
-    b = jax.random.normal(jax.random.PRNGKey(1), (n, n),
-                          jnp.float32).astype(jnp.bfloat16)
-
-    @jax.jit
-    def mm(p, q):
-        for _ in range(k):
-            p = (p @ q) * jnp.bfloat16(1e-4)  # rescale to avoid inf
-        return p
-
-    dt = timed(mm, a, b) / k
-    out["matmul_8192_tflops"] = round(2 * n ** 3 / dt / 1e12, 1)
-    if peak:
-        out["matmul_mfu"] = round(2 * n ** 3 / dt / 1e12 / peak, 4)
 
     # ---- build net + batch ----------------------------------------------
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.parallel import DistributedTrainer, make_mesh
-    from __graft_entry__ import _pure_forward
 
     ctx = mx.tpu()
     with ctx:
@@ -97,7 +54,7 @@ def main():
                         ctx=ctx)
         net(x)
 
-    # ---- 4. full trainer step (before cast: trainer owns AMP) -----------
+    # ---- 1. full trainer step (before segments: they cast the net) ------
     mesh = make_mesh([("dp", 1)], devices=[dev])
     trainer = DistributedTrainer(
         net, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
@@ -118,26 +75,8 @@ def main():
     if peak:
         out["train_mfu"] = round(tf / peak, 4)
 
-    # ---- 2. pure bf16 forward -------------------------------------------
-    net.cast("bfloat16")
-    fwd = _pure_forward(net, ctx)
-    jitted = jax.jit(fwd)
-    xb = x._data.astype(jnp.bfloat16)
-    dt_f = timed(jitted, xb)
-    tf_f = BATCH * FWD_FLOPS / dt_f / 1e12
-    out["fwd_ms"] = round(dt_f * 1e3, 2)
-    out["fwd_tflops"] = round(tf_f, 1)
-    if peak:
-        out["fwd_mfu"] = round(tf_f / peak, 4)
-
-    # ---- 3. fwd+bwd (grad of mean-logit-sum loss, pure bf16) ------------
-    grad_fn = jax.jit(jax.grad(lambda d: fwd(d).astype(jnp.float32).sum()))
-    dt_g = timed(grad_fn, xb)
-    tf_g = BATCH * TRAIN_FLOPS / dt_g / 1e12
-    out["fwdbwd_ms"] = round(dt_g * 1e3, 2)
-    out["fwdbwd_tflops"] = round(tf_g, 1)
-    if peak:
-        out["fwdbwd_mfu"] = round(tf_g / peak, 4)
+    # ---- 2. shared segment decomposition (matmul / fwd / fwd+dgrad) -----
+    _mfu_segments(out, dev, net, ctx, x, FWD_FLOPS, iters=ITERS)
 
     print(json.dumps(out))
 
