@@ -159,8 +159,23 @@ def _is_float(dt):
     return _np.issubdtype(_np.dtype(dt), _np.floating) or str(dt) == "bfloat16"
 
 
+def _x64_for_arrays(arrays, dtypes=()):
+    """Backward arm of the large-tensor policy: replaying a saved op with
+    x64 off canonicalizes saved 64-bit operands to 32 bits and re-resolves
+    device_int_dtype() to int32, so gradients through indexing at
+    positions past 2^31 silently land at the wrong element. Delegates to
+    the single policy authority (ndarray._x64_arming); `dtypes` lets
+    callers arm on 64-bit OUTPUT dtypes too (argmax-style nodes whose
+    zero cotangents must be built wide)."""
+    from .ndarray.ndarray import _x64_arming
+
+    return _x64_arming(arrays=arrays, dtypes=dtypes)
+
+
 @functools.lru_cache(maxsize=8192)
-def _bwd_jitted(name, attr_key, has_rng):
+def _bwd_jitted(name, attr_key, has_rng, x64=False):
+    # x64 joins the cache key only: the same (op, attrs) replayed in and
+    # out of large-tensor mode must not share a trace
     """Jitted per-(op, attrs) backward: recompute forward + vjp in one fused
     executable (the tape-recompute formulation; XLA DCEs what the pullback
     doesn't need)."""
@@ -196,9 +211,14 @@ def _run_backward(heads, head_grads, retain_graph=False):
     cot = {}
     for h, hg in zip(heads, head_grads):
         key = (h._uid, h._version)
-        seed = hg if hg is not None else jnp.ones(h.shape, h.dtype)
-        if hasattr(seed, "_data"):
-            seed = seed._data
+        if hg is not None:
+            seed = hg._data if hasattr(hg, "_data") else hg
+        else:
+            # a 64-bit head needs its ones-seed built under x64 or the
+            # seed silently narrows and the vjp rejects it
+            h_ctx, _ = _x64_for_arrays([h._data])
+            with h_ctx:
+                seed = jnp.ones(h.shape, h.dtype)
         cot[key] = cot[key] + seed if key in cot else seed
 
     touched = {}
@@ -214,29 +234,36 @@ def _run_backward(heads, head_grads, retain_graph=False):
                    and "data" in a else False
                    for a in node.in_arrays):
             continue
+        x64_ctx, x64 = _x64_for_arrays(node.in_arrays,
+                                       dtypes=node.out_dtypes)
         if node.py_backward is not None:
-            all_cots = []
-            for k, shp, dt in zip(node.out_keys, node.out_shapes, node.out_dtypes):
-                c = cot.get(k)
-                all_cots.append(c if c is not None else jnp.zeros(shp, dt))
-            grads = node.py_backward(all_cots)
+            with x64_ctx:
+                all_cots = []
+                for k, shp, dt in zip(node.out_keys, node.out_shapes,
+                                      node.out_dtypes):
+                    c = cot.get(k)
+                    all_cots.append(c if c is not None else jnp.zeros(shp, dt))
+                grads = node.py_backward(all_cots)
             grads = grads if isinstance(grads, (tuple, list)) else (grads,)
             in_cots = [g._data if hasattr(g, "_data") else g for g in grads]
         else:
-            float_cots = []
-            for k, shp, dt in zip(node.out_keys + [None] * (len(node.out_shapes) - len(node.out_keys)),
-                                  node.out_shapes, node.out_dtypes):
-                if not _is_float(dt):
-                    continue
-                c = cot.get(k) if k is not None else None
-                float_cots.append(c if c is not None else jnp.zeros(shp, dt))
-            fn = _bwd_jitted(node.opdef.name, node.attr_key, node.opdef.needs_rng)
             rng = node.rng
             if rng is None:
                 import jax
 
                 rng = jax.random.PRNGKey(0)
-            in_cots = fn(rng, node.in_arrays, tuple(float_cots))
+            fn = _bwd_jitted(node.opdef.name, node.attr_key,
+                             node.opdef.needs_rng, x64)
+            with x64_ctx:
+                float_cots = []
+                for k, shp, dt in zip(node.out_keys + [None] * (len(node.out_shapes) - len(node.out_keys)),
+                                      node.out_shapes, node.out_dtypes):
+                    if not _is_float(dt):
+                        continue
+                    c = cot.get(k) if k is not None else None
+                    float_cots.append(c if c is not None
+                                      else jnp.zeros(shp, dt))
+                in_cots = fn(rng, node.in_arrays, tuple(float_cots))
         for (arr, ver), c in zip(node.inputs, in_cots):
             if c is None or (hasattr(c, "dtype") and str(c.dtype) == "float0"):
                 continue
@@ -258,6 +285,10 @@ def _run_backward(heads, head_grads, retain_graph=False):
                 total = c if total is None else total + c
         if total is None:
             continue
+        from .ndarray.ndarray import _x64_if_wide
+
+        wide_ctx = _x64_if_wide(total, arr._grad._data
+                                if hasattr(arr._grad, "_data") else None)
         if getattr(arr, "_grad_stype", "default") == "row_sparse":
             # sparse grad buffer (attach_grad(stype='row_sparse')): cast the
             # dense tape gradient to row_sparse at write-back so sparse
@@ -265,13 +296,14 @@ def _run_backward(heads, head_grads, retain_graph=False):
             # for Parameter grad_stype)
             from .ndarray.ndarray import NDArray
 
-            dense = total.astype(arr._grad.dtype)
-            if arr._grad_req == "add":
-                prev = arr._grad
-                prev_dense = prev.tostype("default")._data \
-                    if getattr(prev, "stype", "default") != "default" \
-                    else prev._data
-                dense = dense + prev_dense
+            with wide_ctx:
+                dense = total.astype(arr._grad.dtype)
+                if arr._grad_req == "add":
+                    prev = arr._grad
+                    prev_dense = prev.tostype("default")._data \
+                        if getattr(prev, "stype", "default") != "default" \
+                        else prev._data
+                    dense = dense + prev_dense
             rsp = NDArray(dense, ctx=arr._ctx).tostype("row_sparse")
             g = arr._grad
             if getattr(g, "stype", "default") == "row_sparse":
@@ -283,9 +315,12 @@ def _run_backward(heads, head_grads, retain_graph=False):
             else:
                 arr._grad = rsp
         elif arr._grad_req == "add":
-            arr._grad._set_data(arr._grad._data + total.astype(arr._grad.dtype))
+            with wide_ctx:
+                arr._grad._set_data(arr._grad._data
+                                    + total.astype(arr._grad.dtype))
         else:
-            arr._grad._set_data(total.astype(arr._grad.dtype))
+            with wide_ctx:
+                arr._grad._set_data(total.astype(arr._grad.dtype))
         arr._fresh_grad = True
     # A cotangent that reached a key produced by a node consumed in an
     # EARLIER backward means this head shares a subgraph with an already-
@@ -430,7 +465,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         scalar_fn, leaf_arrays = _build_replay_scalar(heads, variables,
                                                       head_grads)
         op = _ReplayGradFn(scalar_fn, n_vars=len(variables))
-        outs = op(*variables, *leaf_arrays)
+        # replaying the tape re-traces every saved op: large-tensor
+        # operands need the same x64 arming the original forward had
+        x64_ctx, _ = _x64_for_arrays(
+            [getattr(a, "_data", a) for a in (*variables, *leaf_arrays)])
+        with x64_ctx:
+            outs = op(*variables, *leaf_arrays)
         return list(outs)
     retain = True if retain_graph is None else retain_graph
     cot = _run_backward(heads, head_grads, retain_graph=retain)

@@ -12,18 +12,29 @@ __all__ = ["set_is_training", "TrainingStateScope", "train_section",
            "grad_and_loss", "grad"]
 
 
+class _PrevState(tuple):
+    """Restore token returned by set_is_training. Truth-tests like the
+    reference's previous-bool return (legacy code branches on the result),
+    while carrying (recording, training) as a pair so the
+    `set_is_training(prev)` round-trip restores a diverged
+    train_mode()/pause() scope exactly."""
+
+    __slots__ = ()
+
+    def __bool__(self):
+        return bool(self[0] or self[1])
+
+
 def set_is_training(is_train):
     """reference: contrib/autograd.py:32 — returns the previous state.
     The legacy flag conflated recording with train mode; here both flags
-    follow, and the returned value is a restore token capturing them as a
-    pair (the legacy `set_is_training(prev)` idiom must not collapse a
-    diverged train_mode()/pause() scope onto one flag)."""
+    follow, and the returned value is a bool-compatible restore token
+    capturing them as a pair."""
     if isinstance(is_train, tuple):
         rec, train = is_train
     else:
         rec = train = bool(is_train)
-    prev = (_ag.set_recording(rec), _ag.set_training(train))
-    return prev
+    return _PrevState((_ag.set_recording(rec), _ag.set_training(train)))
 
 
 class TrainingStateScope:

@@ -37,25 +37,54 @@ _uid_counter = itertools.count(1)
 _INT32_MAX = 2**31 - 1
 
 
-def _x64_if_large(*shapes):
-    """Large-tensor mode (reference: int64 TShape arithmetic exercised by
-    tests/nightly/test_large_array.py). A dimension OR total element count
-    past int32-max makes JAX's default-int32 index arithmetic truncate
-    silently (flat positions — argmax(axis=None), size_array — overflow
-    even when every dim is small), so ops touching such arrays run under a
-    scoped x64 config: gather/scatter positions and index-valued outputs
-    become int64, exactly where int64 is semantically required. Everywhere
-    else the documented x64-off policy (README "int64") stands."""
+_WIDE_DTYPES = ("int64", "uint64", "float64")
+
+
+def _x64_arming(arrays=(), shapes=(), dtypes=()):
+    """Single authority for the large-tensor x64 policy (reference: int64
+    TShape arithmetic exercised by tests/nightly/test_large_array.py).
+
+    Arms when any shape has a dimension OR total element count past
+    int32-max (JAX's default-int32 index arithmetic truncates silently —
+    flat positions, size_array), or when any array/dtype is 64-bit-typed
+    (value-magnitude cases the shape heuristic can't see, e.g. float64
+    argmax indices). Inside the scope, gather/scatter positions and
+    index-valued outputs become int64, exactly where int64 is semantically
+    required; everywhere else the documented x64-off policy (README
+    "int64") stands. Returns (context_manager, armed) so the armed state
+    can join jit cache keys. Every x64 gate in the codebase must delegate
+    here — a diverged copy reintroduces silent 32-bit truncation."""
     import contextlib
     import math
 
-    for shape in shapes:
-        if any(d > _INT32_MAX for d in shape) \
-                or math.prod(shape) > _INT32_MAX:
-            import jax
+    shapes = list(shapes)
+    dts = [str(d) for d in dtypes]
+    for a in arrays:
+        if isinstance(a, dict):  # sparse component dict
+            a = a.get("data", a)
+        if hasattr(a, "shape"):
+            shapes.append(a.shape)
+        if hasattr(a, "dtype"):
+            dts.append(str(a.dtype))
+    armed = any(d in _WIDE_DTYPES for d in dts) or any(
+        any(dim > _INT32_MAX for dim in s) or math.prod(s) > _INT32_MAX
+        for s in shapes)
+    if armed:
+        import jax
 
-            return jax.enable_x64(True)
-    return contextlib.nullcontext()
+        return jax.enable_x64(True), True
+    return contextlib.nullcontext(), False
+
+
+def _x64_if_large(*shapes):
+    """Shape-triggered arm of the policy (see _x64_arming)."""
+    return _x64_arming(shapes=shapes)[0]
+
+
+def _x64_if_wide(*arrays):
+    """Dtype-triggered arm of the policy (see _x64_arming)."""
+    return _x64_arming(arrays=arrays)[0]
+
 
 __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
            "arange", "concat", "save", "load", "waitall", "from_jax"]
@@ -215,8 +244,11 @@ class NDArray:
                                        ctx=self._ctx,
                                        dtype=_np.dtype(self.dtype).name)
         else:
-            self._grad = NDArray(jnp.zeros(self.shape, self.dtype),
-                                 ctx=self._ctx)
+            # a 64-bit array's grad buffer must keep the wide dtype (the
+            # default config would silently truncate the zeros to 32-bit)
+            with _x64_if_wide(self._data):
+                self._grad = NDArray(jnp.zeros(self.shape, self.dtype),
+                                     ctx=self._ctx)
         self._grad_req = grad_req
         self._grad_stype = stype or "default"
 
@@ -580,14 +612,21 @@ def invoke(op_name, inputs, attrs, out=None):
     # the ProfileOperator hook (reference: graph_executor.cc:1309 wraps each
     # pushed op when profiling is enabled)
     # numeric attrs can also demand large-tensor mode: a `shape` whose
-    # output exceeds int32-max (scatter_nd / init ops) or a scalar bound
-    # like `range_max` (sample ops over huge vocabularies)
+    # output exceeds int32-max (scatter_nd / init ops), or any attr the
+    # opdef declares size-bearing (range_max, one_hot depth, Embedding
+    # input_dim, arange stop — OpDef.size_attrs) whose magnitude creates
+    # an index space past int32-max
     attr_shape = attrs.get("shape", ())
     if not (isinstance(attr_shape, (tuple, list))
             and all(isinstance(d, (int, _np.integer)) for d in attr_shape)):
         attr_shape = ()
-    bounds = tuple((int(attrs[k]),) for k in ("range_max",)
-                   if isinstance(attrs.get(k), (int, _np.integer)))
+    import math as _math
+
+    bounds = tuple((int(abs(attrs[k])),) for k in opdef.size_attrs
+                   if isinstance(attrs.get(k), (int, float, _np.integer,
+                                                _np.floating))
+                   and not isinstance(attrs.get(k), bool)
+                   and _math.isfinite(attrs[k]))
     with _x64_if_large(attr_shape, *bounds,
                        *(a.shape for a in in_arrays if hasattr(a, "shape"))):
         results = _profiler.timed_call(op_name, _ops.invoke_jax,

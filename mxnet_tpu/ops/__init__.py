@@ -36,6 +36,12 @@ class OpDef:
     num_outputs_fn: _t.Callable = None  # attrs -> output count, for variadic
                                         # ops whose arity depends on attrs
                                         # (e.g. Proposal output_score)
+    size_attrs: tuple = ()        # attrs whose integer MAGNITUDE creates an
+                                  # index space (range_max, one_hot depth,
+                                  # Embedding input_dim, arange stop): a
+                                  # value past int32-max arms large-tensor
+                                  # x64 mode in ndarray.invoke even when
+                                  # every input array is small
     host: bool = False            # host-side op: fn takes/returns
                                   # NDArray-level objects eagerly (never
                                   # jitted, not on the tape) — the analogue
@@ -51,12 +57,12 @@ _REGISTRY: dict = {}
 
 
 def register(name, num_outputs=1, needs_rng=False, num_visible_outputs=None,
-             aliases=(), num_outputs_fn=None, host=False):
+             aliases=(), num_outputs_fn=None, host=False, size_attrs=()):
     """Decorator registering a pure-jax op function under `name`."""
 
     def deco(fn):
         op = OpDef(name, fn, num_outputs, needs_rng, num_visible_outputs,
-                   tuple(aliases), num_outputs_fn, host)
+                   tuple(aliases), num_outputs_fn, tuple(size_attrs), host)
         _REGISTRY[name] = op
         for a in aliases:
             _REGISTRY[a] = op

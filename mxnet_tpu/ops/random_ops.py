@@ -59,7 +59,8 @@ def random_randint(rng, low=0, high=1, shape=(), dtype="int32"):
     return jax.random.randint(rng, shape, low, high, np_dtype(dtype))
 
 
-@register("_sample_unique_zipfian", needs_rng=True)
+@register("_sample_unique_zipfian", needs_rng=True,
+          size_attrs=("range_max",))
 def sample_unique_zipfian(rng, range_max=1, shape=()):
     """Unique draws per row from the zipfian (log-uniform) class
     distribution p(k) ∝ log((k+2)/(k+1)) — reference:

@@ -529,18 +529,18 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
     return out
 
 
-@register("Embedding")
+@register("Embedding", size_attrs=("input_dim",))
 def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
     """reference: src/operator/tensor/indexing_op.cc (Embedding). Gather rows
     of `weight`; grad of weight is a scatter-add which XLA emits natively."""
     return jnp.take(weight, data.astype(_gather_index_dtype()), axis=0, mode="clip")
 
 
-@register("one_hot")
+@register("one_hot", size_attrs=("depth",))
 def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
     from ..base import np_dtype
 
-    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    oh = jax.nn.one_hot(indices.astype(_gather_index_dtype()), depth)
     return (oh * (on_value - off_value) + off_value).astype(np_dtype(dtype))
 
 
@@ -665,7 +665,7 @@ def _full(shape=(), value=0.0, dtype="float32"):
     return jnp.full(shape, value, np_dtype(dtype))
 
 
-@register("_arange")
+@register("_arange", size_attrs=("start", "stop"))
 def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
     from ..base import np_dtype
 

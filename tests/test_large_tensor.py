@@ -10,6 +10,8 @@ default JAX config; host-side arithmetic stays Python-int exact) is
 documented in README "int64" and exercised in test_operator.py's
 histogram case.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -17,6 +19,13 @@ import mxnet_tpu as mx
 
 INT32_MAX = 2**31 - 1
 LARGE = 2**31 + 16  # just past the int32 boundary
+
+# The reference keeps its >2^31-element runs in tests/nightly; the default
+# CI path keeps only the allocation-free checks (a 4 GiB allocation can
+# OOM small runners). ADVICE r3.
+heavy = pytest.mark.skipif(
+    not os.environ.get("MXTPU_TEST_LARGE_FULL"),
+    reason="allocation-heavy (>2 GiB) — set MXTPU_TEST_LARGE_FULL=1")
 
 
 def test_shape_size_arithmetic_past_int32():
@@ -38,6 +47,7 @@ def test_shape_size_arithmetic_past_int32():
     assert oshape[0][0] * oshape[0][1] == 2**32
 
 
+@heavy
 def test_large_flat_array_static_indexing():
     """A real >2^31-element array: size, static (Python-int) indexing, and
     slicing near the far end — positions that truncate to negative if any
@@ -58,6 +68,7 @@ def test_large_flat_array_static_indexing():
         del a
 
 
+@heavy
 def test_large_reduce_and_argmax():
     """Whole-array reduce over >2^31 elements: the reduction *count* exceeds
     int32, and argmax's returned position is past the boundary."""
@@ -75,6 +86,7 @@ def test_large_reduce_and_argmax():
         del a
 
 
+@heavy
 def test_large_2d_row_take():
     """take() with a trailing big axis: row extraction where the row-start
     byte offsets exceed int32 (the classic large-array indexing overflow)."""
@@ -90,6 +102,7 @@ def test_large_2d_row_take():
         del a
 
 
+@heavy
 def test_take_with_large_index_array():
     """take() with an index *array* holding a position past int32-max: the
     gather index dtype must widen under large-tensor mode (a hard int32
@@ -105,6 +118,7 @@ def test_take_with_large_index_array():
         del a
 
 
+@heavy
 def test_scatter_nd_large_output_shape():
     """scatter_nd whose *output* shape exceeds int32-max while every input
     is small: the `shape` attr alone must trigger large-tensor mode, or the
@@ -128,6 +142,7 @@ def test_scatter_nd_large_output_shape():
         del out
 
 
+@heavy
 def test_size_array_total_size_past_int32():
     """Total element count past int32-max with every dim small: size_array
     (and flat index math generally) must widen — an int32 size wraps to 0."""
@@ -151,6 +166,63 @@ def test_sample_unique_zipfian_huge_range():
     assert (vals >= 0).all()
     assert vals.max() > 0
     assert vals.max() < 2**33
+
+
+def test_backward_preserves_float64_operand():
+    """Backward replay must run under the same x64 arming as the forward:
+    re-tracing with x64 off canonicalizes a saved float64 operand holding
+    2^31+6 down to float32 (which rounds to 2^31), so the gradient value
+    silently shifts. Allocation-free: the magnitude lives in the VALUE, not
+    the shape (ADVICE r3 medium)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray import NDArray
+
+    hi = 2**31 + 6
+    with jax.enable_x64(True):
+        vj = jnp.full((1,), float(hi), jnp.float64)
+        ones = jnp.ones((1,), jnp.float64)
+    v = NDArray(vj)
+    a = NDArray(ones)
+    a.attach_grad()
+    with autograd.record():
+        out = mx.nd.broadcast_mul(a, v)
+    # grad() returns the raw cotangent (no grad-buffer dtype cast): d(out)/da
+    # is exactly v, representable only if the replay kept float64
+    (g,) = autograd.grad([out], [a], retain_graph=True)
+    assert float(np.asarray(g.asnumpy())[0]) == float(hi)
+    # and the attach_grad/backward write-back path must keep the wide dtype
+    # end-to-end (buffer creation, astype, accumulation)
+    out.backward()
+    assert str(a.grad.dtype) == "float64"
+    assert float(a.grad.asnumpy()[0]) == float(hi)
+
+
+@heavy
+def test_backward_through_large_index():
+    """Gradient through take() at a position past int32-max: the cotangent
+    scatter must land at the original element, not at the int32-clipped
+    position (ADVICE r3 medium — backward replay x64 scope)."""
+    from mxnet_tpu import autograd
+
+    hi = INT32_MAX + 6
+    helper = mx.nd.zeros((LARGE,), dtype="int8")
+    helper[hi] = 1
+    idx = helper.argmax(axis=0)  # float64 holding `hi` exactly
+    del helper
+    a = mx.nd.zeros((LARGE,), dtype="float16")
+    a.attach_grad()
+    try:
+        with autograd.record():
+            out = mx.nd.take(a, idx)
+        out.backward()
+        got = a.grad[hi - 1 : hi + 2].asnumpy()
+        np.testing.assert_array_equal(got.astype(np.float32), [0, 1, 0])
+        assert float(a.grad[INT32_MAX].asscalar()) == 0
+    finally:
+        del a
 
 
 def test_int64_histogram_no_truncation_warning(recwarn):
