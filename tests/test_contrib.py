@@ -2,6 +2,7 @@
 model accuracy), text vocab/embedding, DataLoaderIter, SVRG trainer.
 (Reference strategy: tests/python/quantization/test_quantization.py,
 tests/python/unittest/test_contrib_text.py.)"""
+import collections
 import os
 
 import numpy as np
@@ -222,6 +223,107 @@ def test_quantize_model_entropy_conv_accuracy():
         "entropy calibration flipped a decisively-classified sample"
 
 
+def test_quantize_graph_int8_passthrough():
+    """relu/pool/flatten between quantized producers run IN int8
+    (quantized_act/pooling/flatten) with no dequantize/requantize pairs:
+    a conv->relu->pool->flatten->fc graph quantizes to a single int8
+    segment ending in ONE dequantize (VERDICT r3 item 5; reference:
+    quantized_activation.cc, quantized_flatten.cc FQuantizedOp)."""
+    data = mx.sym.var("data")
+    h = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=4,
+                           name="c1")
+    h = mx.sym.relu(h)
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="p1")
+    h = mx.sym.Flatten(h)
+    sym = mx.sym.FullyConnected(data=h, num_hidden=3, name="f1")
+    qsym = q.quantize_graph(sym)
+    ops = [n.op for n in qsym._topo() if not n.is_var]
+    for needed in ("_contrib_quantized_conv", "_contrib_quantized_act",
+                   "_contrib_quantized_pooling",
+                   "_contrib_quantized_flatten",
+                   "_contrib_quantized_fully_connected"):
+        assert needed in ops, (needed, ops)
+    # the whole chain stays int8: one final dequantize, one data quantize
+    assert ops.count("_contrib_dequantize") == 1, ops
+    assert ops.count("_contrib_quantize_v2") == 3, ops  # data + 2 weights
+
+    # numerics of the full int8 chain stay close to fp32
+    params = _rand_params(sym, {"data": (4, 1, 8, 8)})
+    X = np.random.RandomState(5).uniform(-1, 1, (4, 1, 8, 8)) \
+        .astype(np.float32)
+    fp = sym.eval_with({**{"data": X}, **params}).asnumpy()
+    qt = qsym.eval_with({**{"data": X}, **params}).asnumpy()
+    assert (fp.argmax(1) == qt.argmax(1)).mean() >= 0.75
+    np.testing.assert_allclose(qt, fp, atol=0.3, rtol=0.3)
+
+
+def test_fold_batch_norm_bare_defaults():
+    """A BatchNorm built with NO attrs executes with the op defaults
+    (eps=1e-3, fix_gamma=True — ops/nn.py); folding must mirror exactly
+    those, and must skip BNs normalizing a non-channel axis."""
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=4,
+                              name="c1")
+    sym = mx.sym.BatchNorm(conv, name="bn1")
+    rng = np.random.RandomState(2)
+    params = _rand_params(sym, {"data": (2, 3, 8, 8)})
+    params["bn1_gamma"] = mx.nd.array(
+        rng.uniform(0.5, 2.0, (4,)).astype(np.float32))  # != 1: fix_gamma
+    params["bn1_moving_mean"] = mx.nd.array(
+        rng.uniform(-0.5, 0.5, (4,)).astype(np.float32))
+    params["bn1_moving_var"] = mx.nd.array(
+        rng.uniform(1e-6, 1e-2, (4,)).astype(np.float32))  # eps-sensitive
+    X = rng.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+    fp = sym.eval_with({**{"data": X}, **params}).asnumpy()
+    fsym, fargs, _ = q.fold_batch_norm(sym, params, {})
+    assert "BatchNorm" not in [n.op for n in fsym._topo() if not n.is_var]
+    folded = fsym.eval_with({**{"data": X}, **fargs}).asnumpy()
+    np.testing.assert_allclose(folded, fp, rtol=1e-4, atol=1e-4)
+
+    # non-channel axis: folding is invalid and must be skipped
+    sym2 = mx.sym.BatchNorm(conv, axis=3, name="bn2")
+    fsym2, _, _ = q.fold_batch_norm(sym2, params, {})
+    assert "BatchNorm" in [n.op for n in fsym2._topo() if not n.is_var]
+
+
+def test_quantize_model_resnet18_e2e():
+    """End-to-end int8 resnet18: quantize_model over the traced zoo
+    symbol, top-1 agreement with fp32 on synthetic data (VERDICT r3
+    item 5 done-criterion; reference flow:
+    example/quantization/imagenet_gen_qsym.py)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(7)
+    net = vision.resnet18_v1()
+    net.initialize(mx.init.Xavier())
+    X = np.random.RandomState(0).uniform(-1, 1, (8, 3, 32, 32)) \
+        .astype(np.float32)
+    net(mx.nd.array(X))  # deferred init
+    sym = net(mx.sym.var("data"))
+    params = {k: v.data() for k, v in net.collect_params().items()}
+
+    fp = sym.eval_with({**{"data": X}, **params}).asnumpy()
+
+    # fold BN into convs first (deployment pre-pass): the whole
+    # conv->relu->pool trunk then quantizes into int8 segments
+    fsym, fargs, fauxs = q.fold_batch_norm(sym, params, {})
+    assert "BatchNorm" not in [n.op for n in fsym._topo() if not n.is_var]
+    folded = fsym.eval_with({**{"data": X}, **fargs}).asnumpy()
+    np.testing.assert_allclose(folded, fp, rtol=1e-3, atol=1e-3)
+
+    qsym, qargs, qauxs = q.quantize_model(
+        fsym, fargs, fauxs, calib_mode="naive",
+        calib_data=_calib_iter(X, batch=4), num_calib_examples=8)
+    ops = [n.op for n in qsym._topo() if not n.is_var]
+    assert "_contrib_quantized_conv" in ops
+    assert "_contrib_quantized_act" in ops      # post-conv relus stay int8
+    assert "_contrib_quantized_pooling" in ops
+    qt = qsym.eval_with({**{"data": X}, **qargs}).asnumpy()
+    agree = (fp.argmax(1) == qt.argmax(1)).mean()
+    assert agree >= 0.75, "int8 resnet18 flipped too many top-1 (%.2f)" % agree
+
+
 def test_text_vocab():
     counter = ctext.count_tokens_from_str("a b b c c c\nd d d d")
     vocab = ctext.Vocabulary(counter, min_freq=2, unknown_token="<unk>")
@@ -237,6 +339,106 @@ def test_text_custom_embedding(tmp_path):
     emb = ctext.CustomEmbedding(str(p))
     v = emb.get_vecs_by_tokens(["hello", "world"])
     np.testing.assert_allclose(v.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_text_embedding_registry():
+    names = ctext.get_pretrained_file_names()
+    assert set(names) >= {"glove", "fasttext"}
+    glove_files = ctext.get_pretrained_file_names("glove")
+    assert "glove.840B.300d.txt" in glove_files
+    assert "glove.6B.50d.txt" in glove_files
+    ft_files = ctext.get_pretrained_file_names("FastText")  # case-insensitive
+    assert "wiki.simple.vec" in ft_files
+    assert "wiki.en.vec" in ft_files
+    assert "crawl-300d-2M.vec" in ft_files
+    with pytest.raises(KeyError):
+        ctext.get_pretrained_file_names("nope")
+
+
+def test_text_glove_fasttext_local_files(tmp_path):
+    """GloVe/FastText load from embedding_root/<name>/<file> — the
+    no-egress local-file resolution (reference downloads instead,
+    embedding.py:200)."""
+    root = tmp_path / "embeddings"
+    (root / "glove").mkdir(parents=True)
+    (root / "glove" / "glove.6B.50d.txt").write_text(
+        "the 0.1 0.2 0.3\nof 0.4 0.5 0.6\n")
+    emb = ctext.create("glove", pretrained_file_name="glove.6B.50d.txt",
+                       embedding_root=str(root))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("of").asnumpy(), [0.4, 0.5, 0.6], rtol=1e-6)
+    # unknown token hits row 0 (zeros by default)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("zzz").asnumpy(), [0, 0, 0])
+
+    (root / "fasttext").mkdir()
+    # fasttext files open with a `count dim` header line — must be skipped
+    (root / "fasttext" / "wiki.simple.vec").write_text(
+        "2 3\nhello 1 2 3\nworld 4 5 6\n")
+    with pytest.warns(UserWarning):
+        ft = ctext.FastText(pretrained_file_name="wiki.simple.vec",
+                            embedding_root=str(root))
+    v = ft.get_vecs_by_tokens(["hello", "world"])
+    np.testing.assert_allclose(v.asnumpy(), [[1, 2, 3], [4, 5, 6]])
+
+    # missing file: clear error naming the expected location
+    with pytest.raises(mx.base.MXNetError, match="zero egress"):
+        ctext.GloVe(pretrained_file_name="glove.6B.100d.txt",
+                    embedding_root=str(root))
+    # unknown pretrained name: KeyError listing valid files
+    with pytest.raises(KeyError):
+        ctext.GloVe(pretrained_file_name="not_a_file.txt",
+                    embedding_root=str(root))
+
+
+def test_text_embedding_with_vocabulary(tmp_path):
+    """Vocabulary-scoped loading: only vocabulary tokens are indexed, with
+    vectors looked up from the file (reference embedding.py:345)."""
+    p = tmp_path / "emb.txt"
+    p.write_text("a 1 1\nb 2 2\nc 3 3\n")
+    counter = collections.Counter({"b": 3, "zzz": 2})
+    vocab = ctext.Vocabulary(counter)
+    emb = ctext.CustomEmbedding(str(p), vocabulary=vocab)
+    assert len(emb) == len(vocab) == 3  # unk, b, zzz
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("b").asnumpy(), [2, 2])
+    # zzz is indexed but absent from the file -> unknown vector (zeros)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("zzz").asnumpy(), [0, 0])
+    # 'a'/'c' are no longer indexed
+    assert emb.to_indices("a") == 0
+
+
+def test_text_composite_embedding(tmp_path):
+    p1 = tmp_path / "e1.txt"
+    p1.write_text("x 1 2\ny 3 4\n")
+    p2 = tmp_path / "e2.txt"
+    p2.write_text("x 5 7\nz 6 8\n")
+    e1 = ctext.CustomEmbedding(str(p1))
+    e2 = ctext.CustomEmbedding(str(p2))
+    vocab = ctext.Vocabulary(collections.Counter("x y z".split()))
+    comp = ctext.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 4
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("x").asnumpy(), [1, 2, 5, 7])
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("z").asnumpy(), [0, 0, 6, 8])
+
+
+def test_text_update_token_vectors(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0\nworld 3.0 4.0\n")
+    emb = ctext.CustomEmbedding(str(p))
+    emb.update_token_vectors("hello", mx.nd.array([9.0, 9.0]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9])
+    with pytest.raises(ValueError, match="unknown"):
+        emb.update_token_vectors("nope", mx.nd.array([1.0, 1.0]))
+    # lower_case_backup lookup
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("HELLO", lower_case_backup=True).asnumpy(),
+        [9, 9])
 
 
 def test_dataloader_iter():
