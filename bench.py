@@ -450,13 +450,21 @@ def bench_score_int8():
 
         xnp = np.asarray(x.asnumpy(), dtype=np.float32)
 
+        # deployment pre-pass: fold BN into convs so conv->relu->pool
+        # trunks quantize into contiguous int8 segments (no fp32 islands)
+        sym, arg_params, aux_params = q.fold_batch_norm(
+            sym, arg_params, aux_params)
+        from mxnet_tpu.model import save_checkpoint
+
+        save_checkpoint(prefix + "-folded", 0, sym, arg_params, aux_params)
+
         # weights stay fp32 in the param dict (quantization is folded
-        # in-graph), so the exported param file binds to the quantized
+        # in-graph), so the folded param file binds to the quantized
         # symbol unchanged
         qsym, _, _ = q.quantize_model(
             sym, arg_params, aux_params, calib_mode="naive",
             calib_data=NDArrayIter(xnp, batch_size=xnp.shape[0]))
-        pred = Predictor(qsym, prefix + "-0000.params", ctx=ctx,
+        pred = Predictor(qsym, prefix + "-folded-0000.params", ctx=ctx,
                          input_shapes={"data": tuple(xnp.shape)})
 
     def timed_int8(batch):

@@ -23,9 +23,143 @@ import numpy as _np
 from ..base import MXNetError
 from ..symbol.symbol import Symbol, _Node
 
-__all__ = ["quantize_model", "quantize_graph"]
+__all__ = ["quantize_model", "quantize_graph", "fold_batch_norm"]
+
+
+def fold_batch_norm(sym, arg_params, aux_params):
+    """Fold inference-mode BatchNorm into the preceding Convolution /
+    FullyConnected weights and bias (y = s*(Wx+b-mean)+beta with
+    s = gamma/sqrt(var+eps) becomes W'=s*W, b'=s*(b-mean)+beta).
+
+    Deployment pre-pass for int8: with BN folded, conv->relu->pool chains
+    quantize into one int8 segment (the reference reaches the same effect
+    via its MKLDNN subgraph fusion backend before quantize_graph_pass.cc
+    runs). Returns (new_sym, new_arg_params, new_aux_params); the folded
+    BN's parameters are dropped from the dicts."""
+    params = dict(arg_params)
+    auxs = dict(aux_params)
+
+    def value(name):
+        v = params.get(name, auxs.get(name))
+        if v is None:
+            return None
+        return _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+
+    counts = {}
+    for node in sym._topo():
+        for e in node.inputs:
+            counts[id(e[0])] = counts.get(id(e[0]), 0) + 1
+    for n, _ in sym._outputs:
+        counts[id(n)] = counts.get(id(n), 0) + 1
+
+    mapping = {}
+    for node in sym._topo():
+        if node.is_var:
+            n = _Node(None, node.name, dict(node.attrs))
+            n._shape, n._dtype = node._shape, node._dtype
+            mapping[id(node)] = n
+            continue
+        prod_edge = node.inputs[0] if node.inputs else None
+        prod = prod_edge[0] if prod_edge else None
+
+        def _axis_matches(bn, conv):
+            # folding scales weight dim 0 (output channels); only valid
+            # when BN normalizes the conv/FC channel axis
+            axis = int(bn.attrs.get("axis", 1))
+            if conv.op == "FullyConnected":
+                return axis in (1, -1)
+            layout = str(conv.attrs.get("layout") or "NCHW")
+            return axis % len(layout) == layout.index("C")
+
+        if (node.op == "BatchNorm" and prod is not None
+                and prod.op in ("Convolution", "FullyConnected")
+                and prod_edge[1] == 0 and counts.get(id(prod)) == 1
+                and not node.attrs.get("output_mean_var", False)
+                and _axis_matches(node, prod)
+                and all(e[0].is_var for e in node.inputs[1:])
+                and prod.inputs[1][0].is_var):
+            g_n, b_n, m_n, v_n = (e[0].name for e in node.inputs[1:5])
+            w_name = prod.inputs[1][0].name
+            gamma, beta = value(g_n), value(b_n)
+            mean, var = value(m_n), value(v_n)
+            w = value(w_name)
+            no_bias = str(prod.attrs.get("no_bias", False)) in ("True", "1")
+            b_name = None if no_bias or len(prod.inputs) < 3 \
+                else prod.inputs[2][0].name
+            if any(x is None for x in (gamma, beta, mean, var, w)) or \
+                    (b_name is not None and value(b_name) is None):
+                mapping[id(node)] = _Node(
+                    node.op, node.name, dict(node.attrs),
+                    [(mapping[id(e[0])], e[1]) for e in node.inputs],
+                    node.aux_slots)
+                continue
+            # attr defaults MUST mirror the op's execution defaults
+            # (ops/nn.py batch_norm: eps=1e-3, fix_gamma=True), or a BN
+            # built without explicit attrs folds to a different function
+            eps = float(node.attrs.get("eps", 1e-3))
+            if str(node.attrs.get("fix_gamma", True)) in ("True", "1"):
+                gamma = _np.ones_like(gamma)
+            s = gamma / _np.sqrt(var + eps)
+            bias = value(b_name) if b_name is not None \
+                else _np.zeros(w.shape[0], w.dtype)
+            params[w_name] = w * s.reshape((-1,) + (1,) * (w.ndim - 1))
+            new_b_name = b_name or (prod.name + "_folded_bias")
+            params[new_b_name] = (bias - mean) * s + beta
+            for p in (g_n, b_n, m_n, v_n):
+                params.pop(p, None)
+                auxs.pop(p, None)
+            attrs = dict(prod.attrs)
+            attrs["no_bias"] = False
+            bias_var = _Node(None, new_b_name, {})
+            folded = _Node(prod.op, prod.name, attrs,
+                           [(mapping[id(prod.inputs[0][0])],
+                             prod.inputs[0][1]),
+                            (mapping[id(prod.inputs[1][0])],
+                             prod.inputs[1][1]),
+                            (bias_var, 0)])
+            mapping[id(node)] = folded
+        else:
+            mapping[id(node)] = _Node(
+                node.op, node.name, dict(node.attrs),
+                [(mapping[id(e[0])], e[1]) for e in node.inputs],
+                node.aux_slots)
+    new_sym = Symbol([(mapping[id(n)], i) for n, i in sym._outputs])
+    return new_sym, params, auxs
 
 _QUANTIZABLE = {"FullyConnected", "Convolution"}
+
+# ops that run IN the int8 domain when fed by a quantized producer
+# (reference: FQuantizedOp registrations in quantized_activation.cc,
+# quantized_flatten.cc, quantized_pooling.cc, quantized_concat.cc). The
+# pass consumes the producer's (int8, min, max) directly, so the graph
+# stops dequantizing around relu/flatten/pool/concat nodes.
+_INT8_PASSTHROUGH = {
+    "Activation": "_contrib_quantized_act",
+    "relu": "_contrib_quantized_act",
+    "Flatten": "_contrib_quantized_flatten",
+    "flatten": "_contrib_quantized_flatten",
+    "Pooling": "_contrib_quantized_pooling",
+    "Concat": "_contrib_quantized_concat",
+    "concat": "_contrib_quantized_concat",
+}
+
+# the attrs each quantized passthrough kernel understands
+_PASSTHROUGH_KEEP = {
+    "_contrib_quantized_act": ("act_type",),
+    "_contrib_quantized_flatten": (),
+    "_contrib_quantized_pooling": ("kernel", "pool_type", "global_pool",
+                                   "stride", "pad", "pooling_convention",
+                                   "count_include_pad"),
+    "_contrib_quantized_concat": ("dim", "num_args"),
+}
+
+
+def _can_passthrough(node, qop):
+    if qop == "_contrib_quantized_act":
+        return node.op == "relu" or node.attrs.get("act_type") == "relu"
+    if qop == "_contrib_quantized_pooling":
+        return node.attrs.get("pool_type", "max") in ("max", "avg")
+    return True
 
 
 def _can_quantize(node):
@@ -175,6 +309,25 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
     def new_edge(old_node, idx):
         return (mapping[id(old_node)], idx)
 
+    _INT32_PRODUCERS = {"_contrib_quantized_conv",
+                        "_contrib_quantized_fully_connected"}
+
+    def int8_sources(deq, name, cal=None):
+        """(q, min, max) edges in int8 from a pass-inserted dequantize
+        producer. Quantized conv/FC emit an int32 ACCUMULATOR — feeding it
+        onward as int8 would wrap — so a requantize (int32 -> int8,
+        reference requantize-inl.h) is inserted, calibrated when the
+        original edge has a collected range."""
+        q_e, mn_e, mx_e = deq.inputs
+        if q_e[0].op in _INT32_PRODUCERS:
+            attrs = {}
+            if cal is not None:
+                attrs = {"min_calib_range": cal[0], "max_calib_range": cal[1]}
+            rq = _Node("_contrib_requantize", name + "_requantize", attrs,
+                       [q_e, mn_e, mx_e])
+            return ((rq, 0), (rq, 1), (rq, 2))
+        return (q_e, mn_e, mx_e)
+
     for node in sym._topo():
         if node.is_var:
             n = _Node(None, node.name, dict(node.attrs))
@@ -188,17 +341,29 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
             no_bias = bool(node.attrs.get("no_bias", False))
             b_edge = None if (no_bias or len(node.inputs) < 3) else node.inputs[2]
 
-            cal = calib_ranges.get((id(data_edge[0]), data_edge[1]))
-            qattrs = {}
-            if cal is not None:
-                qattrs = {"min_calib_range": cal[0], "max_calib_range": cal[1]}
-            qdata = _Node("_contrib_quantize_v2", node.name + "_quantize",
-                          qattrs, [new_edge(*data_edge)])
+            src = new_edge(*data_edge)
+            if src[0].op == "_contrib_dequantize" and src[1] == 0:
+                # the producer is a dequantize this pass inserted: consume
+                # its int8 sources directly instead of paying a
+                # dequantize->quantize_v2 round trip (reference: the
+                # requantize/dequantize fusion in quantize_graph_pass.cc)
+                d_edges = int8_sources(
+                    src[0], node.name,
+                    calib_ranges.get((id(data_edge[0]), data_edge[1])))
+            else:
+                cal = calib_ranges.get((id(data_edge[0]), data_edge[1]))
+                qattrs = {}
+                if cal is not None:
+                    qattrs = {"min_calib_range": cal[0],
+                              "max_calib_range": cal[1]}
+                qdata = _Node("_contrib_quantize_v2",
+                              node.name + "_quantize", qattrs, [src])
+                d_edges = ((qdata, 0), (qdata, 1), (qdata, 2))
             qweight = _Node("_contrib_quantize_v2", node.name + "_qweight",
                             {}, [new_edge(*w_edge)])
             qop = "_contrib_quantized_fully_connected" \
                 if node.op == "FullyConnected" else "_contrib_quantized_conv"
-            qin = [(qdata, 0), (qweight, 0)]
+            qin = [d_edges[0], (qweight, 0)]
             # bias (fp32; quantized inside the op) or a zero placeholder
             if b_edge is not None:
                 qin.append(new_edge(*b_edge))
@@ -213,7 +378,35 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
                 # quantized op signature has a bias slot; reuse weight as a
                 # dummy — no_bias=True means it is never read
                 qin.append((qweight, 0))
-            qin += [(qdata, 1), (qdata, 2), (qweight, 1), (qweight, 2)]
+            qin += [d_edges[1], d_edges[2], (qweight, 1), (qweight, 2)]
+            qnode = _Node(qop, node.name + "_quantized", attrs, qin)
+            deq = _Node("_contrib_dequantize", node.name + "_dequantize", {},
+                        [(qnode, 0), (qnode, 1), (qnode, 2)])
+            mapping[id(node)] = deq
+        elif (node.op in _INT8_PASSTHROUGH and node.name not in excluded
+              and _can_passthrough(node, _INT8_PASSTHROUGH[node.op])
+              and all(mapping[id(e[0])].op == "_contrib_dequantize"
+                      and e[1] == 0 for e in node.inputs)):
+            qop = _INT8_PASSTHROUGH[node.op]
+            # every producer is a dequantize the pass itself inserted:
+            # consume its int8 sources directly and re-wrap the result,
+            # keeping the whole segment in the quantized domain (the
+            # intermediate dequantize drops out at graph rebuild)
+            srcs = [int8_sources(mapping[id(e[0])],
+                                 "%s_in%d" % (node.name, i),
+                                 calib_ranges.get((id(e[0]), e[1])))
+                    for i, e in enumerate(node.inputs)]
+            attrs = {k: v for k, v in node.attrs.items()
+                     if k in _PASSTHROUGH_KEEP[qop]}
+            if qop == "_contrib_quantized_act":
+                attrs.setdefault("act_type", "relu")
+            if qop == "_contrib_quantized_concat":
+                attrs["num_args"] = len(srcs)
+                qin = [s[0] for s in srcs]
+                for s in srcs:
+                    qin += [s[1], s[2]]
+            else:
+                qin = list(srcs[0])
             qnode = _Node(qop, node.name + "_quantized", attrs, qin)
             deq = _Node("_contrib_dequantize", node.name + "_dequantize", {},
                         [(qnode, 0), (qnode, 1), (qnode, 2)])

@@ -173,6 +173,33 @@ def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
     return out, min_data.reshape((1,)), max_data.reshape((1,))
 
 
+@register("_contrib_quantized_act", num_outputs=3,
+          aliases=("quantized_act", "_contrib_quantized_activation"))
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    """int8 activation (reference: quantized_activation.cc — relu only).
+    relu clamps int8 values at 0, which the existing symmetric scale
+    represents exactly, so the thresholds pass through unchanged (the
+    reference keeps them and marks FNeedRequantize=false)."""
+    import jax.numpy as jnp
+
+    if act_type != "relu":
+        from ..base import MXNetError
+
+        raise MXNetError("_contrib_quantized_act only supports "
+                         "act_type=relu (reference parity)")
+    out = jnp.maximum(data, jnp.int8(0)).astype(data.dtype)
+    return out, min_data.reshape((1,)), max_data.reshape((1,))
+
+
+@register("_contrib_quantized_flatten", num_outputs=3,
+          aliases=("quantized_flatten",))
+def quantized_flatten(data, min_data, max_data):
+    """int8 flatten (reference: quantized_flatten-inl.h — identity values,
+    thresholds pass through; only the shape collapses to (batch, -1))."""
+    out = data.reshape((data.shape[0], -1))
+    return out, min_data.reshape((1,)), max_data.reshape((1,))
+
+
 @register("_contrib_quantized_concat", num_outputs=3,
           aliases=("quantized_concat",))
 def quantized_concat(*args, num_args=None, dim=1):

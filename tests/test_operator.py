@@ -940,6 +940,27 @@ def _quantized_pooling_matches_fp32():
         assert_almost_equal(deq, fp, rtol=2e-2, atol=2e-2)
 
 
+def _quantized_act_flatten_pass_through():
+    """quantized relu clamps int8 at 0 and keeps thresholds; quantized
+    flatten collapses shape only (reference: quantized_activation.cc,
+    quantized_flatten-inl.h)."""
+    x = U(2, 3, 2, 2)
+    q = np.clip(np.round(x * 127), -127, 127).astype(np.int8)
+    mn, mx_ = np.float32(-1), np.float32(1)
+    outs = _outs_np(run_op("quantized_act", [q, mn, mx_],
+                           {"act_type": "relu"}))
+    assert outs[0].dtype == np.int8
+    np.testing.assert_array_equal(outs[0], np.maximum(q, 0))
+    assert outs[1] == mn and outs[2] == mx_
+    with pytest.raises(Exception):
+        run_op("quantized_act", [q, mn, mx_], {"act_type": "tanh"})
+
+    outs = _outs_np(run_op("quantized_flatten", [q, mn, mx_], {}))
+    assert outs[0].shape == (2, 12) and outs[0].dtype == np.int8
+    np.testing.assert_array_equal(outs[0], q.reshape(2, 12))
+    assert outs[1] == mn and outs[2] == mx_
+
+
 def _quantized_concat_rescales_to_widest_range():
     """reference: quantized_concat.cc — inputs rescale to the largest
     [min, max]; output carries that range."""
@@ -1158,6 +1179,11 @@ EXCLUDED = {
     "quantized_pooling": "alias of _contrib_quantized_pooling",
     "_contrib_quantized_concat": "quantized concat test below",
     "quantized_concat": "alias of _contrib_quantized_concat",
+    "_contrib_quantized_act": "quantized act/flatten test below",
+    "quantized_act": "alias of _contrib_quantized_act",
+    "_contrib_quantized_activation": "alias of _contrib_quantized_act",
+    "_contrib_quantized_flatten": "quantized act/flatten test below",
+    "quantized_flatten": "alias of _contrib_quantized_flatten",
     "_contrib_dgl_csr_neighbor_uniform_sample": "dgl suite (test_dgl.py)",
     "dgl_csr_neighbor_uniform_sample": "dgl suite (test_dgl.py)",
     "_contrib_dgl_csr_neighbor_non_uniform_sample": "dgl suite (test_dgl.py)",
@@ -1356,3 +1382,7 @@ def test_quantized_pooling_matches_fp32():
 
 def test_quantized_concat_rescales():
     _quantized_concat_rescales_to_widest_range()
+
+
+def test_quantized_act_flatten():
+    _quantized_act_flatten_pass_through()
