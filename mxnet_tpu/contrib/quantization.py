@@ -52,6 +52,75 @@ def fold_batch_norm(sym, arg_params, aux_params):
     for n, _ in sym._outputs:
         counts[id(n)] = counts.get(id(n), 0) + 1
 
+    def _axis_matches(bn, conv):
+        # folding scales weight dim 0 (output channels); only valid when
+        # BN normalizes the conv/FC channel axis
+        axis = int(bn.attrs.get("axis", 1))
+        if conv.op == "FullyConnected":
+            # flatten=True (the default) makes the output 2-D so axis 1 is
+            # the hidden axis; with flatten=False only axis=-1 is safe
+            if str(conv.attrs.get("flatten", True)) in ("True", "1"):
+                return axis in (1, -1)
+            return axis == -1
+        layout = str(conv.attrs.get("layout") or "NCHW")
+        return axis % len(layout) == layout.index("C")
+
+    def _try_fold(node):
+        """The folded replacement node, or None when folding is invalid —
+        every guard funnels to the shared copy path."""
+        prod_edge = node.inputs[0] if node.inputs else None
+        prod = prod_edge[0] if prod_edge else None
+        if not (node.op == "BatchNorm" and prod is not None
+                and prod.op in ("Convolution", "FullyConnected")
+                and prod_edge[1] == 0 and counts.get(id(prod)) == 1
+                and not node.attrs.get("output_mean_var", False)
+                and _axis_matches(node, prod)
+                and all(e[0].is_var for e in node.inputs[1:])
+                and prod.inputs[1][0].is_var):
+            return None
+        # every folded-into or dropped parameter var must have exactly ONE
+        # consumer: scaling a tied weight or popping shared BN stats would
+        # corrupt the other consumers
+        if any(counts.get(id(e[0])) != 1
+               for e in [prod.inputs[1]] + list(node.inputs[1:5])):
+            return None
+        g_n, b_n, m_n, v_n = (e[0].name for e in node.inputs[1:5])
+        w_name = prod.inputs[1][0].name
+        gamma, beta = value(g_n), value(b_n)
+        mean, var = value(m_n), value(v_n)
+        w = value(w_name)
+        no_bias = str(prod.attrs.get("no_bias", False)) in ("True", "1")
+        b_edge = None if no_bias or len(prod.inputs) < 3 else prod.inputs[2]
+        if b_edge is not None and (not b_edge[0].is_var
+                                   or counts.get(id(b_edge[0])) != 1):
+            return None
+        b_name = b_edge[0].name if b_edge is not None else None
+        if any(x is None for x in (gamma, beta, mean, var, w)) or \
+                (b_name is not None and value(b_name) is None):
+            return None
+        # attr defaults MUST mirror the op's execution defaults
+        # (ops/nn.py batch_norm: eps=1e-3, fix_gamma=True), or a BN built
+        # without explicit attrs folds to a different function
+        eps = float(node.attrs.get("eps", 1e-3))
+        if str(node.attrs.get("fix_gamma", True)) in ("True", "1"):
+            gamma = _np.ones_like(gamma)
+        s = gamma / _np.sqrt(var + eps)
+        bias = value(b_name) if b_name is not None \
+            else _np.zeros(w.shape[0], w.dtype)
+        params[w_name] = w * s.reshape((-1,) + (1,) * (w.ndim - 1))
+        new_b_name = b_name or (prod.name + "_folded_bias")
+        params[new_b_name] = (bias - mean) * s + beta
+        for p in (g_n, b_n, m_n, v_n):
+            params.pop(p, None)
+            auxs.pop(p, None)
+        attrs = dict(prod.attrs)
+        attrs["no_bias"] = False
+        bias_var = _Node(None, new_b_name, {})
+        return _Node(prod.op, prod.name, attrs,
+                     [(mapping[id(prod.inputs[0][0])], prod.inputs[0][1]),
+                      (mapping[id(prod.inputs[1][0])], prod.inputs[1][1]),
+                      (bias_var, 0)])
+
     mapping = {}
     for node in sym._topo():
         if node.is_var:
@@ -59,70 +128,11 @@ def fold_batch_norm(sym, arg_params, aux_params):
             n._shape, n._dtype = node._shape, node._dtype
             mapping[id(node)] = n
             continue
-        prod_edge = node.inputs[0] if node.inputs else None
-        prod = prod_edge[0] if prod_edge else None
-
-        def _axis_matches(bn, conv):
-            # folding scales weight dim 0 (output channels); only valid
-            # when BN normalizes the conv/FC channel axis
-            axis = int(bn.attrs.get("axis", 1))
-            if conv.op == "FullyConnected":
-                return axis in (1, -1)
-            layout = str(conv.attrs.get("layout") or "NCHW")
-            return axis % len(layout) == layout.index("C")
-
-        if (node.op == "BatchNorm" and prod is not None
-                and prod.op in ("Convolution", "FullyConnected")
-                and prod_edge[1] == 0 and counts.get(id(prod)) == 1
-                and not node.attrs.get("output_mean_var", False)
-                and _axis_matches(node, prod)
-                and all(e[0].is_var for e in node.inputs[1:])
-                and prod.inputs[1][0].is_var):
-            g_n, b_n, m_n, v_n = (e[0].name for e in node.inputs[1:5])
-            w_name = prod.inputs[1][0].name
-            gamma, beta = value(g_n), value(b_n)
-            mean, var = value(m_n), value(v_n)
-            w = value(w_name)
-            no_bias = str(prod.attrs.get("no_bias", False)) in ("True", "1")
-            b_name = None if no_bias or len(prod.inputs) < 3 \
-                else prod.inputs[2][0].name
-            if any(x is None for x in (gamma, beta, mean, var, w)) or \
-                    (b_name is not None and value(b_name) is None):
-                mapping[id(node)] = _Node(
-                    node.op, node.name, dict(node.attrs),
-                    [(mapping[id(e[0])], e[1]) for e in node.inputs],
-                    node.aux_slots)
-                continue
-            # attr defaults MUST mirror the op's execution defaults
-            # (ops/nn.py batch_norm: eps=1e-3, fix_gamma=True), or a BN
-            # built without explicit attrs folds to a different function
-            eps = float(node.attrs.get("eps", 1e-3))
-            if str(node.attrs.get("fix_gamma", True)) in ("True", "1"):
-                gamma = _np.ones_like(gamma)
-            s = gamma / _np.sqrt(var + eps)
-            bias = value(b_name) if b_name is not None \
-                else _np.zeros(w.shape[0], w.dtype)
-            params[w_name] = w * s.reshape((-1,) + (1,) * (w.ndim - 1))
-            new_b_name = b_name or (prod.name + "_folded_bias")
-            params[new_b_name] = (bias - mean) * s + beta
-            for p in (g_n, b_n, m_n, v_n):
-                params.pop(p, None)
-                auxs.pop(p, None)
-            attrs = dict(prod.attrs)
-            attrs["no_bias"] = False
-            bias_var = _Node(None, new_b_name, {})
-            folded = _Node(prod.op, prod.name, attrs,
-                           [(mapping[id(prod.inputs[0][0])],
-                             prod.inputs[0][1]),
-                            (mapping[id(prod.inputs[1][0])],
-                             prod.inputs[1][1]),
-                            (bias_var, 0)])
-            mapping[id(node)] = folded
-        else:
-            mapping[id(node)] = _Node(
-                node.op, node.name, dict(node.attrs),
-                [(mapping[id(e[0])], e[1]) for e in node.inputs],
-                node.aux_slots)
+        folded = _try_fold(node)
+        mapping[id(node)] = folded if folded is not None else _Node(
+            node.op, node.name, dict(node.attrs),
+            [(mapping[id(e[0])], e[1]) for e in node.inputs],
+            node.aux_slots)
     new_sym = Symbol([(mapping[id(n)], i) for n, i in sym._outputs])
     return new_sym, params, auxs
 

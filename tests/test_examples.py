@@ -27,6 +27,8 @@ EXAMPLES = [
      ["--num-epochs", "1", "--sentences", "96"], []),
     ("ssd/train.py",
      ["--epochs", "1", "--batch-size", "4", "--samples", "16"], []),
+    ("rcnn/train.py",
+     ["--steps", "8", "--image-size", "48"], []),
     ("quantization/quantize_lenet.py", ["--smoke"], []),
     ("profiler/profile_training.py", ["--steps", "4"], []),
     ("distributed/train_dist.py", ["--tp", "2", "--steps", "4"],
