@@ -1,0 +1,220 @@
+// Multithreaded im2rec packer (reference: tools/im2rec.cc — its speed comes
+// from N worker threads preparing records in parallel while one thread
+// writes them in .lst order). TPU-native scope: the fast path packs the
+// ORIGINAL image bytes (no recode), which is the common dataset-pack case;
+// resize/quality recoding stays in the Python driver (tools/im2rec.py).
+//
+// On-disk format interops with mxnet_tpu/recordio.py and the reference:
+//   record  = uint32 magic 0xced7230a, uint32 lrec (low 29 bits = length),
+//             payload, zero-pad to 4 bytes
+//   payload = IRHeader{uint32 flag; float label; uint64 id; uint64 id2}
+//             [+ flag * float32 labels when flag > 0] + image bytes
+//   idx     = "id\toffset\n" per record, .lst order
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct PackItem {
+  uint64_t id = 0;
+  std::vector<float> labels;
+  std::string path;
+};
+
+bool parse_lst(const char* lst_path, const char* root,
+               std::vector<PackItem>* items) {
+  FILE* f = std::fopen(lst_path, "r");
+  if (!f) return false;
+  std::string line;
+  char buf[1 << 16];
+  bool more = true;
+  while (more) {
+    // accumulate until newline/EOF: lines can exceed any fixed buffer
+    // (detection lists carry thousands of float labels per line)
+    line.clear();
+    while (true) {
+      if (!std::fgets(buf, sizeof(buf), f)) {
+        more = false;
+        break;
+      }
+      line += buf;
+      if (!line.empty() && line.back() == '\n') break;
+    }
+    // match Python's line.strip(): trim whitespace at both ends
+    size_t b = line.find_first_not_of(" \t\r\n");
+    size_t e = line.find_last_not_of(" \t\r\n");
+    line = (b == std::string::npos) ? std::string()
+                                    : line.substr(b, e - b + 1);
+    if (line.empty()) continue;
+    std::vector<std::string> parts;
+    size_t start = 0;
+    while (true) {
+      size_t tab = line.find('\t', start);
+      parts.push_back(line.substr(start, tab - start));
+      if (tab == std::string::npos) break;
+      start = tab + 1;
+    }
+    if (parts.size() < 3) continue;
+    PackItem it;
+    it.id = std::strtoull(parts[0].c_str(), nullptr, 10);
+    for (size_t i = 1; i + 1 < parts.size(); ++i)
+      it.labels.push_back(std::strtof(parts[i].c_str(), nullptr));
+    it.path = std::string(root);
+    if (!it.path.empty() && it.path.back() != '/') it.path += '/';
+    it.path += parts.back();
+    items->push_back(std::move(it));
+  }
+  std::fclose(f);
+  return true;
+}
+
+// payload = IRHeader + labels + file bytes; empty string on read failure
+bool build_payload(const PackItem& it, std::string* out) {
+  FILE* f = std::fopen(it.path.c_str(), "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (sz < 0) { std::fclose(f); return false; }
+  uint32_t flag = 0;
+  float label = 0.f;
+  size_t extra = 0;
+  if (it.labels.size() == 1) {
+    label = it.labels[0];
+  } else {
+    flag = static_cast<uint32_t>(it.labels.size());
+    extra = it.labels.size() * sizeof(float);
+  }
+  const size_t header = 4 + 4 + 8 + 8;
+  out->resize(header + extra + static_cast<size_t>(sz));
+  char* p = &(*out)[0];
+  uint64_t id = it.id, id2 = 0;
+  std::memcpy(p, &flag, 4);
+  std::memcpy(p + 4, &label, 4);
+  std::memcpy(p + 8, &id, 8);
+  std::memcpy(p + 16, &id2, 8);
+  if (extra) std::memcpy(p + header, it.labels.data(), extra);
+  size_t got = std::fread(p + header + extra, 1, static_cast<size_t>(sz), f);
+  std::fclose(f);
+  return got == static_cast<size_t>(sz);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pack lst -> rec + idx with num_threads payload builders. Returns the
+// record count; -(1 + index_of_first_failed_item) for a per-item read
+// failure; INT64_MIN for file-level failures (open or write errors on
+// lst/rec/idx — write errors must NOT report success: a full disk would
+// otherwise leave a silently truncated .rec behind).
+int64_t mxtpu_im2rec_pack(const char* lst_path, const char* root,
+                          const char* rec_path, const char* idx_path,
+                          int num_threads) {
+  constexpr int64_t kFileError = INT64_MIN;
+  std::vector<PackItem> items;
+  if (!parse_lst(lst_path, root, &items)) return kFileError;
+  const size_t n = items.size();
+  if (num_threads < 1) num_threads = 1;
+  const size_t window = static_cast<size_t>(num_threads) * 8 + 8;
+
+  std::vector<std::string> payloads(n);
+  std::vector<char> ready(n, 0);
+  std::vector<char> failed(n, 0);
+  std::atomic<size_t> next{0};
+  std::atomic<size_t> written{0};
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_window;
+
+  auto worker = [&]() {
+    while (true) {
+      size_t i = next.fetch_add(1);
+      if (i >= n) break;
+      {
+        // bound memory: stay within `window` of the writer
+        std::unique_lock<std::mutex> lock(mu);
+        cv_window.wait(lock,
+                       [&] { return i < written.load() + window; });
+      }
+      bool ok = build_payload(items[i], &payloads[i]);
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        if (!ok) failed[i] = 1;
+        ready[i] = 1;
+        cv_ready.notify_all();
+      }
+    }
+  };
+
+  std::vector<std::thread> threads;
+  for (int t = 0; t < num_threads; ++t) threads.emplace_back(worker);
+
+  FILE* rec = std::fopen(rec_path, "wb");
+  FILE* idx = std::fopen(idx_path, "w");
+  int64_t result = static_cast<int64_t>(n);
+  if (!rec || !idx) {
+    result = kFileError;
+  } else {
+    uint64_t offset = 0;
+    for (size_t i = 0; i < n; ++i) {
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv_ready.wait(lock, [&] { return ready[i] != 0; });
+        if (failed[i]) {
+          result = -static_cast<int64_t>(i) - 1;
+        }
+      }
+      if (result < 0) break;
+      const std::string& payload = payloads[i];
+      uint32_t lrec = static_cast<uint32_t>(payload.size());
+      uint32_t head[2] = {kMagic, lrec};
+      size_t pad = (4 - payload.size() % 4) % 4;
+      const char zeros[4] = {0, 0, 0, 0};
+      bool ok =
+          std::fwrite(head, sizeof(uint32_t), 2, rec) == 2 &&
+          std::fwrite(payload.data(), 1, payload.size(), rec) ==
+              payload.size() &&
+          (!pad || std::fwrite(zeros, 1, pad, rec) == pad) &&
+          std::fprintf(idx, "%llu\t%llu\n",
+                       static_cast<unsigned long long>(items[i].id),
+                       static_cast<unsigned long long>(offset)) > 0;
+      if (!ok) {
+        result = kFileError;
+        break;
+      }
+      offset += 8 + payload.size() + pad;
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        payloads[i].clear();
+        payloads[i].shrink_to_fit();
+        written.store(i + 1);
+        cv_window.notify_all();
+      }
+    }
+    if (result >= 0 && (std::fflush(rec) != 0 || std::fflush(idx) != 0)) {
+      result = kFileError;
+    }
+  }
+  {
+    // unblock any worker still waiting on the window after an early stop
+    std::lock_guard<std::mutex> lock(mu);
+    written.store(n);
+    cv_window.notify_all();
+  }
+  next.store(n);
+  for (auto& t : threads) t.join();
+  if (rec) std::fclose(rec);
+  if (idx) std::fclose(idx);
+  return result;
+}
+
+}  // extern "C"

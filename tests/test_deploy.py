@@ -121,6 +121,46 @@ def test_im2rec_and_rec2idx_tools(tmp_path):
         assert len(f.readlines()) == 6
 
 
+def test_im2rec_native_multithreaded_pack(tmp_path):
+    """The C++ fast path (--num-thread > 1, reference tools/im2rec.cc)
+    produces byte-identical .rec/.idx to the Python packer and reads back
+    through MXIndexedRecordIO."""
+    from mxnet_tpu.lib import native
+
+    if native.get() is None:
+        pytest.skip("native library unavailable")
+    np.random.seed(1)
+    root = tmp_path / "imgs"
+    for cls in ("a", "b"):
+        (root / cls).mkdir(parents=True)
+        for i in range(4):
+            _write_png(str(root / cls / ("%d.png" % i)),
+                       (np.random.rand(10, 10, 3) * 255).astype(np.uint8))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    ppy = str(tmp_path / "py")
+    pcc = str(tmp_path / "cc")
+    for prefix, extra in ((ppy, []), (pcc, ["--num-thread", "4"])):
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools/im2rec.py"),
+             prefix, str(root)] + extra,
+            env=env, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+    # same listing (seeded shuffle) -> byte-identical pack
+    with open(ppy + ".rec", "rb") as f1, open(pcc + ".rec", "rb") as f2:
+        assert f1.read() == f2.read()
+    with open(ppy + ".idx") as f1, open(pcc + ".idx") as f2:
+        assert f1.read() == f2.read()
+
+    from mxnet_tpu import recordio
+
+    reader = recordio.MXIndexedRecordIO(pcc + ".idx", pcc + ".rec", "r")
+    assert len(reader.keys) == 8
+    header, img = recordio.unpack_img(reader.read_idx(reader.keys[3]))
+    assert img.shape == (10, 10, 3)
+    reader.close()
+
+
 def test_aot_compiled_predictor_roundtrip(tmp_path):
     """TensorRT-analogue AOT artifact (jax.export StableHLO, params frozen
     in): export_compiled -> CompiledPredictor.load -> forward matches the

@@ -60,6 +60,33 @@ def read_list(lst_path):
             yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
 
 
+def pack_native(args):
+    """Multithreaded C++ fast path (reference: tools/im2rec.cc worker
+    pipeline) — packs ORIGINAL image bytes; only valid when no recode
+    (resize/crop/quality) is requested. Returns the record count, or None
+    when the native library is unavailable (caller falls back)."""
+    import ctypes
+
+    from mxnet_tpu.lib import native
+
+    lib = native.get()
+    if lib is None:
+        return None
+    fn = lib.mxtpu_im2rec_pack
+    fn.restype = ctypes.c_int64
+    fn.argtypes = [ctypes.c_char_p] * 4 + [ctypes.c_int]
+    n = fn((args.prefix + ".lst").encode(), args.root.encode(),
+           (args.prefix + ".rec").encode(), (args.prefix + ".idx").encode(),
+           int(args.num_thread))
+    if n == -(2 ** 63):  # INT64_MIN: file-level open/write failure
+        raise OSError("im2rec native pack: cannot open or write "
+                      "lst/rec/idx files (disk full?)")
+    if n < 0:
+        raise OSError("im2rec native pack: failed reading item %d of %s.lst"
+                      % (-n - 1, args.prefix))
+    return int(n)
+
+
 def pack(args):
     """Pack prefix.lst -> prefix.rec + prefix.idx (reference: im2rec.py
     image_encode/write worker pipeline)."""
@@ -67,6 +94,11 @@ def pack(args):
 
     from mxnet_tpu import image, recordio
 
+    recode = bool(args.resize or args.quality != 95 or args.center_crop)
+    if args.num_thread > 1 and not recode:
+        n = pack_native(args)
+        if n is not None:
+            return n
     lst = args.prefix + ".lst"
     rec = args.prefix + ".rec"
     idx = args.prefix + ".idx"
@@ -76,7 +108,7 @@ def pack(args):
         path = os.path.join(args.root, relpath)
         with open(path, "rb") as f:
             buf = f.read()
-        if args.resize or args.quality != 95 or args.center_crop:
+        if recode:
             img = image.imdecode(buf, to_ndarray=False)
             if args.resize:
                 img = image.resize_short(img, args.resize)
@@ -109,6 +141,10 @@ def main(argv=None):
     p.add_argument("--center-crop", action="store_true")
     p.add_argument("--quality", type=int, default=95)
     p.add_argument("--encoding", choices=("jpg", "png"), default="jpg")
+    p.add_argument("--num-thread", type=int, default=1,
+                   help=">1 uses the multithreaded C++ packer when no "
+                        "recode (resize/crop/quality) is requested "
+                        "(reference: tools/im2rec.cc)")
     args = p.parse_args(argv)
     if args.list:
         n = make_list(args)
