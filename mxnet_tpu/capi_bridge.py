@@ -159,6 +159,179 @@ def _capi_get_grad(arr):
     return arr.grad  # None when no gradient buffer is attached
 
 
+# -- symbol section (reference: c_api_symbolic.cc) --------------------------
+# A C SymbolHandle owns a _SymRec. CreateAtomicSymbol makes a node with no
+# inputs (sym=None); Compose instantiates it through the generated mx.sym
+# op function — after that every symbol fn operates on .sym.
+
+
+class _SymRec:
+    __slots__ = ("op", "attrs", "sym")
+
+    def __init__(self, op=None, attrs=None, sym=None):
+        self.op = op
+        self.attrs = attrs or {}
+        self.sym = sym
+
+    def require(self):
+        if self.sym is None:
+            raise ValueError(
+                "symbol %r has not been composed yet (MXSymbolCompose "
+                "binds its inputs, reference c_api_symbolic.cc:481)"
+                % (self.op,))
+        return self.sym
+
+
+def _capi_sym_create_variable(name):
+    from . import symbol as sym_mod
+
+    return _SymRec(sym=sym_mod.Variable(name))
+
+
+def _capi_sym_create_atomic(op_name, keys, vals):
+    attrs = {k.decode() if isinstance(k, bytes) else k: _parse_attr(v)
+             for k, v in zip(keys, vals)}
+    return _SymRec(op=op_name, attrs=attrs)
+
+
+def _capi_sym_compose(rec, name, keys, args):
+    from . import symbol as sym_mod
+
+    syms = [a.require() for a in args]
+    if keys and len(keys) != len(syms):
+        raise ValueError(
+            "MXSymbolCompose: %d keys for %d inputs (keys must be "
+            "all-positional or one per input)" % (len(keys), len(syms)))
+    kwargs = dict(rec.attrs)
+    if name:
+        kwargs["name"] = name
+    fn = getattr(sym_mod, rec.op)
+    if keys:
+        kwargs.update({k.decode() if isinstance(k, bytes) else k: s
+                       for k, s in zip(keys, syms)})
+        rec.sym = fn(**kwargs)
+    else:
+        rec.sym = fn(*syms, **kwargs)
+
+
+def _capi_sym_copy(rec):
+    return _SymRec(op=rec.op, attrs=dict(rec.attrs), sym=rec.require())
+
+
+def _capi_sym_group(recs):
+    from . import symbol as sym_mod
+
+    return _SymRec(sym=sym_mod.Group([r.require() for r in recs]))
+
+
+def _capi_sym_internals(rec):
+    return _SymRec(sym=rec.require().get_internals())
+
+
+def _capi_sym_get_output(rec, index):
+    return _SymRec(sym=rec.require()[int(index)])
+
+
+def _capi_sym_list_arguments(rec):
+    return list(rec.require().list_arguments())
+
+
+def _capi_sym_list_outputs(rec):
+    return list(rec.require().list_outputs())
+
+
+def _capi_sym_list_aux(rec):
+    return list(rec.require().list_auxiliary_states())
+
+
+def _capi_sym_tojson(rec):
+    return rec.require().tojson()
+
+
+def _capi_sym_from_json(js):
+    from .symbol import symbol as sym_impl
+
+    return _SymRec(sym=sym_impl.load_json(
+        js.decode() if isinstance(js, bytes) else js))
+
+
+def _capi_sym_infer_shape(rec, keys, shapes, partial):
+    """keys + per-key shape tuples -> (arg, out, aux shape lists,
+    complete). Unknown-by-position keys ('' entries) follow
+    list_arguments order like the reference's positional CSR form."""
+    s = rec.require()
+    kwargs = {}
+    names = s.list_arguments()
+    for i, (k, shp) in enumerate(zip(keys, shapes)):
+        k = k.decode() if isinstance(k, bytes) else k
+        kwargs[k if k else names[i]] = tuple(int(d) for d in shp)
+    fn = s.infer_shape_partial if partial else s.infer_shape
+    try:
+        arg, out, aux = fn(**kwargs)
+    except Exception:
+        if partial:
+            raise
+        # under-specified shapes are NOT an error in the reference C API
+        # (c_api_symbolic.cc): it succeeds with *complete = 0
+        return ([], [], [], 0)
+    complete = arg is not None and all(
+        x is not None and all(d > 0 for d in x) for x in (arg + out + aux))
+    return (arg or [], out or [], aux or [], 1 if complete else 0)
+
+
+def _capi_executor_bind(rec, dev_type, dev_id, in_args, arg_grads,
+                        grad_reqs, aux_states):
+    s = rec.require()
+    ctx = _ctx(dev_type, dev_id)
+    names = s.list_arguments()
+    args = dict(zip(names, in_args))
+    args_grad = {n: g for n, g in zip(names, arg_grads) if g is not None}
+    grad_req = {n: _GRAD_REQ.get(int(r), "write")
+                for n, r in zip(names, grad_reqs)}
+    return s.bind(ctx, args=args, args_grad=args_grad or None,
+                  grad_req=grad_req, aux_states=list(aux_states) or None)
+
+
+def _capi_executor_forward(executor, is_train):
+    executor.forward(is_train=bool(is_train))
+
+
+def _capi_executor_outputs(executor):
+    return list(executor.outputs)
+
+
+def _capi_executor_backward(executor, head_grads):
+    executor.backward(out_grads=list(head_grads) if head_grads else None)
+
+
+def _capi_executor_arg_grads(executor):
+    return list(executor.grad_arrays)
+
+
+# -- NDArray save/load (reference: c_api.cc MXNDArraySave/Load) -------------
+
+def _capi_nd_save(fname, arrays, keys):
+    from . import ndarray as nd
+
+    fname = fname.decode() if isinstance(fname, bytes) else fname
+    if keys:
+        nd.save(fname, {k.decode() if isinstance(k, bytes) else k: a
+                        for k, a in zip(keys, arrays)})
+    else:
+        nd.save(fname, list(arrays))
+
+
+def _capi_nd_load(fname):
+    from . import ndarray as nd
+
+    fname = fname.decode() if isinstance(fname, bytes) else fname
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return names, [data[n] for n in names]
+    return [], list(data)
+
+
 def _capi_version():
     from . import __version__
 

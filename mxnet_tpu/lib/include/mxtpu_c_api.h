@@ -65,6 +65,74 @@ int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
 int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
                        NDArrayHandle *ograd_handles, int retain_graph);
 
+/* -- symbol section (c_api_symbolic.cc; reference c_api.h symbol block).
+ * A SymbolHandle from MXSymbolCreateAtomicSymbol is a node with no
+ * inputs; MXSymbolCompose binds them. Returned string/shape pointers are
+ * owned by the handle and stay valid until its next call. */
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out);
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out);
+int MXSymbolFree(SymbolHandle symbol);
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array);
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array);
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+
+/* -- executor (reference c_api_executor.cc Bind/Forward/Backward). Output
+ * handles from MXExecutorOutputs are caller-freed via MXNDArrayFree. */
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads);
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out);
+int MXExecutorFree(ExecutorHandle handle);
+
+/* -- NDArray save/load (reference c_api.cc) */
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+
 #ifdef __cplusplus
 }
 #endif

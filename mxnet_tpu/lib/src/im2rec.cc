@@ -20,9 +20,20 @@
 #include <thread>
 #include <vector>
 
+// record framing is recordio.cc's writer (same library) — ONE
+// implementation of the magic/length/padding format
+extern "C" {
+void* mxtpu_recio_writer_open(const char* path);
+int64_t mxtpu_recio_writer_tell(void* handle);
+int mxtpu_recio_writer_write(void* handle, const char* data, uint64_t len);
+void mxtpu_recio_writer_close(void* handle);
+}
+
 namespace {
 
-constexpr uint32_t kMagic = 0xced7230a;
+// a single record's length field is 29 bits (dmlc lrec); larger payloads
+// would silently corrupt the stream under the writer's mask
+constexpr uint64_t kMaxRecord = (1ull << 29) - 1;
 
 struct PackItem {
   uint64_t id = 0;
@@ -65,7 +76,14 @@ bool parse_lst(const char* lst_path, const char* root,
     }
     if (parts.size() < 3) continue;
     PackItem it;
-    it.id = std::strtoull(parts[0].c_str(), nullptr, 10);
+    char *end = nullptr;
+    it.id = std::strtoull(parts[0].c_str(), &end, 10);
+    if (end == parts[0].c_str() || *end != '\0') {
+      // malformed id column: fail like the Python packer's int() raise
+      // rather than silently packing id=0 (duplicate .idx keys)
+      std::fclose(f);
+      return false;
+    }
     for (size_t i = 1; i + 1 < parts.size(); ++i)
       it.labels.push_back(std::strtof(parts[i].c_str(), nullptr));
     it.path = std::string(root);
@@ -158,13 +176,12 @@ int64_t mxtpu_im2rec_pack(const char* lst_path, const char* root,
   std::vector<std::thread> threads;
   for (int t = 0; t < num_threads; ++t) threads.emplace_back(worker);
 
-  FILE* rec = std::fopen(rec_path, "wb");
+  void* rec = mxtpu_recio_writer_open(rec_path);
   FILE* idx = std::fopen(idx_path, "w");
   int64_t result = static_cast<int64_t>(n);
   if (!rec || !idx) {
     result = kFileError;
   } else {
-    uint64_t offset = 0;
     for (size_t i = 0; i < n; ++i) {
       {
         std::unique_lock<std::mutex> lock(mu);
@@ -175,15 +192,15 @@ int64_t mxtpu_im2rec_pack(const char* lst_path, const char* root,
       }
       if (result < 0) break;
       const std::string& payload = payloads[i];
-      uint32_t lrec = static_cast<uint32_t>(payload.size());
-      uint32_t head[2] = {kMagic, lrec};
-      size_t pad = (4 - payload.size() % 4) % 4;
-      const char zeros[4] = {0, 0, 0, 0};
+      if (payload.size() > kMaxRecord) {
+        result = -static_cast<int64_t>(i) - 1;
+        break;
+      }
+      int64_t offset = mxtpu_recio_writer_tell(rec);
       bool ok =
-          std::fwrite(head, sizeof(uint32_t), 2, rec) == 2 &&
-          std::fwrite(payload.data(), 1, payload.size(), rec) ==
-              payload.size() &&
-          (!pad || std::fwrite(zeros, 1, pad, rec) == pad) &&
+          offset >= 0 &&
+          mxtpu_recio_writer_write(rec, payload.data(),
+                                   payload.size()) == 0 &&
           std::fprintf(idx, "%llu\t%llu\n",
                        static_cast<unsigned long long>(items[i].id),
                        static_cast<unsigned long long>(offset)) > 0;
@@ -191,7 +208,6 @@ int64_t mxtpu_im2rec_pack(const char* lst_path, const char* root,
         result = kFileError;
         break;
       }
-      offset += 8 + payload.size() + pad;
       {
         std::lock_guard<std::mutex> lock(mu);
         payloads[i].clear();
@@ -200,7 +216,7 @@ int64_t mxtpu_im2rec_pack(const char* lst_path, const char* root,
         cv_window.notify_all();
       }
     }
-    if (result >= 0 && (std::fflush(rec) != 0 || std::fflush(idx) != 0)) {
+    if (result >= 0 && std::fflush(idx) != 0) {
       result = kFileError;
     }
   }
@@ -212,7 +228,7 @@ int64_t mxtpu_im2rec_pack(const char* lst_path, const char* root,
   }
   next.store(n);
   for (auto& t : threads) t.join();
-  if (rec) std::fclose(rec);
+  if (rec) mxtpu_recio_writer_close(rec);
   if (idx) std::fclose(idx);
   return result;
 }

@@ -50,11 +50,7 @@ PyObject *call_bridge1(const char *fn, PyObject *obj) {
   return res;
 }
 
-struct ND {
-  PyObject *obj;                     // mxnet_tpu.ndarray.NDArray
-  std::vector<mx_uint> shape;        // GetShape storage
-  std::string bytes;                 // SyncCopyToCPU staging
-};
+using mxtpu_capi::ND;  // shared handle layout (capi_common.h)
 
 ND *nd(NDArrayHandle h) { return static_cast<ND *>(h); }
 

@@ -1,0 +1,527 @@
+// Symbol + Executor + NDArray-IO sections of the flat C ABI (reference:
+// include/mxnet/c_api.h, implemented by src/c_api/c_api_symbolic.cc and
+// c_api_executor.cc). Together with c_api.cc's imperative core this makes
+// the classic C workflow possible: discover creators, compose a symbolic
+// graph, infer shapes, bind an executor, forward/backward, save/load
+// NDArrays. Signatures follow the reference so C hosts recompile
+// unchanged.
+//
+// Handle model mirrors c_api.cc: SymbolHandle owns a Python _SymRec
+// (mxnet_tpu.capi_bridge), ExecutorHandle owns a Python Executor; every
+// returned const char* / shape pointer is backed by storage owned by the
+// handle it came from (valid until the next call on that handle, the
+// reference's own contract).
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "capi_common.h"
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *AtomicSymbolCreator;
+
+namespace {
+
+using mxtpu_capi::GIL;
+using mxtpu_capi::g_last_error;
+using mxtpu_capi::set_error_from_python;
+
+PyObject *bridge(const char *fn, PyObject *args) {
+  return mxtpu_capi::call_module_fn("mxnet_tpu.capi_bridge", fn, args);
+}
+
+using mxtpu_capi::ND;  // shared handle layout (capi_common.h)
+
+struct Sym {
+  PyObject *obj = nullptr;            // _SymRec
+  // string-list return storage (ListArguments/Outputs/Aux, GetAttr, JSON)
+  std::vector<std::string> strs;
+  std::vector<const char *> cstrs;
+  std::string json;
+  // InferShape return storage: flat dims + per-shape pointers
+  std::vector<std::vector<mx_uint>> shp[3];
+  std::vector<mx_uint> shp_ndim[3];
+  std::vector<const mx_uint *> shp_ptr[3];
+};
+
+struct Exec {
+  PyObject *obj = nullptr;            // mxnet_tpu.executor.Executor
+  std::vector<NDArrayHandle> outputs;  // ND* handles (caller frees)
+};
+
+Sym *sym(SymbolHandle h) { return static_cast<Sym *>(h); }
+Exec *ex(ExecutorHandle h) { return static_cast<Exec *>(h); }
+
+int fail() {
+  set_error_from_python();
+  return -1;
+}
+
+// wrap a bridge call returning a _SymRec into a new SymbolHandle
+int sym_out(PyObject *res, SymbolHandle *out) {
+  if (res == nullptr) return fail();
+  Sym *h = new Sym();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+// expose a Python list of str through (size, char**) with handle storage
+int str_list_out(Sym *h, PyObject *list, mx_uint *out_size,
+                 const char ***out_array) {
+  h->strs.clear();
+  h->cstrs.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    h->strs.push_back(s ? s : "");
+  }
+  for (const std::string &s : h->strs) h->cstrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(h->strs.size());
+  *out_array = h->cstrs.empty() ? nullptr : h->cstrs.data();
+  return 0;
+}
+
+// Python list of shape tuples -> slot `which` of the handle's storage
+void shapes_out(Sym *h, PyObject *list, int which, mx_uint *out_size,
+                const mx_uint **out_ndim, const mx_uint ***out_data) {
+  auto &shp = h->shp[which];
+  auto &ndim = h->shp_ndim[which];
+  auto &ptr = h->shp_ptr[which];
+  shp.clear();
+  ndim.clear();
+  ptr.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *t = PyList_GetItem(list, i);
+    std::vector<mx_uint> dims;
+    if (t != Py_None && PySequence_Check(t)) {
+      Py_ssize_t nd = PySequence_Size(t);
+      for (Py_ssize_t d = 0; d < nd; ++d) {
+        PyObject *v = PySequence_GetItem(t, d);
+        dims.push_back(static_cast<mx_uint>(PyLong_AsUnsignedLong(v)));
+        Py_XDECREF(v);
+      }
+    }
+    shp.push_back(std::move(dims));
+  }
+  for (auto &s : shp) {
+    ndim.push_back(static_cast<mx_uint>(s.size()));
+    ptr.push_back(s.empty() ? nullptr : s.data());
+  }
+  *out_size = static_cast<mx_uint>(shp.size());
+  *out_ndim = ndim.empty() ? nullptr : ndim.data();
+  *out_data = ptr.empty() ? nullptr : ptr.data();
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- symbol creation / composition ------------------------------------------
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", name);
+  PyObject *res = args ? bridge("_capi_sym_create_variable", args) : nullptr;
+  Py_XDECREF(args);
+  return sym_out(res, out);
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out) {
+  GIL gil;
+  // creator handles ARE interned op-name strings (see c_api.cc)
+  PyObject *ks = PyList_New(num_param);
+  PyObject *vs = PyList_New(num_param);
+  if (ks == nullptr || vs == nullptr) return fail();
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(vs, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *args = Py_BuildValue("(sNN)",
+                                 static_cast<const char *>(creator), ks, vs);
+  PyObject *res = args ? bridge("_capi_sym_create_atomic", args) : nullptr;
+  Py_XDECREF(args);
+  return sym_out(res, out);
+}
+
+int MXSymbolCompose(SymbolHandle handle, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args_handles) {
+  GIL gil;
+  // all-keyword or all-positional, like the reference (a mixed key list
+  // would mis-pair keys with inputs downstream — reject it loudly)
+  mx_uint n_keyed = 0;
+  for (mx_uint i = 0; i < num_args && keys != nullptr; ++i)
+    if (keys[i] != nullptr && keys[i][0] != '\0') ++n_keyed;
+  if (n_keyed != 0 && n_keyed != num_args) {
+    g_last_error = "MXSymbolCompose: keys must be all-NULL (positional) "
+                   "or all-set (keyword); mixed forms are not supported";
+    return -1;
+  }
+  PyObject *ks = PyList_New(n_keyed);
+  PyObject *ins = PyList_New(num_args);
+  if (ks == nullptr || ins == nullptr) return fail();
+  for (mx_uint i = 0; i < num_args; ++i) {
+    if (n_keyed != 0)
+      PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    PyObject *o = sym(args_handles[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  PyObject *args = Py_BuildValue("(OsNN)", sym(handle)->obj,
+                                 name ? name : "", ks, ins);
+  PyObject *res = args ? bridge("_capi_sym_compose", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolCopy(SymbolHandle handle, SymbolHandle *out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", sym(handle)->obj);
+  PyObject *res = args ? bridge("_capi_sym_copy", args) : nullptr;
+  Py_XDECREF(args);
+  return sym_out(res, out);
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  GIL gil;
+  PyObject *lst = PyList_New(num_symbols);
+  if (lst == nullptr) return fail();
+  for (mx_uint i = 0; i < num_symbols; ++i) {
+    PyObject *o = sym(symbols[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(lst, i, o);
+  }
+  PyObject *args = Py_BuildValue("(N)", lst);
+  PyObject *res = args ? bridge("_capi_sym_group", args) : nullptr;
+  Py_XDECREF(args);
+  return sym_out(res, out);
+}
+
+int MXSymbolGetInternals(SymbolHandle handle, SymbolHandle *out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", sym(handle)->obj);
+  PyObject *res = args ? bridge("_capi_sym_internals", args) : nullptr;
+  Py_XDECREF(args);
+  return sym_out(res, out);
+}
+
+int MXSymbolGetOutput(SymbolHandle handle, mx_uint index, SymbolHandle *out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(OI)", sym(handle)->obj, index);
+  PyObject *res = args ? bridge("_capi_sym_get_output", args) : nullptr;
+  Py_XDECREF(args);
+  return sym_out(res, out);
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  Py_XDECREF(sym(handle)->obj);
+  delete sym(handle);
+  return 0;
+}
+
+// -- listing / serialization ------------------------------------------------
+
+static int list_fn(const char *fn, SymbolHandle handle, mx_uint *out_size,
+                   const char ***out_array) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", sym(handle)->obj);
+  PyObject *res = args ? bridge(fn, args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  int rc = str_list_out(sym(handle), res, out_size, out_array);
+  Py_DECREF(res);
+  return rc;
+}
+
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array) {
+  return list_fn("_capi_sym_list_arguments", handle, out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array) {
+  return list_fn("_capi_sym_list_outputs", handle, out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
+                                const char ***out_array) {
+  return list_fn("_capi_sym_list_aux", handle, out_size, out_array);
+}
+
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", sym(handle)->obj);
+  PyObject *res = args ? bridge("_capi_sym_tojson", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  const char *s = PyUnicode_AsUTF8(res);
+  sym(handle)->json = s ? s : "";
+  Py_DECREF(res);
+  *out_json = sym(handle)->json.c_str();
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", json);
+  PyObject *res = args ? bridge("_capi_sym_from_json", args) : nullptr;
+  Py_XDECREF(args);
+  return sym_out(res, out);
+}
+
+// -- shape inference --------------------------------------------------------
+
+static int infer_shape_impl(
+    SymbolHandle handle, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete, int partial) {
+  GIL gil;
+  PyObject *ks = PyList_New(num_args);
+  PyObject *shps = PyList_New(num_args);
+  if (ks == nullptr || shps == nullptr) return fail();
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(
+        (keys != nullptr && keys[i] != nullptr) ? keys[i] : ""));
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *t = PyList_New(hi - lo);
+    for (mx_uint d = lo; d < hi; ++d)
+      PyList_SET_ITEM(t, d - lo, PyLong_FromUnsignedLong(arg_shape_data[d]));
+    PyList_SET_ITEM(shps, i, t);
+  }
+  PyObject *args = Py_BuildValue("(ONNi)", sym(handle)->obj, ks, shps,
+                                 partial);
+  PyObject *res = args ? bridge("_capi_sym_infer_shape", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Sym *h = sym(handle);
+  shapes_out(h, PyTuple_GetItem(res, 0), 0, in_shape_size, in_shape_ndim,
+             in_shape_data);
+  shapes_out(h, PyTuple_GetItem(res, 1), 1, out_shape_size, out_shape_ndim,
+             out_shape_data);
+  shapes_out(h, PyTuple_GetItem(res, 2), 2, aux_shape_size, aux_shape_ndim,
+             aux_shape_data);
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 3)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  return infer_shape_impl(handle, num_args, keys, arg_ind_ptr,
+                          arg_shape_data, in_shape_size, in_shape_ndim,
+                          in_shape_data, out_shape_size, out_shape_ndim,
+                          out_shape_data, aux_shape_size, aux_shape_ndim,
+                          aux_shape_data, complete, 0);
+}
+
+int MXSymbolInferShapePartial(
+    SymbolHandle handle, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  return infer_shape_impl(handle, num_args, keys, arg_ind_ptr,
+                          arg_shape_data, in_shape_size, in_shape_ndim,
+                          in_shape_data, out_shape_size, out_shape_ndim,
+                          out_shape_data, aux_shape_size, aux_shape_ndim,
+                          aux_shape_data, complete, 1);
+}
+
+// -- executor ---------------------------------------------------------------
+
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  GIL gil;
+  PyObject *ins = PyList_New(len);
+  PyObject *grads = PyList_New(len);
+  PyObject *reqs = PyList_New(len);
+  PyObject *auxs = PyList_New(aux_states_len);
+  if (!ins || !grads || !reqs || !auxs) return fail();
+  for (mx_uint i = 0; i < len; ++i) {
+    PyObject *o = static_cast<ND *>(in_args[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+    PyObject *g = Py_None;
+    if (arg_grad_store != nullptr && arg_grad_store[i] != nullptr)
+      g = static_cast<ND *>(arg_grad_store[i])->obj;
+    Py_INCREF(g);
+    PyList_SET_ITEM(grads, i, g);
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(
+        grad_req_type ? grad_req_type[i] : 1));
+  }
+  for (mx_uint i = 0; i < aux_states_len; ++i) {
+    PyObject *o = static_cast<ND *>(aux_states[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(auxs, i, o);
+  }
+  PyObject *args = Py_BuildValue("(OiiNNNN)",
+                                 sym(symbol_handle)->obj, dev_type, dev_id,
+                                 ins, grads, reqs, auxs);
+  PyObject *res = args ? bridge("_capi_executor_bind", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Exec *h = new Exec();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(Oi)", ex(handle)->obj, is_train);
+  PyObject *res = args ? bridge("_capi_executor_forward", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  GIL gil;
+  PyObject *hg;
+  if (len == 0 || head_grads == nullptr) {
+    hg = Py_None;
+    Py_INCREF(hg);
+  } else {
+    hg = PyList_New(len);
+    if (hg == nullptr) return fail();
+    for (mx_uint i = 0; i < len; ++i) {
+      PyObject *o = static_cast<ND *>(head_grads[i])->obj;
+      Py_INCREF(o);
+      PyList_SET_ITEM(hg, i, o);
+    }
+  }
+  PyObject *args = Py_BuildValue("(ON)", ex(handle)->obj, hg);
+  PyObject *res = args ? bridge("_capi_executor_backward", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", ex(handle)->obj);
+  PyObject *res = args ? bridge("_capi_executor_outputs", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Exec *h = ex(handle);
+  h->outputs.clear();
+  Py_ssize_t n = PyList_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    ND *a = new ND();
+    a->obj = PyList_GetItem(res, i);
+    Py_INCREF(a->obj);
+    h->outputs.push_back(a);
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(h->outputs.size());
+  *out = h->outputs.empty() ? nullptr : h->outputs.data();
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  Py_XDECREF(ex(handle)->obj);
+  delete ex(handle);
+  return 0;
+}
+
+// -- NDArray save / load ----------------------------------------------------
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args_h,
+                  const char **keys) {
+  GIL gil;
+  PyObject *arrs = PyList_New(num_args);
+  PyObject *ks = keys ? PyList_New(num_args) : Py_None;
+  if (arrs == nullptr || ks == nullptr) return fail();
+  if (ks == Py_None) Py_INCREF(ks);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *o = static_cast<ND *>(args_h[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(arrs, i, o);
+    if (keys) PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+  }
+  PyObject *args = Py_BuildValue("(sNN)", fname, arrs, ks);
+  PyObject *res = args ? bridge("_capi_nd_save", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+// load storage lives for the process (the reference keeps it on a
+// thread-local ret store; a C host copies out promptly either way)
+static std::vector<std::string> *g_load_names = nullptr;
+static std::vector<const char *> *g_load_cstrs = nullptr;
+static std::vector<NDArrayHandle> *g_load_handles = nullptr;
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", fname);
+  PyObject *res = args ? bridge("_capi_nd_load", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  PyObject *names = PyTuple_GetItem(res, 0);
+  PyObject *arrs = PyTuple_GetItem(res, 1);
+  delete g_load_names;
+  delete g_load_cstrs;
+  delete g_load_handles;
+  g_load_names = new std::vector<std::string>();
+  g_load_cstrs = new std::vector<const char *>();
+  g_load_handles = new std::vector<NDArrayHandle>();
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i)
+    g_load_names->push_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+  for (const std::string &s : *g_load_names)
+    g_load_cstrs->push_back(s.c_str());
+  for (Py_ssize_t i = 0; i < PyList_Size(arrs); ++i) {
+    ND *a = new ND();
+    a->obj = PyList_GetItem(arrs, i);
+    Py_INCREF(a->obj);
+    g_load_handles->push_back(a);
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(g_load_handles->size());
+  *out_arr = g_load_handles->empty() ? nullptr : g_load_handles->data();
+  *out_name_size = static_cast<mx_uint>(g_load_names->size());
+  *out_names = g_load_cstrs->empty() ? nullptr : g_load_cstrs->data();
+  return 0;
+}
+
+}  // extern "C"

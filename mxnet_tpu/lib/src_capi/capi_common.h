@@ -11,6 +11,7 @@
 
 #include <mutex>
 #include <string>
+#include <vector>
 
 typedef unsigned int mx_uint;
 typedef float mx_float;
@@ -69,6 +70,15 @@ inline void set_error_from_python() {
   Py_XDECREF(value);
   Py_XDECREF(tb);
 }
+
+// NDArrayHandle payload shared by every C-ABI translation unit (handles
+// are allocated in one TU and freed in another — a single definition
+// here keeps delete size/layout coherent by construction)
+struct ND {
+  PyObject *obj = nullptr;           // mxnet_tpu.ndarray.NDArray
+  std::vector<mx_uint> shape;        // GetShape storage
+  std::string bytes;                 // SyncCopyToCPU staging
+};
 
 // call <module>.<fn>(*args) -> new ref or nullptr (exception set)
 inline PyObject *call_module_fn(const char *module, const char *fn,

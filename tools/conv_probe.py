@@ -90,18 +90,23 @@ def main():
         flops = 2 * BATCH * cout * ho * ho * cin * k * k
         row = {"cin": cin, "cout": cout, "hw": hw, "k": k, "s": s,
                "gflops": round(flops / 1e9, 1)}
-        for layout, lhs_spec in {"NCHW": "NCHW", "NHWC": "NHWC"}.items():
+        # weight specs mirror the framework's _conv_dnums (ops/nn.py):
+        # NCHW carries OIHW weights, NHWC carries OHWI — probing the
+        # exact dimension numbers the zoo's layout= path emits
+        for layout, kspec in {"NCHW": "OIHW", "NHWC": "OHWI"}.items():
             dn = lax.conv_dimension_numbers(
-                (1, 1, 1, 1), (1, 1, 1, 1), (lhs_spec, "OIHW", lhs_spec))
+                (1, 1, 1, 1), (1, 1, 1, 1), (layout, kspec, layout))
             if layout == "NCHW":
                 xs = (BATCH, cin, hw, hw)
                 os_ = (BATCH, cout, ho, ho)
+                ws = (cout, cin, k, k)
             else:
                 xs = (BATCH, hw, hw, cin)
                 os_ = (BATCH, ho, ho, cout)
+                ws = (cout, k, k, cin)
             x = jax.random.normal(jax.random.PRNGKey(0), xs,
                                   jnp.float32).astype(jnp.bfloat16)
-            w = jax.random.normal(jax.random.PRNGKey(1), (cout, cin, k, k),
+            w = jax.random.normal(jax.random.PRNGKey(1), ws,
                                   jnp.float32).astype(jnp.bfloat16)
 
             def conv(xx, ww, dn=dn):

@@ -78,9 +78,9 @@ def pack_native(args):
     n = fn((args.prefix + ".lst").encode(), args.root.encode(),
            (args.prefix + ".rec").encode(), (args.prefix + ".idx").encode(),
            int(args.num_thread))
-    if n == -(2 ** 63):  # INT64_MIN: file-level open/write failure
-        raise OSError("im2rec native pack: cannot open or write "
-                      "lst/rec/idx files (disk full?)")
+    if n == -(2 ** 63):  # INT64_MIN: file-level open/parse/write failure
+        raise OSError("im2rec native pack: cannot open, parse, or write "
+                      "lst/rec/idx files (malformed .lst id or full disk?)")
     if n < 0:
         raise OSError("im2rec native pack: failed reading item %d of %s.lst"
                       % (-n - 1, args.prefix))
