@@ -166,6 +166,14 @@ def test_int8_quantized_conv_on_chip():
     X = rng.uniform(-1, 1, (8, 3, 16, 16)).astype(np.float32)
 
     qsym = q.quantize_graph(sym, calib_ranges=None)
+    # the r4 passthrough pass keeps the whole chain int8 on-chip: this
+    # run is the hardware evidence for quantized act/pool/flatten +
+    # requantize, not just quantized_conv
+    qops = [n.op for n in qsym._topo() if not n.is_var]
+    for needed in ("_contrib_quantized_conv", "_contrib_quantized_act",
+                   "_contrib_quantized_pooling",
+                   "_contrib_quantized_flatten", "_contrib_requantize"):
+        assert needed in qops, (needed, qops)
     fp = sym.eval_with({**{"data": X}, **{k: v._data for k, v in params.items()}})
     qt = qsym.eval_with({**{"data": X}, **{k: v._data for k, v in params.items()}})
     err = np.abs(np.asarray(fp) - np.asarray(qt)).max()
