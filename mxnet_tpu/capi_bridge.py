@@ -308,6 +308,74 @@ def _capi_executor_arg_grads(executor):
     return list(executor.grad_arrays)
 
 
+# -- kvstore section (reference: c_api.cc MXKVStore*) -----------------------
+
+def _capi_kv_create(name):
+    from . import kvstore
+
+    return kvstore.create(name.decode() if isinstance(name, bytes) else name)
+
+
+def _capi_kv_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def _capi_kv_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=int(priority))
+
+
+def _capi_kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+
+
+def _capi_kv_type(kv):
+    return kv.type
+
+
+def _capi_kv_rank(kv):
+    return int(kv.rank)
+
+
+def _capi_kv_group_size(kv):
+    return int(kv.num_workers)
+
+
+def _capi_kv_barrier(kv):
+    kv.barrier()
+
+
+def _capi_kv_set_updater(kv, fn_addr, handle_addr):
+    """Install a C updater callback: `fn_addr` is the C function pointer
+    void (*)(int key, NDArrayHandle recv, NDArrayHandle local, void*).
+    The trampoline materializes fresh C handles for each call; the C side
+    frees them via MXNDArrayFree per the reference contract."""
+    import ctypes
+
+    from .lib import native
+
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                          ctypes.c_void_p, ctypes.c_void_p)
+    cb = CB(fn_addr)
+    lib = native.get_capi()
+    lib.mxtpu_capi_wrap_handle.restype = ctypes.c_void_p
+    lib.mxtpu_capi_wrap_handle.argtypes = [ctypes.py_object]
+    lib.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+
+    def updater(key, recv, local):
+        # hand the C callback real NDArrayHandles: heap structs whose
+        # first member is the PyObject*, made on the C side to keep one
+        # allocator for new/delete. Ownership follows the reference
+        # MXKVStoreUpdater contract: the UPDATER frees recv and local
+        # (c_api.h: "It's this updater's responsibility to delete recv
+        # and local") — the trampoline must NOT free them too.
+        hr = lib.mxtpu_capi_wrap_handle(ctypes.py_object(recv))
+        hl = lib.mxtpu_capi_wrap_handle(ctypes.py_object(local))
+        cb(int(key), hr, hl, handle_addr)
+
+    kv._capi_updater = updater  # keep the CFUNCTYPE alive
+    kv.set_updater(updater)
+
+
 # -- NDArray save/load (reference: c_api.cc MXNDArraySave/Load) -------------
 
 def _capi_nd_save(fname, arrays, keys):

@@ -133,6 +133,29 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                   NDArrayHandle **out_arr, mx_uint *out_name_size,
                   const char ***out_names);
 
+/* -- kvstore (c_api_kvstore.cc; reference c_api.h MXKVStore block).
+ * Per the reference MXKVStoreUpdater contract, the updater callback
+ * OWNS the recv/local handles it receives and must free them with
+ * MXNDArrayFree before returning. */
+typedef void *KVStoreHandle;
+typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                NDArrayHandle local, void *handle);
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+int MXKVStoreBarrier(KVStoreHandle handle);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,199 @@
+// KVStore section of the flat C ABI (reference: include/mxnet/c_api.h
+// MXKVStore*, implemented by src/c_api/c_api.cc). Covers the classic
+// data-parallel C workflow: create a store, init/push/pull keyed arrays,
+// install a C updater callback, query rank/size, barrier.
+//
+// Handle model mirrors the other TUs: KVStoreHandle owns a Python
+// mxnet_tpu.kvstore.KVStore. The updater callback crosses C -> Python ->
+// C: MXKVStoreSetUpdater hands the function pointer (as uintptr) to the
+// bridge, which wraps it with ctypes and re-materializes NDArrayHandles
+// per call via mxtpu_capi_wrap_handle below.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "capi_common.h"
+
+typedef void *NDArrayHandle;
+typedef void *KVStoreHandle;
+typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                NDArrayHandle local, void *handle);
+
+namespace {
+
+using mxtpu_capi::GIL;
+using mxtpu_capi::ND;
+using mxtpu_capi::g_last_error;
+using mxtpu_capi::set_error_from_python;
+
+PyObject *bridge(const char *fn, PyObject *args) {
+  return mxtpu_capi::call_module_fn("mxnet_tpu.capi_bridge", fn, args);
+}
+
+struct KV {
+  PyObject *obj = nullptr;   // mxnet_tpu.kvstore.KVStore
+  std::string type_storage;  // GetType return storage
+};
+
+KV *kv(KVStoreHandle h) { return static_cast<KV *>(h); }
+
+int fail() {
+  set_error_from_python();
+  return -1;
+}
+
+// (keys_as_ints, nd_handles) -> (PyList[int], PyList[NDArray]) pair
+int key_val_lists(mx_uint num, const int *keys, NDArrayHandle *vals,
+                  PyObject **out_keys, PyObject **out_vals) {
+  PyObject *ks = PyList_New(num);
+  PyObject *vs = PyList_New(num);
+  if (ks == nullptr || vs == nullptr) return -1;
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SET_ITEM(ks, i, PyLong_FromLong(keys[i]));
+    PyObject *o = static_cast<ND *>(vals[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(vs, i, o);
+  }
+  *out_keys = ks;
+  *out_vals = vs;
+  return 0;
+}
+
+// int-returning bridge call with one KVStore argument
+int kv_int_fn(const char *fn, KVStoreHandle handle, int *out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", kv(handle)->obj);
+  PyObject *res = args ? bridge(fn, args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// wrap a live Python NDArray (borrowed ref from the caller) into a fresh
+// C handle — used by the bridge's updater trampoline; freed by the C
+// host via MXNDArrayFree like any other handle
+NDArrayHandle mxtpu_capi_wrap_handle(PyObject *obj) {
+  ND *h = new ND();
+  Py_INCREF(obj);
+  h->obj = obj;
+  return h;
+}
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", type ? type : "local");
+  PyObject *res = args ? bridge("_capi_kv_create", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  KV *h = new KV();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  Py_XDECREF(kv(handle)->obj);
+  delete kv(handle);
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  GIL gil;
+  PyObject *ks = nullptr, *vs = nullptr;
+  if (key_val_lists(num, keys, vals, &ks, &vs) != 0) return fail();
+  PyObject *args = Py_BuildValue("(ONN)", kv(handle)->obj, ks, vs);
+  PyObject *res = args ? bridge("_capi_kv_init", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  GIL gil;
+  PyObject *ks = nullptr, *vs = nullptr;
+  if (key_val_lists(num, keys, vals, &ks, &vs) != 0) return fail();
+  PyObject *args = Py_BuildValue("(ONNi)", kv(handle)->obj, ks, vs,
+                                 priority);
+  PyObject *res = args ? bridge("_capi_kv_push", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  GIL gil;
+  PyObject *ks = nullptr, *vs = nullptr;
+  if (key_val_lists(num, keys, vals, &ks, &vs) != 0) return fail();
+  PyObject *args = Py_BuildValue("(ONNi)", kv(handle)->obj, ks, vs,
+                                 priority);
+  PyObject *res = args ? bridge("_capi_kv_pull", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  GIL gil;
+  PyObject *args = Py_BuildValue(
+      "(OKK)", kv(handle)->obj,
+      static_cast<unsigned long long>(
+          reinterpret_cast<uintptr_t>(updater)),
+      static_cast<unsigned long long>(
+          reinterpret_cast<uintptr_t>(updater_handle)));
+  PyObject *res = args ? bridge("_capi_kv_set_updater", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", kv(handle)->obj);
+  PyObject *res = args ? bridge("_capi_kv_type", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  const char *s = PyUnicode_AsUTF8(res);
+  kv(handle)->type_storage = s ? s : "";
+  Py_DECREF(res);
+  *type = kv(handle)->type_storage.c_str();
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank) {
+  return kv_int_fn("_capi_kv_rank", handle, rank);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size) {
+  return kv_int_fn("_capi_kv_group_size", handle, size);
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", kv(handle)->obj);
+  PyObject *res = args ? bridge("_capi_kv_barrier", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"

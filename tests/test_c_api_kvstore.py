@@ -1,0 +1,144 @@
+"""KVStore section of the flat C ABI: create/init/push/pull, rank/size/
+type/barrier, and the C updater callback (the data-parallel C workflow,
+reference c_api.h MXKVStore*). The callback crosses C -> Python -> C with
+fresh NDArrayHandles per call."""
+import ctypes
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.lib import native
+
+
+def _capi():
+    lib = native.get_capi()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    c = ctypes
+    lib.MXGetLastError.restype = c.c_char_p
+    lib.MXNDArrayCreateEx.argtypes = [
+        c.POINTER(c.c_uint), c.c_uint, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.POINTER(c.c_void_p)]
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_size_t]
+    lib.MXNDArrayFree.argtypes = [c.c_void_p]
+    lib.MXKVStoreCreate.argtypes = [c.c_char_p, c.POINTER(c.c_void_p)]
+    lib.MXKVStoreFree.argtypes = [c.c_void_p]
+    lib.MXKVStoreInit.argtypes = [c.c_void_p, c.c_uint,
+                                  c.POINTER(c.c_int),
+                                  c.POINTER(c.c_void_p)]
+    lib.MXKVStorePush.argtypes = [c.c_void_p, c.c_uint,
+                                  c.POINTER(c.c_int),
+                                  c.POINTER(c.c_void_p), c.c_int]
+    lib.MXKVStorePull.argtypes = lib.MXKVStorePush.argtypes
+    lib.MXKVStoreGetType.argtypes = [c.c_void_p, c.POINTER(c.c_char_p)]
+    lib.MXKVStoreGetRank.argtypes = [c.c_void_p, c.POINTER(c.c_int)]
+    lib.MXKVStoreGetGroupSize.argtypes = lib.MXKVStoreGetRank.argtypes
+    lib.MXKVStoreBarrier.argtypes = [c.c_void_p]
+    return lib
+
+
+def _ok(rc, lib):
+    assert rc == 0, lib.MXGetLastError().decode()
+
+
+def _create_nd(lib, arr):
+    shape = (ctypes.c_uint * arr.ndim)(*arr.shape)
+    h = ctypes.c_void_p()
+    _ok(lib.MXNDArrayCreateEx(shape, arr.ndim, 1, 0, 0, 0,
+                              ctypes.byref(h)), lib)
+    buf = np.ascontiguousarray(arr.astype(np.float32))
+    _ok(lib.MXNDArraySyncCopyFromCPU(h, buf.ctypes.data, buf.size), lib)
+    return h
+
+
+def _to_numpy(lib, h, shape):
+    out = np.empty(shape, np.float32)
+    _ok(lib.MXNDArraySyncCopyToCPU(h, out.ctypes.data,
+                                   int(np.prod(shape))), lib)
+    return out
+
+
+def test_kvstore_create_push_pull():
+    lib = _capi()
+    h = ctypes.c_void_p()
+    _ok(lib.MXKVStoreCreate(b"local", ctypes.byref(h)), lib)
+    t = ctypes.c_char_p()
+    _ok(lib.MXKVStoreGetType(h, ctypes.byref(t)), lib)
+    assert t.value == b"local"
+    rank, size = ctypes.c_int(), ctypes.c_int()
+    _ok(lib.MXKVStoreGetRank(h, ctypes.byref(rank)), lib)
+    _ok(lib.MXKVStoreGetGroupSize(h, ctypes.byref(size)), lib)
+    assert rank.value == 0 and size.value == 1
+    _ok(lib.MXKVStoreBarrier(h), lib)
+
+    init_v = _create_nd(lib, np.zeros(4))
+    keys = (ctypes.c_int * 1)(3)
+    vals = (ctypes.c_void_p * 1)(init_v.value)
+    _ok(lib.MXKVStoreInit(h, 1, keys, vals), lib)
+
+    # push without an updater: aggregate replaces the stored value
+    push_v = _create_nd(lib, np.arange(4, dtype=np.float32))
+    vals = (ctypes.c_void_p * 1)(push_v.value)
+    _ok(lib.MXKVStorePush(h, 1, keys, vals, 0), lib)
+
+    out = _create_nd(lib, np.zeros(4))
+    vals = (ctypes.c_void_p * 1)(out.value)
+    _ok(lib.MXKVStorePull(h, 1, keys, vals, 0), lib)
+    np.testing.assert_allclose(_to_numpy(lib, out, (4,)),
+                               np.arange(4, dtype=np.float32))
+    for v in (init_v, push_v, out):
+        lib.MXNDArrayFree(v)
+    _ok(lib.MXKVStoreFree(h), lib)
+
+
+def test_kvstore_c_updater_callback():
+    """An SGD-style updater installed through the C contract: the callback
+    reads recv/local through the C handle API and writes local back."""
+    lib = _capi()
+    c = ctypes
+    CB = c.CFUNCTYPE(None, c.c_int, c.c_void_p, c.c_void_p, c.c_void_p)
+    lib.MXKVStoreSetUpdater.argtypes = [c.c_void_p, CB, c.c_void_p]
+
+    h = c.c_void_p()
+    _ok(lib.MXKVStoreCreate(b"local", c.byref(h)), lib)
+
+    calls = []
+
+    @CB
+    def updater(key, recv, local, handle):
+        r = _to_numpy(lib, c.c_void_p(recv), (4,))
+        l = _to_numpy(lib, c.c_void_p(local), (4,))
+        new = np.ascontiguousarray(l - 0.5 * r)
+        lib.MXNDArraySyncCopyFromCPU(c.c_void_p(local), new.ctypes.data,
+                                     new.size)
+        calls.append(int(key))
+        # the reference contract: the updater owns and frees its handles
+        lib.MXNDArrayFree(c.c_void_p(recv))
+        lib.MXNDArrayFree(c.c_void_p(local))
+
+    _ok(lib.MXKVStoreSetUpdater(h, updater, None), lib)
+
+    w0 = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    grad = np.array([2.0, 2.0, 2.0, 2.0], np.float32)
+    init_v = _create_nd(lib, w0)
+    keys = (c.c_int * 1)(9)
+    vals = (c.c_void_p * 1)(init_v.value)
+    _ok(lib.MXKVStoreInit(h, 1, keys, vals), lib)
+
+    gv = _create_nd(lib, grad)
+    vals = (c.c_void_p * 1)(gv.value)
+    _ok(lib.MXKVStorePush(h, 1, keys, vals, 0), lib)
+    assert calls == [9]
+
+    out = _create_nd(lib, np.zeros(4))
+    vals = (c.c_void_p * 1)(out.value)
+    _ok(lib.MXKVStorePull(h, 1, keys, vals, 0), lib)
+    np.testing.assert_allclose(_to_numpy(lib, out, (4,)), w0 - 0.5 * grad)
+
+    for v in (init_v, gv, out):
+        lib.MXNDArrayFree(v)
+    _ok(lib.MXKVStoreFree(h), lib)
