@@ -376,6 +376,70 @@ def _capi_kv_set_updater(kv, fn_addr, handle_addr):
     kv.set_updater(updater)
 
 
+# -- data-iterator section (reference: c_api.cc MXDataIter*) ----------------
+# A DataIterCreator handle is an interned iterator-name string (the same
+# scheme as op creators); an iterator handle owns the Python DataIter
+# plus its current batch.
+
+# the file-fed iterators (the reference's C creators are the compiled
+# file-based ones; NDArrayIter is a Python-side construct there too)
+_DATA_ITERS = ("MNISTIter", "CSVIter", "LibSVMIter", "ImageRecordIter")
+
+
+def _capi_list_data_iters():
+    return list(_DATA_ITERS)
+
+
+def _capi_iter_create(name, keys, vals):
+    from . import io
+
+    name = name.decode() if isinstance(name, bytes) else name
+    if name not in _DATA_ITERS:
+        raise ValueError("unknown data iter %r (have %s)"
+                         % (name, ", ".join(_DATA_ITERS)))
+    kwargs = {k.decode() if isinstance(k, bytes) else k: _parse_attr(v)
+              for k, v in zip(keys, vals)}
+    it = getattr(io, name)(**kwargs)
+    return {"iter": iter(it), "src": it, "batch": None}
+
+
+def _capi_iter_next(state):
+    try:
+        state["batch"] = next(state["iter"])
+        return 1
+    except StopIteration:
+        state["batch"] = None
+        return 0
+
+
+def _capi_iter_before_first(state):
+    state["src"].reset()
+    state["iter"] = iter(state["src"])
+    state["batch"] = None
+
+
+def _batch(state):
+    b = state["batch"]
+    if b is None:
+        raise ValueError("no current batch: call MXDataIterNext first")
+    return b
+
+
+def _capi_iter_get_data(state):
+    return _batch(state).data[0]
+
+
+def _capi_iter_get_label(state):
+    b = _batch(state)
+    if not b.label:
+        raise ValueError("batch carries no label")
+    return b.label[0]
+
+
+def _capi_iter_get_pad(state):
+    return int(_batch(state).pad or 0)
+
+
 # -- NDArray save/load (reference: c_api.cc MXNDArraySave/Load) -------------
 
 def _capi_nd_save(fname, arrays, keys):

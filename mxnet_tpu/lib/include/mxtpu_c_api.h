@@ -156,6 +156,28 @@ int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
 int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
 int MXKVStoreBarrier(KVStoreHandle handle);
 
+/* -- data iterators (c_api_io.cc; reference c_api.h MXDataIter block).
+ * Creator handles are interned iterator-name strings. GetData/GetLabel
+ * return fresh handles onto the CURRENT batch (caller frees). */
+typedef void *DataIterHandle;
+typedef void *DataIterCreator;
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
 #ifdef __cplusplus
 }
 #endif

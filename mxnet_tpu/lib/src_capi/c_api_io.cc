@@ -1,0 +1,171 @@
+// Data-iterator section of the flat C ABI (reference: include/mxnet/
+// c_api.h MXDataIter*, implemented by src/c_api/c_api.cc over the IO
+// registry). Creator handles are interned iterator-name strings, the
+// same scheme the op creators use; an iterator handle owns the Python
+// DataIter plus its current batch.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <string>
+#include <vector>
+
+#include "capi_common.h"
+
+typedef void *NDArrayHandle;
+typedef void *DataIterHandle;
+typedef void *DataIterCreator;
+
+namespace {
+
+using mxtpu_capi::GIL;
+using mxtpu_capi::ND;
+using mxtpu_capi::g_last_error;
+using mxtpu_capi::set_error_from_python;
+
+PyObject *bridge(const char *fn, PyObject *args) {
+  return mxtpu_capi::call_module_fn("mxnet_tpu.capi_bridge", fn, args);
+}
+
+struct It {
+  PyObject *obj = nullptr;  // bridge iterator state dict
+};
+
+It *it(DataIterHandle h) { return static_cast<It *>(h); }
+
+int fail() {
+  set_error_from_python();
+  return -1;
+}
+
+// process-lifetime creator-name storage (mirrors c_api.cc op creators)
+std::vector<std::string> *g_iter_names = nullptr;
+std::vector<void *> *g_iter_creators = nullptr;
+
+int ensure_iter_names() {
+  GIL gil;
+  if (g_iter_names != nullptr) return 0;
+  PyObject *res = bridge("_capi_list_data_iters", nullptr);
+  if (res == nullptr) return fail();
+  auto *names = new std::vector<std::string>();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i)
+    names->push_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
+  Py_DECREF(res);
+  auto *creators = new std::vector<void *>();
+  for (std::string &s : *names)
+    creators->push_back(const_cast<char *>(s.c_str()));
+  g_iter_creators = creators;
+  g_iter_names = names;  // publish last
+  return 0;
+}
+
+// a batch-array getter returning a fresh NDArrayHandle
+int nd_getter(const char *fn, DataIterHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", it(handle)->obj);
+  PyObject *res = args ? bridge(fn, args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  ND *h = new ND();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array) {
+  if (ensure_iter_names() != 0) return -1;
+  *out_size = static_cast<mx_uint>(g_iter_creators->size());
+  *out_array = g_iter_creators->data();
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  *name = static_cast<const char *>(creator);
+  if (description) *description = "";
+  // per-arg metadata is introspectable from Python (help()); the C
+  // surface reports none, like several reference iterators do
+  if (num_args) *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  GIL gil;
+  PyObject *ks = PyList_New(num_param);
+  PyObject *vs = PyList_New(num_param);
+  if (ks == nullptr || vs == nullptr) return fail();
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(vs, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *args = Py_BuildValue(
+      "(sNN)", static_cast<const char *>(creator), ks, vs);
+  PyObject *res = args ? bridge("_capi_iter_create", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  It *h = new It();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  Py_XDECREF(it(handle)->obj);
+  delete it(handle);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", it(handle)->obj);
+  PyObject *res = args ? bridge("_capi_iter_next", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", it(handle)->obj);
+  PyObject *res = args ? bridge("_capi_iter_before_first", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  return nd_getter("_capi_iter_get_data", handle, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  return nd_getter("_capi_iter_get_label", handle, out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", it(handle)->obj);
+  PyObject *res = args ? bridge("_capi_iter_get_pad", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  *pad = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"

@@ -82,6 +82,7 @@ extern "C" {
 // C handle — used by the bridge's updater trampoline; freed by the C
 // host via MXNDArrayFree like any other handle
 NDArrayHandle mxtpu_capi_wrap_handle(PyObject *obj) {
+  GIL gil;  // ctypes releases the GIL around foreign calls
   ND *h = new ND();
   Py_INCREF(obj);
   h->obj = obj;
@@ -172,7 +173,11 @@ int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
   Py_XDECREF(args);
   if (res == nullptr) return fail();
   const char *s = PyUnicode_AsUTF8(res);
-  kv(handle)->type_storage = s ? s : "";
+  if (s == nullptr) {  // non-str .type: report, don't leave the
+    Py_DECREF(res);    // exception pending for an innocent later call
+    return fail();
+  }
+  kv(handle)->type_storage = s;
   Py_DECREF(res);
   *type = kv(handle)->type_storage.c_str();
   return 0;
