@@ -32,6 +32,10 @@ parser.add_argument("--vocab-size", type=int, default=40,
                     help="synthetic corpus vocabulary size")
 parser.add_argument("--sentences", type=int, default=200,
                     help="synthetic corpus size")
+parser.add_argument("--buckets", type=str, default="8,12,16,20",
+                    help="comma-separated bucket lengths (each bucket is "
+                         "one compiled executable; fewer buckets = faster "
+                         "CI smoke)")
 
 
 def synthetic_corpus(rs, n_sentences, vocab_size):
@@ -60,7 +64,7 @@ def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
 
 def main():
     args = parser.parse_args()
-    buckets = [8, 12, 16, 20]
+    buckets = [int(b) for b in args.buckets.split(",")]
     start_label = 1
     invalid_label = 0
 

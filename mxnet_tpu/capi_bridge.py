@@ -112,14 +112,20 @@ def _parse_attr(val):
         return s
 
 
+def _parse_attrs(keys, vals):
+    """One parsing site for every C-ABI (keys, vals) string-attr pair
+    (invoke, symbol creation, iterator creation)."""
+    return {k.decode() if isinstance(k, bytes) else k: _parse_attr(v)
+            for k, v in zip(keys, vals)}
+
+
 def _capi_invoke(op_name, inputs, keys, vals, outs=None):
     """MXImperativeInvoke core: op by name, NDArray inputs, string attrs.
     With `outs` (the reference's in-place contract) results are written
     into the given arrays; returns a list of output NDArrays either way."""
     from .ndarray import invoke
 
-    attrs = {k.decode() if isinstance(k, bytes) else k: _parse_attr(v)
-             for k, v in zip(keys, vals)}
+    attrs = _parse_attrs(keys, vals)
     out = invoke(op_name, tuple(inputs), attrs,
                  out=list(outs) if outs is not None else None)
     return list(out) if isinstance(out, (list, tuple)) else [out]
@@ -189,9 +195,7 @@ def _capi_sym_create_variable(name):
 
 
 def _capi_sym_create_atomic(op_name, keys, vals):
-    attrs = {k.decode() if isinstance(k, bytes) else k: _parse_attr(v)
-             for k, v in zip(keys, vals)}
-    return _SymRec(op=op_name, attrs=attrs)
+    return _SymRec(op=op_name, attrs=_parse_attrs(keys, vals))
 
 
 def _capi_sym_compose(rec, name, keys, args):
@@ -397,9 +401,7 @@ def _capi_iter_create(name, keys, vals):
     if name not in _DATA_ITERS:
         raise ValueError("unknown data iter %r (have %s)"
                          % (name, ", ".join(_DATA_ITERS)))
-    kwargs = {k.decode() if isinstance(k, bytes) else k: _parse_attr(v)
-              for k, v in zip(keys, vals)}
-    it = getattr(io, name)(**kwargs)
+    it = getattr(io, name)(**_parse_attrs(keys, vals))
     return {"iter": iter(it), "src": it, "batch": None}
 
 

@@ -238,6 +238,10 @@ class Block:
 
 _TRACING = threading.local()
 
+# marks an NDArray slot in a cached trace's static-arg skeleton; a unique
+# object so literal-None arguments can never be mistaken for a slot
+_ARRAY_SLOT = object()
+
 
 def _is_tracing():
     return getattr(_TRACING, "flag", False)
@@ -404,7 +408,11 @@ class HybridBlock(Block):
             if isinstance(a, NDArray):
                 arg_ctx = a.context
                 break
-        static_args = [a if not isinstance(a, NDArray) else None for a in args]
+        # dedicated placeholder sentinel: a literal None ARGUMENT (e.g. an
+        # optional mask passed as None) must not collide with the
+        # array-slot marker, or the trace consumes one array too many
+        _slot = _ARRAY_SLOT
+        static_args = [_slot if isinstance(a, NDArray) else a for a in args]
         block = self
 
         def traced(key, arg_arrays, param_arrays):
@@ -415,8 +423,8 @@ class HybridBlock(Block):
                 for p, arr in zip(param_nds, param_arrays):
                     p._data = arr
                 arg_it = iter(arg_arrays)
-                call_args = [a if a is not None else NDArray(next(arg_it), ctx=arg_ctx)
-                             for a in static_args]
+                call_args = [NDArray(next(arg_it), ctx=arg_ctx)
+                             if a is _slot else a for a in static_args]
                 # enter the args' ctx during the trace: fresh arrays created
                 # mid-forward (arange position ids, masks) must carry it, or
                 # sub-blocks fed by them fetch params on the ambient default

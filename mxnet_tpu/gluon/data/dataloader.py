@@ -25,6 +25,7 @@ feeding shared-memory NDArrays. TPU-native equivalent:
 """
 from __future__ import annotations
 
+import os
 import pickle
 import queue
 import threading
@@ -175,12 +176,17 @@ def _worker_probe():
         return False
 
 
-def _host_safe_probe(dataset, pool_factory, timeout=60.0):
+def _host_safe_probe(dataset, pool_factory, timeout=None):
     """True iff the dataset is picklable and one sample round-trips through
     a real worker process without producing device arrays, hanging, or
     raising. The probe runs in the worker itself (never toggling parent
     state — other threads may be decoding concurrently); a worker that
-    deadlocks on the forked jax runtime is caught by the timeout."""
+    deadlocks on the forked jax runtime is caught by the timeout
+    (MXTPU_DATALOADER_PROBE_TIMEOUT, default 20s — the legit probe path
+    touches no jax and returns in well under a second)."""
+    if timeout is None:
+        timeout = float(os.environ.get("MXTPU_DATALOADER_PROBE_TIMEOUT",
+                                       20.0))
     try:
         pickle.dumps(dataset)
     except Exception:

@@ -106,8 +106,19 @@ int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
   PyObject *vs = PyList_New(num_param);
   if (ks == nullptr || vs == nullptr) return fail();
   for (mx_uint i = 0; i < num_param; ++i) {
-    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
-    PyList_SET_ITEM(vs, i, PyUnicode_FromString(vals[i]));
+    // surrogateescape round-trips non-UTF-8 bytes (Linux paths are not
+    // guaranteed UTF-8); a NULL in the list would crash the bridge
+    PyObject *k = PyUnicode_DecodeFSDefault(keys[i]);
+    PyObject *v = PyUnicode_DecodeFSDefault(vals[i]);
+    if (k == nullptr || v == nullptr) {
+      Py_XDECREF(k);
+      Py_XDECREF(v);
+      Py_DECREF(ks);
+      Py_DECREF(vs);
+      return fail();
+    }
+    PyList_SET_ITEM(ks, i, k);
+    PyList_SET_ITEM(vs, i, v);
   }
   PyObject *args = Py_BuildValue(
       "(sNN)", static_cast<const char *>(creator), ks, vs);

@@ -24,7 +24,7 @@ EXAMPLES = [
     ("rnn/word_lm.py",
      ["--epochs", "1", "--vocab", "80", "--limit-batches", "8"], []),
     ("rnn/lstm_bucketing.py",
-     ["--num-epochs", "1", "--sentences", "96"], []),
+     ["--num-epochs", "1", "--sentences", "96", "--buckets", "8,16"], []),
     ("ssd/train.py",
      ["--epochs", "1", "--batch-size", "4", "--samples", "16"], []),
     ("rcnn/train.py",

@@ -318,3 +318,24 @@ def test_model_zoo_construction():
         x = mx.nd.random.uniform(shape=(1, 3, 224, 224))
         out = net(x)
         assert out.shape == (1, 10), name
+
+
+def test_hybridize_literal_none_argument():
+    """A literal None argument (optional mask idiom) must not be mistaken
+    for an array slot in the cached trace — regression: BERT-style
+    attention(q, k, v, None) raised StopIteration on the compiled path."""
+    from mxnet_tpu import gluon
+
+    class M(gluon.HybridBlock):
+        def hybrid_forward(self, F, x, mask=None):
+            return x * 2 if mask is None else x * mask
+
+    net = M()
+    net.hybridize()
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    out = net(x, None)
+    np.testing.assert_allclose(out.asnumpy(), 2 * np.ones((2, 3)))
+    # and the masked signature still compiles separately
+    m = mx.nd.array(np.full((2, 3), 3.0, np.float32))
+    np.testing.assert_allclose(net(x, m).asnumpy(), 3 * np.ones((2, 3)))
+    np.testing.assert_allclose(net(x, None).asnumpy(), 2 * np.ones((2, 3)))

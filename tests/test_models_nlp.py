@@ -65,6 +65,8 @@ def test_bert_trains():
     head = gluon.nn.Dense(2)
     net.initialize(ctx=mx.cpu())
     head.initialize(ctx=mx.cpu())
+    net.hybridize()  # compiled forward keeps the 60-step fit cheap
+    head.hybridize()
     params = gluon.ParameterDict()
     params.update(net.collect_params())
     params.update(head.collect_params())
@@ -94,6 +96,7 @@ def test_word_lm_trains():
     lm = word_lm.RNNModel(vocab_size=V, embed_size=32, hidden_size=32,
                           num_layers=1, dropout=0.0)
     lm.initialize(ctx=mx.cpu())
+    lm.hybridize()  # compiled forward keeps the 120-step fit cheap
     trainer = gluon.Trainer(lm.collect_params(), "adam",
                             {"learning_rate": 1e-2})
     lossfn = gluon.loss.SoftmaxCrossEntropyLoss()

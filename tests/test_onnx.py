@@ -11,6 +11,8 @@ protobuf shim's wire format is independently validated with protoc.
 import shutil
 import subprocess
 
+import os
+
 import numpy as np
 import pytest
 
@@ -57,10 +59,17 @@ _FAMILIES = [
     ("mobilenet_v2_0_25", (1, 3, 32, 32)),
 ]
 
+# the two slowest graphs (~80s combined) ride the FULL gate; every family
+# keeps a default-run member (CI budget, VERDICT r3 #8)
+_SLOW_FAMILIES = {"densenet121", "inception_v3"}
+
 
 @pytest.mark.parametrize("name,shape", _FAMILIES,
                          ids=[f[0] for f in _FAMILIES])
 def test_model_zoo_roundtrip(name, shape, tmp_path):
+    if name in _SLOW_FAMILIES and \
+            not os.environ.get("MXTPU_TEST_EXAMPLES_FULL"):
+        pytest.skip("slow zoo family — set MXTPU_TEST_EXAMPLES_FULL=1")
     mx.random.seed(11)
     net = getattr(vision, name)()
     net.initialize(mx.init.Xavier())
