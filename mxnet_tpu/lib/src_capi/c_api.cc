@@ -299,8 +299,13 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
     PyList_SET_ITEM(ins, i, o);
   }
   for (int i = 0; i < num_params; ++i) {
-    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
-    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+    if (!mxtpu_capi::set_str_item(keys, i, param_keys[i]) ||
+        !mxtpu_capi::set_str_item(vals, i, param_vals[i])) {
+      Py_DECREF(keys);
+      Py_DECREF(vals);
+      set_error_from_python();
+      return -1;
+    }
   }
   // reference in-place contract: a non-null *outputs with *num_outputs>0
   // means the caller provides preallocated arrays the op writes into

@@ -142,8 +142,12 @@ int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
   PyObject *vs = PyList_New(num_param);
   if (ks == nullptr || vs == nullptr) return fail();
   for (mx_uint i = 0; i < num_param; ++i) {
-    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
-    PyList_SET_ITEM(vs, i, PyUnicode_FromString(vals[i]));
+    if (!mxtpu_capi::set_str_item(ks, i, keys[i]) ||
+        !mxtpu_capi::set_str_item(vs, i, vals[i])) {
+      Py_DECREF(ks);
+      Py_DECREF(vs);
+      return fail();
+    }
   }
   PyObject *args = Py_BuildValue("(sNN)",
                                  static_cast<const char *>(creator), ks, vs);
@@ -169,8 +173,11 @@ int MXSymbolCompose(SymbolHandle handle, const char *name, mx_uint num_args,
   PyObject *ins = PyList_New(num_args);
   if (ks == nullptr || ins == nullptr) return fail();
   for (mx_uint i = 0; i < num_args; ++i) {
-    if (n_keyed != 0)
-      PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    if (n_keyed != 0 && !mxtpu_capi::set_str_item(ks, i, keys[i])) {
+      Py_DECREF(ks);
+      Py_DECREF(ins);
+      return fail();
+    }
     PyObject *o = sym(args_handles[i])->obj;
     Py_INCREF(o);
     PyList_SET_ITEM(ins, i, o);
@@ -297,8 +304,12 @@ static int infer_shape_impl(
   PyObject *shps = PyList_New(num_args);
   if (ks == nullptr || shps == nullptr) return fail();
   for (mx_uint i = 0; i < num_args; ++i) {
-    PyList_SET_ITEM(ks, i, PyUnicode_FromString(
-        (keys != nullptr && keys[i] != nullptr) ? keys[i] : ""));
+    if (!mxtpu_capi::set_str_item(
+            ks, i, (keys != nullptr && keys[i] != nullptr) ? keys[i] : "")) {
+      Py_DECREF(ks);
+      Py_DECREF(shps);
+      return fail();
+    }
     mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
     PyObject *t = PyList_New(hi - lo);
     for (mx_uint d = lo; d < hi; ++d)
@@ -474,7 +485,11 @@ int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args_h,
     PyObject *o = static_cast<ND *>(args_h[i])->obj;
     Py_INCREF(o);
     PyList_SET_ITEM(arrs, i, o);
-    if (keys) PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    if (keys && !mxtpu_capi::set_str_item(ks, i, keys[i])) {
+      Py_DECREF(arrs);
+      Py_DECREF(ks);
+      return fail();
+    }
   }
   PyObject *args = Py_BuildValue("(sNN)", fname, arrs, ks);
   PyObject *res = args ? bridge("_capi_nd_save", args) : nullptr;

@@ -99,8 +99,13 @@ int create_impl(const char *symbol_json_str, const void *param_bytes,
   PyObject *outputs;
   if (num_output_nodes > 0) {
     outputs = PyList_New(num_output_nodes);
-    for (mx_uint i = 0; i < num_output_nodes; ++i)
-      PyList_SET_ITEM(outputs, i, PyUnicode_FromString(output_keys[i]));
+    for (mx_uint i = 0; i < num_output_nodes; ++i) {
+      if (!mxtpu_capi::set_str_item(outputs, i, output_keys[i])) {
+        Py_DECREF(outputs);
+        set_error_from_python();
+        return -1;
+      }
+    }
   } else {
     outputs = Py_None;
     Py_INCREF(outputs);

@@ -80,6 +80,18 @@ struct ND {
   std::string bytes;                 // SyncCopyToCPU staging
 };
 
+// C string -> Python str via the filesystem default codec
+// (surrogateescape round-trips non-UTF-8 bytes — Linux paths and op
+// attr values are NOT guaranteed UTF-8; a raw PyUnicode_FromString NULL
+// stored into a list crashes the next traversal instead of erroring).
+// Appends into `list` at `i`; false with the Python error set on failure.
+inline bool set_str_item(PyObject *list, Py_ssize_t i, const char *s) {
+  PyObject *u = PyUnicode_DecodeFSDefault(s != nullptr ? s : "");
+  if (u == nullptr) return false;
+  PyList_SET_ITEM(list, i, u);
+  return true;
+}
+
 // call <module>.<fn>(*args) -> new ref or nullptr (exception set)
 inline PyObject *call_module_fn(const char *module, const char *fn,
                                 PyObject *args) {
