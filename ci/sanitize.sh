@@ -6,7 +6,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 SRC="mxnet_tpu/lib/src/recordio.cc mxnet_tpu/lib/src/bufpool.cc \
-     mxnet_tpu/lib/tests/native_tests.cc"
+     mxnet_tpu/lib/src/im2rec.cc mxnet_tpu/lib/tests/native_tests.cc"
 OUT=$(mktemp -d)
 
 echo "== ASan + UBSan =="
