@@ -312,6 +312,96 @@ def _capi_executor_arg_grads(executor):
     return list(executor.grad_arrays)
 
 
+def _capi_sym_get_name(rec):
+    name = rec.require().name
+    return (name or "", 1 if name is not None else 0)
+
+
+def _capi_sym_get_attr(rec, key):
+    key = key.decode() if isinstance(key, bytes) else key
+    val = rec.require().attr(key)
+    return (str(val) if val is not None else "",
+            1 if val is not None else 0)
+
+
+def _capi_sym_set_attr(rec, key, val):
+    from .symbol.symbol import _wrap_attr_keys
+
+    key = key.decode() if isinstance(key, bytes) else key
+    val = val.decode() if isinstance(val, bytes) else val
+    s = rec.require()
+    # user attrs store __key__-wrapped (they must never reach op kwargs)
+    # and as RAW strings — the reference MXSymbolSetAttr contract; no
+    # _parse_attr here or set/get round-trips would re-format values
+    s._outputs[0][0].attrs.update(_wrap_attr_keys({key: val}))
+
+
+def _unwrap_attr_key(k):
+    return k[2:-2] if k.startswith("__") and k.endswith("__") and len(k) > 4 \
+        else k
+
+
+def _capi_sym_list_attr(rec, shallow):
+    """Flattened [k1, v1, k2, v2, ...]; deep form prefixes descendant
+    node names as 'name$key' (reference c_api_symbolic.cc ListAttr).
+    User attrs present themselves under their unwrapped names, the form
+    the reference stores and the C host wrote."""
+    s = rec.require()
+    pairs = []
+    if shallow:
+        node = s._outputs[0][0]
+        for k, v in sorted(node.attrs.items()):
+            pairs += [_unwrap_attr_key(str(k)), str(v)]
+    else:
+        for name, attrs in sorted(s.attr_dict().items()):
+            for k, v in sorted(attrs.items()):
+                pairs += ["%s$%s" % (name, _unwrap_attr_key(str(k))),
+                          str(v)]
+    return pairs
+
+
+def _capi_atomic_symbol_info(op_name):
+    """(description, arg_names, arg_type_infos, arg_descriptions,
+    key_var_num_args) derived from the generated op function's
+    caller-facing signature (reference reads dmlc::Parameter reflection;
+    here the signature IS the parameter surface)."""
+    import inspect
+
+    from . import ndarray as nd
+
+    op_name = op_name.decode() if isinstance(op_name, bytes) else op_name
+    from . import ops
+
+    opdef = ops.get(op_name)
+    fn = opdef.fn  # the raw op fn carries the real parameter surface
+    doc = (getattr(getattr(nd, op_name, None), "__doc__", None)
+           or fn.__doc__ or "").strip()
+    names, types = [], []
+    has_varargs = False
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+        if opdef.needs_rng and params:
+            params = params[1:]  # the PRNG key is runtime-injected
+        for p in params:
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                has_varargs = True
+                continue
+            if p.kind == inspect.Parameter.VAR_KEYWORD:
+                continue
+            names.append(p.name)
+            types.append("" if p.default is inspect.Parameter.empty
+                         else "optional, default=%r" % (p.default,))
+    except (TypeError, ValueError):
+        pass
+    # the reference's key_var_num_args is the COUNT parameter's name
+    # (hosts pass {num_args: N} when composing variadic ops), not the
+    # *args name itself
+    var_args = ""
+    if has_varargs:
+        var_args = "num_args" if "num_args" in names else ""
+    return (doc, names, types, [""] * len(names), var_args)
+
+
 # -- kvstore section (reference: c_api.cc MXKVStore*) -----------------------
 
 def _capi_kv_create(name):

@@ -90,6 +90,22 @@ int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
                         const char ***out_str_array);
 int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
                                 const char ***out_str_array);
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success);
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                    const char *value);
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out);  /* 2*out_size strings (k,v,...) */
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out);
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type);
 int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
 int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
 int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,

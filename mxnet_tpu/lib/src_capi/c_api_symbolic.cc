@@ -15,6 +15,7 @@
 #include <Python.h>
 
 #include <cstring>
+#include <map>
 #include <string>
 #include <vector>
 
@@ -287,6 +288,153 @@ int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
   PyObject *res = args ? bridge("_capi_sym_from_json", args) : nullptr;
   Py_XDECREF(args);
   return sym_out(res, out);
+}
+
+// -- name / attributes ------------------------------------------------------
+
+// (out, success) string getter sharing the handle's json storage slot
+static int str_success_fn(const char *fn, SymbolHandle handle,
+                          const char *key, const char **out, int *success) {
+  GIL gil;
+  PyObject *args = key
+      ? Py_BuildValue("(Os)", sym(handle)->obj, key)
+      : Py_BuildValue("(O)", sym(handle)->obj);
+  PyObject *res = args ? bridge(fn, args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  const char *s = PyUnicode_AsUTF8(PyTuple_GetItem(res, 0));
+  int ok = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  if (s == nullptr) {
+    Py_DECREF(res);
+    return fail();
+  }
+  sym(handle)->json = s;
+  Py_DECREF(res);
+  *success = ok;
+  *out = ok ? sym(handle)->json.c_str() : nullptr;
+  return 0;
+}
+
+int MXSymbolGetName(SymbolHandle handle, const char **out, int *success) {
+  return str_success_fn("_capi_sym_get_name", handle, nullptr, out, success);
+}
+
+int MXSymbolGetAttr(SymbolHandle handle, const char *key, const char **out,
+                    int *success) {
+  return str_success_fn("_capi_sym_get_attr", handle, key, out, success);
+}
+
+int MXSymbolSetAttr(SymbolHandle handle, const char *key,
+                    const char *value) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(Oss)", sym(handle)->obj, key,
+                                 value ? value : "");
+  PyObject *res = args ? bridge("_capi_sym_set_attr", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  Py_DECREF(res);
+  return 0;
+}
+
+// ListAttr returns 2*out_size strings (k, v, k, v, ...) per the
+// reference contract; out_size counts PAIRS
+static int list_attr_impl(SymbolHandle handle, int shallow,
+                          mx_uint *out_size, const char ***out) {
+  GIL gil;
+  PyObject *args = Py_BuildValue("(Oi)", sym(handle)->obj, shallow);
+  PyObject *res = args ? bridge("_capi_sym_list_attr", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  mx_uint flat = 0;
+  int rc = str_list_out(sym(handle), res, &flat, out);
+  Py_DECREF(res);
+  *out_size = flat / 2;
+  return rc;
+}
+
+int MXSymbolListAttr(SymbolHandle handle, mx_uint *out_size,
+                     const char ***out) {
+  return list_attr_impl(handle, 0, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle handle, mx_uint *out_size,
+                            const char ***out) {
+  return list_attr_impl(handle, 1, out_size, out);
+}
+
+// -- creator introspection --------------------------------------------------
+
+namespace {
+// per-creator info storage, keyed by the interned name pointer (process
+// lifetime, like the creator names themselves); cached so repeated
+// queries (binding generators iterate all creators) don't leak
+struct CreatorInfo {
+  std::string desc, var_args;
+  std::vector<std::string> strs;
+  std::vector<const char *> names, types, descs;
+};
+
+std::map<const void *, CreatorInfo *> *g_creator_info = nullptr;
+}  // namespace
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type) {
+  GIL gil;
+  *name = static_cast<const char *>(creator);
+  if (g_creator_info == nullptr)
+    g_creator_info = new std::map<const void *, CreatorInfo *>();
+  auto it = g_creator_info->find(creator);
+  if (it != g_creator_info->end()) {
+    CreatorInfo *info = it->second;
+    *description = info->desc.c_str();
+    *num_args = static_cast<mx_uint>(info->names.size());
+    *arg_names = info->names.empty() ? nullptr : info->names.data();
+    *arg_type_infos = info->types.empty() ? nullptr : info->types.data();
+    *arg_descriptions = info->descs.empty() ? nullptr : info->descs.data();
+    *key_var_num_args = info->var_args.c_str();
+    if (return_type != nullptr) *return_type = "";
+    return 0;
+  }
+  PyObject *args = Py_BuildValue("(s)", *name);
+  PyObject *res = args ? bridge("_capi_atomic_symbol_info", args) : nullptr;
+  Py_XDECREF(args);
+  if (res == nullptr) return fail();
+  auto *info = new CreatorInfo();
+  const char *d = PyUnicode_AsUTF8(PyTuple_GetItem(res, 0));
+  info->desc = d ? d : "";
+  PyObject *nl = PyTuple_GetItem(res, 1);
+  PyObject *tl = PyTuple_GetItem(res, 2);
+  PyObject *dl = PyTuple_GetItem(res, 3);
+  const char *va = PyUnicode_AsUTF8(PyTuple_GetItem(res, 4));
+  info->var_args = va ? va : "";
+  Py_ssize_t n = PyList_Size(nl);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    for (PyObject *lst : {nl, tl, dl}) {
+      const char *s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+      info->strs.push_back(s ? s : "");
+    }
+  }
+  // pointers are stable now: strs never reallocates again
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    info->names.push_back(info->strs[3 * i].c_str());
+    info->types.push_back(info->strs[3 * i + 1].c_str());
+    info->descs.push_back(info->strs[3 * i + 2].c_str());
+  }
+  Py_DECREF(res);
+  *description = info->desc.c_str();
+  *num_args = static_cast<mx_uint>(n);
+  *arg_names = info->names.empty() ? nullptr : info->names.data();
+  *arg_type_infos = info->types.empty() ? nullptr : info->types.data();
+  *arg_descriptions = info->descs.empty() ? nullptr : info->descs.data();
+  *key_var_num_args = info->var_args.c_str();
+  if (return_type != nullptr) *return_type = "";
+  (*g_creator_info)[creator] = info;  // process-lifetime cache
+  return 0;
 }
 
 // -- shape inference --------------------------------------------------------

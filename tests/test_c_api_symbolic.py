@@ -182,6 +182,64 @@ def test_symbol_compose_and_listing():
         lib.MXSymbolFree(h)
 
 
+def test_symbol_name_attrs_and_creator_info():
+    lib = _capi()
+    c = ctypes
+    lib.MXSymbolGetName.argtypes = [c.c_void_p, c.POINTER(c.c_char_p),
+                                    c.POINTER(c.c_int)]
+    lib.MXSymbolGetAttr.argtypes = [c.c_void_p, c.c_char_p,
+                                    c.POINTER(c.c_char_p),
+                                    c.POINTER(c.c_int)]
+    lib.MXSymbolSetAttr.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p]
+    lib.MXSymbolListAttrShallow.argtypes = [
+        c.c_void_p, c.POINTER(c.c_uint), c.POINTER(c.POINTER(c.c_char_p))]
+    lib.MXSymbolListAttr.argtypes = lib.MXSymbolListAttrShallow.argtypes
+    lib.MXSymbolGetAtomicSymbolInfo.argtypes = [
+        c.c_void_p, c.POINTER(c.c_char_p), c.POINTER(c.c_char_p),
+        c.POINTER(c.c_uint), c.POINTER(c.POINTER(c.c_char_p)),
+        c.POINTER(c.POINTER(c.c_char_p)), c.POINTER(c.POINTER(c.c_char_p)),
+        c.POINTER(c.c_char_p), c.POINTER(c.c_char_p)]
+
+    act, _ = _build_fc_graph(lib)
+    out, ok = c.c_char_p(), c.c_int()
+    _ok(lib.MXSymbolGetName(act, c.byref(out), c.byref(ok)), lib)
+    assert ok.value == 1 and out.value.decode().startswith("act")
+
+    # set + get + list attributes
+    _ok(lib.MXSymbolSetAttr(act, b"ctx_group", b"dev1"), lib)
+    _ok(lib.MXSymbolGetAttr(act, b"ctx_group", c.byref(out), c.byref(ok)),
+        lib)
+    assert ok.value == 1 and out.value == b"dev1"
+    _ok(lib.MXSymbolGetAttr(act, b"nope", c.byref(out), c.byref(ok)), lib)
+    assert ok.value == 0
+    n = c.c_uint()
+    arr = c.POINTER(c.c_char_p)()
+    _ok(lib.MXSymbolListAttrShallow(act, c.byref(n), c.byref(arr)), lib)
+    pairs = {arr[2 * i].decode(): arr[2 * i + 1].decode()
+             for i in range(n.value)}
+    assert pairs.get("ctx_group") == "dev1"
+    _ok(lib.MXSymbolListAttr(act, c.byref(n), c.byref(arr)), lib)
+    deep = {arr[2 * i].decode(): arr[2 * i + 1].decode()
+            for i in range(n.value)}
+    assert any(k.endswith("$ctx_group") for k in deep), deep
+    lib.MXSymbolFree(act)
+
+    # creator introspection: FullyConnected surfaces its param names
+    name, desc = c.c_char_p(), c.c_char_p()
+    na = c.c_uint()
+    an = c.POINTER(c.c_char_p)()
+    at = c.POINTER(c.c_char_p)()
+    ad = c.POINTER(c.c_char_p)()
+    kv, rt = c.c_char_p(), c.c_char_p()
+    _ok(lib.MXSymbolGetAtomicSymbolInfo(
+        _creator(lib, "FullyConnected"), c.byref(name), c.byref(desc),
+        c.byref(na), c.byref(an), c.byref(at), c.byref(ad), c.byref(kv),
+        c.byref(rt)), lib)
+    assert name.value == b"FullyConnected"
+    names = [an[i].decode() for i in range(na.value)]
+    assert "num_hidden" in names and "data" in names
+
+
 def test_symbol_json_roundtrip_matches_python():
     lib = _capi()
     act, _ = _build_fc_graph(lib)
