@@ -34,6 +34,8 @@ _SUBMODULES = [
     "parallel", "attribute", "name", "operator", "contrib", "rtc",
     "torch_bridge", "registry", "log", "libinfo", "util",
     "kvstore_server", "executor_manager", "rnn",
+    # legacy-name shims (reference top-level module map)
+    "misc", "ndarray_doc", "symbol_doc",
 ]
 import importlib as _importlib
 import os as _os

@@ -1,0 +1,7 @@
+"""Legacy `mxnet.torch` namespace (reference: python/mxnet/torch.py — the
+lua-Torch TH/THNN op wrapper). Lua Torch is long dead; this name now
+fronts the PyTorch bridge (`mxnet_tpu.torch_bridge`): zero-copy DLPack
+exchange plus tape-integrated torch function calls, which subsumes what
+the TH wrapper provided (calling torch kernels on mxnet arrays)."""
+from .torch_bridge import *  # noqa: F401,F403
+from .torch_bridge import __all__  # noqa: F401

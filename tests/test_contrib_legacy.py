@@ -63,3 +63,33 @@ def test_mark_variables_and_compute_gradient():
         cag.set_is_training(prev)
     cag.compute_gradient([y])
     np.testing.assert_allclose(g.asnumpy(), 2 * x.asnumpy())
+
+
+def test_legacy_top_level_module_map():
+    """The reference's remaining top-level modules exist under the same
+    names: misc (0.x LR schedulers), ndarray_doc/symbol_doc (doc
+    registries), torch (fronting the modern torch bridge)."""
+    import importlib
+
+    from mxnet_tpu import misc, ndarray_doc, symbol_doc
+
+    s = misc.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(0) == 1.0 and s(10) == 0.5 and s(25) == 0.25
+    m = misc.MultiFactorScheduler(step=[5, 15])
+    m.base_lr = 1.0
+    assert abs(m(16) - 0.01) < 1e-9
+
+    class SliceDoc(ndarray_doc.NDArrayDoc):
+        """Extra slice notes."""
+
+    doc = ndarray_doc._build_doc("Slice", "slice op", ["data"],
+                                 ["NDArray"], ["input"])
+    assert "Extra slice notes." in doc and "Parameters" in doc
+
+    fc = mx.sym.FullyConnected(mx.sym.var("x"), num_hidden=4, name="fc")
+    shapes = symbol_doc.SymbolDoc.get_output_shape(fc, x=(2, 8))
+    assert list(shapes.values())[0] == (2, 4)
+
+    mxtorch = importlib.import_module("mxnet_tpu.torch")
+    assert hasattr(mxtorch, "to_torch") and hasattr(mxtorch, "function")
