@@ -35,7 +35,7 @@ _SUBMODULES = [
     "torch_bridge", "registry", "log", "libinfo", "util",
     "kvstore_server", "executor_manager", "rnn",
     # legacy-name shims (reference top-level module map)
-    "misc", "ndarray_doc", "symbol_doc",
+    "misc", "ndarray_doc", "symbol_doc", "torch",
 ]
 import importlib as _importlib
 import os as _os
@@ -44,6 +44,10 @@ for _m in _SUBMODULES:
     if _os.path.exists(_os.path.join(_os.path.dirname(__file__), _m + ".py")) or \
        _os.path.isdir(_os.path.join(_os.path.dirname(__file__), _m)):
         globals()[_m] = _importlib.import_module("." + _m, __name__)
+
+# reference __init__.py aliases `torch` as `th` too
+if "torch" in globals():
+    th = globals()["torch"]
 
 if "kvstore_server" in globals() and _os.environ.get("DMLC_ROLE") in (
         "server", "scheduler"):

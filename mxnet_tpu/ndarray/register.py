@@ -85,6 +85,8 @@ def populate(target_module_dict):
     linalg = _OpNamespace()
     random_ns = _OpNamespace()
     sparse_ns = _OpNamespace()
+    image_ns = _OpNamespace()
+    op_ns = _OpNamespace()
     seen = set()
     for name in _ops.list_ops():
         opdef = _ops.get(name)
@@ -100,10 +102,18 @@ def populate(target_module_dict):
             setattr(random_ns, name[len("_random_"):], f)
         elif name.startswith("_sample_"):
             setattr(random_ns, name[1:], f)
+        elif name.startswith("_image_"):
+            setattr(image_ns, name[len("_image_"):], f)
+        if name.isidentifier():
+            setattr(op_ns, name, f)  # flat mx.nd.op.* (reference op.py)
         if not name.startswith("_contrib_") and not name.startswith("_linalg_"):
             target_module_dict.setdefault(name, f)
     target_module_dict["contrib"] = contrib
     target_module_dict["linalg"] = linalg
     target_module_dict["random"] = random_ns
     target_module_dict["sparse"] = sparse_ns
+    # op namespace mx.nd.image.* (reference image.cc family); the host-side
+    # mx.image module (iterators/augmenters) is separate
+    target_module_dict.setdefault("image", image_ns)
+    target_module_dict.setdefault("op", op_ns)
     return contrib, linalg, random_ns, sparse_ns

@@ -134,3 +134,4 @@ from . import contrib as _contrib  # noqa: E402,F401
 from . import linalg as _linalg  # noqa: E402,F401
 from . import quantization as _quantization  # noqa: E402,F401
 from . import dgl as _dgl  # noqa: E402,F401
+from . import image_ops as _image_ops  # noqa: E402,F401

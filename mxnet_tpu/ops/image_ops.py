@@ -1,0 +1,104 @@
+"""Image op family (reference: src/operator/image/ — _image_to_tensor,
+_image_normalize, _image_resize, _image_crop; exposed as the
+`mx.nd.image.*` / `mx.sym.image.*` namespaces). HWC layout in,
+reference semantics: to_tensor converts to CHW float [0,1]; normalize is
+per-channel on CHW; resize/crop operate on HWC (batched NHWC allowed)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _per_channel(val, c, dtype):
+    arr = jnp.asarray(val, dtype)
+    if arr.ndim == 0:
+        arr = jnp.broadcast_to(arr, (c,))
+    return arr
+
+
+@register("_image_to_tensor", aliases=("to_tensor",))
+def image_to_tensor(data):
+    """(H,W,C) or (N,H,W,C) uint8/float [0,255] -> (C,H,W)/(N,C,H,W)
+    float32 [0,1] (reference: image_random.cc _image_to_tensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def image_normalize(data, mean=0.0, std=1.0):
+    """(C,H,W)/(N,C,H,W) float: (x - mean[c]) / std[c] (reference:
+    image_random.cc _image_normalize — type-checked to float there too;
+    an integer input would silently truncate mean/std to 0)."""
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        from ..base import MXNetError
+
+        raise MXNetError(
+            "image.normalize expects a float input (run to_tensor first); "
+            "got %s" % data.dtype)
+    c = data.shape[0] if data.ndim == 3 else data.shape[1]
+    m = _per_channel(mean, c, data.dtype)
+    s = _per_channel(std, c, data.dtype)
+    shape = (c, 1, 1) if data.ndim == 3 else (1, c, 1, 1)
+    return (data - m.reshape(shape)) / s.reshape(shape)
+
+
+@register("_image_resize", aliases=("image_resize",))
+def image_resize(data, size=(), keep_ratio=False, interp=1):
+    """(H,W,C)/(N,H,W,C) resize (reference: resize.cc). `size` is an int
+    (short side when keep_ratio else square) or (w, h). interp 0=nearest,
+    1=bilinear (OpenCV codes; others lower to bilinear on TPU)."""
+    from ..base import MXNetError
+
+    batched = data.ndim == 4
+    h, w = (data.shape[1], data.shape[2]) if batched \
+        else (data.shape[0], data.shape[1])
+    if isinstance(size, (tuple, list)) and len(size) not in (1, 2):
+        raise MXNetError("image.resize: size must be an int, (s,) or "
+                         "(w, h); got %r" % (size,))
+    if isinstance(size, (tuple, list)) and len(size) == 2:
+        new_w, new_h = int(size[0]), int(size[1])
+    else:
+        s = int(size[0]) if isinstance(size, (tuple, list)) else int(size)
+        if s < 1:
+            raise MXNetError("image.resize: size is required and must be "
+                             "positive; got %r" % (size,))
+        if keep_ratio:
+            # reference resize-inl.h truncates (static_cast<int>), not
+            # rounds — ported pipelines hard-code these shapes
+            if h < w:
+                new_h, new_w = s, max(1, w * s // h)
+            else:
+                new_w, new_h = s, max(1, h * s // w)
+        else:
+            new_w = new_h = s
+    method = "nearest" if int(interp) == 0 else "linear"
+    if batched:
+        out_shape = (data.shape[0], new_h, new_w, data.shape[3])
+    else:
+        out_shape = (new_h, new_w, data.shape[2])
+    out = jax.image.resize(data.astype(jnp.float32), out_shape,
+                           method=method)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        # round like OpenCV's saturate_cast (plain astype truncates,
+        # biasing uint8 outputs ~0.5 LSB dark)
+        info = jnp.iinfo(data.dtype)
+        return jnp.clip(jnp.round(out), info.min, info.max) \
+            .astype(data.dtype)
+    return out
+
+
+@register("_image_crop", aliases=("image_crop",))
+def image_crop(data, x=0, y=0, width=1, height=1):
+    """Fixed-window crop at (x, y) of size (width, height) on
+    (H,W,C)/(N,H,W,C) (reference: crop.cc _image_crop)."""
+    x, y, width, height = int(x), int(y), int(width), int(height)
+    if data.ndim == 3:
+        return jax.lax.slice(data, (y, x, 0),
+                             (y + height, x + width, data.shape[2]))
+    return jax.lax.slice(data, (0, y, x, 0),
+                         (data.shape[0], y + height, x + width,
+                          data.shape[3]))

@@ -1179,6 +1179,14 @@ EXCLUDED = {
     "quantized_pooling": "alias of _contrib_quantized_pooling",
     "_contrib_quantized_concat": "quantized concat test below",
     "quantized_concat": "alias of _contrib_quantized_concat",
+    "_image_to_tensor": "image op family test below",
+    "to_tensor": "alias of _image_to_tensor",
+    "_image_normalize": "image op family test below",
+    "image_normalize": "alias of _image_normalize",
+    "_image_resize": "image op family test below",
+    "image_resize": "alias of _image_resize",
+    "_image_crop": "image op family test below",
+    "image_crop": "alias of _image_crop",
     "_contrib_quantized_act": "quantized act/flatten test below",
     "quantized_act": "alias of _contrib_quantized_act",
     "_contrib_quantized_activation": "alias of _contrib_quantized_act",
@@ -1386,3 +1394,49 @@ def test_quantized_concat_rescales():
 
 def test_quantized_act_flatten():
     _quantized_act_flatten_pass_through()
+
+
+def test_image_op_family():
+    """mx.nd.image.* namespace (reference src/operator/image/):
+    to_tensor HWC->CHW [0,1]; per-channel normalize; resize (int /
+    (w,h) / keep_ratio); fixed-window crop; batched variants."""
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 255, (8, 6, 3)).astype(np.uint8)
+    img = mx.nd.array(raw, dtype="uint8")
+
+    t = mx.nd.image.to_tensor(img)
+    assert t.shape == (3, 8, 6) and t.dtype == np.float32
+    np.testing.assert_allclose(t.asnumpy(),
+                               raw.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    batch = mx.nd.array(raw[None], dtype="uint8")
+    assert mx.nd.image.to_tensor(batch).shape == (1, 3, 8, 6)
+
+    n = mx.nd.image.normalize(t, mean=(0.5, 0.4, 0.3), std=(0.2, 0.2, 0.2))
+    np.testing.assert_allclose(
+        n.asnumpy(),
+        (raw.transpose(2, 0, 1) / 255.0
+         - np.array([0.5, 0.4, 0.3])[:, None, None]) / 0.2,
+        rtol=1e-5, atol=1e-6)
+
+    r = mx.nd.image.resize(img, size=4)
+    assert r.shape == (4, 4, 3)
+    rk = mx.nd.image.resize(img, size=4, keep_ratio=True)
+    assert rk.shape == (5, 4, 3)  # short side (w=6) -> 4, h scales to 5
+    rwh = mx.nd.image.resize(img, size=(2, 6))  # (w, h)
+    assert rwh.shape == (6, 2, 3)
+
+    c = mx.nd.image.crop(img, x=1, y=2, width=3, height=4)
+    np.testing.assert_array_equal(c.asnumpy(), raw[2:6, 1:4])
+
+    # normalize demands a float input (int mean/std would truncate to 0)
+    with pytest.raises(mx.base.MXNetError, match="float"):
+        mx.nd.image.normalize(img, mean=(0.5,), std=(0.2,))
+    # size is required
+    with pytest.raises(mx.base.MXNetError, match="size"):
+        mx.nd.image.resize(img)
+
+    # flat op namespaces exist too (reference nd/op.py + symbol/op.py)
+    assert mx.nd.op.relu is mx.nd.relu
+    assert hasattr(mx.sym.op, "FullyConnected")
+    # and the legacy torch aliases (reference __init__.py `as th`)
+    assert hasattr(mx, "torch") and hasattr(mx, "th")
