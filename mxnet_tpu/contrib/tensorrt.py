@@ -1,0 +1,39 @@
+"""TensorRT integration surface (reference: python/mxnet/contrib/
+tensorrt.py). TensorRT is CUDA-only and declared out of scope for the
+TPU build (SURVEY §7); the TPU-native analogue of a TRT engine is the
+StableHLO AOT artifact (`mxnet_tpu.predict.Predictor.export_compiled` /
+`CompiledPredictor`). The reference names exist so ported scripts fail
+with direction instead of AttributeError; the use_tensorrt flag is
+accepted and always reports False."""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["set_use_tensorrt", "get_use_tensorrt", "get_optimized_symbol",
+           "tensorrt_bind"]
+
+_MSG = ("TensorRT is CUDA-only and out of scope for the TPU build; use "
+        "Predictor.export_compiled -> CompiledPredictor (StableHLO AOT) "
+        "for the equivalent frozen-engine deployment path")
+
+
+def set_use_tensorrt(status):
+    """reference: tensorrt.py:30 — accepted for script compatibility;
+    enabling it raises (there is no TRT runtime here)."""
+    if status:
+        raise MXNetError(_MSG)
+
+
+def get_use_tensorrt():
+    """reference: tensorrt.py:40 — always False on TPU."""
+    return False
+
+
+def get_optimized_symbol(executor):
+    """reference: tensorrt.py:50."""
+    raise MXNetError(_MSG)
+
+
+def tensorrt_bind(symbol, ctx, all_params, **kwargs):
+    """reference: tensorrt.py:76."""
+    raise MXNetError(_MSG)

@@ -93,3 +93,18 @@ def test_legacy_top_level_module_map():
 
     mxtorch = importlib.import_module("mxnet_tpu.torch")
     assert hasattr(mxtorch, "to_torch") and hasattr(mxtorch, "function")
+
+
+def test_tensorrt_surface_redirects():
+    """contrib.tensorrt exists with the reference names; enabling it
+    points at the StableHLO AOT path (documented out-of-scope)."""
+    from mxnet_tpu.contrib import tensorrt as trt
+
+    assert trt.get_use_tensorrt() is False
+    trt.set_use_tensorrt(False)  # no-op
+    import pytest as _pytest
+
+    with _pytest.raises(mx.base.MXNetError, match="export_compiled"):
+        trt.set_use_tensorrt(True)
+    with _pytest.raises(mx.base.MXNetError, match="StableHLO"):
+        trt.tensorrt_bind(None, None, {})

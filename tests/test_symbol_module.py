@@ -271,3 +271,24 @@ def test_executor_manager_data_parallel():
     np.testing.assert_allclose(out_args["fc1_weight"].asnumpy(),
                                arg_params["fc1_weight"].asnumpy(),
                                rtol=1e-5)
+
+
+def test_python_loss_module():
+    """PythonLossModule (reference module/python_module.py): a
+    grad_func-driven loss head exposes scores as outputs and their
+    gradient through get_input_grads."""
+    from mxnet_tpu.module import PythonLossModule
+
+    m = PythonLossModule(grad_func=lambda scores, labels:
+                         scores - labels)
+    m.bind(data_shapes=[("data", (4, 3))],
+           label_shapes=[("softmax_label", (4, 3))])
+    assert m.output_shapes == [("pyloss_output", (4, 3))]
+    rng = np.random.RandomState(0)
+    s = mx.nd.array(rng.rand(4, 3).astype(np.float32))
+    l = mx.nd.array(rng.rand(4, 3).astype(np.float32))
+    m.forward(mx.io.DataBatch(data=[s], label=[l]))
+    np.testing.assert_allclose(m.get_outputs()[0].asnumpy(), s.asnumpy())
+    m.backward()
+    np.testing.assert_allclose(m.get_input_grads()[0].asnumpy(),
+                               (s - l).asnumpy(), rtol=1e-6)
