@@ -1,0 +1,3 @@
+"""Jupyter-notebook training instrumentation (reference:
+python/mxnet/notebook/)."""
+from . import callback  # noqa: F401

@@ -346,3 +346,29 @@ def test_image_det_iter_unindexed_sequential(tmp_path):
     assert sum(1 for _ in it) == 3
     it.reset()
     assert sum(1 for _ in it) == 3
+
+
+def test_notebook_pandas_logger():
+    """notebook.callback.PandasLogger (reference python/mxnet/notebook/):
+    metrics land in train/eval/epoch DataFrames via the fit() callback
+    slots; the bokeh-backed live charts raise with direction."""
+    import pytest as _pytest
+
+    from mxnet_tpu import metric as mmetric
+    from mxnet_tpu.module.base_module import BatchEndParam
+    from mxnet_tpu.notebook.callback import LiveLearningCurve, PandasLogger
+
+    lg = PandasLogger(batch_size=4, frequent=1)
+    m = mmetric.Accuracy()
+    m.update([mx.nd.array([0.0, 1.0])],
+             [mx.nd.array(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))])
+    lg.train_cb(BatchEndParam(epoch=0, nbatch=1, eval_metric=m,
+                              locals=None))
+    lg.epoch_cb()
+    assert len(lg.train_df) == 1 and "accuracy" in lg.train_df.columns
+    assert len(lg.epoch_df) == 1
+    assert set(lg.callback_args()) == {"batch_end_callback",
+                                       "eval_end_callback",
+                                       "epoch_end_callback"}
+    with _pytest.raises(ImportError, match="bokeh"):
+        LiveLearningCurve()
