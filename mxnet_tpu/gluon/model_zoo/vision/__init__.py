@@ -30,8 +30,19 @@ for _mod in [_sys.modules[__name__ + "." + _m]
 
 
 def get_model(name, **kwargs):
-    """reference: model_zoo/vision/__init__.py get_model"""
+    """reference: model_zoo/vision/__init__.py get_model. Accepts the
+    reference's dotted names too ('mobilenet0.25', 'squeezenet1.0',
+    'inceptionv3' — its key style) alongside the pythonic factory
+    names ('mobilenet0_25', 'inception_v3')."""
     name = name.lower()
+    if name not in _models:
+        # reference key style -> factory-name normalization
+        alt = name.replace(".", "_")
+        if alt == "inceptionv3":
+            alt = "inception_v3"
+        alt = alt.replace("mobilenetv2_", "mobilenet_v2_")
+        if alt in _models:
+            name = alt
     if name not in _models:
         raise MXNetError("Model %s not supported. Available: %s"
                          % (name, sorted(_models)))

@@ -339,3 +339,15 @@ def test_hybridize_literal_none_argument():
     m = mx.nd.array(np.full((2, 3), 3.0, np.float32))
     np.testing.assert_allclose(net(x, m).asnumpy(), 3 * np.ones((2, 3)))
     np.testing.assert_allclose(net(x, None).asnumpy(), 2 * np.ones((2, 3)))
+
+
+def test_get_model_reference_key_styles():
+    """get_model accepts the reference's dotted key style
+    ('mobilenet0.25', 'squeezenet1.0', 'inceptionv3', 'mobilenetv2_1.0')
+    alongside the pythonic factory names."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    for name in ("mobilenet0.25", "squeezenet1.0", "inceptionv3",
+                 "mobilenetv2_0.25", "resnet18_v1", "vgg11"):
+        net = vision.get_model(name, classes=10)
+        assert net is not None, name
