@@ -165,6 +165,46 @@ def _capi_get_grad(arr):
     return arr.grad  # None when no gradient buffer is attached
 
 
+def _capi_nd_slice(arr, begin, end):
+    begin, end = int(begin), int(end)
+    n = arr.shape[0] if arr.shape else 0
+    # reference MXNDArraySlice CHECK-fails on bad ranges; numpy-style
+    # clamping would hand a C host silently short data with rc=0
+    if not 0 <= begin < end <= n:
+        raise MXNetError("MXNDArraySlice: invalid range [%d, %d) for "
+                         "axis-0 size %d" % (begin, end, n))
+    return arr[begin:end]
+
+
+def _capi_nd_at(arr, idx):
+    idx = int(idx)
+    n = arr.shape[0] if arr.shape else 0
+    if not 0 <= idx < n:
+        raise MXNetError("MXNDArrayAt: index %d out of range for axis-0 "
+                         "size %d" % (idx, n))
+    return arr[idx]
+
+
+def _capi_nd_reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def _capi_nd_storage_type(arr):
+    # reference enum: -1 undefined, 0 default (dense), 1 row_sparse, 2 csr
+    st = getattr(arr, "stype", "default")
+    return {"default": 0, "row_sparse": 1, "csr": 2}.get(st, 0)
+
+
+def _capi_nd_wait_to_read(arr):
+    arr.wait_to_read()
+
+
+def _capi_wait_all():
+    from . import ndarray as nd
+
+    nd.waitall()
+
+
 # -- symbol section (reference: c_api_symbolic.cc) --------------------------
 # A C SymbolHandle owns a _SymRec. CreateAtomicSymbol makes a node with no
 # inputs (sym=None); Compose instantiates it through the generated mx.sym

@@ -40,6 +40,14 @@ int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
 int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
                         int *out_dev_id);
 int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out);
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitAll();
 
 int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
 int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,

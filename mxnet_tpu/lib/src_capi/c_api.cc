@@ -499,4 +499,89 @@ int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
   return 0;
 }
 
+// -- views / reshape / sync (reference c_api.cc NDArray block) --------------
+
+// shared tail: wrap a bridge-returned NDArray into a fresh handle
+static int nd_result(PyObject *res, NDArrayHandle *out) {
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  ND *h = new ND();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out) {
+  *out = nullptr;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(OII)", nd(handle)->obj, slice_begin,
+                                 slice_end);
+  PyObject *res = args ? call_bridge("_capi_nd_slice", args) : nullptr;
+  Py_XDECREF(args);
+  return nd_result(res, out);
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  *out = nullptr;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(OI)", nd(handle)->obj, idx);
+  PyObject *res = args ? call_bridge("_capi_nd_at", args) : nullptr;
+  Py_XDECREF(args);
+  return nd_result(res, out);
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out) {
+  *out = nullptr;
+  GIL gil;
+  PyObject *shape = PyList_New(ndim);
+  if (shape == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  for (int i = 0; i < ndim; ++i)
+    PyList_SET_ITEM(shape, i, PyLong_FromLong(dims[i]));
+  PyObject *args = Py_BuildValue("(ON)", nd(handle)->obj, shape);
+  PyObject *res = args ? call_bridge("_capi_nd_reshape", args) : nullptr;
+  Py_XDECREF(args);
+  return nd_result(res, out);
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type) {
+  GIL gil;
+  PyObject *res = call_bridge1("_capi_nd_storage_type", nd(handle)->obj);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_storage_type = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  GIL gil;
+  PyObject *res = call_bridge1("_capi_nd_wait_to_read", nd(handle)->obj);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  GIL gil;
+  PyObject *res = call_bridge("_capi_wait_all", nullptr);
+  if (res == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
 }  // extern "C"

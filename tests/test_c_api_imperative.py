@@ -111,6 +111,56 @@ def _invoke(lib, creator, inputs, attrs):
     return handles
 
 
+def test_ndarray_views_and_sync():
+    """Slice/At/Reshape views, storage type, and the wait calls
+    (reference c_api.cc NDArray block)."""
+    lib = _capi()
+    c = ctypes
+    lib.MXNDArraySlice.argtypes = [c.c_void_p, c.c_uint, c.c_uint,
+                                   c.POINTER(c.c_void_p)]
+    lib.MXNDArrayAt.argtypes = [c.c_void_p, c.c_uint,
+                                c.POINTER(c.c_void_p)]
+    lib.MXNDArrayReshape.argtypes = [c.c_void_p, c.c_int,
+                                     c.POINTER(c.c_int),
+                                     c.POINTER(c.c_void_p)]
+    lib.MXNDArrayGetStorageType.argtypes = [c.c_void_p,
+                                            c.POINTER(c.c_int)]
+    lib.MXNDArrayWaitToRead.argtypes = [c.c_void_p]
+
+    arr = np.arange(12, dtype=np.float32).reshape(4, 3)
+    h = _create(lib, arr)
+
+    out = c.c_void_p()
+    assert lib.MXNDArraySlice(h, 1, 3, c.byref(out)) == 0
+    np.testing.assert_array_equal(_to_numpy(lib, out, (2, 3)), arr[1:3])
+    lib.MXNDArrayFree(out)
+
+    assert lib.MXNDArrayAt(h, 2, c.byref(out)) == 0
+    np.testing.assert_array_equal(_to_numpy(lib, out, (3,)), arr[2])
+    lib.MXNDArrayFree(out)
+
+    dims = (c.c_int * 2)(6, 2)
+    assert lib.MXNDArrayReshape(h, 2, dims, c.byref(out)) == 0
+    np.testing.assert_array_equal(_to_numpy(lib, out, (6, 2)),
+                                  arr.reshape(6, 2))
+    lib.MXNDArrayFree(out)
+
+    st = c.c_int(-7)
+    assert lib.MXNDArrayGetStorageType(h, c.byref(st)) == 0
+    assert st.value == 0  # dense
+    assert lib.MXNDArrayWaitToRead(h) == 0
+    assert lib.MXNDArrayWaitAll() == 0
+
+    # error contract: OOB indices/ranges fail with rc=-1 + message, not
+    # silently clamped data (the reference CHECK-fails too)
+    assert lib.MXNDArrayAt(h, 99, c.byref(out)) == -1
+    assert b"out of range" in lib.MXGetLastError()
+    assert lib.MXNDArraySlice(h, 1, 99, c.byref(out)) == -1
+    assert lib.MXNDArraySlice(h, 3, 1, c.byref(out)) == -1
+    assert b"invalid range" in lib.MXGetLastError()
+    lib.MXNDArrayFree(h)
+
+
 def test_version_and_op_listing():
     lib = _capi()
     v = ctypes.c_int()
