@@ -197,7 +197,13 @@ def bench_train():
 
     _sweep_segment(out, dev, flops_per_img,
                    lambda sb: timed_train(*_sweep_batch_arrays(ctx, sb, hw), sb))
-    _mfu_segments(out, dev, net, ctx, x, flops_per_img / 3)
+    # decompose at the chip-bound batch (the sweep size) when the sweep ran:
+    # the MFU plan is read against sweep_mfu, so the segments must time the
+    # same configuration, not the latency-bound headline batch
+    seg_x = x
+    if "sweep_batch" in out:
+        seg_x = _sweep_batch_arrays(ctx, out["sweep_batch"], hw)[0]
+    _mfu_segments(out, dev, net, ctx, seg_x, flops_per_img / 3)
     print(json.dumps(out))
 
 
@@ -251,7 +257,11 @@ def _mfu_segments(out, dev, net, ctx, x, fwd_flops_per_img, iters=None):
         def mm(p, q):
             for _ in range(k_mm):
                 p = (p @ q) * jnp.bfloat16(1e-4)
-            return p
+            # reduce to a scalar: the drain fetch must not pull the full
+            # n_mm^2 bf16 product (128 MB at 8192) back over the tunnel —
+            # that fetch dominated the timed region and under-reported the
+            # matmul ceiling ~5x
+            return jnp.sum(p, dtype=jnp.float32)
 
         dt = timed(mm, a, b) / k_mm
         tf_mm = 2 * n_mm ** 3 / dt / 1e12
