@@ -213,28 +213,81 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
 # Pooling (reference: src/operator/nn/pooling.cc)
 # --------------------------------------------------------------------------
 
-def _patches_max(x, kernel, stride, pads):
-    """Max pool via patch extraction — differentiable formulation used only
-    inside the backward rule of `_float_max_pool`. Pad value must be finite:
-    conv_general_dilated_patches gathers through a one-hot conv, and
-    0 * -inf = NaN would poison every border window."""
+def _extract_patches(x, kernel, stride, pads, pad_value):
+    """Channels-first window unfold: (N, C, prod(k), *out_spatial). Shared
+    by _patches_max and the large-kernel maxpool backward fallback so the
+    dimension_numbers/reshape layout stays in lockstep. Pad value must be
+    finite when the result feeds arithmetic: conv_general_dilated_patches
+    gathers through a one-hot conv, and 0 * -inf = NaN would poison every
+    border window."""
     n, c = x.shape[0], x.shape[1]
-    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
-    padded = jnp.pad(x, ((0, 0), (0, 0)) + pads, constant_values=neg)
+    padded = jnp.pad(x, ((0, 0), (0, 0)) + tuple(pads),
+                     constant_values=pad_value)
     patches = lax.conv_general_dilated_patches(
         padded, filter_shape=kernel, window_strides=stride,
         padding=[(0, 0)] * len(kernel),
         dimension_numbers=_conv_dnums(x.ndim))
-    out_spatial = patches.shape[2:]
-    k_elems = int(_np.prod(kernel))
-    return patches.reshape((n, c, k_elems) + out_spatial).max(axis=2)
+    return patches.reshape(
+        (n, c, int(_np.prod(kernel))) + patches.shape[2:])
+
+
+def _patches_max(x, kernel, stride, pads):
+    """Max pool via patch extraction — differentiable formulation used only
+    inside the backward rule of `_float_max_pool`."""
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+    return _extract_patches(x, kernel, stride, pads, neg).max(axis=2)
+
+
+def _max_pool_taps_bwd(x, y, g, kernel, stride, pads):
+    """Channels-first maxpool input-grad as a pure elementwise expression.
+
+    dx[p] = sum over windows w containing p of [x[p] == y[w]] * g[w].
+    For tap offset a in prod(kernel), the window touching padded position
+    q = w*s + a is read by zero-stuffing y/g onto the padded input grid
+    (lax.pad with interior dilation s-1, offset a). All prod(k) terms are
+    compare/select/adds that XLA fuses into ONE kernel — ~1 read of x and
+    1 write of dx vs the old patches-based vjp, which rebuilt
+    conv_general_dilated_patches in backward (a k^2*C-channel one-hot conv:
+    0.5 TFLOP and ~12 ms/step of the round-4 bs256 ResNet-50 profile for
+    the single stem maxpool).
+
+    Tie semantics: every in-window position equal to the max receives the
+    full window cotangent (reference CPU pooling backward behavior,
+    src/operator/nn/pool.h max path), vs the even split jnp.max's vjp gave
+    the old formulation. Ties are measure-zero for float activations."""
+    nsp = len(kernel)
+    xshape = x.shape[2:]
+    oshape = y.shape[2:]
+    padded = tuple(xshape[i] + pads[i][0] + pads[i][1] for i in range(nsp))
+    ninf = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple(pads))
+    dxp = jnp.zeros_like(xp)
+    import itertools
+    for taps in itertools.product(*[range(k) for k in kernel]):
+        cfg = []
+        ok = True
+        for i in range(nsp):
+            hi = padded[i] - taps[i] - ((oshape[i] - 1) * stride[i] + 1)
+            if hi < 0:  # tap runs past the padded edge for every window
+                ok = False
+                break
+            cfg.append((taps[i], hi, stride[i] - 1))
+        if not ok:
+            continue
+        cfg = ((0, 0, 0), (0, 0, 0)) + tuple(cfg)
+        up_y = lax.pad(y, ninf, cfg)
+        up_g = lax.pad(g, jnp.zeros((), g.dtype), cfg)
+        dxp = dxp + jnp.where(xp == up_y, up_g, jnp.zeros((), g.dtype))
+    sl = (slice(None), slice(None)) + tuple(
+        slice(pads[i][0], pads[i][0] + xshape[i]) for i in range(nsp))
+    return dxp[sl]
 
 
 @functools.lru_cache(maxsize=None)
 def _float_max_pool(kernel, stride, pads, ch_last=False):
-    """Float max pooling: cheap `lax.reduce_window` forward, patches-based
-    backward (reduce_window(max) has no linearization rule in jax 0.9, which
-    breaks reverse-mode AD under jit — CachedOp backward)."""
+    """Float max pooling: cheap `lax.reduce_window` forward, custom
+    backward (reduce_window(max)'s own grad lowers to TPU SelectAndScatter,
+    which serializes; the tap-mask expression below stays elementwise)."""
     window, strides, padding = _pool_window(kernel, stride, pads, ch_last)
 
     nsp = len(kernel)
@@ -247,19 +300,40 @@ def _float_max_pool(kernel, stride, pads, ch_last=False):
                                  window, strides, padding)
 
     def fwd(x):
-        return mp(x), x
+        y = mp(x)
+        return y, (x, y)
 
-    def bwd(x, g):
-        def ref(t):
-            # _patches_max is channels-first; transposes fold into the
-            # gather conv under XLA
-            if ch_last:
-                t = jnp.transpose(t, to_ncfirst)
-            out = _patches_max(t, kernel, stride, pads)
-            return jnp.transpose(out, to_chlast) if ch_last else out
-
-        _, pull = jax.vjp(ref, x)
-        return (pull(g)[0],)
+    def bwd(res, g):
+        x, y = res
+        if ch_last:
+            x = jnp.transpose(x, to_ncfirst)
+            y = jnp.transpose(y, to_ncfirst)
+            g = jnp.transpose(g, to_ncfirst)
+        out_sp = y.shape[2:]
+        covers = all(
+            kernel[i] >= x.shape[2 + i] + pads[i][0] + pads[i][1]
+            for i in range(nsp))
+        if all(o == 1 for o in out_sp) and covers:
+            # single window COVERING the padded input (global pool): one
+            # broadcast compare. The coverage check matters: a 2x2/s2
+            # window on a 3x3 input also has 1x1 output but never reads
+            # the last row/col, which must not receive gradient.
+            dx = jnp.where(x == y, g, jnp.zeros((), g.dtype))
+        elif int(_np.prod(kernel)) <= 32:
+            dx = _max_pool_taps_bwd(x, y, g, kernel, stride, pads)
+        else:
+            # large overlapping kernels (rare): patches-based fallback,
+            # with the same full-credit tie semantics as the taps path
+            # (explicit equality mask instead of jnp.max's even-split vjp;
+            # the patch extraction itself is linear, so only it is vjp'd)
+            patches, pull = jax.vjp(
+                lambda t: _extract_patches(t, kernel, stride, pads, 0), x)
+            mask = patches == y[:, :, None]
+            dx = pull(jnp.where(mask, g[:, :, None],
+                                jnp.zeros((), g.dtype)))[0]
+        if ch_last:
+            dx = jnp.transpose(dx, to_chlast)
+        return (dx,)
 
     mp.defvjp(fwd, bwd)
     return mp
@@ -316,53 +390,161 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
 # Normalization (batch_norm.cc, layer_norm.cc, instance_norm.cc, l2_norm...)
 # --------------------------------------------------------------------------
 
+def _bn_axes(ndim, ax):
+    red = tuple(i for i in range(ndim) if i != ax)
+    bshape_fn = lambda shape: tuple(  # noqa: E731
+        shape[ax] if i == ax else 1 for i in range(ndim))
+    return red, bshape_fn
+
+
+def _bn_stats(data, red):
+    """Per-channel batch mean/var in ONE fused HBM pass over `data`.
+
+    Both reductions consume the same read (XLA multi-output-fuses them;
+    jnp.var's mean-subtracted two-pass re-reads the activation — GBs per BN
+    layer at train bs>=256). Raw E[x^2]-E[x]^2 cancels catastrophically for
+    large-mean/small-spread channels, so shift by a per-channel proxy of
+    the batch mean first: the mean over ONE slice of the leading reduced
+    dim (an O(1/N) read), within ~std/sqrt(HW) of the true channel mean
+    for any input. The f32 cast of `data` here is consumed ONLY inside the
+    fused reductions, so no f32 copy of the activation is materialized —
+    keeping it out of the normalize path is what lets every conv output
+    stay a single bf16 tensor (round-4 profile: the old shared x32 cast
+    made XLA emit (f32, bf16) pairs out of every conv fusion, 3x the
+    write bytes)."""
+    lead = red[0]  # first reduced dim (batch unless axis==0)
+    proxy = jnp.mean(
+        lax.slice_in_dim(data, 0, 1, axis=lead).astype(jnp.float32),
+        axis=red, keepdims=True)
+    d = data.astype(jnp.float32) - proxy
+    s1 = jnp.mean(d, axis=red)
+    s2 = jnp.mean(jnp.square(d), axis=red)
+    mean = proxy.reshape(s1.shape) + s1
+    var = jnp.maximum(s2 - jnp.square(s1), 0.0)
+    return mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_train(data, gamma, beta, ax, eps, fix_gamma):
+    return _bn_train_fwd(data, gamma, beta, ax, eps, fix_gamma)[0]
+
+
+def _bn_train_fwd(data, gamma, beta, ax, eps, fix_gamma):
+    red, bshape_fn = _bn_axes(data.ndim, ax)
+    bshape = bshape_fn(data.shape)
+    mean, var = _bn_stats(data, red)
+    inv = lax.rsqrt(var + eps)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    scale = g.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - mean * scale
+    dt = data.dtype
+    # the big-tensor math is ONE fused FMA in the input dtype; per-channel
+    # scale/shift are computed in f32 (cheap, accurate) then rounded once
+    out = (data * scale.astype(dt).reshape(bshape)
+           + shift.astype(dt).reshape(bshape))
+    return (out, mean, var), (data, gamma, beta, mean, inv)
+
+
+def _bn_train_bwd(ax, eps, fix_gamma, res, cts):
+    """Hand-written BN train backward, bandwidth-lean (round-4 MFU work):
+    all full-tensor math stays in the input dtype; dgamma/dbeta accumulate
+    in f32 inside fused convert-reduces; the correction terms ride C-sized
+    f32 vectors. Cotangents for the mean/var outputs are ignored: they feed
+    the moving-stat buffers (never differentiated); differentiating through
+    output_mean_var stats is unsupported (documented divergence)."""
+    data, gamma, beta, mean, inv = res
+    ct = cts[0]
+    red, bshape_fn = _bn_axes(data.ndim, ax)
+    bshape = bshape_fn(data.shape)
+    n = 1
+    for i in red:
+        n *= data.shape[i]
+    dt = data.dtype
+    xhat = ((data - mean.astype(dt).reshape(bshape))
+            * inv.astype(dt).reshape(bshape))
+    dbeta = jnp.sum(ct, axis=red, dtype=jnp.float32)
+    dgamma = jnp.sum(ct * xhat, axis=red, dtype=jnp.float32)
+    g32 = (jnp.ones_like(inv) if fix_gamma
+           else gamma.astype(jnp.float32))
+    coef = (g32 * inv).astype(dt).reshape(bshape)
+    c_b = (dbeta / n).astype(dt).reshape(bshape)
+    c_g = (dgamma / n).astype(dt).reshape(bshape)
+    dx = coef * (ct - c_b - xhat * c_g)
+    dgamma_out = (jnp.zeros_like(gamma) if fix_gamma
+                  else dgamma.astype(gamma.dtype))
+    return dx, dgamma_out, dbeta.astype(beta.dtype)
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _bn_frozen(data, gamma, beta, mean, var, ax, eps, fix_gamma):
+    return _bn_frozen_fwd(data, gamma, beta, mean, var, ax, eps, fix_gamma)[0]
+
+
+def _bn_frozen_fwd(data, gamma, beta, mean, var, ax, eps, fix_gamma):
+    red, bshape_fn = _bn_axes(data.ndim, ax)
+    bshape = bshape_fn(data.shape)
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    scale = g.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - mean.astype(jnp.float32) * scale
+    dt = data.dtype
+    out = (data * scale.astype(dt).reshape(bshape)
+           + shift.astype(dt).reshape(bshape))
+    return out, (data, gamma, beta, mean, var)
+
+
+def _bn_frozen_bwd(ax, eps, fix_gamma, res, ct):
+    data, gamma, beta, mean, var = res
+    red, bshape_fn = _bn_axes(data.ndim, ax)
+    bshape = bshape_fn(data.shape)
+    dt = data.dtype
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    g32 = jnp.ones_like(inv) if fix_gamma else gamma.astype(jnp.float32)
+    dx = ct * (g32 * inv).astype(dt).reshape(bshape)
+    dbeta = jnp.sum(ct, axis=red, dtype=jnp.float32)
+    if fix_gamma:
+        dgamma = jnp.zeros_like(gamma)
+    else:
+        xhat = ((data - mean.astype(dt).reshape(bshape))
+                * inv.astype(dt).reshape(bshape))
+        dgamma = jnp.sum(ct * xhat, axis=red,
+                         dtype=jnp.float32).astype(gamma.dtype)
+    return (dx, dgamma, dbeta.astype(beta.dtype),
+            jnp.zeros_like(mean), jnp.zeros_like(var))
+
+
+_bn_frozen.defvjp(_bn_frozen_fwd, _bn_frozen_bwd)
+
+
 @register("BatchNorm", num_outputs=3, num_visible_outputs=1)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                fix_gamma=True, use_global_stats=False, output_mean_var=False,
                axis=1, cudnn_off=False, is_train=False):
     """Returns (out, new_moving_mean, new_moving_var); the dispatch layer
     writes outputs 1..2 back into the aux-state arrays (reference mutates aux
-    in place, src/operator/nn/batch_norm.cc)."""
+    in place, src/operator/nn/batch_norm.cc).
+
+    Both paths use a hand-written custom_vjp (see _bn_train/_bn_frozen):
+    full-tensor math runs in the input dtype end to end (bf16 under AMP),
+    per-channel vectors and reduction accumulators in f32. Under pjit with
+    a sharded batch axis the stats reductions psum across replicas
+    automatically (the reference's SyncBatchNorm, sync_batch_norm.cc,
+    falls out of GSPMD)."""
     ax = axis % data.ndim
-    red = tuple(i for i in range(data.ndim) if i != ax)
-    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
     if is_train and not use_global_stats:
-        # single-pass batch stats: both reductions consume the SAME read of
-        # x (XLA fuses them into one HBM pass; jnp.var's mean-subtracted
-        # two-pass re-reads the activation tensor — GBs per BN layer at
-        # train bs>=256). Raw E[x^2]-E[x]^2 cancels catastrophically for
-        # large-mean/small-spread channels, so shift by a per-channel proxy
-        # of the batch mean first: the mean over ONE slice of the leading
-        # reduced dim (an O(1/N) read), which sits within ~std/sqrt(HW) of
-        # the true channel mean for any input — including step 0, where a
-        # moving_mean-based shift would still be cold. stop_gradient keeps
-        # autodiff clean; mean/var are shift-invariant, so treating the
-        # proxy as constant yields the exact gradients.
-        x32 = data.astype(jnp.float32)
-        lead = red[0]  # first reduced dim (batch unless axis==0)
-        proxy = lax.stop_gradient(jnp.mean(
-            lax.slice_in_dim(x32, 0, 1, axis=lead), axis=red, keepdims=True))
-        d = x32 - proxy
-        s1 = jnp.mean(d, axis=red)
-        s2 = jnp.mean(jnp.square(d), axis=red)
-        mean = proxy.reshape(s1.shape) + s1
-        var = jnp.maximum(s2 - jnp.square(s1), 0.0)
-        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
-        new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
-    else:
-        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
-        new_mm, new_mv = moving_mean, moving_var
-    # normalize in per-channel affine form: out = x*scale + shift. scale/
-    # shift are computed in fp32 on C-sized vectors (cheap, accurate); the
-    # big-tensor math is ONE fused multiply-add. The x->fp32 cast stays so
-    # the cast vjp hands fp32 cotangents to the channel reductions in
-    # backward (bf16-accumulated dgamma/dbeta would lose precision).
-    inv = lax.rsqrt(var + eps)
-    scale = g.astype(jnp.float32) * inv
-    shift = beta.astype(jnp.float32) - mean * scale
-    out = data.astype(jnp.float32) * scale.reshape(bshape) + shift.reshape(bshape)
-    return out.astype(data.dtype), new_mm, new_mv
+        out, mean, var = _bn_train(data, gamma, beta, ax, float(eps),
+                                   bool(fix_gamma))
+        new_mm = (moving_mean * momentum
+                  + mean.astype(moving_mean.dtype) * (1 - momentum))
+        new_mv = (moving_var * momentum
+                  + var.astype(moving_var.dtype) * (1 - momentum))
+        return out, new_mm, new_mv
+    out = _bn_frozen(data, gamma, beta, moving_mean, moving_var, ax,
+                     float(eps), bool(fix_gamma))
+    return out, moving_mean, moving_var
 
 
 @register("LayerNorm")

@@ -1,0 +1,160 @@
+"""Regression tests for the round-4 bandwidth-lean backward rewrites:
+maxpool tap-mask backward (3 branches) and the custom-vjp BatchNorm.
+
+Reference semantics anchors: src/operator/nn/pool.h (max pool backward
+gives every tied in-window maximum the full window cotangent),
+src/operator/nn/batch_norm.cc (train stats + affine, frozen path).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx  # noqa: F401  (platform setup via conftest)
+from mxnet_tpu.ops.nn import _float_max_pool, _patches_max, batch_norm
+
+
+def _ref_pool(x, kernel, stride, pads, shape, ch_last):
+    if ch_last:
+        perm = (0, len(shape) - 1) + tuple(range(1, len(shape) - 1))
+        x = jnp.transpose(x, perm)
+    out = _patches_max(x, kernel, stride, pads)
+    if ch_last:
+        inv = (0,) + tuple(range(2, len(shape))) + (1,)
+        out = jnp.transpose(out, inv)
+    return out
+
+
+@pytest.mark.parametrize("kernel,stride,pads,shape,ch_last", [
+    ((3, 3), (2, 2), ((1, 1), (1, 1)), (2, 3, 11, 11), False),  # stem config
+    ((3, 3), (2, 2), ((1, 2), (1, 2)), (2, 3, 10, 10), False),  # full conv.
+    ((2,), (2,), ((0, 0),), (2, 3, 12), False),                  # 1D
+    ((2, 2, 2), (2, 2, 2), ((0, 0),) * 3, (1, 2, 6, 6, 6), False),  # 3D
+    ((3, 3), (2, 2), ((1, 1), (1, 1)), (2, 11, 11, 3), True),    # NHWC
+    ((7, 7), (3, 3), ((0, 0), (0, 0)), (2, 3, 20, 20), False),   # >32 taps
+    # 1x1 output whose window does NOT cover the input: the last row/col
+    # is never read by forward and must get zero gradient (round-4 review)
+    ((2, 2), (2, 2), ((0, 0), (0, 0)), (2, 3, 3, 3), False),
+])
+def test_max_pool_bwd_matches_patches(kernel, stride, pads, shape, ch_last):
+    rng = np.random.RandomState(0)
+    x = jnp.array(rng.randn(*shape).astype(np.float32))
+    mp = _float_max_pool(kernel, stride, pads, ch_last)
+    y = mp(x)
+    ct = jnp.array(rng.randn(*y.shape).astype(np.float32))
+    ref = _ref_pool(x, kernel, stride, pads, shape, ch_last)
+    assert np.allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+    dx = jax.grad(lambda t: jnp.vdot(mp(t), ct))(x)
+    dx_ref = jax.grad(lambda t: jnp.vdot(
+        _ref_pool(t, kernel, stride, pads, shape, ch_last), ct))(x)
+    assert np.abs(np.asarray(dx) - np.asarray(dx_ref)).max() < 1e-6
+
+
+@pytest.mark.parametrize("kernel,stride,shape", [
+    ((2, 2), (2, 2), (1, 1, 4, 4)),      # taps branch
+    ((7, 7), (7, 7), (1, 1, 14, 14)),    # patches-fallback branch
+    ((4, 4), (4, 4), (1, 1, 4, 4)),      # covering/global branch
+])
+def test_max_pool_tie_semantics_full_credit(kernel, stride, shape):
+    """Every tied maximum receives the full window cotangent (pool.h),
+    identically in all three backward branches."""
+    pads = ((0, 0), (0, 0))
+    x = jnp.ones(shape, jnp.float32)
+    mp = _float_max_pool(kernel, stride, pads, False)
+    dx = jax.grad(lambda t: mp(t).sum())(x)
+    assert np.allclose(np.asarray(dx), 1.0)
+
+
+def _plain_bn(x, g, b, fix_gamma, axis=1, eps=1e-3):
+    ax = axis % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != ax)
+    bs = tuple(x.shape[ax] if i == ax else 1 for i in range(x.ndim))
+    gg = jnp.ones_like(g) if fix_gamma else g
+    mean = jnp.mean(x, axis=red)
+    var = jnp.var(x, axis=red)
+    xh = (x - mean.reshape(bs)) * jax.lax.rsqrt(var.reshape(bs) + eps)
+    return gg.reshape(bs) * xh + b.reshape(bs)
+
+
+@pytest.mark.parametrize("fix_gamma", [True, False])
+@pytest.mark.parametrize("axis,shape", [(1, (4, 3, 5, 5)), (3, (4, 5, 5, 3))])
+def test_bn_train_grads_match_autodiff(fix_gamma, axis, shape):
+    rng = np.random.RandomState(0)
+    C = shape[axis]
+    x = jnp.array(rng.randn(*shape).astype(np.float32) + 1.5)
+    g = jnp.array(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.array(rng.randn(C).astype(np.float32))
+    mm, mv = jnp.zeros(C), jnp.ones(C)
+    ct = jnp.array(rng.randn(*shape).astype(np.float32))
+
+    def f_new(x, g, b):
+        return jnp.vdot(batch_norm(x, g, b, mm, mv, eps=1e-3,
+                                   fix_gamma=fix_gamma, axis=axis,
+                                   is_train=True)[0], ct)
+
+    def f_ref(x, g, b):
+        return jnp.vdot(_plain_bn(x, g, b, fix_gamma, axis), ct)
+
+    gn = jax.grad(f_new, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(x, g, b)
+    for k, (n, r) in enumerate(zip(gn, gr)):
+        if fix_gamma and k == 1:
+            assert np.abs(np.asarray(n)).max() == 0
+            continue
+        denom = np.abs(np.asarray(r)).max() + 1e-8
+        assert np.abs(np.asarray(n) - np.asarray(r)).max() / denom < 2e-4
+
+
+def test_bn_frozen_grads_match_autodiff():
+    rng = np.random.RandomState(1)
+    x = jnp.array(rng.randn(4, 3, 5, 5).astype(np.float32))
+    g = jnp.array(rng.rand(3).astype(np.float32) + 0.5)
+    b = jnp.array(rng.randn(3).astype(np.float32))
+    mm = jnp.array([0.1, -0.2, 0.3], jnp.float32)
+    mv = jnp.array([0.5, 1.5, 1.0], jnp.float32)
+    ct = jnp.array(rng.randn(4, 3, 5, 5).astype(np.float32))
+
+    def f_new(x, g, b):
+        return jnp.vdot(batch_norm(x, g, b, mm, mv, eps=1e-3,
+                                   fix_gamma=False, use_global_stats=True,
+                                   is_train=True)[0], ct)
+
+    def f_ref(x, g, b):
+        bs = (1, 3, 1, 1)
+        xh = (x - mm.reshape(bs)) * jax.lax.rsqrt(mv.reshape(bs) + 1e-3)
+        return jnp.vdot(g.reshape(bs) * xh + b.reshape(bs), ct)
+
+    gn = jax.grad(f_new, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(x, g, b)
+    for n, r in zip(gn, gr):
+        denom = np.abs(np.asarray(r)).max() + 1e-8
+        assert np.abs(np.asarray(n) - np.asarray(r)).max() / denom < 2e-4
+
+
+def test_bn_second_order_reverse_over_reverse():
+    """create_graph-style grad-of-grad must flow through the custom vjp."""
+    rng = np.random.RandomState(2)
+    x = jnp.array(rng.randn(4, 3, 5, 5).astype(np.float32))
+    g = jnp.array(rng.rand(3).astype(np.float32) + 0.5)
+    b = jnp.array(rng.randn(3).astype(np.float32))
+    mm, mv = jnp.zeros(3), jnp.ones(3)
+    h = jax.grad(lambda t: jnp.sum(jax.grad(lambda y: jnp.sum(
+        batch_norm(y, g, b, mm, mv, is_train=True)[0] ** 2))(t) ** 2))(x)
+    assert np.isfinite(np.asarray(h)).all()
+
+
+def test_bn_bf16_keeps_tensor_dtype():
+    """The round-4 contract: no f32 materialization of the activation —
+    output dtype bf16 in, bf16 out, moving stats in their own dtype."""
+    rng = np.random.RandomState(3)
+    x = jnp.array(rng.randn(2, 3, 4, 4).astype(np.float32)).astype(jnp.bfloat16)
+    g = jnp.ones(3, jnp.bfloat16)
+    b = jnp.zeros(3, jnp.bfloat16)
+    mm, mv = jnp.zeros(3, jnp.float32), jnp.ones(3, jnp.float32)
+    out, nm, nv = batch_norm(x, g, b, mm, mv, is_train=True)
+    assert out.dtype == jnp.bfloat16
+    assert nm.dtype == jnp.float32 and nv.dtype == jnp.float32
+    # and the result is still a faithful normalization
+    o32 = np.asarray(out.astype(jnp.float32))
+    assert abs(o32.mean()) < 0.1 and abs(o32.std() - 1.0) < 0.15

@@ -1,0 +1,101 @@
+"""On-chip bisect of the ResNet-50 bs256 train-step MFU gap (round 4).
+
+step_profile.py shows the step is fusion-dominated (~65% of device time in
+elementwise/reduce fusions vs 20% in convs). This script attributes that
+time to components by timing the same DistributedTrainer step with pieces
+knocked out:
+
+  full        — the bench configuration (train-mode BN)
+  bn_frozen   — BatchNorm use_global_stats=True (no batch stats; affine
+                + running stats only; backward still reduces dgamma/dbeta)
+  bn_identity — BatchNorm monkeypatched to identity (isolates ALL BN cost)
+  relu_identity — Activation monkeypatched to identity (isolates ReLU
+                mask traffic fwd+bwd)
+
+Each timing: warmup, drain, free-running ITERS loop, asnumpy drain
+(docs/perf_notes.md methodology — only a host fetch bounds the region).
+"""
+import json
+import os
+import time
+
+BATCH = int(os.environ.get("MXTPU_PROBE_BATCH", 256))
+ITERS = int(os.environ.get("MXTPU_PROBE_ITERS", 20))
+
+
+def build_and_time(bn_mode="train", relu_identity=False):
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    import mxnet_tpu.ops as _ops
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import DistributedTrainer, make_mesh
+
+    patched = []
+
+    def patch(name, fn):
+        op = _ops._REGISTRY[name]
+        patched.append((op, op.fn))
+        op.fn = fn
+
+    try:
+        if bn_mode == "identity":
+            # arity-preserving identity: BatchNorm returns (out, mm, mv)
+            patch("BatchNorm", lambda d, g, b, mm, mv, **kw: (d, mm, mv))
+        elif bn_mode == "frozen":
+            orig = _ops._REGISTRY["BatchNorm"].fn
+            patch("BatchNorm", lambda *a, **kw: orig(
+                *a, **{**kw, "use_global_stats": True}))
+        if relu_identity:
+            patch("Activation", lambda d, act_type="relu", **kw: d)
+
+        ctx = mx.tpu()
+        with ctx:
+            net = vision.resnet50_v1()
+            net.initialize(ctx=ctx)
+            rng = np.random.RandomState(0)
+            x = mx.nd.array(rng.uniform(-1, 1, (BATCH, 3, 224, 224))
+                            .astype(np.float32), ctx=ctx)
+            y = mx.nd.array(rng.randint(0, 1000, (BATCH,))
+                            .astype(np.float32), ctx=ctx)
+            net(x)
+        mesh = make_mesh([("dp", 1)], devices=[jax.devices()[0]])
+        tr = DistributedTrainer(
+            net, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+            loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh,
+            amp_dtype="bfloat16")
+        for _ in range(5):
+            tr.step(x, y)
+        tr.step(x, y).asnumpy()
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = tr.step(x, y)
+        loss.asnumpy()
+        dt = (time.perf_counter() - t0) / ITERS
+        return dt
+    finally:
+        for op, fn in patched:
+            op.fn = fn
+
+
+def main():
+    res = {}
+    for tag, kw in [
+        ("full", {}),
+        ("bn_frozen", {"bn_mode": "frozen"}),
+        ("bn_identity", {"bn_mode": "identity"}),
+        ("relu_identity", {"relu_identity": True}),
+    ]:
+        dt = build_and_time(**kw)
+        res[tag] = round(dt * 1e3, 2)
+        print(json.dumps({tag: res[tag]}), flush=True)
+    flops = BATCH * 3 * 2 * 4.089e9
+    out = {"batch": BATCH, "step_ms": res,
+           "mfu_full": round(flops / (res["full"] / 1e3) / 1e12 / 197, 4)}
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

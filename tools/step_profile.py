@@ -1,0 +1,126 @@
+"""On-chip op-level profile of the ResNet-50 bs256 bf16 train step.
+
+Captures an xplane trace of a few steady-state DistributedTrainer steps
+(the exact executable bench.py times) and prints the top HLO ops by self
+time, aggregated by category (conv fwd/dgrad/wgrad, fusions, reductions,
+...). This answers what docs/perf_notes.md's whole-model/per-shape
+contradiction leaves open: per-shape conv kernels run near peak
+(conv_probe), yet the model's backward runs at ~1/4 of forward
+efficiency — so the time must be in ops the per-shape probe doesn't see.
+
+Usage: python tools/step_profile.py [batch] (default 256)
+Writes step_trace/ and prints a JSON summary per op category.
+"""
+import glob
+import json
+import os
+import sys
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+
+
+def capture(trace_dir):
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import DistributedTrainer, make_mesh
+
+    ctx = mx.tpu()
+    with ctx:
+        net = vision.resnet50_v1()
+        net.initialize(ctx=ctx)
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.uniform(-1, 1, (BATCH, 3, 224, 224))
+                        .astype(np.float32), ctx=ctx)
+        y = mx.nd.array(rng.randint(0, 1000, (BATCH,)).astype(np.float32),
+                        ctx=ctx)
+        net(x)
+    mesh = make_mesh([("dp", 1)], devices=[jax.devices()[0]])
+    tr = DistributedTrainer(
+        net, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh,
+        amp_dtype="bfloat16")
+    for _ in range(3):
+        tr.step(x, y)
+    tr.step(x, y).asnumpy()  # drain
+    jax.profiler.start_trace(trace_dir)
+    for _ in range(3):
+        tr.step(x, y)
+    tr.step(x, y).asnumpy()
+    jax.profiler.stop_trace()
+
+
+def summarize(trace_dir):
+    """Aggregate device-track op self-times from the trace-events JSON
+    (vm.trace.json.gz — same content as the xplane, no proto deps)."""
+    import gzip
+
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz")))
+    if not paths:
+        print(json.dumps({"error": "no trace.json.gz captured"}))
+        return
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # map pid/tid -> track name; device tracks are the TensorCore ones
+    procs = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            procs[ev["pid"]] = ev["args"].get("name", "")
+    dev_pids = {pid for pid, nm in procs.items()
+                if "TPU" in nm or "/device" in nm.lower()}
+    cats = {}
+    ops = {}
+    total_us = 0.0
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("pid") not in dev_pids:
+            continue
+        nm = ev.get("name", "")
+        # XLA module / step envelope events nest the real op events;
+        # skip them so times aren't double-counted
+        if nm.startswith("jit_") or "XLA Modules" in nm:
+            continue
+        dur = float(ev.get("dur", 0.0))
+        total_us += dur
+        cats[classify(nm)] = cats.get(classify(nm), 0.0) + dur
+        ops[nm] = ops.get(nm, 0.0) + dur
+    out = {
+        "device_tracks": sorted(procs[p] for p in dev_pids),
+        "trace_total_ms": round(total_us / 1e3, 2),
+        "by_category_ms": {k: round(v / 1e3, 2) for k, v in
+                           sorted(cats.items(), key=lambda kv: -kv[1])},
+        "top_ops_ms": {k: round(v / 1e3, 2) for k, v in
+                       sorted(ops.items(), key=lambda kv: -kv[1])[:40]},
+    }
+    print(json.dumps(out, indent=1))
+
+
+def classify(nm):
+    n = nm.lower()
+    if "convolution" in n or "conv" in n:
+        return "conv"
+    if "dot" in n:
+        return "dot"
+    if "reduce-window" in n or "select-and-scatter" in n:
+        return "pooling"
+    if "all-reduce" in n or "collective" in n:
+        return "collective"
+    if "reduce" in n:
+        return "reduce"
+    if "fusion" in n:
+        return "fusion"
+    if "copy" in n or "transpose" in n or "bitcast" in n:
+        return "layout"
+    if "scatter" in n or "gather" in n or "dynamic" in n:
+        return "scatter_gather"
+    return "other"
+
+
+if __name__ == "__main__":
+    d = os.environ.get("MXTPU_STEP_TRACE_DIR", "step_trace")
+    capture(d)
+    summarize(d)
