@@ -547,16 +547,59 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
     return out, moving_mean, moving_var
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln_core(data, gamma, beta, ax, eps):
+    return _ln_core_fwd(data, gamma, beta, ax, eps)[0]
+
+
+def _ln_core_fwd(data, gamma, beta, ax, eps):
+    """Row-stat LayerNorm, same bandwidth discipline as _bn_train: the f32
+    cast lives only inside the fused row reductions (no f32 copy of the
+    activation materializes); the normalize is input-dtype math with the
+    per-row mean/inv rounded once. One fused read computes both moments,
+    shifted by a per-row proxy (the row's first element) so the
+    E[d^2]-E[d]^2 form cannot cancel catastrophically for
+    large-mean/small-spread rows."""
+    proxy = lax.slice_in_dim(data, 0, 1, axis=ax).astype(jnp.float32)
+    d = data.astype(jnp.float32) - proxy
+    s1 = jnp.mean(d, axis=ax, keepdims=True)
+    s2 = jnp.mean(jnp.square(d), axis=ax, keepdims=True)
+    mean = proxy + s1
+    var = jnp.maximum(s2 - jnp.square(s1), 0.0)
+    inv = lax.rsqrt(var + eps)
+    dt = data.dtype
+    bshape = tuple(data.shape[ax] if i == ax else 1
+                   for i in range(data.ndim))
+    xhat = (data - mean.astype(dt)) * inv.astype(dt)
+    out = (xhat * gamma.astype(dt).reshape(bshape)
+           + beta.astype(dt).reshape(bshape))
+    return out, (data, gamma, beta, mean, inv)
+
+
+def _ln_core_bwd(ax, eps, res, ct):
+    data, gamma, beta, mean, inv = res
+    dt = data.dtype
+    ndim = data.ndim
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(ndim))
+    red = tuple(i for i in range(ndim) if i != ax)
+    xhat = (data - mean.astype(dt)) * inv.astype(dt)
+    dgamma = jnp.sum(ct * xhat, axis=red, dtype=jnp.float32)
+    dbeta = jnp.sum(ct, axis=red, dtype=jnp.float32)
+    g = ct * gamma.astype(dt).reshape(bshape)
+    # row-wise corrections in f32 (per-row vectors are cheap)
+    m1 = jnp.mean(g.astype(jnp.float32), axis=ax, keepdims=True)
+    m2 = jnp.mean((g * xhat).astype(jnp.float32), axis=ax, keepdims=True)
+    dx = inv.astype(dt) * (g - m1.astype(dt) - xhat * m2.astype(dt))
+    return (dx, dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype))
+
+
+_ln_core.defvjp(_ln_core_fwd, _ln_core_bwd)
+
+
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     ax = axis % data.ndim
-    x32 = data.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=ax, keepdims=True)
-    var = jnp.var(x32, axis=ax, keepdims=True)
-    out = (x32 - mean) * lax.rsqrt(var + eps)
-    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
-    out = out * gamma.astype(jnp.float32).reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
-    return out.astype(data.dtype)
+    return _ln_core(data, gamma, beta, ax, float(eps))
 
 
 @register("InstanceNorm")

@@ -158,3 +158,45 @@ def test_bn_bf16_keeps_tensor_dtype():
     # and the result is still a faithful normalization
     o32 = np.asarray(out.astype(jnp.float32))
     assert abs(o32.mean()) < 0.1 and abs(o32.std() - 1.0) < 0.15
+
+
+def _ref_ln(x, g, b, ax, eps=1e-5):
+    mean = jnp.mean(x, axis=ax, keepdims=True)
+    var = jnp.var(x, axis=ax, keepdims=True)
+    nd = x.ndim
+    bs = tuple(x.shape[ax % nd] if i == ax % nd else 1 for i in range(nd))
+    return (x - mean) * jax.lax.rsqrt(var + eps) * g.reshape(bs) + b.reshape(bs)
+
+
+@pytest.mark.parametrize("shape,ax", [((4, 7, 16), -1), ((4, 16), -1),
+                                      ((3, 16, 5), 1)])
+def test_layer_norm_grads_match_autodiff(shape, ax):
+    from mxnet_tpu.ops.nn import layer_norm
+    rng = np.random.RandomState(0)
+    C = shape[ax % len(shape)]
+    x = jnp.array((rng.randn(*shape) * 2 + 5).astype(np.float32))
+    g = jnp.array(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.array(rng.randn(C).astype(np.float32))
+    out = layer_norm(x, g, b, axis=ax, eps=1e-5)
+    assert np.allclose(np.asarray(out),
+                       np.asarray(_ref_ln(x, g, b, ax)), atol=2e-4)
+    ct = jnp.array(rng.randn(*shape).astype(np.float32))
+    gn = jax.grad(lambda *a: jnp.vdot(
+        layer_norm(*a, axis=ax, eps=1e-5), ct), argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(lambda *a: jnp.vdot(
+        _ref_ln(*a, ax), ct), argnums=(0, 1, 2))(x, g, b)
+    for n, r in zip(gn, gr):
+        denom = np.abs(np.asarray(r)).max() + 1e-8
+        assert np.abs(np.asarray(n) - np.asarray(r)).max() / denom < 3e-4
+
+
+def test_layer_norm_bf16_keeps_tensor_dtype():
+    from mxnet_tpu.ops.nn import layer_norm
+    rng = np.random.RandomState(1)
+    x = jnp.array(rng.randn(4, 7, 16).astype(np.float32)).astype(jnp.bfloat16)
+    g = jnp.ones(16, jnp.bfloat16)
+    b = jnp.zeros(16, jnp.bfloat16)
+    o = layer_norm(x, g, b, axis=-1)
+    assert o.dtype == jnp.bfloat16
+    o32 = np.asarray(o.astype(jnp.float32))
+    assert abs(o32.mean()) < 0.05 and abs(o32.std() - 1.0) < 0.1
