@@ -322,20 +322,32 @@ def _sweep_segment(out, dev, flops_per_img, run):
     steps at bs>=256 on a CPU would stall the artifact for hours). Set
     MXTPU_BENCH_SWEEP_BATCH=0 to disable on TPU too.
 
+    Two points by default: MXTPU_BENCH_SWEEP_BATCH (256; fields sweep_*)
+    and the larger MXTPU_BENCH_SWEEP_BATCH2 (512; fields sweep2_* — the
+    step is HBM-bound so MFU rises with batch). Either =0 disables that
+    point; a failure at one point (e.g. sweep2 OOM) keeps the other's
+    fields and records sweep{,2}_error.
+
     `run(sweep_batch)` -> imgs/sec at that batch."""
-    try:
-        sweep_batch = int(os.environ.get("MXTPU_BENCH_SWEEP_BATCH") or 256)
-        if (sweep_batch and sweep_batch != BATCH
-                and getattr(dev, "platform", "cpu") != "cpu"):
-            big_ips = run(sweep_batch)
-            out["sweep_batch"] = sweep_batch
-            out["sweep_imgs_per_sec"] = round(big_ips, 2)
-            peak = _chip_peak_tflops(dev)
+    if getattr(dev, "platform", "cpu") == "cpu":
+        return
+    peak = _chip_peak_tflops(dev)
+    seen = {BATCH}
+    for prefix, env, default in (("sweep", "MXTPU_BENCH_SWEEP_BATCH", 256),
+                                 ("sweep2", "MXTPU_BENCH_SWEEP_BATCH2", 512)):
+        try:
+            b = int(os.environ.get(env) or default)
+            if not b or b in seen:
+                continue
+            seen.add(b)
+            ips = run(b)
+            out["%s_batch" % prefix] = b
+            out["%s_imgs_per_sec" % prefix] = round(ips, 2)
             if peak:
-                out["sweep_mfu"] = round(
-                    big_ips * flops_per_img / (peak * 1e12), 4)
-    except Exception as e:  # noqa: BLE001 — sweep is best-effort extra
-        out["sweep_error"] = str(e)[:200]
+                out["%s_mfu" % prefix] = round(
+                    ips * flops_per_img / (peak * 1e12), 4)
+        except Exception as e:  # noqa: BLE001 — sweep is best-effort extra
+            out["%s_error" % prefix] = str(e)[:200]
 
 
 # Scoring nets beyond the headline ResNet-50, mirroring the reference's

@@ -1,7 +1,10 @@
 """Transformer/BERT + word-LM model tests (reference strategy: small
 end-to-end convergence + hybridize consistency, SURVEY §4 trainer-level
 integration tests)."""
+import os
+
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon
@@ -56,6 +59,13 @@ def test_mha_cross_attention():
     assert out.shape == (2, 5, 16)
 
 
+# long eager fits (~1.5 min CPU each); default coverage comes from the BERT
+# pipeline-trainer convergence tests + the lstm_bucketing example
+convergence_full = pytest.mark.skipif(
+    not os.environ.get("MXTPU_TEST_CONVERGENCE_FULL"),
+    reason="set MXTPU_TEST_CONVERGENCE_FULL=1 for the long eager fits")
+
+@convergence_full
 def test_bert_trains():
     """Tiny sequence-classification fit: pooled output -> 2 classes."""
     np.random.seed(0)
@@ -87,6 +97,7 @@ def test_bert_trains():
     assert acc > 0.9, "BERT classifier did not converge (acc=%.3f)" % acc
 
 
+@convergence_full
 def test_word_lm_trains():
     """Next-token prediction on a deterministic cyclic sequence: the LM must
     drive perplexity near 1 (reference: example/rnn/word_lm training loop)."""

@@ -1,5 +1,7 @@
 """SSD end-to-end tests (reference coverage model: example/ssd/ +
 tests/python/unittest/test_operator.py MultiBox cases)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -75,6 +77,11 @@ def test_ssd_hard_negative_mining_ratio():
     assert (cls_t2.asnumpy() >= 0).all()
 
 
+@pytest.mark.skipif(
+    not os.environ.get("MXTPU_TEST_CONVERGENCE_FULL"),
+    reason="long one-batch overfit (~2 min CPU); the default run keeps "
+           "test_ssd_train_from_det_iter + the ssd/train.py example as the "
+           "SSD training coverage — set MXTPU_TEST_CONVERGENCE_FULL=1")
 def test_ssd_loss_decreases_overfit():
     """One-batch overfit: the joint loss must fall substantially (reference
     train-style convergence check, tests/python/train)."""
