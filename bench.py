@@ -290,6 +290,14 @@ def _mfu_segments(out, dev, net, ctx, x, fwd_flops_per_img, iters=None):
         if peak:
             out["seg_fwd_dgrad_mfu"] = round(
                 batch * 2 * fwd_flops_per_img / dt_g / 1e12 / peak, 4)
+        # input-grad forces the STEM's dgrad (input-dilated, MXU-hostile),
+        # which the real train step never computes (dx of the first conv is
+        # dead and XLA DCEs it) — alexnet's stride-4 11x11 stem makes this
+        # segment read 50x slower than its real step. Flag it so artifact
+        # readers weigh the number correctly.
+        out["seg_fwd_dgrad_note"] = ("includes stem dgrad (DCE'd in real "
+                                     "training; dominant for large-stride "
+                                     "stems)")
     except Exception as e:  # noqa: BLE001 — segments are best-effort extra
         out["seg_error"] = str(e)[:200]
 

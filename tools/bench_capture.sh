@@ -1,16 +1,15 @@
 #!/bin/bash
-# Opportunistic on-chip perf capture (VERDICT r2 "make perf evidence exist").
+# Opportunistic on-chip capture: everything the perf program needs from ONE
+# tunnel window. Probes the accelerator in a loop (a wedged axon PJRT dial
+# blocks jax.devices() forever — each probe is a fresh subprocess under
+# `timeout`); the moment the chip answers it runs, in decision-relevance
+# order: train + score benches, the op-level step profile, the BN bisect,
+# the remaining bench modes, and the real-chip smoke suite.
 #
-# Loops probing the accelerator tunnel (a wedged axon PJRT dial blocks
-# jax.devices() forever — each probe is a fresh subprocess under `timeout`).
-# The moment the chip answers, runs bench.py in all four modes plus the
-# real-chip smoke suite and writes the artifacts into the repo so a green
-# perf number exists regardless of tunnel luck at snapshot time.
-#
-# Usage: tools/bench_capture.sh [tag]       (default tag: local_r03)
+# Usage: tools/bench_capture.sh [tag]      (default tag: local_r04b)
 set -u
 cd "$(dirname "$0")/.."
-TAG="${1:-local_r03}"
+TAG="${1:-local_r04b}"
 PROBE_TIMEOUT="${MXTPU_PROBE_TIMEOUT:-120}"
 SLEEP="${MXTPU_PROBE_INTERVAL:-60}"
 
@@ -39,9 +38,22 @@ run_one() {  # run_one <suffix> [extra ENV=VAL ...]
   echo "[bench_capture] $SUFFIX rc=$? $(cat "$OUT" 2>/dev/null | head -c 300)" >&2
 }
 
+# decision-relevant first: the post-BN/maxpool-fix train number
 run_one train           MXTPU_BENCH_MODE=train
-run_one train_nhwc      MXTPU_BENCH_MODE=train MXTPU_BENCH_LAYOUT=NHWC
 run_one score           MXTPU_BENCH_MODE=score
+
+echo "[bench_capture] step profile" >&2
+rm -rf step_trace
+PYTHONPATH=".:${PYTHONPATH:-}" timeout 1200 python tools/step_profile.py 256 \
+  > "PROFILE_${TAG}.json" 2> "PROFILE_${TAG}.log"
+echo "[bench_capture] profile rc=$?" >&2
+
+echo "[bench_capture] bn bisect" >&2
+PYTHONPATH=".:${PYTHONPATH:-}" timeout 1500 python tools/bn_bisect.py \
+  > "BISECT_${TAG}.json" 2> "BISECT_${TAG}.log"
+echo "[bench_capture] bisect rc=$?" >&2
+
+run_one train_nhwc      MXTPU_BENCH_MODE=train MXTPU_BENCH_LAYOUT=NHWC
 run_one score_nhwc      MXTPU_BENCH_MODE=score MXTPU_BENCH_LAYOUT=NHWC
 run_one score_resnet152 MXTPU_BENCH_MODE=score MXTPU_BENCH_NET=resnet152
 run_one score_inception MXTPU_BENCH_MODE=score MXTPU_BENCH_NET=inception_v3
