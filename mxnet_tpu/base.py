@@ -21,6 +21,27 @@ __all__ = ["MXNetError", "string_types", "numeric_types", "integer_types"]
 HOST_ARRAY_MODE = False
 
 
+def honor_explicit_cpu_platform():
+    """Re-assert an EXPLICIT ``JAX_PLATFORMS=cpu`` env choice over a
+    sitecustomize PJRT hook that force-overrides ``jax_platforms`` at
+    interpreter start (dialing accelerator hardware — a wedged remote dial
+    then hangs the first jax computation). Only the exact value "cpu" is
+    honored: accelerator selections keep whatever fallback chain (e.g.
+    "axon,cpu") the deployment configured. Called from package import and
+    from the embedded-interpreter C bridge; safe to call repeatedly."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return
+    try:
+        import jax
+
+        if jax.config.jax_platforms != "cpu":
+            jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — never block import on config shape
+        pass
+
+
 class MXNetError(RuntimeError):
     """Error raised by the framework (reference: python/mxnet/base.py:49)."""
 

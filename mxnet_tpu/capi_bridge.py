@@ -22,14 +22,11 @@ import numpy as _np
 
 from .base import MXNetError
 
-# A sitecustomize PJRT hook may force-override jax_platforms at interpreter
-# start (dialing accelerator hardware); in an EMBEDDED interpreter booted by
-# a plain-C host there is no conftest to re-assert the env's explicit
-# choice, so honor it here before any jax computation runs.
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    import jax as _jax
+# In an EMBEDDED interpreter booted by a plain-C host there is no conftest
+# to re-assert the env's explicit platform choice before jax runs.
+from .base import honor_explicit_cpu_platform
 
-    _jax.config.update("jax_platforms", "cpu")
+honor_explicit_cpu_platform()
 
 # the reference's dtype enum (python/mxnet/base.py _DTYPE_MX_TO_NP order,
 # mirrored by include/mxnet/ndarray.h)

@@ -35,6 +35,7 @@ EXAMPLES = [
      ["--tp", "2"]),
     ("moe/train_moe.py", ["--steps", "8"], []),
     ("gan/dcgan.py", ["--steps", "6"], []),
+    ("ctc/lstm_ocr.py", ["--steps", "12", "--batch", "8"], []),
     ("sparse/linear_classification.py", ["--steps", "60"], []),
 ]
 
