@@ -16,11 +16,13 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from .base import MXNetError, honor_explicit_cpu_platform
+from .base import (MXNetError, enable_persistent_compile_cache,
+                   honor_explicit_cpu_platform)
 
 # before any backend initializes: a sitecustomize PJRT hook may have
 # clobbered the documented `JAX_PLATFORMS=cpu` contract (see the helper)
 honor_explicit_cpu_platform()
+enable_persistent_compile_cache()
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 from . import engine
 from . import random

@@ -144,7 +144,11 @@ def _record(opdef, attrs, rng, inputs, in_arrays, out_nd, all_results):
     from .ndarray.ndarray import NDArray
 
     st = _st()
-    nd_inputs = [(i, i._version) for i in inputs if isinstance(i, NDArray)]
+    # positionally aligned with in_arrays: None marks a non-NDArray slot
+    # (e.g. an optional array input passed as None), so backward cotangents
+    # zip back onto the right arrays
+    nd_inputs = [(i, i._version) if isinstance(i, NDArray) else None
+                 for i in inputs]
     attr_key = tuple(sorted((k, _ops._hashable(v)) for k, v in attrs.items()))
     out_keys = [(o._uid, o._version) for o in out_nd]
     # aux outputs (written back into trailing inputs) count too: their
@@ -264,7 +268,10 @@ def _run_backward(heads, head_grads, retain_graph=False):
                     float_cots.append(c if c is not None
                                       else jnp.zeros(shp, dt))
                 in_cots = fn(rng, node.in_arrays, tuple(float_cots))
-        for (arr, ver), c in zip(node.inputs, in_cots):
+        for pair, c in zip(node.inputs, in_cots):
+            if pair is None:
+                continue
+            arr, ver = pair
             if c is None or (hasattr(c, "dtype") and str(c.dtype) == "float0"):
                 continue
             key = (arr._uid, ver)
@@ -397,7 +404,8 @@ def _build_replay_scalar(heads, variables, head_grads):
                 "Function / bridged op in the heads' graph (its forward is "
                 "not re-traceable); compute that grad without create_graph")
         keep.append(node)
-        needed.update((a._uid, v) for a, v in node.inputs)
+        needed.update((p[0]._uid, p[1]) for p in node.inputs
+                      if p is not None)
     tape = list(reversed(keep))
     if st.freed and (needed & st.freed):
         # same guard as _run_backward: a freed shared subgraph would become
@@ -412,7 +420,10 @@ def _build_replay_scalar(heads, variables, head_grads):
         produced.update(node.out_keys)
     leaf_info = {}
     for node in tape:
-        for (arr, ver), const in zip(node.inputs, node.in_arrays):
+        for pair, const in zip(node.inputs, node.in_arrays):
+            if pair is None:
+                continue
+            arr, ver = pair
             k = (arr._uid, ver)
             if k not in produced and k not in var_keys \
                     and k not in leaf_info:
@@ -424,8 +435,8 @@ def _build_replay_scalar(heads, variables, head_grads):
     def scalar_fn(*vals):
         env = dict(zip(var_keys + leaf_keys, vals))
         for node in tape:
-            ins = [env.get((a._uid, v), const)
-                   for (a, v), const in zip(node.inputs, node.in_arrays)]
+            ins = [const if p is None else env.get((p[0]._uid, p[1]), const)
+                   for p, const in zip(node.inputs, node.in_arrays)]
             kwargs = dict(node.attr_key)
             call = ((node.rng,) + tuple(ins) if node.opdef.needs_rng
                     else tuple(ins))

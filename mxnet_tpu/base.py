@@ -42,6 +42,39 @@ def honor_explicit_cpu_platform():
         pass
 
 
+def enable_persistent_compile_cache():
+    """Opt-in persistent XLA compilation cache: set ``MXTPU_COMPILE_CACHE``
+    to a directory (or ``1`` for the repo-local default) and executables are
+    cached keyed by HLO+backend, so repeated bench/capture runs — each a
+    fresh process compiling the same ResNet/BERT step over a slow remote
+    dial — skip straight to execution. Deliberately NOT default-on: XLA:CPU
+    AOT reloads warn about machine-feature mismatches (potential SIGILL) and
+    save little, so the CPU test suite stays uncached; ``bench.py`` arms it
+    for accelerator runs. Best-effort: backends that cannot serialize
+    executables simply miss the cache."""
+    import os
+
+    choice = os.environ.get("MXTPU_COMPILE_CACHE", "")
+    if not choice or choice.lower() in ("0", "off", "none", "disable",
+                                        "false", "no"):
+        return
+    if choice.lower() in ("1", "on", "true", "yes"):
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache")
+    else:
+        cache_dir = choice
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even fast compiles: over a tunneled dial the round-trip,
+        # not local compile time, is what repeat runs are paying for
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:  # noqa: BLE001 — never block import on config shape
+        pass
+
+
 class MXNetError(RuntimeError):
     """Error raised by the framework (reference: python/mxnet/base.py:49)."""
 

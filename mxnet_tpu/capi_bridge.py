@@ -16,17 +16,14 @@ values) passing through as strings.
 from __future__ import annotations
 
 import ast
-import os
 
 import numpy as _np
 
 from .base import MXNetError
 
-# In an EMBEDDED interpreter booted by a plain-C host there is no conftest
-# to re-assert the env's explicit platform choice before jax runs.
-from .base import honor_explicit_cpu_platform
-
-honor_explicit_cpu_platform()
+# (importing this module always executes the package __init__ first, which
+# re-asserts an explicit JAX_PLATFORMS=cpu choice — including in an
+# EMBEDDED interpreter booted by a plain-C host where no conftest runs)
 
 # the reference's dtype enum (python/mxnet/base.py _DTYPE_MX_TO_NP order,
 # mirrored by include/mxnet/ndarray.h)

@@ -42,23 +42,40 @@ def _make_function(opdef):
             kwargs.pop("num_args", None)
             attrs.update(kwargs)
         else:
+            # bind arguments to their declared parameter slot: ops follow
+            # the arrays-first convention (every param before the last
+            # array param is an array param), so a None in an optional
+            # array slot must ride as a positional placeholder — silently
+            # shifting later arrays one slot left binds them to the WRONG
+            # parameter (e.g. CTCLoss label_lengths landing in
+            # pred_lengths when pred_lengths=None)
+            slot = {}
             consumed = set()
             for i, a in enumerate(args):
                 pname = pos_names[i] if i < len(pos_names) else None
-                if isinstance(a, NDArray):
-                    inputs.append(a)
+                if isinstance(a, NDArray) or a is None:
+                    slot[pname] = a
                     consumed.add(pname)
                 elif pname is not None:
                     attrs[pname] = a
                     consumed.add(pname)
-            # NDArray kwargs slot in by declared parameter order
+            # NDArray kwargs bind to their own declared slot too
             for pname in pos_names:
-                if pname in consumed:
-                    continue
-                if pname in kwargs and isinstance(kwargs[pname], NDArray):
-                    inputs.append(kwargs.pop(pname))
+                if pname not in consumed and pname in kwargs \
+                        and isinstance(kwargs[pname], NDArray):
+                    slot[pname] = kwargs.pop(pname)
             attrs.update({k: v for k, v in kwargs.items()
                           if not isinstance(v, NDArray)})
+            order = {p: i for i, p in enumerate(pos_names)}
+            arr_idx = [order[p] for p, v in slot.items()
+                       if v is not None and p in order]
+            if arr_idx:
+                last = max(arr_idx)
+                # interior gaps (optional arrays not provided) ride as
+                # None so later arrays keep their declared position;
+                # trailing Nones are dropped (defaults apply)
+                inputs = [slot.get(p) for p in pos_names[:last + 1]
+                          if p not in attrs]
         result = invoke(opdef.name, tuple(inputs), attrs, out=out)
         if ctx is not None and out is None and isinstance(result, NDArray):
             result = result.as_in_context(ctx)

@@ -128,7 +128,17 @@ def _make_symbol_function(opdef):
         node_name = _name_mod.current().get(name, opdef.name.lstrip("_").lower())
         slots, aux_names = _slot_names(opdef.name, attrs)
         if slots is None:
-            # no table entry: inputs are whatever Symbols were passed
+            # no table entry: inputs are whatever Symbols were passed.
+            # When they bind to non-leading parameters (an optional array
+            # slot was skipped — e.g. CTCLoss label_lengths without
+            # pred_lengths), record the parameter names as graph metadata
+            # (dunder attrs are filtered from op params at eval) so
+            # execution binds by keyword instead of silently shifting
+            # later arrays into the wrong slot
+            if inputs and all(nm is not None for nm, _ in inputs):
+                pn_order = [nm for nm, _ in inputs]
+                if pn_order != pos_names[:len(pn_order)]:
+                    attrs["__input_names__"] = tuple(pn_order)
             edges = [s._outputs[0] for _, s in inputs]
             aux_slots = ()
             n_hidden = (opdef.num_outputs - opdef.visible_outputs
@@ -378,19 +388,30 @@ def infer_var_shapes(sym, known):
             if not ready:
                 continue
             opdef = _ops.get(node.op)
-            attrs = dict(node.attrs)
+            # dunder attrs are graph metadata (user __key__ attrs,
+            # __input_names__ slot binding), not op params
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not (k.startswith("__") and k.endswith("__"))}
             if _takes_is_train(opdef):
                 attrs.setdefault("is_train", True)
+            bind_names = node.attrs.get("__input_names__")
             in_structs = [jax.ShapeDtypeStruct(out_shapes[id(src)][idx],
                                                jnp.float32)
                           for src, idx in node.inputs]
+            if bind_names is not None and len(bind_names) == len(in_structs):
+                def _call(*a, _bn=tuple(bind_names), _at=attrs, _f=opdef.fn):
+                    if opdef.needs_rng:
+                        return _f(a[0], **dict(zip(_bn, a[1:])), **_at)
+                    return _f(**dict(zip(_bn, a)), **_at)
+            else:
+                def _call(*a, _at=attrs, _f=opdef.fn):
+                    return _f(*a, **_at)
             if opdef.needs_rng:
                 in_structs = [jax.ShapeDtypeStruct((2,), jnp.uint32)] \
                     + in_structs
 
             try:
-                res = jax.eval_shape(lambda *a: opdef.fn(*a, **attrs),
-                                     *in_structs)
+                res = jax.eval_shape(_call, *in_structs)
             except Exception:
                 continue
             res = tuple(res) if isinstance(res, (tuple, list)) else (res,)

@@ -324,9 +324,19 @@ class Symbol:
 
             if _takes_is_train(opdef):
                 attrs.setdefault("is_train", is_train)
-            if opdef.needs_rng:
-                in_arrays = (next_subkey(),) + in_arrays
-            out = opdef.fn(*in_arrays, **attrs)
+            # the generated caller records parameter names when inputs bind
+            # to non-leading slots (optional array args skipped); honor them
+            bind_names = node.attrs.get("__input_names__")
+            if bind_names is not None and len(bind_names) == len(in_arrays):
+                kw = dict(zip(bind_names, in_arrays))
+                if opdef.needs_rng:
+                    out = opdef.fn(next_subkey(), **kw, **attrs)
+                else:
+                    out = opdef.fn(**kw, **attrs)
+            else:
+                if opdef.needs_rng:
+                    in_arrays = (next_subkey(),) + in_arrays
+                out = opdef.fn(*in_arrays, **attrs)
             out = tuple(out) if isinstance(out, (tuple, list)) else (out,)
             computed[id(node)] = out
             # hidden trailing outputs update the trailing aux inputs

@@ -351,3 +351,43 @@ def test_get_model_reference_key_styles():
                  "mobilenetv2_0.25", "resnet18_v1", "vgg11"):
         net = vision.get_model(name, classes=10)
         assert net is not None, name
+
+
+def test_ctc_loss_label_lengths_nonzero_padding():
+    """Explicit label_lengths must override the padding heuristic (the
+    reference derives use_label_lengths from argument presence — gluon
+    loss.py CTCLoss); with junk (nonzero) label padding only the explicit
+    lengths give the right loss. Oracle: torch.nn.functional.ctc_loss."""
+    torch = pytest.importorskip("torch")
+    T, B, C = 6, 2, 5
+    rng = np.random.RandomState(3)
+    x = rng.randn(B, T, C).astype(np.float32)  # NTC layout (gluon default)
+    labels = np.array([[1, 2, 4], [3, 1, 2]], np.float32)  # [0,2]=4 is junk
+    lens = np.array([2, 3], np.float32)
+    ctc = gluon.loss.CTCLoss()
+    out = ctc(mx.nd.array(x), mx.nd.array(labels),
+              None, mx.nd.array(lens)).asnumpy()
+    logp = torch.log_softmax(torch.tensor(x.transpose(1, 0, 2)), dim=-1)
+    tl = torch.nn.functional.ctc_loss(
+        logp, torch.tensor(labels, dtype=torch.long),
+        input_lengths=torch.tensor([T, T]),
+        target_lengths=torch.tensor([2, 3]),
+        blank=0, reduction="none", zero_infinity=True)
+    np.testing.assert_allclose(out, tl.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_ctc_loss_label_lengths_hybridize_parity():
+    """The symbolic path must bind skipped optional array slots by name
+    (symbol/register.py __input_names__ metadata), matching eager."""
+    T, B, C = 6, 2, 5
+    rng = np.random.RandomState(7)
+    x = rng.randn(B, T, C).astype(np.float32)
+    labels = np.array([[1, 2, 4], [3, 1, 2]], np.float32)
+    lens = np.array([2, 3], np.float32)
+    ctc = gluon.loss.CTCLoss()
+    eager = ctc(mx.nd.array(x), mx.nd.array(labels),
+                None, mx.nd.array(lens)).asnumpy()
+    ctc.hybridize()
+    hyb = ctc(mx.nd.array(x), mx.nd.array(labels),
+              None, mx.nd.array(lens)).asnumpy()
+    np.testing.assert_allclose(eager, hyb, rtol=1e-5, atol=1e-5)
