@@ -9,8 +9,9 @@ signature of variable width, digits are separated by background gaps, and
 the model must learn both segmentation and classification from the
 unaligned label sequence — exactly what CTC is for.
 
-Conventions match the reference (ctc_loss.cc blank_label='first'): class 0
-is blank, digits 0-9 map to classes 1-10.
+Conventions match the reference gluon CTCLoss (blank_label='last',
+gluon/loss.py): labels are zero-based, digits 0-9 map to classes 0-9 and
+the last class (10) is the blank.
 
 Run: JAX_PLATFORMS=cpu python examples/ctc/lstm_ocr.py [--steps 150]
 """
@@ -26,7 +27,8 @@ from mxnet_tpu.gluon import nn, rnn
 SEQ_LEN = 24          # "image width" in columns
 FEAT = 16             # column height
 NUM_DIGITS = (3, 4)   # like the reference's 3-4 digit captchas
-CLASSES = 11          # blank + 10 digits
+CLASSES = 11          # 10 digits + trailing blank (class 10)
+BLANK = CLASSES - 1
 
 
 def make_generator(seed=7):
@@ -50,7 +52,7 @@ def make_generator(seed=7):
                 x[i, pos:pos + width] += signatures[d]
                 kept.append(d)
                 pos += width + gap
-            labels[i, :len(kept)] = np.array(kept) + 1  # 1-based (0 = blank)
+            labels[i, :len(kept)] = np.array(kept)  # zero-based (blank=last)
             lab_len[i] = len(kept)
         return x, labels, lab_len
 
@@ -75,8 +77,8 @@ def greedy_decode(logits):
     for row in logits.argmax(axis=-1):
         out, prev = [], -1
         for c in row:
-            if c != prev and c != 0:
-                out.append(int(c) - 1)
+            if c != prev and c != BLANK:
+                out.append(int(c))
             prev = c
         seqs.append(out)
     return seqs
@@ -116,7 +118,7 @@ def main(argv=None):
     logits = net(mx.nd.array(xb)).asnumpy()
     hits = 0
     for pred, lab, n in zip(greedy_decode(logits), yb, yl):
-        if pred == [int(v) - 1 for v in lab[:int(n)]]:
+        if pred == [int(v) for v in lab[:int(n)]]:
             hits += 1
     acc = hits / 256
     print("sequence accuracy: %.3f" % acc)

@@ -146,13 +146,15 @@ class CTCLoss(Loss):
             pred = F.swapaxes(pred, 0, 1)
         if self._batch_axis == 1:
             label = F.swapaxes(label, 0, 1)
-        # the reference derives the use_* flags from argument presence
-        # (python/mxnet/gluon/loss.py CTCLoss.hybrid_forward); without them
-        # the op falls back to counting non-padding labels, which is wrong
-        # for nonzero padding values
+        # the reference derives the use_* flags from argument presence and
+        # passes blank_label='last' (zero-based labels, blank=alphabet_size-1,
+        # padding -1 — python/mxnet/gluon/loss.py CTCLoss.hybrid_forward);
+        # without the flags the op falls back to counting non-padding labels,
+        # which is wrong for other padding values
         loss = F.CTCLoss(pred, label, pred_lengths, label_lengths,
                          use_data_lengths=pred_lengths is not None,
-                         use_label_lengths=label_lengths is not None)
+                         use_label_lengths=label_lengths is not None,
+                         blank_label="last")
         return _apply_weighting(F, loss, self._weight, sample_weight)
 
 

@@ -25,9 +25,16 @@ def _make_function(opdef):
     if opdef.needs_rng and params and params[0].name == "rng":
         params = params[1:]
     var_pos = any(p.kind == inspect.Parameter.VAR_POSITIONAL for p in params)
-    pos_names = [p.name for p in params
-                 if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
-                               inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+    pos_params = [p for p in params
+                  if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                                inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+    pos_names = [p.name for p in pos_params]
+    # arrays-first convention: a param is an array slot iff it has no
+    # default or its default is None (optional array); a non-None default
+    # marks an attr. Used to avoid injecting placeholder Nones for
+    # unsupplied attrs that happen to precede the last supplied array.
+    arrayish = {p.name: (p.default is inspect.Parameter.empty
+                         or p.default is None) for p in pos_params}
 
     def generated(*args, out=None, name=None, **kwargs):
         inputs = []
@@ -50,13 +57,21 @@ def _make_function(opdef):
             # parameter (e.g. CTCLoss label_lengths landing in
             # pred_lengths when pred_lengths=None)
             slot = {}
+            extras = []  # NDArray positionals past the declared signature
             consumed = set()
             for i, a in enumerate(args):
                 pname = pos_names[i] if i < len(pos_names) else None
-                if isinstance(a, NDArray) or a is None:
+                if pname is None:
+                    if isinstance(a, NDArray):
+                        extras.append(a)
+                    elif a is not None:
+                        raise TypeError(
+                            "%s: unexpected extra positional %r"
+                            % (opdef.name, a))
+                elif isinstance(a, NDArray) or a is None:
                     slot[pname] = a
                     consumed.add(pname)
-                elif pname is not None:
+                else:
                     attrs[pname] = a
                     consumed.add(pname)
             # NDArray kwargs bind to their own declared slot too
@@ -73,9 +88,12 @@ def _make_function(opdef):
                 last = max(arr_idx)
                 # interior gaps (optional arrays not provided) ride as
                 # None so later arrays keep their declared position;
-                # trailing Nones are dropped (defaults apply)
+                # trailing Nones are dropped (defaults apply). Unsupplied
+                # attr params (non-None default) are skipped, not turned
+                # into placeholder Nones.
                 inputs = [slot.get(p) for p in pos_names[:last + 1]
-                          if p not in attrs]
+                          if p in slot or (p not in attrs and arrayish[p])]
+            inputs.extend(extras)
         result = invoke(opdef.name, tuple(inputs), attrs, out=out)
         if ctx is not None and out is None and isinstance(result, NDArray):
             result = result.as_in_context(ctx)

@@ -115,9 +115,14 @@ def ctc_loss(pred, label, pred_lengths=None, label_lengths=None,
     reference). label: (B, L) class indices (padded). Returns per-sample loss."""
     T, B, C = pred.shape
     L = label.shape[1]
-    # blank=0 → labels arrive 1-based relative to blank, as in reference usage
+    # blank_label='first': blank=0, labels 1-based, padding 0 (reference
+    # symbolic default). blank_label='last': blank=C-1, labels 0-based,
+    # padding -1 (what the reference gluon CTCLoss wrapper passes).
     blank = 0 if blank_label == "first" else C - 1
-    lab = label.astype(jnp.int32)
+    lab_raw = label.astype(jnp.int32)
+    # clamp padding (-1 under 'last') to blank so gathers stay in range;
+    # padded positions sit past 2*l_len and never reach the final alphas
+    lab = jnp.where(lab_raw < 0, blank, lab_raw)
     logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
     S = 2 * L + 1
     # extended label sequence with interleaved blanks
@@ -156,9 +161,13 @@ def ctc_loss(pred, label, pred_lengths=None, label_lengths=None,
         else jnp.full((B,), T - 1, jnp.int32)
     if use_label_lengths and label_lengths is not None:
         l_len = label_lengths.astype(jnp.int32)
+    elif blank == 0:
+        # 'first': labels 1-based, 0 is padding
+        l_len = jnp.sum((lab_raw != 0).astype(jnp.int32), axis=1)
     else:
-        l_len = jnp.sum((lab != blank).astype(jnp.int32), axis=1) if blank == 0 \
-            else jnp.full((B,), L, jnp.int32)
+        # 'last': labels 0-based, -1 is padding (reference ctc_loss.cc
+        # padding_mask for blank_label='last')
+        l_len = jnp.sum((lab_raw >= 0).astype(jnp.int32), axis=1)
     final = alphas[t_idx, jnp.arange(B)]  # (B, S)
     end1 = jnp.take_along_axis(final, (2 * l_len)[:, None], axis=1)[:, 0]
     end2 = jnp.take_along_axis(final, jnp.maximum(2 * l_len - 1, 0)[:, None], axis=1)[:, 0]

@@ -356,13 +356,15 @@ def test_get_model_reference_key_styles():
 def test_ctc_loss_label_lengths_nonzero_padding():
     """Explicit label_lengths must override the padding heuristic (the
     reference derives use_label_lengths from argument presence — gluon
-    loss.py CTCLoss); with junk (nonzero) label padding only the explicit
-    lengths give the right loss. Oracle: torch.nn.functional.ctc_loss."""
+    loss.py CTCLoss); with junk label padding only the explicit lengths
+    give the right loss. Gluon labels are ZERO-based with blank=C-1
+    (the wrapper passes blank_label='last' like the reference).
+    Oracle: torch.nn.functional.ctc_loss."""
     torch = pytest.importorskip("torch")
     T, B, C = 6, 2, 5
     rng = np.random.RandomState(3)
     x = rng.randn(B, T, C).astype(np.float32)  # NTC layout (gluon default)
-    labels = np.array([[1, 2, 4], [3, 1, 2]], np.float32)  # [0,2]=4 is junk
+    labels = np.array([[1, 2, 3], [3, 1, 2]], np.float32)  # [0,2]=3 is junk
     lens = np.array([2, 3], np.float32)
     ctc = gluon.loss.CTCLoss()
     out = ctc(mx.nd.array(x), mx.nd.array(labels),
@@ -372,7 +374,27 @@ def test_ctc_loss_label_lengths_nonzero_padding():
         logp, torch.tensor(labels, dtype=torch.long),
         input_lengths=torch.tensor([T, T]),
         target_lengths=torch.tensor([2, 3]),
-        blank=0, reduction="none", zero_infinity=True)
+        blank=C - 1, reduction="none", zero_infinity=True)
+    np.testing.assert_allclose(out, tl.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_ctc_loss_gluon_blank_last_padding_heuristic():
+    """Without label_lengths the gluon wrapper follows the reference's
+    blank_label='last' convention: zero-based labels padded with -1.
+    Oracle: torch.nn.functional.ctc_loss with blank=C-1."""
+    torch = pytest.importorskip("torch")
+    T, B, C = 6, 2, 5
+    rng = np.random.RandomState(5)
+    x = rng.randn(B, T, C).astype(np.float32)
+    labels = np.array([[0, 2, -1], [3, 1, 2]], np.float32)  # -1 = padding
+    ctc = gluon.loss.CTCLoss()
+    out = ctc(mx.nd.array(x), mx.nd.array(labels)).asnumpy()
+    logp = torch.log_softmax(torch.tensor(x.transpose(1, 0, 2)), dim=-1)
+    tl = torch.nn.functional.ctc_loss(
+        logp, torch.tensor([[0, 2, 0], [3, 1, 2]], dtype=torch.long),
+        input_lengths=torch.tensor([T, T]),
+        target_lengths=torch.tensor([2, 3]),
+        blank=C - 1, reduction="none", zero_infinity=True)
     np.testing.assert_allclose(out, tl.numpy(), rtol=1e-3, atol=1e-3)
 
 
