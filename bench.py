@@ -646,17 +646,6 @@ def bench_lstm():
 
     ctx = mx.tpu()
     dev = jax.devices()[0]
-    with ctx:
-        # dropout 0: measure the math, not rng (same stance as bench_bert)
-        net = RNNModel(vocab, embed, hidden, layers, dropout=0.0)
-        net.initialize(mx.init.Xavier())
-        rng = np.random.RandomState(0)
-        tokens = mx.nd.array(rng.randint(0, vocab, (bptt, batch))
-                             .astype(np.int32), ctx=ctx, dtype="int32")
-        labels = mx.nd.array(rng.randint(0, vocab, (bptt, batch))
-                             .astype(np.float32), ctx=ctx)
-        net(tokens)
-
     mesh = make_mesh([("dp", 1)], devices=[dev])
 
     class SeqCE(gluon.loss.SoftmaxCrossEntropyLoss):
@@ -664,26 +653,38 @@ def bench_lstm():
             return super().hybrid_forward(
                 F, pred.reshape((-1, vocab)), label.reshape((-1,)))
 
-    trainer = DistributedTrainer(
-        net, "sgd", {"learning_rate": 1.0},
-        loss=SeqCE(), mesh=mesh, amp_dtype=AMP_DTYPE)
+    def run_at(b, collect_ms=False):
+        with ctx:
+            # dropout 0: measure the math, not rng (same stance as
+            # bench_bert)
+            net = RNNModel(vocab, embed, hidden, layers, dropout=0.0)
+            net.initialize(mx.init.Xavier())
+            rng = np.random.RandomState(0)
+            tok = mx.nd.array(rng.randint(0, vocab, (bptt, b))
+                              .astype(np.int32), ctx=ctx, dtype="int32")
+            lab = mx.nd.array(rng.randint(0, vocab, (bptt, b))
+                              .astype(np.float32), ctx=ctx)
+            net(tok)
+        tr = DistributedTrainer(
+            net, "sgd", {"learning_rate": 1.0},
+            loss=SeqCE(), mesh=mesh, amp_dtype=AMP_DTYPE)
+        for _ in range(WARMUP):
+            tr.step(tok, lab)
+        tr.step(tok, lab).asnumpy()
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = tr.step(tok, lab)
+        loss.asnumpy()
+        tps = b * bptt * ITERS / (time.perf_counter() - t0)
+        ms = []
+        if collect_ms:
+            for _ in range(ITERS):
+                t1 = time.perf_counter()
+                tr.step(tok, lab).asnumpy()
+                ms.append((time.perf_counter() - t1) * 1e3)
+        return tps, ms
 
-    for _ in range(WARMUP):
-        trainer.step(tokens, labels)
-    trainer.step(tokens, labels).asnumpy()
-
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        loss = trainer.step(tokens, labels)
-    loss.asnumpy()
-    dt = time.perf_counter() - t0
-    tokens_per_sec = batch * bptt * ITERS / dt
-
-    step_ms = []
-    for _ in range(ITERS):
-        t1 = time.perf_counter()
-        trainer.step(tokens, labels).asnumpy()
-        step_ms.append((time.perf_counter() - t1) * 1e3)
+    tokens_per_sec, step_ms = run_at(batch, collect_ms=True)
 
     # fwd FLOPs/token: 4 gates x (h x in + h x h) MACs x 2 per layer,
     # + decoder h x vocab x 2; train = 3x fwd
@@ -709,6 +710,21 @@ def bench_lstm():
         "mfu": round(mfu, 4) if mfu is not None else None,
     }
     out.update(_percentiles(step_ms))
+    # sweep point: the bs=32 headline is latency-bound on the recurrence;
+    # a larger batch shows how much of the gap is batch size vs kernel
+    # (same stance as the CNN _sweep_segment; TPU only, best-effort)
+    if getattr(dev, "platform", "cpu") != "cpu":
+        try:
+            sb = int(os.environ.get("MXTPU_BENCH_SWEEP_BATCH") or 256)
+            if sb and sb != batch:
+                stps, _ = run_at(sb)
+                out["sweep_batch"] = sb
+                out["sweep_tokens_per_sec"] = round(stps, 2)
+                if peak:
+                    out["sweep_mfu"] = round(
+                        stps * flops_per_token / (peak * 1e12), 4)
+        except Exception as e:  # noqa: BLE001 — sweep is best-effort extra
+            out["sweep_error"] = str(e)[:200]
     print(json.dumps(out))
 
 

@@ -90,10 +90,38 @@ def _cell_step(mode, H):
     return step
 
 
+def _pallas_lstm_enabled():
+    """Fused Pallas LSTM layer: default on for TPU; MXTPU_PALLAS_LSTM=1
+    forces it elsewhere (interpret mode), =0 disables everywhere."""
+    import os
+
+    env = os.environ.get("MXTPU_PALLAS_LSTM", "auto")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
 def _run_layer(x, wx, wh, bx, bh, h0, c0, mode, reverse=False):
     """x: (T,B,I) -> (T,B,H). Pre-computes the input projections for the whole
-    sequence as one big MXU matmul, then scans the (small) recurrent matmul."""
+    sequence as one big MXU matmul, then runs the recurrence — as a fused
+    Pallas kernel for LSTM on TPU (weights VMEM-resident across the whole
+    time loop; see pallas_kernels.lstm_layer), else as a lax.scan whose step
+    does the (small) recurrent matmul."""
     H = h0.shape[-1]
+    if mode == "lstm" and _pallas_lstm_enabled():
+        from . import pallas_kernels
+
+        if pallas_kernels.lstm_layer_fits(
+                x.shape[1], H, jnp.dtype(x.dtype).itemsize):
+            gx_all = jnp.dot(x, wx.T) + (bx + bh)  # both biases additive
+            if reverse:
+                gx_all = jnp.flip(gx_all, axis=0)
+            ys, hT, cT = pallas_kernels.lstm_layer(gx_all, wh, h0, c0)
+            if reverse:
+                ys = jnp.flip(ys, axis=0)
+            return ys, hT, cT
     gx_all = jnp.dot(x, wx.T) + bx  # (T,B,G*H) — single large matmul
     step_fn = _cell_step(mode, H)
 

@@ -169,3 +169,124 @@ def test_flash_backward_bf16_finite_and_close():
         assert np.isfinite(an).all()
         np.testing.assert_allclose(an, np.asarray(b_.astype(jnp.float32)),
                                    atol=0.25)
+
+
+# --- fused Pallas LSTM layer (pallas_kernels.lstm_layer) --------------------
+
+def _lstm_scan_oracle(x, wx, wh, bx, bh, h0, c0, reverse=False):
+    """The lax.scan LSTM path (ops/rnn.py fallback) as numerics oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import rnn as rnn_mod
+
+    H = h0.shape[-1]
+    gx = jnp.dot(x, wx.T) + bx
+    step = rnn_mod._cell_step("lstm", H)
+    (hT, cT), ys = jax.lax.scan(lambda c, g: step(c, g, wh, bh),
+                                (h0, c0), gx, reverse=reverse)
+    return ys, hT, cT
+
+
+def _lstm_pallas(x, wx, wh, bx, bh, h0, c0, reverse=False):
+    import jax.numpy as jnp
+
+    gx = jnp.dot(x, wx.T) + (bx + bh)
+    if reverse:
+        gx = jnp.flip(gx, axis=0)
+    ys, hT, cT = pk.lstm_layer(gx, wh, h0, c0)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+def _lstm_inputs(T=7, B=5, I=6, H=9, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(T, B, I).astype(dtype),
+            (rng.randn(4 * H, I) * 0.3).astype(dtype),
+            (rng.randn(4 * H, H) * 0.3).astype(dtype),
+            (rng.randn(4 * H) * 0.1).astype(dtype),
+            (rng.randn(4 * H) * 0.1).astype(dtype),
+            (rng.randn(B, H) * 0.5).astype(dtype),
+            (rng.randn(B, H) * 0.5).astype(dtype))
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_lstm_layer_matches_scan(reverse):
+    args = _lstm_inputs()
+    ys1, h1, c1 = _lstm_scan_oracle(*args, reverse=reverse)
+    ys2, h2, c2 = _lstm_pallas(*args, reverse=reverse)
+    np.testing.assert_allclose(np.asarray(ys1), np.asarray(ys2),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_layer_gradients_match_scan():
+    import jax
+    import jax.numpy as jnp
+
+    args = _lstm_inputs()
+
+    def loss(path):
+        def f(*a):
+            ys, hT, cT = path(*a)
+            return jnp.sum(ys ** 2) + jnp.sum(hT * 0.7) + jnp.sum(jnp.tanh(cT))
+        return f
+
+    g1 = jax.grad(loss(_lstm_scan_oracle), argnums=tuple(range(7)))(*args)
+    g2 = jax.grad(loss(_lstm_pallas), argnums=tuple(range(7)))(*args)
+    for name, a, b in zip("x wx wh bx bh h0 c0".split(), g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5, err_msg=name)
+
+
+def test_lstm_layer_single_step_and_bf16():
+    import jax.numpy as jnp
+
+    # T=1 exercises the empty h_prev tail; bf16 exercises the AMP dtypes
+    args = _lstm_inputs(T=1, B=3, I=4, H=5, seed=2)
+    ys1, h1, c1 = _lstm_scan_oracle(*args)
+    ys2, h2, c2 = _lstm_pallas(*args)
+    np.testing.assert_allclose(np.asarray(ys1), np.asarray(ys2),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                               rtol=2e-5, atol=2e-5)
+
+    argsb = [jnp.asarray(a, jnp.bfloat16) for a in _lstm_inputs(seed=3)]
+    ysb, hb, cb = _lstm_pallas(*argsb)
+    ysr, hr, cr = _lstm_scan_oracle(*argsb)
+    np.testing.assert_allclose(np.asarray(ysb, np.float32),
+                               np.asarray(ysr, np.float32),
+                               rtol=5e-2, atol=5e-2)
+    assert ysb.dtype == jnp.bfloat16
+
+
+def test_rnn_op_uses_pallas_path(monkeypatch):
+    """The RNN op's LSTM mode routes through the Pallas layer when enabled
+    and matches the scan path bit-for-bit at the op level."""
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(4)
+    T, B, I, H, L = 5, 2, 4, 5, 2
+    size = sum(4 * H * ((I if l == 0 else H) + H + 2) for l in range(L))
+    data = rng.randn(T, B, I).astype(np.float32)
+    par = (rng.randn(size) * 0.3).astype(np.float32)
+    h0 = np.zeros((L, B, H), np.float32)
+    c0 = np.zeros((L, B, H), np.float32)
+
+    def run():
+        out = mx.nd.RNN(mx.nd.array(data), mx.nd.array(par),
+                        mx.nd.array(h0), mx.nd.array(c0),
+                        state_size=H, num_layers=L, mode="lstm",
+                        state_outputs=True)
+        return [np.asarray(o.asnumpy()) for o in out]
+
+    monkeypatch.setenv("MXTPU_PALLAS_LSTM", "0")
+    ref = run()
+    monkeypatch.setenv("MXTPU_PALLAS_LSTM", "1")
+    pal = run()
+    for a, b in zip(ref, pal):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
