@@ -490,13 +490,14 @@ def bench_score_int8():
 
         save_checkpoint(prefix + "-folded", 0, sym, arg_params, aux_params)
 
-        # weights stay fp32 in the param dict (quantization is folded
-        # in-graph), so the folded param file binds to the quantized
-        # symbol unchanged
-        qsym, _, _ = q.quantize_model(
+        # weights quantize OFFLINE (int8 `_quantize` params) — the compiled
+        # step binds int8 weights directly; save and bind the returned
+        # quantized param dict
+        qsym, qargs, qauxs = q.quantize_model(
             sym, arg_params, aux_params, calib_mode="naive",
             calib_data=NDArrayIter(xnp, batch_size=xnp.shape[0]))
-        pred = Predictor(qsym, prefix + "-folded-0000.params", ctx=ctx,
+        save_checkpoint(prefix + "-quant", 0, qsym, qargs, qauxs)
+        pred = Predictor(qsym, prefix + "-quant-0000.params", ctx=ctx,
                          input_shapes={"data": tuple(xnp.shape)})
 
     def timed_int8(batch):

@@ -23,7 +23,8 @@ import numpy as _np
 from ..base import MXNetError
 from ..symbol.symbol import Symbol, _Node
 
-__all__ = ["quantize_model", "quantize_graph", "fold_batch_norm"]
+__all__ = ["quantize_model", "quantize_graph", "quantize_params",
+           "fold_batch_norm"]
 
 
 def fold_batch_norm(sym, arg_params, aux_params):
@@ -369,11 +370,32 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
                 qdata = _Node("_contrib_quantize_v2",
                               node.name + "_quantize", qattrs, [src])
                 d_edges = ((qdata, 0), (qdata, 1), (qdata, 2))
-            qweight = _Node("_contrib_quantize_v2", node.name + "_qweight",
-                            {}, [new_edge(*w_edge)])
+            if w_edge[0].is_var and w_edge[1] == 0:
+                # weight is a parameter: quantize OFFLINE. The graph gets
+                # `<name>_quantize{,_min,_max}` vars which quantize_params
+                # fills from the fp32 weight once, so the compiled step
+                # never re-reads fp32 weights or recomputes their ranges
+                # (reference: quantize_graph_pass.cc renames the weight
+                # entry and _quantize_params materializes it).
+                base = w_edge[0].name + "_quantize"
+                qwv = _Node(None, base, {})
+                if w_edge[0]._shape is not None:
+                    qwv._shape = w_edge[0]._shape
+                qwv._dtype = _np.int8
+                mnv = _Node(None, base + "_min", {})
+                mxv = _Node(None, base + "_max", {})
+                mnv._shape = mxv._shape = (1,)
+                mnv._dtype = mxv._dtype = _np.float32
+                w_edges = ((qwv, 0), (mnv, 0), (mxv, 0))
+            else:
+                # computed weight (rare): quantize at runtime
+                qweight = _Node("_contrib_quantize_v2",
+                                node.name + "_qweight", {},
+                                [new_edge(*w_edge)])
+                w_edges = ((qweight, 0), (qweight, 1), (qweight, 2))
             qop = "_contrib_quantized_fully_connected" \
                 if node.op == "FullyConnected" else "_contrib_quantized_conv"
-            qin = [d_edges[0], (qweight, 0)]
+            qin = [d_edges[0], w_edges[0]]
             # bias (fp32; quantized inside the op) or a zero placeholder
             if b_edge is not None:
                 qin.append(new_edge(*b_edge))
@@ -387,8 +409,8 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
             if b_edge is None:
                 # quantized op signature has a bias slot; reuse weight as a
                 # dummy — no_bias=True means it is never read
-                qin.append((qweight, 0))
-            qin += [d_edges[1], d_edges[2], (qweight, 1), (qweight, 2)]
+                qin.append(w_edges[0])
+            qin += [d_edges[1], d_edges[2], w_edges[1], w_edges[2]]
             qnode = _Node(qop, node.name + "_quantized", attrs, qin)
             deq = _Node("_contrib_dequantize", node.name + "_dequantize", {},
                         [(qnode, 0), (qnode, 1), (qnode, 2)])
@@ -429,14 +451,49 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
     return Symbol(outs)
 
 
+def quantize_params(qsym, arg_params):
+    """Materialize the offline-quantized weight params a quantize_graph
+    symbol expects: for every `<w>_quantize` var, the int8 tensor plus its
+    `_min`/`_max` range scalars computed from the fp32 param `<w>`
+    (reference: contrib/quantization.py _quantize_params). Params still
+    consumed in fp32 (biases, excluded layers) pass through; fp32 weights
+    whose only consumer was the quantized op are dropped."""
+    from ..ndarray import array as _nd_array
+
+    out = {}
+    var_names = [n.name for n in qsym._topo() if n.is_var]
+    for name in var_names:
+        if name.endswith("_quantize"):
+            orig = name[:-len("_quantize")]
+            if orig not in arg_params:
+                raise MXNetError(
+                    "quantize_params: no fp32 source param %r for %r"
+                    % (orig, name))
+            v = arg_params[orig]
+            w = _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v,
+                            _np.float32)
+            mn, mx = float(w.min()), float(w.max())
+            scale = 127.0 / max(abs(mn), abs(mx), 1e-20)
+            qw = _np.clip(_np.round(w * scale), -127, 127).astype(_np.int8)
+            out[name] = _nd_array(qw, dtype="int8")
+            out[name + "_min"] = _nd_array(_np.array([mn], _np.float32))
+            out[name + "_max"] = _nd_array(_np.array([mx], _np.float32))
+        elif not name.endswith(("_quantize_min", "_quantize_max")) \
+                and name in arg_params:
+            out[name] = arg_params[name]
+    return out
+
+
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    label_names=("softmax_label",), ctx=None,
                    excluded_sym_names=(), calib_mode="none", calib_data=None,
                    num_calib_examples=None, quantized_dtype="int8",
                    logger=logging):
     """reference: contrib/quantization.py:422 quantize_model. Returns
-    (quantized_sym, arg_params, aux_params) — weights stay fp32 in the
-    param dict and are quantized in-graph (XLA folds them at jit time)."""
+    (quantized_sym, quantized_arg_params, aux_params) — weights are
+    quantized OFFLINE into int8 `_quantize` params (+ range scalars) like
+    the reference's _quantize_params, so the compiled step reads int8
+    weights directly instead of re-quantizing fp32 weights every batch."""
     if calib_mode not in ("none", "naive", "entropy"):
         raise MXNetError("calib_mode must be none/naive/entropy")
     calib_ranges = {}
@@ -454,4 +511,5 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                     calib_mode)
     qsym = quantize_graph(sym, excluded_sym_names, calib_ranges,
                           quantized_dtype=quantized_dtype)
-    return qsym, arg_params, aux_params
+    qargs = quantize_params(qsym, arg_params)
+    return qsym, qargs, aux_params

@@ -819,7 +819,9 @@ def load(fname):
 
         fname = io.BytesIO(bytes(fname))
     with _np.load(fname, allow_pickle=False) as f:
-        out = {k: array(f[k]) for k in f.files}
+        # preserve the on-disk dtype: array() defaults to float32, which
+        # would silently upcast e.g. offline-quantized int8 params
+        out = {k: array(f[k], dtype=f[k].dtype) for k in f.files}
     keys = list(out)
     if keys and all(k.isdigit() for k in keys):
         return [out[k] for k in sorted(keys, key=int)]

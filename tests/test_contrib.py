@@ -67,8 +67,9 @@ def test_quantize_graph_structure():
 def test_quantized_symbol_module_bind():
     """A quantized symbol must bind in Module (the reference deployment
     flow: example/quantization/imagenet_inference.py mod.bind on qsym).
-    Regression: weight vars sit behind _contrib_quantize_v2 nodes, so
-    infer_shape must resolve rule shapes through them."""
+    Weights are offline-quantized `_quantize` vars (reference
+    _quantize_params naming); infer_shape must resolve rule shapes on
+    them."""
     from mxnet_tpu.contrib import quantization as q
 
     data = mx.sym.var("data")
@@ -82,8 +83,12 @@ def test_quantized_symbol_module_bind():
 
     arg_shapes, out_shapes, _ = qsym.infer_shape(data=(2, 1, 8, 8))
     by_name = dict(zip(qsym.list_arguments(), arg_shapes))
-    assert by_name["qc1_weight"] == (4, 1, 3, 3)
-    assert by_name["qf1_weight"] == (3, 4 * 6 * 6)
+    assert by_name["qc1_weight_quantize"] == (4, 1, 3, 3)
+    assert by_name["qf1_weight_quantize"] == (3, 4 * 6 * 6)
+    # offline-quantized params carry int8 data + fp32 ranges
+    assert qa["qc1_weight_quantize"].dtype == np.int8
+    assert qa["qc1_weight_quantize_min"].shape == (1,)
+    assert "qc1_weight" not in qa  # fp32 weight dropped (only consumer)
 
     mod = mx.module.Module(qsym, label_names=None, context=mx.cpu())
     mod.bind(data_shapes=[("data", (2, 1, 8, 8))], for_training=False)
@@ -244,16 +249,18 @@ def test_quantize_graph_int8_passthrough():
                    "_contrib_quantized_flatten",
                    "_contrib_quantized_fully_connected"):
         assert needed in ops, (needed, ops)
-    # the whole chain stays int8: one final dequantize, one data quantize
+    # the whole chain stays int8: one final dequantize; the ONLY runtime
+    # quantize is the data input (weights are offline `_quantize` vars)
     assert ops.count("_contrib_dequantize") == 1, ops
-    assert ops.count("_contrib_quantize_v2") == 3, ops  # data + 2 weights
+    assert ops.count("_contrib_quantize_v2") == 1, ops
 
     # numerics of the full int8 chain stay close to fp32
     params = _rand_params(sym, {"data": (4, 1, 8, 8)})
     X = np.random.RandomState(5).uniform(-1, 1, (4, 1, 8, 8)) \
         .astype(np.float32)
     fp = sym.eval_with({**{"data": X}, **params}).asnumpy()
-    qt = qsym.eval_with({**{"data": X}, **params}).asnumpy()
+    qparams = q.quantize_params(qsym, params)
+    qt = qsym.eval_with({**{"data": X}, **qparams}).asnumpy()
     assert (fp.argmax(1) == qt.argmax(1)).mean() >= 0.75
     np.testing.assert_allclose(qt, fp, atol=0.3, rtol=0.3)
 

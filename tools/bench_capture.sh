@@ -70,6 +70,10 @@ run_one score_inception MXTPU_BENCH_MODE=score MXTPU_BENCH_NET=inception_v3
 run_one train_inception MXTPU_BENCH_MODE=train MXTPU_BENCH_NET=inception_v3 MXTPU_BENCH_BATCH=128
 run_one train_alexnet   MXTPU_BENCH_MODE=train MXTPU_BENCH_NET=alexnet MXTPU_BENCH_BATCH=256
 run_one score_int8      MXTPU_BENCH_MODE=score_int8
+echo "[bench_capture] int8 probe" >&2
+PYTHONPATH=".:${PYTHONPATH:-}" timeout 900 python tools/int8_probe.py \
+  > "INT8_PROBE_${TAG}.jsonl" 2> "INT8_PROBE_${TAG}.log"
+echo "[bench_capture] int8 probe rc=$?" >&2
 run_one bert            MXTPU_BENCH_MODE=bert
 run_one lstm            MXTPU_BENCH_MODE=lstm
 
