@@ -316,6 +316,9 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
     excluded = set(excluded_sym_names)
     calib_ranges = calib_ranges or {}
     mapping = {}  # id(old node) -> new node
+    offline_vars = {}  # weight name -> (qwv, mnv, mxv) var nodes, shared by
+    #                    every quantized consumer of that weight (duplicate
+    #                    same-named vars would corrupt list_arguments())
 
     def new_edge(old_node, idx):
         return (mapping[id(old_node)], idx)
@@ -378,14 +381,22 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
                 # (reference: quantize_graph_pass.cc renames the weight
                 # entry and _quantize_params materializes it).
                 base = w_edge[0].name + "_quantize"
-                qwv = _Node(None, base, {})
-                if w_edge[0]._shape is not None:
-                    qwv._shape = w_edge[0]._shape
-                qwv._dtype = _np.int8
-                mnv = _Node(None, base + "_min", {})
-                mxv = _Node(None, base + "_max", {})
-                mnv._shape = mxv._shape = (1,)
-                mnv._dtype = mxv._dtype = _np.float32
+                if base in offline_vars:
+                    # weight shared by multiple quantized consumers: reuse
+                    # the var triple created for the first one (reference
+                    # renames a single shared entry; fresh same-named vars
+                    # here would yield duplicate argument names)
+                    qwv, mnv, mxv = offline_vars[base]
+                else:
+                    qwv = _Node(None, base, {})
+                    if w_edge[0]._shape is not None:
+                        qwv._shape = w_edge[0]._shape
+                    qwv._dtype = _np.int8
+                    mnv = _Node(None, base + "_min", {})
+                    mxv = _Node(None, base + "_max", {})
+                    mnv._shape = mxv._shape = (1,)
+                    mnv._dtype = mxv._dtype = _np.float32
+                    offline_vars[base] = (qwv, mnv, mxv)
                 w_edges = ((qwv, 0), (mnv, 0), (mxv, 0))
             else:
                 # computed weight (rare): quantize at runtime

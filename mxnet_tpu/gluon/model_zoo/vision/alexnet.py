@@ -1,4 +1,7 @@
-"""AlexNet (reference: python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""AlexNet (reference: python/mxnet/gluon/model_zoo/vision/alexnet.py).
+
+Derived from the reference implementation (Apache-2.0); block structure and
+parameter naming kept for checkpoint compatibility with reference-trained models."""
 from __future__ import annotations
 
 from ....base import MXNetError

@@ -1,4 +1,7 @@
-"""DenseNet 121/161/169/201 (reference: python/mxnet/gluon/model_zoo/vision/densenet.py)."""
+"""DenseNet 121/161/169/201 (reference: python/mxnet/gluon/model_zoo/vision/densenet.py).
+
+Derived from the reference implementation (Apache-2.0); block structure and
+parameter naming kept for checkpoint compatibility with reference-trained models."""
 from __future__ import annotations
 
 from ....base import MXNetError

@@ -1,4 +1,7 @@
-"""Inception V3 (reference: python/mxnet/gluon/model_zoo/vision/inception.py)."""
+"""Inception V3 (reference: python/mxnet/gluon/model_zoo/vision/inception.py).
+
+Derived from the reference implementation (Apache-2.0); block structure and
+parameter naming kept for checkpoint compatibility with reference-trained models."""
 from __future__ import annotations
 
 from ....base import MXNetError

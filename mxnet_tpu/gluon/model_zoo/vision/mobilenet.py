@@ -1,4 +1,7 @@
-"""MobileNet v1/v2 (reference: python/mxnet/gluon/model_zoo/vision/mobilenet.py)."""
+"""MobileNet v1/v2 (reference: python/mxnet/gluon/model_zoo/vision/mobilenet.py).
+
+Derived from the reference implementation (Apache-2.0); block structure and
+parameter naming kept for checkpoint compatibility with reference-trained models."""
 from __future__ import annotations
 
 from ....base import MXNetError

@@ -1,4 +1,7 @@
-"""SqueezeNet 1.0/1.1 (reference: python/mxnet/gluon/model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0/1.1 (reference: python/mxnet/gluon/model_zoo/vision/squeezenet.py).
+
+Derived from the reference implementation (Apache-2.0); block structure and
+parameter naming kept for checkpoint compatibility with reference-trained models."""
 from __future__ import annotations
 
 from ....base import MXNetError

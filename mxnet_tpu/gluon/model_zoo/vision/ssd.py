@@ -2,6 +2,10 @@
 get_symbol_train/get_symbol + symbol/common.py multi_layer_feature/
 multibox_layer, configs from symbol/symbol_factory.py get_config).
 
+Derived from the reference implementation (Apache-2.0); layer structure and
+parameter naming kept for checkpoint compatibility with reference-trained
+models.
+
 TPU-native design notes:
 - The whole network is a HybridBlock: one jit-compiled XLA program per shape
   covers base features, the extra pyramid, all predictor heads, and the

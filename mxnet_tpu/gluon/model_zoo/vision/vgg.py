@@ -1,4 +1,7 @@
-"""VGG 11/13/16/19 (+BN variants) (reference: python/mxnet/gluon/model_zoo/vision/vgg.py)."""
+"""VGG 11/13/16/19 (+BN variants) (reference: python/mxnet/gluon/model_zoo/vision/vgg.py).
+
+Derived from the reference implementation (Apache-2.0); block structure and
+parameter naming kept for checkpoint compatibility with reference-trained models."""
 from __future__ import annotations
 
 from ....base import MXNetError

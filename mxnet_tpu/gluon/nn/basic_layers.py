@@ -194,13 +194,23 @@ class Flatten(HybridBlock):
 
 class BatchNorm(HybridBlock):
     """Batch normalization (reference: basic_layers.py:310). Moving stats are
-    aux parameters updated functionally (see ops/nn.py batch_norm)."""
+    aux parameters updated functionally (see ops/nn.py batch_norm).
+
+    TPU extension: `act_type="relu"` folds the following activation into the
+    op (BatchNormRelu), and calling the layer with a second input —
+    ``bn(x, residual)`` — folds a residual add in front of the activation
+    (BatchNormAddRelu). Parameter names/shapes are identical to the plain
+    layer, so fused and unfused models share checkpoints; under
+    MXTPU_PALLAS_CONV_EPILOGUE the fused op lowers to the Pallas
+    conv-epilogue kernels (ops/pallas_kernels.conv_epilogue)."""
 
     def __init__(self, axis=None, momentum=0.9, epsilon=1e-5, center=True, scale=True,
                  use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones", running_mean_initializer="zeros",
-                 running_variance_initializer="ones", in_channels=0, **kwargs):
+                 running_variance_initializer="ones", in_channels=0,
+                 act_type=None, **kwargs):
         super().__init__(**kwargs)
+        self._act_type = act_type
         if axis is None:
             # reference default is the channels-first axis (1); inside a
             # channels-last layout_scope the default follows the layout
@@ -233,7 +243,7 @@ class BatchNorm(HybridBlock):
                                                allow_deferred_init=True,
                                                differentiable=False)
 
-    def _shape_hook(self, x):
+    def _shape_hook(self, x, addend=None):
         if self._in_channels == 0:
             c = x.shape[self._axis]
             for p in (self.gamma, self.beta, self.running_mean, self.running_var):
@@ -245,7 +255,19 @@ class BatchNorm(HybridBlock):
             dtype = "float32"  # BN stats stay fp32 (reference does the same)
         super().cast(dtype)
 
-    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+    def hybrid_forward(self, F, x, addend=None, gamma=None, beta=None,
+                       running_mean=None, running_var=None):
+        if addend is not None:
+            if self._act_type is None:
+                raise ValueError(
+                    "BatchNorm: a residual input requires act_type "
+                    "(the fused BatchNormAddRelu path)")
+            return F.BatchNormAddRelu(x, addend, gamma, beta, running_mean,
+                                      running_var, act_type=self._act_type,
+                                      **self._kwargs)
+        if self._act_type is not None:
+            return F.BatchNormRelu(x, gamma, beta, running_mean, running_var,
+                                   act_type=self._act_type, **self._kwargs)
         return F.BatchNorm(x, gamma, beta, running_mean, running_var, **self._kwargs)
 
 

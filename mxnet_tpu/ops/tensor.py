@@ -461,9 +461,20 @@ def slice_like(data, shape_like, axes=()):
 
 
 @register("space_to_depth")
-def space_to_depth(data, block_size=1):
-    n, c, h, w = data.shape
+def space_to_depth(data, block_size=1, layout="NCHW"):
+    """Reference space_to_depth (NCHW, depth order = row-parity-major:
+    out channel = a·b·C + ß·C + c). The TPU build adds layout="NHWC"
+    (channels-last, same depth order) so the space-to-depth ResNet stem
+    works in the MXU-preferred layout without transposes."""
+    from .nn import _channels_last
+
     b = block_size
+    if _channels_last(layout):
+        n, h, w, c = data.shape
+        x = jnp.reshape(data, (n, h // b, b, w // b, b, c))
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return jnp.reshape(x, (n, h // b, w // b, c * b * b))
+    n, c, h, w = data.shape
     x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
     x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
     return jnp.reshape(x, (n, c * b * b, h // b, w // b))

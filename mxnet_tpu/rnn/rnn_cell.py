@@ -1,5 +1,9 @@
 """Symbolic RNN cells (reference: python/mxnet/rnn/rnn_cell.py).
 
+Derived from the reference implementation (Apache-2.0); cell/parameter
+naming (i2h/h2h weight-bias layout, gate order) kept for checkpoint
+compatibility with reference-trained models.
+
 The cell API unrolls recurrences explicitly into the symbolic graph —
 the formulation BucketingModule's per-length executors consume. Under
 this framework each unrolled bucket length compiles to its own XLA

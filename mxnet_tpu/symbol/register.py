@@ -21,6 +21,10 @@ _INPUT_SLOTS = {
     "Convolution": (["data", "weight", "bias"], []),
     "Deconvolution": (["data", "weight", "bias"], []),
     "BatchNorm": (["data", "gamma", "beta"], ["moving_mean", "moving_var"]),
+    "BatchNormRelu": (["data", "gamma", "beta"],
+                      ["moving_mean", "moving_var"]),
+    "BatchNormAddRelu": (["data", "addend", "gamma", "beta"],
+                         ["moving_mean", "moving_var"]),
     "LayerNorm": (["data", "gamma", "beta"], []),
     "InstanceNorm": (["data", "gamma", "beta"], []),
     "Embedding": (["data", "weight"], []),
@@ -310,6 +314,8 @@ _ARG_SHAPE_RULES = {
     "Convolution": _conv_rule,
     "Deconvolution": _deconv_rule,
     "BatchNorm": _bn_rule,
+    "BatchNormRelu": _bn_rule,
+    "BatchNormAddRelu": _bn_rule,
     "LayerNorm": _ln_rule,
     "InstanceNorm": _ln_rule,
     "Embedding": _embed_rule,

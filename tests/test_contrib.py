@@ -64,6 +64,29 @@ def test_quantize_graph_structure():
     assert "FullyConnected" in ops2
 
 
+def test_quantize_graph_shared_weight_no_duplicate_args():
+    """A weight consumed by TWO quantized layers must map to ONE
+    `<w>_quantize{,_min,_max}` var triple — duplicate same-named var nodes
+    deviate from nnvm semantics and break positional argument consumers
+    (ADVICE round-5 #1)."""
+    data = mx.sym.var("data")
+    w = mx.sym.var("shared_w")
+    f1 = mx.sym.FullyConnected(data=data, weight=w, num_hidden=16,
+                               no_bias=True, name="fc1")
+    f2 = mx.sym.FullyConnected(data=data, weight=w, num_hidden=16,
+                               no_bias=True, name="fc2")
+    sym = f1 + f2
+    qsym = q.quantize_graph(sym)
+    args = qsym.list_arguments()
+    dupes = [n for n, c in collections.Counter(args).items() if c > 1]
+    assert dupes == [], dupes
+    assert "shared_w_quantize" in args
+    # the two quantized FCs really consume the SAME var node
+    qvars = [n for n in qsym._topo()
+             if n.is_var and n.name == "shared_w_quantize"]
+    assert len(qvars) == 1
+
+
 def test_quantized_symbol_module_bind():
     """A quantized symbol must bind in Module (the reference deployment
     flow: example/quantization/imagenet_inference.py mod.bind on qsym).

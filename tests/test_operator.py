@@ -534,6 +534,19 @@ case("BatchNorm", U(2, 3, 4, 4), np.ones(3, np.float32),
      np.ones(3, np.float32), attrs={"fix_gamma": False},
      check=lambda outs, c: outs[0].shape == (2, 3, 4, 4) or
      pytest.fail("bn shape"))
+case("BatchNormRelu", U(2, 3, 4, 4), np.ones(3, np.float32),
+     np.zeros(3, np.float32), np.zeros(3, np.float32),
+     np.ones(3, np.float32), attrs={"fix_gamma": False},
+     check=lambda outs, c: (outs[0].shape == (2, 3, 4, 4)
+                            and float(outs[0].min()) >= 0.0) or
+     pytest.fail("bn+relu shape/sign"))
+case("BatchNormAddRelu", U(2, 3, 4, 4), U(2, 3, 4, 4),
+     np.ones(3, np.float32), np.zeros(3, np.float32),
+     np.zeros(3, np.float32), np.ones(3, np.float32),
+     attrs={"fix_gamma": False},
+     check=lambda outs, c: (outs[0].shape == (2, 3, 4, 4)
+                            and float(outs[0].min()) >= 0.0) or
+     pytest.fail("bn+add+relu shape/sign"))
 case("LayerNorm", U(2, 6), np.ones(6, np.float32), np.zeros(6, np.float32),
      ref=lambda x, g, b, **kw: (x - x.mean(-1, keepdims=True))
      / np.sqrt(x.var(-1, keepdims=True) + 1e-5),

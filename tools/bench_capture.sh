@@ -21,6 +21,13 @@ print(d.platform, d.device_kind)
 " 2>/dev/null
 }
 
+# offline evidence first (CPU, no accelerator needed): HLO-diff + FLOP/byte
+# notes for every perf-sensitive segment at this SHA land in
+# docs/perf_evidence/ even if the tunnel never opens this round
+echo "[bench_capture] generating offline perf evidence (CPU)" >&2
+JAX_PLATFORMS=cpu timeout 900 python tools/perf_evidence.py >&2 || \
+  echo "[bench_capture] perf_evidence FAILED (continuing)" >&2
+
 echo "[bench_capture] probing accelerator every ${SLEEP}s..." >&2
 while true; do
   KIND=$(probe) && [ -n "$KIND" ] && break
@@ -65,6 +72,18 @@ echo "[bench_capture] bisect rc=$?" >&2
 
 run_one train_nhwc      MXTPU_BENCH_MODE=train MXTPU_BENCH_LAYOUT=NHWC
 run_one score_nhwc      MXTPU_BENCH_MODE=score MXTPU_BENCH_LAYOUT=NHWC
+
+# conv-epilogue + space-to-depth stem A-B (the round-6 fusion work): off /
+# fused / stem / combined, all NHWC train — one window answers the whole
+# comparison without further code changes
+run_one train_nhwc_epioff      MXTPU_BENCH_MODE=train MXTPU_BENCH_LAYOUT=NHWC \
+                               MXTPU_PALLAS_CONV_EPILOGUE=0
+run_one train_nhwc_epifuse     MXTPU_BENCH_MODE=train MXTPU_BENCH_LAYOUT=NHWC \
+                               MXTPU_PALLAS_CONV_EPILOGUE=1
+run_one train_nhwc_s2d         MXTPU_BENCH_MODE=train MXTPU_BENCH_LAYOUT=NHWC \
+                               MXTPU_PALLAS_CONV_EPILOGUE=0 MXTPU_S2D_STEM=1
+run_one train_nhwc_epifuse_s2d MXTPU_BENCH_MODE=train MXTPU_BENCH_LAYOUT=NHWC \
+                               MXTPU_PALLAS_CONV_EPILOGUE=1 MXTPU_S2D_STEM=1
 run_one score_resnet152 MXTPU_BENCH_MODE=score MXTPU_BENCH_NET=resnet152
 run_one score_inception MXTPU_BENCH_MODE=score MXTPU_BENCH_NET=inception_v3
 run_one train_inception MXTPU_BENCH_MODE=train MXTPU_BENCH_NET=inception_v3 MXTPU_BENCH_BATCH=128
@@ -76,6 +95,7 @@ PYTHONPATH=".:${PYTHONPATH:-}" timeout 900 python tools/int8_probe.py \
 echo "[bench_capture] int8 probe rc=$?" >&2
 run_one bert            MXTPU_BENCH_MODE=bert
 run_one lstm            MXTPU_BENCH_MODE=lstm
+run_one lstm_scan       MXTPU_BENCH_MODE=lstm MXTPU_PALLAS_LSTM=0
 
 echo "[bench_capture] running tpu smoke suite" >&2
 MXTPU_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_smoke.py -v \

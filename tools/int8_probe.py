@@ -105,16 +105,19 @@ def main():
         @jax.jit
         def int8_loop(x, w):
             def body(_, c):
-                # perturb via the int8 weight: xor with a 0/1 derived from
-                # the carry (additive fp perturbation would change dtype)
-                wp = w + (c * 1e30).astype(jnp.int8)  # c ~ 1e-30 -> 0 or 1
+                # perturb via the int8 weight: XOR with a 0/1 derived
+                # from the carry — unlike `w + bit`, XOR cannot wrap int8
+                # (127+1 -> -128 flipped perturbed weights to the extreme,
+                # so the int8 and bf16 loops computed on slightly different
+                # weight distributions)
+                wp = w ^ (c * 1e30).astype(jnp.int8)  # c ~ 1e-30 -> 0 or 1
                 return chain(conv(x, wp, jnp.int32))
             return lax.fori_loop(0, ITERS, body, jnp.zeros((), jnp.float32))
 
         @jax.jit
         def int8_rq_loop(x, w):
             def body(_, c):
-                wp = w + (c * 1e30).astype(jnp.int8)
+                wp = w ^ (c * 1e30).astype(jnp.int8)
                 acc = conv(x, wp, jnp.int32)
                 # deployed epilogue: static-scale requantize to int8
                 q = jnp.clip(jnp.round(acc.astype(jnp.float32) * 7.3e-4),
@@ -146,8 +149,10 @@ def main():
             @jax.jit
             def loop(a, b):
                 def body(_, c):
-                    bp = b + (c * (1e30 if pet is jnp.int32 else 1.0)
-                              ).astype(b.dtype)
+                    if pet is jnp.int32:  # int8 operands: XOR, no wraparound
+                        bp = b ^ (c * 1e30).astype(b.dtype)
+                    else:
+                        bp = b + c.astype(b.dtype)
                     kw = {"preferred_element_type": pet} if pet else {}
                     return chain(jnp.dot(a, bp, **kw))
                 return lax.fori_loop(0, ITERS, body,
