@@ -9,9 +9,13 @@ the small registry machinery the op/optimizer/metric/initializer registries use
 """
 from __future__ import annotations
 
+import os
+import tempfile
+
 import numpy as _np
 
-__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types"]
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
+           "atomic_writer"]
 
 # Host-array mode: when True, host-side pipeline stages (image decode,
 # dataset __getitem__) hand back plain numpy instead of NDArray. Set in
@@ -77,6 +81,79 @@ def enable_persistent_compile_cache():
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (reference: python/mxnet/base.py:49)."""
+
+
+class atomic_writer:
+    """Crash-consistent file write: ``with atomic_writer(path, 'wb') as f``
+    writes to a same-directory temp file, fsyncs it, and atomically renames
+    onto `path` only if the block completed — a process killed mid-write can
+    leave a stale temp file but never a truncated `path`. Readers therefore
+    always see either the previous complete file or the new complete file
+    (the reference's single-file NDArray::Save had no such guarantee; a kill
+    mid-save corrupted the checkpoint). The rename is same-filesystem by
+    construction (temp lives next to the target)."""
+
+    def __init__(self, path, mode="wb"):
+        self._path = os.fspath(path)
+        self._mode = mode
+        self._tmp = None
+        self._f = None
+
+    def __enter__(self):
+        d = os.path.dirname(os.path.abspath(self._path)) or "."
+        fd, self._tmp = tempfile.mkstemp(
+            dir=d, prefix=os.path.basename(self._path) + ".tmp-")
+        # mkstemp creates 0600; the rename would stamp that onto the target.
+        # Preserve an existing target's mode, else honor the umask like a
+        # plain open() would — shared-directory checkpoints must stay
+        # readable by their consumers (eval/monitoring processes).
+        try:
+            mode = os.stat(self._path).st_mode & 0o7777
+        except OSError:
+            umask = os.umask(0)
+            os.umask(umask)
+            mode = 0o666 & ~umask
+        try:
+            os.fchmod(fd, mode)
+        except OSError:
+            pass
+        self._f = os.fdopen(fd, self._mode)
+        return self._f
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            try:
+                if exc_type is None:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+            finally:
+                # close unconditionally — a flush/fsync failure (ENOSPC)
+                # must not leak the temp fd on every retried checkpoint
+                self._f.close()
+            if exc_type is None:
+                os.replace(self._tmp, self._path)
+                self._tmp = None
+                _fsync_dir(os.path.dirname(os.path.abspath(self._path)) or ".")
+        finally:
+            if self._tmp is not None and os.path.exists(self._tmp):
+                os.unlink(self._tmp)
+        return False
+
+
+def _fsync_dir(path):
+    """Persist a rename by fsyncing the containing directory (POSIX: the
+    rename itself is atomic but only durable once the dir entry is synced).
+    Best-effort — some filesystems refuse O_RDONLY dir fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 string_types = (str,)

@@ -10,6 +10,8 @@ sharded copy of each parameter on the mesh and lets XLA insert the
 all-reduces inside the compiled step (SURVEY §2.3 row 1)."""
 from __future__ import annotations
 
+import os
+
 from ..base import MXNetError
 from .. import optimizer as opt
 from .parameter import Parameter, ParameterDict
@@ -40,6 +42,10 @@ class Trainer:
         self._kvstore = None
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
+        # completed-update cursor: drives the fault-injection hook and is
+        # saved/restored with the optimizer states so an auto-resumed run
+        # keeps a monotonically correct step count (parallel/resilience.py)
+        self._step_count = 0
 
     def _check_contexts(self):
         contexts = None
@@ -104,6 +110,11 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    @property
+    def step_count(self):
+        """Number of completed step() calls (survives save/load_states)."""
+        return self._step_count
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Allreduce grads + update (reference: trainer.py:298)."""
         if not self._kv_initialized:
@@ -111,6 +122,13 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        self._step_count += 1
+        # step-boundary fault hook; the env guard keeps the hot path free
+        # of even the import lookup when injection is unarmed
+        if os.environ.get("MXTPU_FAULT_INJECT"):
+            from ..parallel import resilience
+
+            resilience.maybe_inject_fault(self._step_count)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -165,15 +183,35 @@ class Trainer:
                 arr._fresh_grad = False
 
     def save_states(self, fname):
-        """reference: trainer.py:429"""
+        """reference: trainer.py:429 — extended with the step cursor so an
+        auto-resumed run (parallel/resilience.py) continues the schedule,
+        and written atomically (temp + fsync + rename) so a kill mid-save
+        never truncates the states file."""
+        import pickle
+
+        from ..base import atomic_writer
+
         assert self._optimizer is not None
-        with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=True))
+        blob = {"__mxtpu_trainer_states__": 1,
+                "updater": self._updaters[0].get_states(dump_optimizer=True),
+                "step_count": self._step_count}
+        with atomic_writer(fname, "wb") as f:
+            pickle.dump(blob, f)
 
     def load_states(self, fname):
-        """reference: trainer.py:458"""
+        """reference: trainer.py:458 (legacy raw updater blobs still load)."""
+        import pickle
+
         with open(fname, "rb") as f:
-            states = f.read()
+            raw = f.read()
+        states = raw
+        try:
+            blob = pickle.loads(raw)
+        except Exception:
+            blob = None
+        if isinstance(blob, dict) and "__mxtpu_trainer_states__" in blob:
+            states = blob["updater"]
+            self._step_count = int(blob.get("step_count", 0))
         for updater in self._updaters:
             updater.set_states(states)
             updater.optimizer = self._optimizer

@@ -230,10 +230,13 @@ class KVStore:
         self._compression_params = params
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
-        """reference: kvstore.py:482."""
+        """reference: kvstore.py:482 — written atomically (temp + fsync +
+        rename) like every other checkpoint path."""
+        from .base import atomic_writer
+
         if self._updater is None:
             raise MXNetError("no optimizer installed on this kvstore")
-        with open(fname, "wb") as f:
+        with atomic_writer(fname, "wb") as f:
             f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
 
     def load_optimizer_states(self, fname):
@@ -262,6 +265,18 @@ class _DistKVStore(KVStore):
     Under one process this is identical to `local`. The cross-process sum
     uses a tiny jitted psum over a 1-axis process mesh — DCN-aware via XLA.
     """
+
+    def __init__(self, name="dist_sync"):
+        super().__init__(name)
+        # ensure the process group exists (bounded rendezvous): a worker
+        # that calls kv.create('dist_sync') without an explicit
+        # init_process_group() still joins the group — and a group whose
+        # peer never arrives fails with a diagnosable MXNetError within
+        # MXTPU_RENDEZVOUS_TIMEOUT instead of hanging the first collective.
+        # No-op when single-process, env-less, or already initialized.
+        from .parallel import collectives
+
+        collectives.init_process_group()
 
     def init(self, key, value):
         super().init(key, value)

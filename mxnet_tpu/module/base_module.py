@@ -141,13 +141,32 @@ class BaseModule(object):
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
+            monitor=None, sparse_row_id_fn=None,
+            checkpoint_dir=None, checkpoint_period=1, resume=None):
         """The canonical symbolic training loop (reference:
-        base_module.py:409; call stack SURVEY §3.1)."""
+        base_module.py:409; call stack SURVEY §3.1).
+
+        Fault tolerance (beyond the reference — docs/fault_tolerance.md):
+        `checkpoint_dir` enables crash-consistent end-of-epoch checkpoints
+        (params + optimizer states, atomic rename, keep-last-N) every
+        `checkpoint_period` epochs via parallel.resilience.CheckpointManager;
+        `resume='auto'` restores the newest COMPLETE checkpoint from that
+        directory — params, optimizer states, RNG chain and epoch cursor —
+        so a restarted generation (tools/launch.py --max-restarts) continues
+        training instead of starting from epoch 0. `resume=<int>` pins an
+        epoch explicitly (raises MXNetError if that step is corrupt)."""
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
 
         initializer = initializer or Uniform(0.01)
+
+        mgr = None
+        if checkpoint_dir is not None:
+            from ..parallel.resilience import CheckpointManager
+
+            mgr = CheckpointManager(checkpoint_dir)
+        elif resume is not None:
+            raise MXNetError("fit(resume=...) needs checkpoint_dir=")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -159,10 +178,27 @@ class BaseModule(object):
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if mgr is not None and resume is not None:
+            header = mgr.restore(
+                load_params=self.load_params,
+                load_states=self.load_optimizer_states,
+                step=None if resume == "auto" else int(resume))
+            # restore() returns None only for resume='auto' with no complete
+            # checkpoint (fresh start); an explicit epoch that is missing or
+            # corrupt raises its own MXNetError inside restore()
+            if header is not None:
+                begin_epoch = int(header["meta"].get(
+                    "epoch", header["step"])) + 1
+                self.logger.info(
+                    "resumed from checkpoint step %d (%s); continuing at "
+                    "epoch %d", header["step"], mgr.directory, begin_epoch)
         if validation_metric is None:
             validation_metric = eval_metric
         eval_metric = _as_metric(eval_metric)
 
+        from ..parallel.resilience import maybe_inject_fault
+
+        fit_updates = 0
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -173,6 +209,10 @@ class BaseModule(object):
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                fit_updates += 1
+                # step-boundary fault hook: counts updates since THIS
+                # process started (no-op unless MXTPU_FAULT_INJECT is set)
+                maybe_inject_fault(fit_updates)
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
@@ -190,6 +230,11 @@ class BaseModule(object):
 
             arg_p, aux_p = self.get_params()
             self.set_params(arg_p, aux_p)  # sync exec copies
+
+            if mgr is not None and (epoch + 1) % checkpoint_period == 0:
+                mgr.save(epoch, save_params=self.save_params,
+                         save_states=self.save_optimizer_states,
+                         meta={"epoch": epoch})
 
             if epoch_end_callback is not None:
                 for cb in _as_list(epoch_end_callback):

@@ -312,8 +312,12 @@ class Module(BaseModule):
         nd.save(fname, save_dict)
 
     def save_optimizer_states(self, fname):
+        from ..base import atomic_writer
+
         assert self.optimizer_initialized
-        with open(fname, "wb") as f:
+        # atomic (temp + fsync + rename): save_checkpoint's .states file
+        # gets the same crash-consistency as its .params file
+        with atomic_writer(fname, "wb") as f:
             f.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

@@ -760,18 +760,26 @@ def waitall():
 def save(fname, data):
     """Save NDArrays (reference format: prefix.params via NDArray::Save
     src/ndarray/ndarray.cc; ours is an npz container — same keys/roundtrip,
-    different binary layout, documented divergence)."""
+    different binary layout, documented divergence).
+
+    Crash-consistent: the npz is written to a same-directory temp file,
+    fsynced, and atomically renamed onto `fname` — a worker killed mid-save
+    (the fault-tolerance layer's failure model, docs/fault_tolerance.md)
+    never leaves a truncated `.params` file, only either the old complete
+    file or the new one. Every checkpoint path (`model.save_checkpoint`,
+    `Block.save_parameters`, `Module.save_params`) funnels through here."""
+    from ..base import atomic_writer
+
     if isinstance(data, NDArray):
         data = {"0": data}
     if isinstance(data, (list, tuple)):
         data = {str(i): v for i, v in enumerate(data)}
-    _np.savez(fname if fname.endswith(".npz") else fname, **{
-        k: v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v) for k, v in data.items()})
-    import os
-
-    # numpy appends .npz; keep the exact requested filename
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
+    arrays = {k: v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
+              for k, v in data.items()}
+    # write through a file object: savez then cannot append ".npz" to the
+    # name, so the rename target is exactly the requested filename
+    with atomic_writer(fname, "wb") as f:
+        _np.savez(f, **arrays)
 
 
 class _DLPackCapsule:
@@ -813,15 +821,35 @@ def to_dlpack_for_write(arr):
 
 
 def load(fname):
+    from ..base import MXNetError
+
+    src = fname
     if isinstance(fname, (bytes, bytearray)):
         # in-memory load (reference: MXNDListCreate takes raw file bytes)
         import io
 
-        fname = io.BytesIO(bytes(fname))
-    with _np.load(fname, allow_pickle=False) as f:
-        # preserve the on-disk dtype: array() defaults to float32, which
-        # would silently upcast e.g. offline-quantized int8 params
-        out = {k: array(f[k], dtype=f[k].dtype) for k in f.files}
+        src = io.BytesIO(bytes(fname))
+        fname = "<bytes>"
+    import zipfile
+    import zlib
+
+    try:
+        with _np.load(src, allow_pickle=False) as f:
+            # preserve the on-disk dtype: array() defaults to float32, which
+            # would silently upcast e.g. offline-quantized int8 params
+            out = {k: array(f[k], dtype=f[k].dtype) for k in f.files}
+    except (zipfile.BadZipFile, EOFError, zlib.error) as e:
+        # ONLY the actual truncation/corruption signatures get the
+        # corruption diagnosis — other errors (allow_pickle refusals,
+        # IO/permission problems) keep their original meaning
+        raise MXNetError(
+            "failed to load NDArrays from %r: file is truncated or corrupt "
+            "(%s: %s). nd.save writes atomically (temp + rename), so a "
+            "complete save can't produce this — the file was likely copied "
+            "partially, written by an interrupted transfer, or predates the "
+            "atomic-save format. Restore from the previous checkpoint "
+            "(CheckpointManager.latest() skips corrupt steps automatically)."
+            % (fname, type(e).__name__, e)) from e
     keys = list(out)
     if keys and all(k.isdigit() for k in keys):
         return [out[k] for k in sorted(keys, key=int)]

@@ -540,12 +540,25 @@ class Updater:
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
 
     def set_states(self, states):
+        def _nd_state(s):
+            # inverse of get_states' _np_state: rehydrate numpy leaves into
+            # NDArray (dtype preserved — momentum may be fp16/bf16). Leaving
+            # numpy in self.states crashed the first post-restore update
+            # (the jitted optimizer kernels key on NDArray inputs).
+            if isinstance(s, _np.ndarray):
+                return nd.array(s, dtype=s.dtype)
+            if isinstance(s, (tuple, list)):
+                return tuple(_nd_state(x) for x in s)
+            return s
+
         data = pickle.loads(states)
         if isinstance(data, tuple) and len(data) == 2:
-            self.states, opt_state = data
+            loaded, opt_state = data
             self.optimizer.__dict__.update(opt_state)
         else:
-            self.states = data
+            loaded = data
+        self.states = {k: _nd_state(v) for k, v in loaded.items()}
+        self.states_synced = {k: True for k in self.states}
 
     def get_states(self, dump_optimizer=False):
         def _np_state(s):

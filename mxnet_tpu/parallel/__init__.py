@@ -13,6 +13,9 @@ with mesh-sharded compiled steps:
   pipeline    — GPipe-style microbatch pipeline over the pp axis
   pipeline_trainer — PipelineTrainer: pipeline a real Gluon model
                 (BERT encoder stack) end-to-end incl. optimizer
+  resilience  — fault tolerance: crash-consistent CheckpointManager,
+                auto-resume, MXTPU_FAULT_INJECT harness (pairs with the
+                elastic tools/launch.py --max-restarts supervisor)
   (expert parallelism: gluon.contrib.moe.MoEFFN + the `ep` sharding rule)
 """
 from .mesh import (make_mesh, default_mesh, current_mesh, use_mesh,
@@ -23,6 +26,8 @@ from . import collectives
 from .collectives import (init_process_group, rank, num_workers, barrier,
                           all_reduce_arrays)
 from .trainer import DistributedTrainer
+from . import resilience
+from .resilience import CheckpointManager, maybe_inject_fault
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply, pipeline_stack_params
 from .pipeline_trainer import PipelineTrainer
@@ -33,6 +38,7 @@ __all__ = [
     "ShardingRules", "named_sharding", "shard_array", "batch_spec",
     "param_spec", "constraint", "collectives", "init_process_group", "rank",
     "num_workers", "barrier", "all_reduce_arrays", "DistributedTrainer",
+    "resilience", "CheckpointManager", "maybe_inject_fault",
     "ring_attention", "ring_attention_sharded",
     "pipeline_apply", "pipeline_stack_params", "PipelineTrainer",
 ]

@@ -137,14 +137,66 @@ def broadcast_arrays(src, devices):
 
 # ---- multi-host bootstrap (ps-lite scheduler replacement) -----------------
 
-def init_process_group(coordinator_address=None, num_processes=None,
-                       process_id=None):
-    """Multi-host rendezvous via jax.distributed — replaces the DMLC_PS_ROOT
-    scheduler env protocol (SURVEY §3.4). No-op when single-process or when
-    the envs are absent."""
+def _enable_cpu_collectives(jax):
+    """Multi-process groups on the CPU backend need an explicit cross-host
+    collectives implementation — without one, every cross-process psum dies
+    with XLA's 'Multiprocess computations aren't implemented on the CPU
+    backend'. Select gloo when the platform is explicitly CPU (tests,
+    localhost launches; MXTPU_CPU_COLLECTIVES overrides, 'none' disables).
+    Must run before backend init, i.e. alongside the rendezvous."""
     import os
 
+    impl = os.environ.get("MXTPU_CPU_COLLECTIVES", "gloo")
+    if impl == "none":
+        return
+    plats = (jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS")
+             or "")
+    if "cpu" not in [p.strip() for p in plats.split(",")]:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+    except Exception:
+        pass  # config absent on this jax: keep the old single-process-only
+        # behavior rather than failing the rendezvous
+
+
+def _group_initialized(jax):
+    """Is the jax.distributed client already up? `jax.distributed
+    .is_initialized` only exists on newer jax; older releases (this image's
+    0.4.37 included) expose the state via the module-level singleton. This
+    gap made init_process_group raise on EVERY multi-process worker — the
+    five seed test_dist_kvstore failures."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    try:
+        from jax._src import distributed as _dist
+
+        state = getattr(_dist, "global_state", None)
+        return state is not None and state.client is not None
+    except Exception:
+        return False
+
+
+def init_process_group(coordinator_address=None, num_processes=None,
+                       process_id=None, timeout=None, retries=None):
+    """Multi-host rendezvous via jax.distributed — replaces the DMLC_PS_ROOT
+    scheduler env protocol (SURVEY §3.4). No-op when single-process or when
+    the envs are absent.
+
+    Bounded (docs/fault_tolerance.md): the rendezvous waits at most
+    `timeout` seconds (default ``MXTPU_RENDEZVOUS_TIMEOUT``, 300) for the
+    group to assemble, redialing transient errors `retries` times (default
+    ``MXTPU_RENDEZVOUS_RETRIES``, 0) with exponential backoff before
+    raising a diagnosable MXNetError — a worker group whose peer died or
+    never launched fails fast instead of parking every rank forever (the
+    ps-lite scheduler's van timeout analogue, restored for the
+    jax.distributed coordinator)."""
+    import os
+    import time as _time
+
     import jax
+
+    from ..base import MXNetError
 
     def _env_int(*names):
         for n in names:
@@ -174,22 +226,125 @@ def init_process_group(coordinator_address=None, num_processes=None,
         process_id = _env_int("MXTPU_PROCESS_ID", "DMLC_WORKER_ID",
                               "OMPI_COMM_WORLD_RANK", "PMI_RANK",
                               "SLURM_PROCID")
-    if jax.distributed.is_initialized():
+    if _group_initialized(jax):
         return  # idempotent re-entry
+    if timeout is None:
+        timeout = _env_int("MXTPU_RENDEZVOUS_TIMEOUT")
+        if timeout is None:
+            timeout = 300  # explicit 0 means "fail immediately", keep it
+    if retries is None:
+        # default 0: total time to a clear failure stays within ONE timeout
+        # (+ margin) — the acceptance bar for a never-arriving peer. Set
+        # MXTPU_RENDEZVOUS_RETRIES>0 for flaky fabrics where a second dial
+        # (with backoff) is worth paying the extra timeout windows.
+        retries = _env_int("MXTPU_RENDEZVOUS_RETRIES") or 0
     # NOTE: must run before the first jax computation — the backend snapshots
     # the process group at creation (call this before importing anything
     # that touches jax arrays, or at worker start; tools/launch.py pattern)
-    if coordinator_address is None:
-        # no launcher-provided coordinator: hand jax the whole rendezvous —
-        # its cluster auto-detection covers slurm (srun nodelist), OpenMPI,
-        # and Cloud TPU pod metadata, and fails with its own clear error
-        # when nothing can resolve. Do NOT pass size/rank: auto-detection
-        # derives them from the same source as the coordinator.
-        jax.distributed.initialize()
-        return
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    _enable_cpu_collectives(jax)
+
+    def _diagnosis(cause):
+        return (
+            "distributed rendezvous failed (timeout %ds): rank %s of %s "
+            "dialing coordinator %s — %s. A peer likely died before "
+            "rendezvous or never launched; check the other ranks' logs "
+            "(tools/launch.py prefixes them per rank), raise "
+            "MXTPU_RENDEZVOUS_TIMEOUT for slow fleets, or use "
+            "tools/launch.py --max-restarts for automatic group restart."
+            % (timeout, "?" if process_id is None else process_id,
+               num_processes, coordinator_address or "<auto-detect>", cause))
+
+    backoff = 1.0
+    for attempt in range(retries + 1):
+        try:
+            _dial_with_deadline(jax, coordinator_address, num_processes,
+                                process_id, timeout)
+            return
+        except _RendezvousTimeout:
+            # the deadline expired with every side still waiting: the
+            # missing peer won't materialize on a redial, so retries are
+            # pointless — surface the bounded failure immediately
+            raise MXNetError(_diagnosis(
+                "group did not assemble within the deadline")) from None
+        except Exception as e:  # bind failure / RuntimeError / grpc error
+            # tear down any half-initialized client so a retry starts clean
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            if attempt >= retries:
+                raise MXNetError(_diagnosis(
+                    "%s: %s (after %d attempt(s))"
+                    % (type(e).__name__, e, retries + 1))) from e
+            _time.sleep(backoff)
+            backoff = min(backoff * 2, 30.0)
+
+
+class _RendezvousTimeout(Exception):
+    """Internal: the dial thread outlived the configured deadline."""
+
+
+def _dial_with_deadline(jax, coordinator_address, num_processes, process_id,
+                        timeout):
+    """Run jax.distributed.initialize under OUR deadline instead of XLA's.
+
+    XLA's own initialization_timeout is useless as a failure bound: on
+    expiry the coordination-service client LOG(FATAL)s — the whole process
+    aborts with a C++ stack instead of an exception anything can catch
+    (observed: 'Terminating process because the JAX distributed service
+    detected fatal errors ... DEADLINE_EXCEEDED ... RegisterTask'). So the
+    dial runs on a daemon thread with XLA's deadline pushed far past ours,
+    and the calling thread enforces `timeout` with a join: expiry raises a
+    catchable _RendezvousTimeout → MXNetError, and the parked dial thread
+    dies with the process (the worker exits on the error; even if the
+    caller lingers, XLA's far deadline eventually reclaims the thread)."""
+    import threading
+
+    box = {}
+    lock = threading.Lock()
+
+    def dial():
+        try:
+            if coordinator_address is None:
+                # no launcher-provided coordinator: hand jax the whole
+                # rendezvous — its cluster auto-detection covers slurm (srun
+                # nodelist), OpenMPI, and Cloud TPU pod metadata, and fails
+                # with its own clear error when nothing can resolve. Do NOT
+                # pass size/rank: auto-detection derives them from the same
+                # source as the coordinator.
+                jax.distributed.initialize(
+                    initialization_timeout=timeout + 86400)
+            else:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    initialization_timeout=timeout + 86400)
+            with lock:
+                if box.get("abandoned"):
+                    # the caller already reported failure and may have
+                    # fallen back to single-process work: a group that
+                    # assembles late must NOT silently come alive under it
+                    try:
+                        jax.distributed.shutdown()
+                    except Exception:
+                        pass
+                else:
+                    box["ok"] = True
+        except BaseException as e:  # surfaced to the caller below
+            box["err"] = e
+
+    t = threading.Thread(target=dial, name="mxtpu-rendezvous-dial",
+                         daemon=True)
+    t.start()
+    t.join(timeout)
+    with lock:
+        if "ok" in box:
+            return
+        box["abandoned"] = True
+    if "err" in box:
+        raise box["err"]
+    raise _RendezvousTimeout()
 
 
 def rank():

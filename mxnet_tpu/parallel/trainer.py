@@ -345,6 +345,10 @@ class DistributedTrainer:
             key, t, jnp.asarray(lr, dtype=jnp.float32),
             self._arrays, self._states, *batch)
         ctx = self._params[0].list_ctx()[0]
+        from . import resilience
+
+        # step-boundary fault hook (no-op unless MXTPU_FAULT_INJECT is set)
+        resilience.maybe_inject_fault(self._step_count)
         return NDArray(loss_val, ctx=ctx)
 
     def _shard_batch(self, arr):
@@ -481,9 +485,13 @@ class DistributedTrainer:
 
         import jax
 
+        from ..base import atomic_writer
+
         states = _tree_map(lambda a: np.asarray(jax.device_get(a)),
                            self._states)
-        with open(fname, "wb") as f:
+        # atomic (temp + fsync + rename): a preempted pod mid-save keeps the
+        # previous complete states file intact (parallel/resilience.py)
+        with atomic_writer(fname, "wb") as f:
             pickle.dump({"states": states, "step": self._step_count,
                          "num_update": self._optimizer.num_update}, f)
 

@@ -13,7 +13,7 @@ import threading
 
 import numpy as _np
 
-__all__ = ["seed", "next_key", "current_seed"]
+__all__ = ["seed", "next_key", "current_seed", "get_state", "set_state"]
 
 _state = threading.local()
 _DEFAULT_SEED = 0
@@ -44,6 +44,37 @@ def seed(seed_state, ctx="all"):
 
 def current_seed():
     return _get().seed_val
+
+
+def get_state():
+    """Snapshot the global key chain as plain host data (for checkpoints —
+    parallel/resilience.py captures this so a resumed run continues the
+    SAME random stream it would have seen uninterrupted: dropout masks,
+    shuffles and init draws replay identically after auto-resume)."""
+    import jax
+
+    st = _get()
+    key = st.key
+    if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+        key = jax.random.key_data(key)  # typed keys serialize via raw data
+    return {"seed": st.seed_val,
+            "key": _np.asarray(key).tolist(),
+            "staged_ctr": getattr(st, "staged_ctr", 0)}
+
+
+def set_state(state):
+    """Restore a get_state() snapshot (checkpoint resume path)."""
+    import jax
+    import jax.numpy as jnp
+
+    st = _get()
+    st.seed_val = int(state["seed"])
+    key = jnp.asarray(_np.asarray(state["key"], dtype=_np.uint32))
+    # rewrap through the typed-key API when the snapshot came from one
+    if jax.dtypes.issubdtype(st.key.dtype, jax.dtypes.prng_key):
+        key = jax.random.wrap_key_data(key)
+    st.key = key
+    st.staged_ctr = int(state.get("staged_ctr", 0))
 
 
 def next_key():

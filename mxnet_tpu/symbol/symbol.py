@@ -523,7 +523,11 @@ class Symbol:
         return json.dumps(out, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        from ..base import atomic_writer
+
+        # atomic (temp + fsync + rename): a kill mid-save never truncates an
+        # existing prefix-symbol.json (same guarantee as nd.save)
+        with atomic_writer(fname, "w") as f:
             f.write(self.tojson())
 
     # debugging

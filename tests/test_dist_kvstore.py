@@ -12,18 +12,36 @@ import pytest
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Sandbox hardening (round-5 postmortem: these tests hit the 600s subprocess
+# timeout on boxes where the rendezvous can't complete): every worker group
+# runs under a finite MXTPU_RENDEZVOUS_TIMEOUT so a peer that can't arrive
+# produces a diagnosable MXNetError in the captured output, and the launcher
+# gets --max-restarts so a coordinator port-bind collision (launcher probed a
+# port, another process grabbed it first) retries on a FRESH port instead of
+# failing the test. Worst case is bounded: restarts × (timeout + teardown),
+# well inside the subprocess timeout.
+_RDV_TIMEOUT = "60"
+_RESTARTS = ["--max-restarts", "2", "--restart-backoff", "0.5"]
+_SUBPROC_TIMEOUT = 420
 
-@pytest.mark.parametrize("n", [2, 3])
-def test_dist_sync_kvstore_multiprocess(n):
+
+def _worker_env():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # workers use their own single cpu device
+    env.setdefault("MXTPU_RENDEZVOUS_TIMEOUT", _RDV_TIMEOUT)
+    return env
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_dist_sync_kvstore_multiprocess(n):
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
-         "-n", str(n), "--",
+         "-n", str(n)] + _RESTARTS + ["--",
          sys.executable,
          os.path.join(_ROOT, "tests", "dist_sync_kvstore_worker.py")],
-        env=env, capture_output=True, text=True, timeout=600)
+        env=_worker_env(), capture_output=True, text=True,
+        timeout=_SUBPROC_TIMEOUT)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
     for r in range(n):
@@ -46,15 +64,13 @@ def test_dist_trainer_single_device_syncs():
     whenever len(contexts) < 2, silently training each rank independently).
     Ranks train on different shards; identical weight checksums prove the
     sync happened."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
-         "-n", "2", "--",
+         "-n", "2"] + _RESTARTS + ["--",
          sys.executable,
          os.path.join(_ROOT, "tests", "dist_trainer_worker.py")],
-        env=env, capture_output=True, text=True, timeout=600)
+        env=_worker_env(), capture_output=True, text=True,
+        timeout=_SUBPROC_TIMEOUT)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
     import re
@@ -76,9 +92,6 @@ def test_launch_ssh_mode(tmp_path):
     shim.chmod(0o755)
     hostfile = tmp_path / "hosts"
     hostfile.write_text("# two slots on one 'machine'\n127.0.0.1:2\n")
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
     # the shim runs everything locally, so probe a known-free local port
     # instead of letting ssh mode pick a random unverifiable one
     import socket
@@ -88,11 +101,12 @@ def test_launch_ssh_mode(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
          "-n", "2", "--launcher", "ssh", "-H", str(hostfile),
-         "--port", str(port),
+         "--port", str(port)] + _RESTARTS + [
          "--ssh-cmd", str(shim), "--",
          sys.executable,
          os.path.join(_ROOT, "tests", "dist_sync_kvstore_worker.py")],
-        env=env, capture_output=True, text=True, timeout=600)
+        env=_worker_env(), capture_output=True, text=True,
+        timeout=_SUBPROC_TIMEOUT)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
     for r in range(2):
@@ -127,9 +141,6 @@ for r in range(np):
 sys.exit(max(p.wait() for p in procs))
 """)
     shim.chmod(0o755)
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
     import socket
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -137,10 +148,12 @@ sys.exit(max(p.wait() for p in procs))
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
          "-n", "2", "--launcher", "mpi", "--mpi-cmd", str(shim),
-         "--coordinator-host", "127.0.0.1", "--port", str(port), "--",
+         "--coordinator-host", "127.0.0.1", "--port", str(port)]
+        + _RESTARTS + ["--",
          sys.executable,
          os.path.join(_ROOT, "tests", "dist_sync_kvstore_worker.py")],
-        env=env, capture_output=True, text=True, timeout=600)
+        env=_worker_env(), capture_output=True, text=True,
+        timeout=_SUBPROC_TIMEOUT)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
     for r in range(2):

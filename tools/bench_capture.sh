@@ -12,6 +12,11 @@ cd "$(dirname "$0")/.."
 TAG="${1:-local_r04b}"
 PROBE_TIMEOUT="${MXTPU_PROBE_TIMEOUT:-120}"
 SLEEP="${MXTPU_PROBE_INTERVAL:-60}"
+# total wall-clock budget for the probe loop: a down tunnel fails FAST with
+# a stale-labeled artifact instead of retrying blind for 75+ minutes (the
+# round-5 failure mode). Backoff doubles per failed probe, capped.
+PROBE_DEADLINE="${MXTPU_PROBE_DEADLINE:-1800}"
+SLEEP_MAX="${MXTPU_PROBE_INTERVAL_MAX:-300}"
 
 probe() {
   timeout "$PROBE_TIMEOUT" python -c "
@@ -28,11 +33,26 @@ echo "[bench_capture] generating offline perf evidence (CPU)" >&2
 JAX_PLATFORMS=cpu timeout 900 python tools/perf_evidence.py >&2 || \
   echo "[bench_capture] perf_evidence FAILED (continuing)" >&2
 
-echo "[bench_capture] probing accelerator every ${SLEEP}s..." >&2
+echo "[bench_capture] probing accelerator (deadline ${PROBE_DEADLINE}s)..." >&2
+PROBE_START=$(date +%s)
+BACKOFF="$SLEEP"
 while true; do
   KIND=$(probe) && [ -n "$KIND" ] && break
-  echo "[bench_capture] $(date -u +%H:%M:%S) probe failed/hung; retrying" >&2
-  sleep "$SLEEP"
+  ELAPSED=$(( $(date +%s) - PROBE_START ))
+  if [ "$ELAPSED" -ge "$PROBE_DEADLINE" ]; then
+    # stale-labeled artifact: downstream tooling sees an explicit
+    # tunnel-down record at this SHA instead of silently-missing files
+    SHA=$(git rev-parse --short HEAD 2>/dev/null || echo unknown)
+    printf '{"error": "accelerator unreachable", "stale": true, "probe_deadline_s": %s, "elapsed_s": %s, "sha": "%s", "utc": "%s"}\n' \
+      "$PROBE_DEADLINE" "$ELAPSED" "$SHA" "$(date -u +%FT%TZ)" \
+      > "BENCH_${TAG}_stale.json"
+    echo "[bench_capture] tunnel never opened within ${PROBE_DEADLINE}s;" \
+         "wrote BENCH_${TAG}_stale.json and giving up" >&2
+    exit 3
+  fi
+  echo "[bench_capture] $(date -u +%H:%M:%S) probe failed/hung (${ELAPSED}s/${PROBE_DEADLINE}s); retry in ${BACKOFF}s" >&2
+  sleep "$BACKOFF"
+  BACKOFF=$(( BACKOFF * 2 )); [ "$BACKOFF" -gt "$SLEEP_MAX" ] && BACKOFF="$SLEEP_MAX"
 done
 echo "[bench_capture] device up: $KIND" >&2
 

@@ -26,12 +26,25 @@ pod is the whole launch story (parallel/collectives.py:init_process_group).
 Every mode emits the standard env protocol so
 `mxnet_tpu.kv.create('dist_sync')` works unmodified:
 
-  MXTPU_COORDINATOR     host:port of process 0's coordinator service
-  MXTPU_NUM_WORKERS     group size        (alias: DMLC_NUM_WORKER)
-  MXTPU_PROCESS_ID      this process rank (alias: DMLC_WORKER_ID)
+  MXTPU_COORDINATOR          host:port of process 0's coordinator service
+  MXTPU_NUM_WORKERS          group size        (alias: DMLC_NUM_WORKER)
+  MXTPU_PROCESS_ID           this process rank (alias: DMLC_WORKER_ID)
+  MXTPU_RESTART_GENERATION   supervised respawn count (0 = first launch)
+
+Elastic supervision (--max-restarts N, docs/fault_tolerance.md): the
+launcher supervises the group; the FIRST rank failure triggers an
+escalating SIGTERM→SIGKILL teardown of every worker's process group (no
+rank is ever left parked in a rendezvous waiting for a dead peer), then —
+restarts permitting — the whole group respawns after an exponential
+backoff on a FRESH rendezvous port. Workers resume from the last complete
+checkpoint via parallel.resilience. Local/ssh worker output is prefixed
+per rank so multi-rank post-mortems stay readable. This restores, in
+TPU-native form, the node-failure semantics ps-lite's scheduler provided
+the reference (PAPER §1 layer map).
 
 Usage:
   python tools/launch.py -n 4 python train.py ...
+  python tools/launch.py -n 4 --max-restarts 3 python train.py ...
   python tools/launch.py -n 8 --launcher ssh -H hosts.txt python train.py ...
   python tools/launch.py -n 16 --launcher mpi --hostfile hosts.txt -- \
       python train.py ...
@@ -46,6 +59,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -65,12 +79,16 @@ def _remote_port():
     return random.randint(10000, 29999)
 
 
-def _protocol_env(n, coord, extra, rank=None):
+def _protocol_env(n, coord, extra, rank=None, generation=0):
     """The env-var protocol workers see. rank=None yields only the
-    rank-independent half (mpi mode: the process manager assigns ranks)."""
+    rank-independent half (mpi mode: the process manager assigns ranks).
+    `generation` counts supervised group restarts (0 = first launch) so
+    workers — and the MXTPU_FAULT_INJECT harness — can tell a respawned
+    life from the original (parallel/resilience.py:restart_generation)."""
     env = {
         "MXTPU_COORDINATOR": coord,
         "MXTPU_NUM_WORKERS": str(n),
+        "MXTPU_RESTART_GENERATION": str(generation),
         # reference-compatible aliases (DMLC_* protocol, launch.py:29)
         "DMLC_NUM_WORKER": str(n),
         "DMLC_ROLE": "worker",
@@ -98,18 +116,94 @@ def _parse_hostfile(path):
     return slots
 
 
-def _spawn_and_wait(cmds):
-    """Spawn every (argv, env) and supervise the group by polling: the
-    FIRST failure — a spawn error partway through the list, or any worker
-    exiting nonzero — SIGTERMs the survivors, so one crashed rank never
-    leaves the rest parked in the rendezvous waiting for it. Workers that
-    exit 0 simply leave the others to finish. (ssh mode: the SIGTERM hits
-    the local ssh client; sshd tears the remote command down with the
-    connection.)"""
-    procs = []
+def _log(msg):
+    sys.stderr.write("[launcher] %s\n" % msg)
+    sys.stderr.flush()
+
+
+_PUMP_LOCK = threading.Lock()
+
+
+def _pump(stream, label):
+    """Copy one worker's merged stdout/stderr to our stdout, prefixing every
+    line with its rank — post-mortems of a multi-rank failure stay readable
+    (the reference dmlc-tracker interleaved raw streams)."""
+    out = sys.stdout.buffer if hasattr(sys.stdout, "buffer") else None
+    prefix = ("[%s] " % label).encode()
+    for line in iter(stream.readline, b""):
+        with _PUMP_LOCK:
+            if out is not None:
+                out.write(prefix + line)
+                out.flush()
+            else:  # stdout replaced by a text-only object (capture shims)
+                sys.stdout.write((prefix + line).decode("utf-8", "replace"))
+                sys.stdout.flush()
+    stream.close()
+
+
+def _signal_group(procs, sig):
+    """Deliver `sig` to each worker's whole process GROUP (workers are
+    spawned session leaders), so grandchildren — dataloader workers, shells
+    the command spawned — die with it instead of leaking."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                os.killpg(p.pid, sig)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    p.send_signal(sig)
+                except OSError:
+                    pass
+
+
+def _teardown(procs, grace=None):
+    """Escalating group teardown: SIGTERM everyone, give the group `grace`
+    seconds (MXTPU_TEARDOWN_GRACE, default 10) to exit cleanly — flushing
+    logs, closing checkpoints in progress — then SIGKILL the survivors. A
+    rank wedged in a collective waiting for the dead peer ignores nothing
+    after SIGKILL, so the restart loop is never blocked by a hung group."""
+    if all(p.poll() is not None for p in procs):
+        return
+    if grace is None:
+        grace = float(os.environ.get("MXTPU_TEARDOWN_GRACE", "10"))
+    _signal_group(procs, signal.SIGTERM)
+    deadline = time.time() + grace
+    while time.time() < deadline and any(p.poll() is None for p in procs):
+        time.sleep(0.05)
+    survivors = [p for p in procs if p.poll() is None]
+    if survivors:
+        _log("%d worker(s) survived SIGTERM for %.0fs; sending SIGKILL"
+             % (len(survivors), grace))
+        _signal_group(survivors, signal.SIGKILL)
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+
+
+def _run_generation(cmds):
+    """Spawn every (argv, env, label) and supervise by polling: the FIRST
+    failure — a spawn error partway through the list, or any worker exiting
+    nonzero — tears the survivors down (escalating SIGTERM→SIGKILL on the
+    process groups), so one crashed rank never leaves the rest parked in
+    the rendezvous waiting for it. Workers that exit 0 simply leave the
+    others to finish. (ssh mode: the teardown hits the local ssh client;
+    sshd tears the remote command down with the connection.) Labeled
+    workers get their output line-prefixed via a pump thread."""
+    procs, pumps = [], []
     try:
-        for argv, env in cmds:
-            procs.append(subprocess.Popen(argv, env=env))
+        for argv, env, label in cmds:
+            p = subprocess.Popen(
+                argv, env=env, start_new_session=True,
+                stdout=subprocess.PIPE if label else None,
+                stderr=subprocess.STDOUT if label else None)
+            procs.append(p)
+            if label:
+                t = threading.Thread(target=_pump, args=(p.stdout, label),
+                                     daemon=True)
+                t.start()
+                pumps.append(t)
         pending = list(procs)
         rc = 0
         while pending and not rc:
@@ -120,22 +214,58 @@ def _spawn_and_wait(cmds):
                     rc = rc or r
             if pending and not rc:
                 time.sleep(0.1)
-        return rc  # nonzero -> finally SIGTERMs the stragglers
+        return rc  # nonzero -> finally tears down the stragglers
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
+        _teardown(procs)
+        for t in pumps:
+            t.join(timeout=5)
+
+
+def _spawn_and_wait(make_cmds, max_restarts=0, backoff=1.0):
+    """Supervising restart loop (the elastic-training front half; the back
+    half is checkpoint auto-resume, parallel/resilience.py). `make_cmds`
+    maps a generation number to the (argv, env, label) list for that
+    generation — called FRESH each time so every generation gets a new
+    rendezvous port (the dead coordinator's port may sit in TIME_WAIT) and
+    workers see MXTPU_RESTART_GENERATION. On group failure: escalating
+    teardown, exponential-backoff wait, respawn — up to `max_restarts`
+    times, after which the last exit code propagates."""
+    generation = 0
+    delay = max(backoff, 0.0)
+    while True:
+        if generation:
+            _log("spawning generation %d" % generation)
+        rc = _run_generation(make_cmds(generation))
+        if rc == 0:
+            return 0
+        if generation >= max_restarts:
+            if max_restarts:
+                _log("group failed (rc=%d); %d restart(s) exhausted, giving "
+                     "up" % (rc, max_restarts))
+            return rc
+        generation += 1
+        _log("group failed (rc=%d); restarting (%d/%d) in %.1fs on a fresh "
+             "rendezvous port" % (rc, generation, max_restarts, delay))
+        if delay:
+            time.sleep(delay)
+        delay = min(max(delay, 0.5) * 2, 60.0)
 
 
 def _launch_local(args):
-    port = args.port or _free_port()
-    coord = "127.0.0.1:%d" % port
-    cmds = []
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update(_protocol_env(args.num_workers, coord, args.env, rank))
-        cmds.append((args.command, env))
-    return _spawn_and_wait(cmds)
+    def make_cmds(generation):
+        # fresh port per generation: --port pins one (the old coordinator is
+        # dead by restart time, so rebinding it is safe), else probe anew
+        port = args.port or _free_port()
+        coord = "127.0.0.1:%d" % port
+        cmds = []
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env.update(_protocol_env(args.num_workers, coord, args.env, rank,
+                                     generation))
+            cmds.append((args.command, env, "rank %d" % rank))
+        return cmds
+
+    return _spawn_and_wait(make_cmds, args.max_restarts, args.restart_backoff)
 
 
 def _launch_ssh(args):
@@ -149,25 +279,31 @@ def _launch_ssh(args):
     if len(slots) < args.num_workers:
         raise SystemExit("hostfile provides %d slots < -n %d"
                          % (len(slots), args.num_workers))
-    port = args.port or _remote_port()
-    coord = "%s:%d" % (slots[0], port)
     cwd = os.getcwd()
     ssh = shlex.split(args.ssh_cmd)
-    cmds = []
-    for rank in range(args.num_workers):
-        host = slots[rank]
-        env = _protocol_env(args.num_workers, coord, args.env, rank)
-        # PYTHONPATH travels so `python tools/launch.py` from a checkout
-        # works without install on the remote side
-        if os.environ.get("PYTHONPATH"):
-            env.setdefault("PYTHONPATH", os.environ["PYTHONPATH"])
-        envs = " ".join("%s=%s" % (k, shlex.quote(v))
-                        for k, v in sorted(env.items()))
-        remote = "cd %s && env %s %s" % (
-            shlex.quote(cwd), envs,
-            " ".join(shlex.quote(c) for c in args.command))
-        cmds.append((ssh + [host, remote], dict(os.environ)))
-    return _spawn_and_wait(cmds)
+
+    def make_cmds(generation):
+        port = args.port or _remote_port()
+        coord = "%s:%d" % (slots[0], port)
+        cmds = []
+        for rank in range(args.num_workers):
+            host = slots[rank]
+            env = _protocol_env(args.num_workers, coord, args.env, rank,
+                                generation)
+            # PYTHONPATH travels so `python tools/launch.py` from a checkout
+            # works without install on the remote side
+            if os.environ.get("PYTHONPATH"):
+                env.setdefault("PYTHONPATH", os.environ["PYTHONPATH"])
+            envs = " ".join("%s=%s" % (k, shlex.quote(v))
+                            for k, v in sorted(env.items()))
+            remote = "cd %s && env %s %s" % (
+                shlex.quote(cwd), envs,
+                " ".join(shlex.quote(c) for c in args.command))
+            cmds.append((ssh + [host, remote], dict(os.environ),
+                         "rank %d" % rank))
+        return cmds
+
+    return _spawn_and_wait(make_cmds, args.max_restarts, args.restart_backoff)
 
 
 # per-flavor syntax for exporting one env var through the mpi launcher
@@ -186,30 +322,36 @@ def _launch_mpi(args):
     default address follows the placement: the hostfile's first host when
     one is given (mpirun fills hosts in order), else this host (purely
     local mpirun). --coordinator-host/--port override both."""
-    if args.coordinator_host:
-        host = args.coordinator_host
-        port = args.port or _remote_port()
-    elif args.hostfile:
-        host = _parse_hostfile(args.hostfile)[0]
-        # rank 0 is remote: no local probe can verify its ports
-        port = args.port or _remote_port()
-    else:
-        host = "127.0.0.1"
-        port = args.port or _free_port()
-    coord = "%s:%d" % (host, port)
-    proto = _protocol_env(args.num_workers, coord, args.env)
-    env = dict(os.environ)
-    env.update(proto)
-    cmd = shlex.split(args.mpi_cmd) + ["-np", str(args.num_workers)]
-    if args.hostfile:
-        cmd += ["--hostfile", args.hostfile]
-    flag = _MPI_ENV_FLAG[args.mpi_flavor]
-    export = set(proto)
-    if "PYTHONPATH" in env:
-        export.add("PYTHONPATH")
-    for var in sorted(export):
-        cmd += flag(var, env[var])
-    return _spawn_and_wait([(cmd + args.command, env)])
+    def make_cmds(generation):
+        if args.coordinator_host:
+            host = args.coordinator_host
+            port = args.port or _remote_port()
+        elif args.hostfile:
+            host = _parse_hostfile(args.hostfile)[0]
+            # rank 0 is remote: no local probe can verify its ports
+            port = args.port or _remote_port()
+        else:
+            host = "127.0.0.1"
+            port = args.port or _free_port()
+        coord = "%s:%d" % (host, port)
+        proto = _protocol_env(args.num_workers, coord, args.env,
+                              generation=generation)
+        env = dict(os.environ)
+        env.update(proto)
+        cmd = shlex.split(args.mpi_cmd) + ["-np", str(args.num_workers)]
+        if args.hostfile:
+            cmd += ["--hostfile", args.hostfile]
+        flag = _MPI_ENV_FLAG[args.mpi_flavor]
+        export = set(proto)
+        if "PYTHONPATH" in env:
+            export.add("PYTHONPATH")
+        for var in sorted(export):
+            cmd += flag(var, env[var])
+        # label=None: mpirun already multiplexes rank output; piping it
+        # through a prefix pump would only obscure mpirun's own framing
+        return [(cmd + args.command, env, None)]
+
+    return _spawn_and_wait(make_cmds, args.max_restarts, args.restart_backoff)
 
 
 def main(argv=None):
@@ -246,6 +388,18 @@ def main(argv=None):
                              "env flags (scheduler forwards the env)")
     parser.add_argument("--env", action="append", default=[],
                         help="extra KEY=VAL for every worker")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="elastic supervision: after a group failure "
+                             "(escalating SIGTERM→SIGKILL teardown) respawn "
+                             "the whole group up to N times with exponential "
+                             "backoff and a fresh rendezvous port; workers "
+                             "see MXTPU_RESTART_GENERATION and auto-resume "
+                             "from the last complete checkpoint "
+                             "(parallel/resilience.py). Default 0 = fail "
+                             "fast, the pre-elastic behavior")
+    parser.add_argument("--restart-backoff", type=float, default=1.0,
+                        help="initial seconds between generations (doubles "
+                             "each restart, capped at 60)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     if args.command and args.command[0] == "--":
