@@ -138,6 +138,9 @@ def bench_train():
         net, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
         loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh,
         amp_dtype=AMP_DTYPE)
+    # declare per-step FLOPs so always-on telemetry publishes achieved MFU
+    # alongside the bench's own number (docs/observability.md)
+    mx.telemetry.set_step_flops(flops_per_img * BATCH)
 
     def timed_train(xb, yb, batch):
         """warmup -> drain -> free-running timed loop (async dispatch

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Lint: no bare `print(` in mxnet_tpu/ library code.
+
+Library output must go through `mxnet_tpu.log` (formatter, levels, capture)
+and — for numbers — telemetry (docs/observability.md); a bare print is
+invisible to both. Tokenize-based so strings/comments/docstring examples
+never false-positive, and `pprint`/`toc_print(`/method calls (`x.print(`)
+never match.
+
+Allowlist:
+  * mxnet_tpu/test_utils.py   (test harness: talks to the test runner)
+  * mxnet_tpu/notebook/       (notebook display helpers)
+  * lines ending in `# allow-print` — explicit CLI/user-display surfaces
+    (e.g. visualization.print_summary, whose entire job is printing)
+
+Usage: python ci/lint_print.py [root]      (default root: repo checkout)
+Exit 0 = clean; exit 1 = violations listed on stdout.
+"""
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tokenize
+
+ALLOW_FILES = {os.path.join("mxnet_tpu", "test_utils.py")}
+ALLOW_DIRS = {os.path.join("mxnet_tpu", "notebook")}
+ALLOW_MARKER = "# allow-print"
+
+
+def find_bare_prints(path, rel):
+    """Yield (line, text) for every bare `print(` call in the file."""
+    with open(path, "rb") as f:
+        src = f.read()
+    lines = src.decode("utf-8", "replace").splitlines()
+    try:
+        tokens = list(tokenize.tokenize(io.BytesIO(src).readline))
+    except (tokenize.TokenError, SyntaxError):
+        return
+    for i, tok in enumerate(tokens):
+        if tok.type != tokenize.NAME or tok.string != "print":
+            continue
+        # next real token must open a call
+        nxt = next((t for t in tokens[i + 1:]
+                    if t.type not in (tokenize.COMMENT, tokenize.NL)), None)
+        if nxt is None or nxt.type != tokenize.OP or nxt.string != "(":
+            continue
+        # attribute access (x.print) or def print( are not builtin print
+        prev = next((t for t in reversed(tokens[:i])
+                     if t.type not in (tokenize.COMMENT, tokenize.NL,
+                                       tokenize.NEWLINE, tokenize.INDENT,
+                                       tokenize.DEDENT)), None)
+        if prev is not None and prev.type == tokenize.OP and prev.string == ".":
+            continue
+        if prev is not None and prev.type == tokenize.NAME and \
+                prev.string in ("def", "class"):
+            continue
+        line_text = lines[tok.start[0] - 1] if tok.start[0] <= len(lines) \
+            else ""
+        if ALLOW_MARKER in line_text:
+            continue
+        yield tok.start[0], line_text.strip()
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    root = os.path.abspath(argv[0] if argv else
+                           os.path.join(os.path.dirname(__file__), ".."))
+    pkg = os.path.join(root, "mxnet_tpu")
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            if rel in ALLOW_FILES:
+                continue
+            if any(rel.startswith(d + os.sep) for d in ALLOW_DIRS):
+                continue
+            for line, text in find_bare_prints(path, rel) or ():
+                violations.append((rel, line, text))
+    if violations:
+        sys.stdout.write(
+            "bare print( in library code — route through mxnet_tpu.log "
+            "(+ telemetry for numbers), or mark an explicit user-display "
+            "surface with `# allow-print`:\n")
+        for rel, line, text in violations:
+            sys.stdout.write("  %s:%d: %s\n" % (rel, line, text))
+        return 1
+    sys.stdout.write("lint_print: clean (%s)\n" % pkg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ from . import autograd
 # subsystem imports are appended as the build widens (round-1 scaffold keeps
 # this list in sync with the modules that exist)
 _SUBMODULES = [
+    "telemetry",
     "optimizer", "initializer", "lr_scheduler", "metric", "symbol", "executor",
     "module", "io", "recordio", "image", "kvstore", "gluon", "callback",
     "model", "profiler", "runtime", "test_utils", "visualization", "monitor",
@@ -61,6 +62,13 @@ if "kvstore_server" in globals() and _os.environ.get("DMLC_ROLE") in (
     # server-role processes; ours logs the collectives architecture note
     # and exits so reference launch scripts keep a correct worker count
     kvstore_server._maybe_exit_non_worker()  # noqa: F821
+
+# telemetry-configured processes (MXTPU_TELEMETRY_DIR set — launched jobs)
+# get the SIGUSR1 flight-recorder dump handler from import time, so even a
+# hang BEFORE the first training step (rendezvous, compile) is diagnosable
+# via the launcher's SIGUSR1-then-SIGTERM teardown
+if "telemetry" in globals() and _os.environ.get("MXTPU_TELEMETRY_DIR"):
+    telemetry.install_signal_handler()  # noqa: F821
 
 if "symbol" in globals():
     sym = symbol  # noqa: F821

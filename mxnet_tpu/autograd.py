@@ -185,6 +185,11 @@ def _bwd_jitted(name, attr_key, has_rng, x64=False):
     doesn't need)."""
     import jax
 
+    from .telemetry import core as _tm_core
+    from .telemetry import recorder as _tm_rec
+
+    _tm_core.counter("mxtpu_jit_cache_miss_total").inc()
+    _tm_rec.record_event("jit_compile", op="_backward_" + name)
     opdef = _ops.get(name)
     kwargs = dict(attr_key)
 
@@ -256,6 +261,9 @@ def _run_backward(heads, head_grads, retain_graph=False):
                 import jax
 
                 rng = jax.random.PRNGKey(0)
+            from .telemetry import core as _tm_core
+
+            _tm_core.counter("mxtpu_jit_cache_lookup_total").inc()
             fn = _bwd_jitted(node.opdef.name, node.attr_key,
                              node.opdef.needs_rng, x64)
             with x64_ctx:
@@ -267,7 +275,15 @@ def _run_backward(heads, head_grads, retain_graph=False):
                     c = cot.get(k) if k is not None else None
                     float_cots.append(c if c is not None
                                       else jnp.zeros(shp, dt))
-                in_cots = fn(rng, node.in_arrays, tuple(float_cots))
+                from . import profiler as _profiler
+
+                # the backward half of the ProfileOperator hook: each tape
+                # node replays as one "_backward_<op>" event (the
+                # reference's backward-op naming), sharing timed_call with
+                # the forward dispatch sites
+                in_cots = _profiler.timed_call(
+                    "_backward_" + node.opdef.name, fn,
+                    (rng, node.in_arrays, tuple(float_cots)))
         for pair, c in zip(node.inputs, in_cots):
             if pair is None:
                 continue

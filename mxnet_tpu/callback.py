@@ -1,12 +1,22 @@
 """Training callbacks (reference: python/mxnet/callback.py — Speedometer :120,
-do_checkpoint :55, log_train_metric, ProgressBar)."""
+do_checkpoint :55, log_train_metric, ProgressBar).
+
+Progress output goes through `mxnet_tpu.log.get_logger` (the framework
+formatter, level INFO so progress is visible by default) and every number a
+callback prints is ALSO published as a telemetry metric — the human log and
+the machine-readable JSONL/Prometheus views stay in lockstep
+(docs/observability.md)."""
 from __future__ import annotations
 
-import logging
 import time
+
+from . import log as _log
+from . import telemetry
 
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "ProgressBar",
            "module_checkpoint"]
+
+_LOG = _log.get_logger("mxnet_tpu.callback", level=_log.INFO)
 
 
 def do_checkpoint(prefix, period=1):
@@ -40,8 +50,10 @@ def log_train_metric(period, auto_reset=False):
         if param.nbatch % period == 0 and param.eval_metric is not None:
             name_value = param.eval_metric.get_name_value()
             for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
+                _LOG.info("Iter[%d] Batch[%d] Train-%s=%f",
+                          param.epoch, param.nbatch, name, value)
+                telemetry.gauge("mxtpu_train_metric",
+                                {"metric": name}).set(float(value))
             if auto_reset:
                 param.eval_metric.reset()
 
@@ -67,17 +79,21 @@ class Speedometer:
         if self.init:
             if count % self.frequent == 0:
                 speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                telemetry.gauge("mxtpu_speedometer_samples_per_sec").set(speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
+                    for name, value in name_value:
+                        telemetry.gauge("mxtpu_train_metric",
+                                        {"metric": name}).set(float(value))
                     if self.auto_reset:
                         param.eval_metric.reset()
                     msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" \
                         % (param.epoch, count, speed)
                     msg += "".join("\t%s=%f" % kv for kv in name_value)
-                    logging.info(msg)
+                    _LOG.info(msg)
                 else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
+                    _LOG.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                              param.epoch, count, speed)
                 self.tic = time.time()
         else:
             self.init = True
@@ -96,4 +112,4 @@ class ProgressBar:
         filled_len = int(round(self.bar_len * count / float(self.total)))
         percents = int(round(100.0 * count / float(self.total)))
         prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        _LOG.info("[%s] %s%s\r", prog_bar, percents, "%")

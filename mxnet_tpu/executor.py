@@ -153,6 +153,13 @@ class Executor:
                bool(is_train))
         fn = self._fwd_cache.get(sig)
         if fn is None:
+            from .telemetry import core as _tm_core
+            from .telemetry import recorder as _tm_rec
+
+            _tm_core.counter("mxtpu_executor_build_total",
+                             {"what": "forward"}).inc()
+            _tm_rec.record_event("jit_compile", op="executor_forward",
+                                 is_train=bool(is_train))
             fn = self._build_forward(bool(is_train))
             self._fwd_cache[sig] = fn
         key = _random.next_key()
@@ -208,6 +215,12 @@ class Executor:
             return
         fn = self._bwd_cache.get(sig)
         if fn is None:
+            from .telemetry import core as _tm_core
+            from .telemetry import recorder as _tm_rec
+
+            _tm_core.counter("mxtpu_executor_build_total",
+                             {"what": "backward"}).inc()
+            _tm_rec.record_event("jit_compile", op="executor_backward")
             fn = self._build_backward(sig[1], wrt)
             self._bwd_cache[sig] = fn
 

@@ -233,7 +233,7 @@ class Block:
         lines = ["%-40s %12s" % ("Layer", "Params"), "-" * 53]
         lines += ["%-40s %12d" % r for r in summary_rows]
         lines += ["-" * 53, "%-40s %12d" % ("Total (direct)", total)]
-        print("\n".join(lines))
+        print("\n".join(lines))  # allow-print
 
 
 _TRACING = threading.local()

@@ -366,6 +366,35 @@ class DataLoader:
             pass  # interpreter teardown: pool internals may already be gone
 
     def __iter__(self):
+        """Instrumented front: yields from the real iterator while feeding
+        the telemetry wait-vs-compute split — seconds this consumer spent
+        BLOCKED on batch production vs. seconds it held the batch (its own
+        step compute) between `next` calls. A starved accelerator shows up
+        as wait >> compute."""
+        import time as _time
+
+        from ... import telemetry
+
+        tm_wait = telemetry.counter("mxtpu_data_wait_seconds_total",
+                                    {"src": "dataloader"})
+        tm_compute = telemetry.counter("mxtpu_data_compute_seconds_total",
+                                       {"src": "dataloader"})
+        tm_batches = telemetry.counter("mxtpu_data_batches_total",
+                                       {"src": "dataloader"})
+        inner = self._iter_raw()
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                batch = next(inner)
+            except StopIteration:
+                return
+            t1 = _time.perf_counter()
+            tm_wait.inc(t1 - t0)
+            tm_batches.inc()
+            yield batch
+            tm_compute.inc(_time.perf_counter() - t1)
+
+    def _iter_raw(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
                 yield self._load(batch)

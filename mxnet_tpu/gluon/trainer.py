@@ -11,9 +11,11 @@ all-reduces inside the compiled step (SURVEY §2.3 row 1)."""
 from __future__ import annotations
 
 import os
+import time
 
 from ..base import MXNetError
 from .. import optimizer as opt
+from .. import telemetry
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -117,12 +119,17 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Allreduce grads + update (reference: trainer.py:298)."""
+        t0 = time.perf_counter()
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
         self._step_count += 1
+        # always-on telemetry: step wall time, examples/sec, MFU (when step
+        # FLOPs are declared) + the flight-recorder/watchdog heartbeat
+        telemetry.observe_step(time.perf_counter() - t0,
+                               examples=batch_size, step=self._step_count)
         # step-boundary fault hook; the env guard keeps the hot path free
         # of even the import lookup when injection is unarmed
         if os.environ.get("MXTPU_FAULT_INJECT"):

@@ -32,6 +32,7 @@ import pickle
 
 from .base import MXNetError
 from .ndarray import NDArray
+from .telemetry.core import counter as _tm_counter
 
 __all__ = ["KVStore", "create"]
 
@@ -123,6 +124,7 @@ class KVStore:
         """
         keys, batched = _key_list(key)
         vals = _group_vals(value, len(keys), batched)
+        _tm_counter("mxtpu_kvstore_ops_total", {"op": "push"}).inc(len(keys))
         from .ndarray.sparse import BaseSparseNDArray, add as _sparse_add
 
         comp = getattr(self, "_compression", None)
@@ -160,6 +162,7 @@ class KVStore:
 
         keys, batched = _key_list(key)
         outs = _group_vals(out, len(keys), batched)
+        _tm_counter("mxtpu_kvstore_ops_total", {"op": "pull"}).inc(len(keys))
         for k, ogroup in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %r has not been initialized" % (k,))

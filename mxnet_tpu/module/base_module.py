@@ -197,6 +197,16 @@ class BaseModule(object):
         eval_metric = _as_metric(eval_metric)
 
         from ..parallel.resilience import maybe_inject_fault
+        from .. import telemetry
+
+        # input-pipeline starvation metrics: seconds spent WAITING on the
+        # data iterator vs. seconds spent in forward/backward/update — the
+        # first thing to read when a run is slow (is it the loader or the
+        # chip?)
+        tm_wait = telemetry.counter("mxtpu_data_wait_seconds_total",
+                                    {"src": "fit"})
+        tm_compute = telemetry.counter("mxtpu_data_compute_seconds_total",
+                                       {"src": "fit"})
 
         fit_updates = 0
         for epoch in range(begin_epoch, num_epoch):
@@ -204,12 +214,28 @@ class BaseModule(object):
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
-            for data_batch in train_data:
+            batch_iter = iter(train_data)
+            while True:
+                t_wait = time.perf_counter()
+                try:
+                    data_batch = next(batch_iter)
+                except StopIteration:
+                    break
+                t_step = time.perf_counter()
+                tm_wait.inc(t_step - t_wait)
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
                 fit_updates += 1
+                examples = None
+                try:
+                    examples = int(data_batch.data[0].shape[0])
+                except (AttributeError, IndexError, TypeError):
+                    pass
+                telemetry.observe_step(time.perf_counter() - t_step,
+                                       examples=examples, step=fit_updates,
+                                       kind="fit")
                 # step-boundary fault hook: counts updates since THIS
                 # process started (no-op unless MXTPU_FAULT_INJECT is set)
                 maybe_inject_fault(fit_updates)
@@ -222,6 +248,7 @@ class BaseModule(object):
                                          eval_metric=eval_metric,
                                          locals=locals()))
                 nbatch += 1
+                tm_compute.inc(time.perf_counter() - t_step)
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -12,9 +12,13 @@ from __future__ import annotations
 
 import re
 
+from . import log as _log
+from . import telemetry
 from .ndarray.ndarray import NDArray
 
 __all__ = ["Monitor"]
+
+_LOG = _log.get_logger("mxnet_tpu.monitor", level=_log.INFO)
 
 
 class Monitor:
@@ -84,6 +88,10 @@ class Monitor:
         return res
 
     def toc_print(self):
-        """reference: monitor.py:118."""
-        for step, name, stat in self.toc():
-            print("Batch: %7d %30s %s" % (step, name, stat))
+        """reference: monitor.py:118 — routed through mxnet_tpu.log instead
+        of bare print, and counted in telemetry so monitored runs are
+        visible in the JSONL stream too."""
+        rows = self.toc()
+        telemetry.counter("mxtpu_monitor_rows_total").inc(len(rows))
+        for step, name, stat in rows:
+            _LOG.info("Batch: %7d %30s %s", step, name, stat)

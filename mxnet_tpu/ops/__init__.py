@@ -20,6 +20,8 @@ import functools
 import typing as _t
 
 from ..base import MXNetError
+from ..telemetry import core as _telemetry
+from ..telemetry import recorder as _recorder
 
 __all__ = ["OpDef", "register", "get", "list_ops", "invoke_jax"]
 
@@ -90,11 +92,32 @@ def _hashable(v):
     return v
 
 
+# jit executable-cache telemetry: a lookup lands here per eager dispatch;
+# the lru_cache body below runs only on a miss, so
+# hits = mxtpu_jit_cache_lookup_total - mxtpu_jit_cache_miss_total.
+# Resolved lazily so a process that starts MXTPU_TELEMETRY=0 and calls
+# set_enabled(True) later records real counts (never cache the null)
+_TM_JIT = {}
+
+
+def _jit_counter(name):
+    c = _TM_JIT.get(name)
+    if c is None:
+        if not _telemetry._STATE.enabled:
+            return _telemetry._NULL
+        c = _telemetry.counter(name)
+        _TM_JIT[name] = c
+    return c
+
+
 @functools.lru_cache(maxsize=8192)
 def _jitted(name, attr_key):
     op = _REGISTRY[name]
     kwargs = dict(attr_key)
     import jax
+
+    _jit_counter("mxtpu_jit_cache_miss_total").inc()
+    _recorder.record_event("jit_compile", op=name)
 
     def call(*arrays):
         return op.fn(*arrays, **kwargs)
@@ -121,6 +144,7 @@ def invoke_jax(name, arrays, attrs):
     if any(isinstance(a, jax.core.Tracer) for a in arrays):
         return op.fn(*arrays, **dict(attrs))
     attr_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+    _jit_counter("mxtpu_jit_cache_lookup_total").inc()
     return _jitted(name, attr_key)(*arrays)
 
 

@@ -14,6 +14,11 @@ Two call modes:
 """
 from __future__ import annotations
 
+import time as _time_mod
+
+from ..telemetry import core as _telemetry
+from ..telemetry import recorder as _recorder
+
 __all__ = [
     "psum", "pmean", "pmax", "pmin", "all_gather", "reduce_scatter",
     "ppermute", "axis_index", "axis_size", "all_to_all",
@@ -89,6 +94,30 @@ def axis_size(axis_name):
 
 # ---- eager cross-device reduce (kvstore('device') backend) ----------------
 
+def _payload_bytes(arrays):
+    """Total bytes across a list of jax/np arrays (best-effort)."""
+    total = 0
+    for a in arrays:
+        try:
+            total += int(a.size) * int(a.dtype.itemsize)
+        except (AttributeError, TypeError):
+            pass
+    return total
+
+
+def _observe_collective(op, arrays, seconds):
+    """Telemetry for one eager collective: call count, payload bytes, and
+    dispatch latency (async enqueue time — profile_sync-style device timing
+    belongs to the profiler, not the always-on layer)."""
+    if not _telemetry._STATE.enabled:
+        return  # the kill switch must also skip the payload-byte scan
+    nbytes = _payload_bytes(arrays)
+    labels = {"op": op}
+    _telemetry.counter("mxtpu_collective_calls_total", labels).inc()
+    _telemetry.counter("mxtpu_collective_bytes_total", labels).inc(nbytes)
+    _telemetry.histogram("mxtpu_collective_seconds", labels).observe(seconds)
+
+
 def all_reduce_arrays(arrays):
     """Sum a list of same-shaped jax arrays living on different devices and
     return the sum materialized on each array's device — the eager
@@ -98,8 +127,12 @@ def all_reduce_arrays(arrays):
 
     if not arrays:
         return []
+    t0 = _time_mod.perf_counter()
     if len(arrays) == 1:
-        return [jax.device_put(arrays[0], list(arrays[0].devices())[0])]
+        out = [jax.device_put(arrays[0], list(arrays[0].devices())[0])]
+        _observe_collective("all_reduce", arrays,
+                            _time_mod.perf_counter() - t0)
+        return out
     # pairwise tree reduce: log2(n) rounds of concurrent adds instead of a
     # serial hub chain (the comm.h:451-728 CommDevice analogue)
     level = list(arrays)
@@ -112,7 +145,9 @@ def all_reduce_arrays(arrays):
             nxt.append(level[-1])
         level = nxt
     total = level[0]
-    return [jax.device_put(total, list(a.devices())[0]) for a in arrays]
+    out = [jax.device_put(total, list(a.devices())[0]) for a in arrays]
+    _observe_collective("all_reduce", arrays, _time_mod.perf_counter() - t0)
+    return out
 
 
 def _barrier_sum(v):
@@ -132,7 +167,11 @@ _BARRIER_JIT = None
 def broadcast_arrays(src, devices):
     import jax
 
-    return [jax.device_put(src, d) for d in devices]
+    t0 = _time_mod.perf_counter()
+    out = [jax.device_put(src, d) for d in devices]
+    _observe_collective("broadcast", [src] * len(out),
+                        _time_mod.perf_counter() - t0)
+    return out
 
 
 # ---- multi-host bootstrap (ps-lite scheduler replacement) -----------------
@@ -255,15 +294,31 @@ def init_process_group(coordinator_address=None, num_processes=None,
                num_processes, coordinator_address or "<auto-detect>", cause))
 
     backoff = 1.0
+    _recorder.record_event(
+        "rendezvous_start", coordinator=coordinator_address or "<auto>",
+        num_processes=num_processes, process_id=process_id,
+        generation=_telemetry.restart_generation(), timeout_s=timeout)
+    t_dial = _time.perf_counter()
     for attempt in range(retries + 1):
         try:
             _dial_with_deadline(jax, coordinator_address, num_processes,
                                 process_id, timeout)
+            _recorder.record_event(
+                "rendezvous_ok",
+                seconds=round(_time.perf_counter() - t_dial, 3),
+                attempts=attempt + 1)
+            _telemetry.counter("mxtpu_rendezvous_total",
+                               {"outcome": "ok"}).inc()
             return
         except _RendezvousTimeout:
             # the deadline expired with every side still waiting: the
             # missing peer won't materialize on a redial, so retries are
             # pointless — surface the bounded failure immediately
+            _recorder.record_event(
+                "rendezvous_failed", cause="deadline",
+                seconds=round(_time.perf_counter() - t_dial, 3))
+            _telemetry.counter("mxtpu_rendezvous_total",
+                               {"outcome": "timeout"}).inc()
             raise MXNetError(_diagnosis(
                 "group did not assemble within the deadline")) from None
         except Exception as e:  # bind failure / RuntimeError / grpc error
@@ -273,6 +328,12 @@ def init_process_group(coordinator_address=None, num_processes=None,
             except Exception:
                 pass
             if attempt >= retries:
+                _recorder.record_event(
+                    "rendezvous_failed", cause=type(e).__name__,
+                    seconds=round(_time.perf_counter() - t_dial, 3),
+                    attempts=attempt + 1)
+                _telemetry.counter("mxtpu_rendezvous_total",
+                                   {"outcome": "error"}).inc()
                 raise MXNetError(_diagnosis(
                     "%s: %s (after %d attempt(s))"
                     % (type(e).__name__, e, retries + 1))) from e

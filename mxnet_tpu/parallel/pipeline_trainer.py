@@ -428,6 +428,9 @@ class PipelineTrainer:
         from .. import random as _random
         from ..ndarray import NDArray
 
+        import time as _time
+
+        t0 = _time.perf_counter()
         if self._loss is not None and len(batch) < 2:
             raise MXNetError("step(*inputs, label) needs a label for the "
                              "configured loss")
@@ -453,6 +456,12 @@ class PipelineTrainer:
         loss_val, self._outer_arrays, self._cell_leaves, self._states = fn(
             key, t, jnp.asarray(lr, dtype=jnp.float32),
             self._outer_arrays, self._cell_leaves, self._states, *arrs)
+        from .. import telemetry
+
+        examples = int(arrs[0].shape[0]) if getattr(arrs[0], "ndim", 0) \
+            else None
+        telemetry.observe_step(_time.perf_counter() - t0, examples=examples,
+                               step=self._step_count, kind="pipeline")
         return NDArray(loss_val, ctx=self._ctx)
 
     def forward(self, *batch, is_train=False):

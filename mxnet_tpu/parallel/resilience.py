@@ -34,6 +34,7 @@ import time
 import zlib
 
 from ..base import MXNetError, atomic_writer, _fsync_dir
+from .. import telemetry
 
 __all__ = ["CheckpointManager", "maybe_inject_fault", "fault_spec",
            "restart_generation"]
@@ -157,6 +158,7 @@ class CheckpointManager:
         on non-zero ranks when rank0_only)."""
         if self._rank0_only and _current_rank() != 0:
             return None
+        t0 = time.perf_counter()
         self._sweep_stale_tmp()
         tmp = tempfile.mkdtemp(dir=self._dir,
                                prefix=".tmp-%s-%08d-" % (self._prefix, step))
@@ -194,7 +196,23 @@ class CheckpointManager:
         finally:
             if tmp is not None:
                 shutil.rmtree(tmp, ignore_errors=True)
+        # measure BEFORE _retain(): retention may legally delete the step
+        # just published (pinned-resume past newer checkpoints), and a
+        # successful save must never crash on its own bookkeeping
+        try:
+            nbytes = sum(os.path.getsize(os.path.join(final, n))
+                         for n in os.listdir(final))
+        except OSError:
+            nbytes = 0
         self._retain()
+        seconds = time.perf_counter() - t0
+        telemetry.histogram("mxtpu_checkpoint_seconds",
+                            {"what": "save"}).observe(seconds)
+        telemetry.counter("mxtpu_checkpoint_bytes_total",
+                          {"what": "save"}).inc(nbytes)
+        telemetry.record_event("checkpoint_save", step=int(step),
+                               seconds=round(seconds, 4), bytes=nbytes,
+                               path=final)
         return final
 
     def _fsync_and_crc(self, path):
@@ -301,6 +319,7 @@ class CheckpointManager:
         None when no complete checkpoint exists. An EXPLICITLY requested
         step that fails verification raises MXNetError instead of silently
         falling back."""
+        t0 = time.perf_counter()
         if step is None:
             found = self.latest()
             if found is None:
@@ -322,6 +341,16 @@ class CheckpointManager:
             from .. import random as _random
 
             _random.set_state(header["rng"])
+        seconds = time.perf_counter() - t0
+        nbytes = sum(os.path.getsize(os.path.join(path, n)) for n in files
+                     if os.path.exists(os.path.join(path, n)))
+        telemetry.histogram("mxtpu_checkpoint_seconds",
+                            {"what": "restore"}).observe(seconds)
+        telemetry.counter("mxtpu_checkpoint_bytes_total",
+                          {"what": "restore"}).inc(nbytes)
+        telemetry.record_event("checkpoint_restore", step=int(step),
+                               seconds=round(seconds, 4), bytes=nbytes,
+                               generation=restart_generation())
         return header
 
 
@@ -335,6 +364,13 @@ class CheckpointManager:
 #   MXTPU_FAULT_INJECT="kill@step=7,rank=1"         SIGKILL-equivalent exit
 #                                                   of rank 1 at step 7
 #   MXTPU_FAULT_INJECT="exc@step=3"                 raise MXNetError
+#   MXTPU_FAULT_INJECT="hang@step=5,rank=1"         park the rank forever at
+#                                                   the step boundary (models
+#                                                   a wedged collective /
+#                                                   stuck host callback; the
+#                                                   telemetry watchdog +
+#                                                   flight recorder are the
+#                                                   intended detectors)
 #   MXTPU_FAULT_INJECT="corrupt_ckpt@step=5,dir=/tmp/ck"
 #                                                   garble the newest
 #                                                   checkpoint's params file
@@ -359,9 +395,9 @@ def fault_spec(env=None):
     entries = []
     for part in raw.replace(";", " ").split():
         action, _, conds = part.partition("@")
-        if action not in ("kill", "exc", "corrupt_ckpt"):
+        if action not in ("kill", "exc", "hang", "corrupt_ckpt"):
             raise MXNetError("MXTPU_FAULT_INJECT: unknown action %r in %r "
-                             "(kill|exc|corrupt_ckpt)" % (action, part))
+                             "(kill|exc|hang|corrupt_ckpt)" % (action, part))
         entry = {"action": action, "step": None, "rank": None,
                  "gen": 0, "code": _FAULT_EXIT_CODE, "dir": None}
         for cond in filter(None, conds.split(",")):
@@ -421,6 +457,15 @@ def _fire(entry, step, rank):
     if action == "exc":
         raise MXNetError("injected fault (MXTPU_FAULT_INJECT) at step %d "
                          "rank %d" % (step, rank))
+    if action == "hang":
+        # park forever at the step boundary — the deterministic stand-in
+        # for a wedged collective. Interruptible only by signals: the
+        # telemetry watchdog (MXTPU_WATCHDOG_TIMEOUT) should dump + abort,
+        # and the launcher's SIGUSR1-then-SIGTERM teardown reaps the rest.
+        import time as _t
+
+        while True:
+            _t.sleep(3600)
     if action == "corrupt_ckpt":
         directory = entry["dir"] or os.environ.get("MXTPU_CKPT_DIR")
         if not directory:

@@ -314,6 +314,9 @@ class DistributedTrainer:
         from .. import random as _random
         from ..ndarray import NDArray
 
+        import time as _time
+
+        t0 = _time.perf_counter()
         if self._loss is not None and label is None:
             raise MXNetError("this trainer was built with a loss that takes "
                              "(pred, label); step() needs a label argument")
@@ -327,6 +330,12 @@ class DistributedTrainer:
         sig = tuple((tuple(b.shape), str(b.dtype)) for b in batch)
         fn = self._compiled.get(sig)
         if fn is None:
+            from .. import telemetry
+
+            telemetry.counter("mxtpu_executor_build_total",
+                              {"what": "dist_step"}).inc()
+            telemetry.record_event("jit_compile", op="dist_trainer_step",
+                                   batch_sig=str(sig))
             fn = self._build_step([b.shape for b in batch])
             self._compiled[sig] = fn
 
@@ -345,6 +354,14 @@ class DistributedTrainer:
             key, t, jnp.asarray(lr, dtype=jnp.float32),
             self._arrays, self._states, *batch)
         ctx = self._params[0].list_ctx()[0]
+        from .. import telemetry
+
+        # global-batch examples/sec: the leading dim of the (global) batch
+        examples = None
+        if batch and getattr(batch[0], "ndim", 0) > 0:
+            examples = int(batch[0].shape[0])
+        telemetry.observe_step(_time.perf_counter() - t0, examples=examples,
+                               step=self._step_count, kind="dist")
         from . import resilience
 
         # step-boundary fault hook (no-op unless MXTPU_FAULT_INJECT is set)

@@ -21,6 +21,7 @@ import threading
 import time
 
 from .base import MXNetError
+from .telemetry import core as _telemetry
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Domain", "Task", "Frame", "Event", "Counter", "Marker",
@@ -29,6 +30,8 @@ __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
 _lock = threading.Lock()
 _events = []            # chrome trace event dicts
 _aggregate = {}         # name -> [count, total_us, min_us, max_us]
+_tids = {}              # thread ident -> (stable small tid, registered name)
+_rank_cache = [None]    # launcher rank, resolved once (stamps trace pids)
 _config = {
     "filename": "profile.json",
     "profile_all": False,
@@ -84,9 +87,38 @@ def resume():
     _state["paused"] = False
 
 
+def _rank():
+    """Trace pid = launcher rank, so merged multi-rank traces show one
+    process lane per rank (tools/trace_merge.py)."""
+    if _rank_cache[0] is None:
+        _rank_cache[0] = _telemetry.rank()
+    return _rank_cache[0]
+
+
+def _tid():
+    """Stable per-thread small id. The old `get_ident() % 10000` was
+    collision-prone (idents are pthread addresses; two threads 10000*k
+    apart collapsed into one trace lane). First use of a thread also emits
+    its chrome-trace `thread_name` metadata event so merged traces show
+    named lanes."""
+    ident = threading.get_ident()
+    entry = _tids.get(ident)
+    if entry is None:
+        with _lock:
+            entry = _tids.get(ident)
+            if entry is None:
+                name = threading.current_thread().name
+                entry = (len(_tids) + 1, name)
+                _tids[ident] = entry
+                _events.append({"ph": "M", "name": "thread_name",
+                                "pid": _rank(), "tid": entry[0],
+                                "args": {"name": name}})
+    return entry[0]
+
+
 def _emit(name, cat, start_us, dur_us, args=None):
     ev = {"name": name, "cat": cat, "ph": "X", "ts": start_us, "dur": dur_us,
-          "pid": 0, "tid": threading.get_ident() % 10000}
+          "pid": _rank(), "tid": _tid()}
     if args:
         ev["args"] = args
     with _lock:
@@ -127,9 +159,25 @@ def _block_results(results):
         results.block_until_ready()
 
 
+_DISPATCH_COUNTERS = {}
+
+
+def _dispatch_counter(cat):
+    c = _DISPATCH_COUNTERS.get(cat)
+    if c is None:
+        if not _telemetry._STATE.enabled:
+            return _telemetry._NULL  # don't cache the null across a toggle
+        c = _telemetry.counter("mxtpu_op_dispatch_total", {"cat": cat})
+        _DISPATCH_COUNTERS[cat] = c
+    return c
+
+
 def timed_call(name, fn, args, cat="imperative"):
     """Run fn(*args), recording it as one op event when profiling is active
-    (single shared wrapper for every dispatch site)."""
+    (single shared wrapper for every dispatch site). Always counts the
+    dispatch in telemetry (`mxtpu_op_dispatch_total{cat}`) — the always-on
+    layer rides the same choke point the profiler hook uses."""
+    _dispatch_counter(cat).inc()
     if not is_active() or not _category_enabled(cat):
         return fn(*args)
     t0 = _now_us()
@@ -142,21 +190,40 @@ def timed_call(name, fn, args, cat="imperative"):
 
 def record_memory(name, nbytes):
     if _config["profile_memory"] or _config["profile_all"]:
+        pid = _rank()
         with _lock:
             _events.append({"name": "memory", "ph": "C", "ts": _now_us(),
-                            "pid": 0, "args": {name: nbytes}})
+                            "pid": pid, "args": {name: nbytes}})
 
 
 def dump(finished=True, profile_process="worker"):
     """Write the chrome trace file (reference: profiler.py dump ->
-    MXDumpProfile). Open it at chrome://tracing or perfetto.dev."""
+    MXDumpProfile). Open it at chrome://tracing or perfetto.dev; merge
+    per-rank dumps with tools/trace_merge.py (each dump stamps pid=rank and
+    carries process_name/thread_name metadata so the merged timeline shows
+    named rank/thread lanes).
+
+    `finished=True` (default) also RESETS the aggregate-stats table, not
+    just the event list — back-to-back profile sessions must not mix rows
+    (the reference's dump-finished semantics)."""
+    r = _rank()
+    meta = [
+        {"ph": "M", "name": "process_name", "pid": r, "tid": 0,
+         "args": {"name": "rank %d (%s)" % (r, profile_process)}},
+        {"ph": "M", "name": "process_sort_index", "pid": r, "tid": 0,
+         "args": {"sort_index": r}},
+    ]
     with _lock:
-        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        data = {"traceEvents": meta + list(_events), "displayTimeUnit": "ms"}
     with open(_config["filename"], "w") as f:
         json.dump(data, f)
     if finished:
         with _lock:
             _events.clear()
+            _aggregate.clear()
+            # next session re-registers threads (their thread_name metadata
+            # events were just cleared with the event list)
+            _tids.clear()
 
 
 def dumps(reset=False):
@@ -248,9 +315,10 @@ class Counter:
     def set_value(self, value):
         self._value = value
         if is_active():
+            pid = _rank()
             with _lock:
                 _events.append({"name": self.name, "ph": "C", "ts": _now_us(),
-                                "pid": 0, "args": {self.name: value}})
+                                "pid": pid, "args": {self.name: value}})
 
     def increment(self, delta=1):
         self.set_value(self._value + delta)
@@ -276,10 +344,12 @@ class Marker:
 
     def mark(self, scope="process"):
         if is_active():
+            pid, tid = _rank(), _tid()
             with _lock:
                 _events.append({"name": self.name, "ph": "i", "ts": _now_us(),
-                                "pid": 0, "s": {"process": "p", "thread": "t",
-                                                "global": "g"}.get(scope, "p")})
+                                "pid": pid, "tid": tid,
+                                "s": {"process": "p", "thread": "t",
+                                      "global": "g"}.get(scope, "p")})
 
 
 # --------------------------------------------------------------------------

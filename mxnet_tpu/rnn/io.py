@@ -71,8 +71,11 @@ class BucketSentenceIter(DataIter):
         self.buckets = [buckets[i] for i in used]
         self.data = [_np.asarray(self.data[i], dtype=dtype) for i in used]
         if ndiscard:
-            print("WARNING: discarded %d sentences longer than the "
-                  "largest bucket." % ndiscard)
+            import logging
+
+            logging.getLogger("mxnet_tpu.rnn").warning(
+                "discarded %d sentences longer than the largest bucket.",
+                ndiscard)
 
         self.batch_size = batch_size
         self.data_name = data_name

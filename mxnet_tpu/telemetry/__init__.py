@@ -1,0 +1,125 @@
+"""mxnet_tpu.telemetry — always-on runtime metrics + distributed flight
+recorder.
+
+One coherent telemetry spine for the framework (docs/observability.md):
+
+  * `counter` / `gauge` / `histogram` — lock-free per-process metrics with
+    periodic JSONL flush (``MXTPU_TELEMETRY_DIR``) and an optional
+    Prometheus text endpoint (``MXTPU_TELEMETRY_PORT``) — core.py;
+  * `record_event` / `record_step` / `dump` — a ring buffer of recent
+    events plus a hang watchdog (``MXTPU_WATCHDOG_TIMEOUT``) and SIGUSR1
+    stack dumps — recorder.py;
+  * `observe_step` — the single call every trainer step makes: step wall
+    time, examples/sec, achieved MFU (when per-step FLOPs are known), and
+    the watchdog heartbeat.
+
+Zero hard dependencies (pure stdlib; jax is only touched lazily for the MFU
+peak-FLOPs lookup), metrics default ON, exporters default OFF.
+"""
+from __future__ import annotations
+
+import os
+
+from .core import (  # noqa: F401
+    BYTE_BOUNDS, LATENCY_BOUNDS, counter, enabled, flush, gauge,
+    get_registry, histogram, prometheus_text, rank, restart_generation,
+    set_enabled, snapshot, start_http_server, telemetry_dir,
+)
+from .recorder import (  # noqa: F401
+    dump, dump_path, events, install_signal_handler, last_step, record_event,
+    record_step,
+)
+from . import core as _core
+
+__all__ = [
+    "counter", "gauge", "histogram", "enabled", "set_enabled", "snapshot",
+    "prometheus_text", "flush", "start_http_server", "get_registry",
+    "record_event", "record_step", "events", "dump", "dump_path",
+    "last_step", "install_signal_handler", "observe_step", "set_step_flops",
+    "rank", "restart_generation", "telemetry_dir",
+    "LATENCY_BOUNDS", "BYTE_BOUNDS",
+]
+
+
+# ---------------------------------------------------------------------------
+# step-level instrumentation (shared by gluon.Trainer, DistributedTrainer,
+# PipelineTrainer and the module.fit loop)
+# ---------------------------------------------------------------------------
+
+_STEP_FLOPS = [None]     # model FLOPs per optimizer step (fwd+bwd), if known
+_PEAK_FLOPS = [False]    # False = not yet resolved; None = unknown chip
+
+
+def set_step_flops(flops):
+    """Declare the model's FLOPs per training step so `observe_step` can
+    publish achieved MFU against `runtime.chip_peak_tflops`. Benchmarks and
+    training scripts that know their FLOP count call this once;
+    ``MXTPU_STEP_FLOPS`` is the env spelling."""
+    _STEP_FLOPS[0] = float(flops) if flops else None
+
+
+if os.environ.get("MXTPU_STEP_FLOPS"):
+    try:
+        set_step_flops(float(os.environ["MXTPU_STEP_FLOPS"]))
+    except ValueError:
+        pass
+
+
+def _peak_flops():
+    """Aggregate peak bf16 FLOP/s of the local devices (cached; None when
+    the chip is unknown — e.g. CPU test runs)."""
+    if _PEAK_FLOPS[0] is False:
+        peak = None
+        try:
+            import jax
+
+            from .. import runtime
+
+            devs = jax.devices()
+            per_chip = runtime.chip_peak_tflops(devs[0])
+            if per_chip:
+                peak = per_chip * 1e12 * len(devs)
+        except Exception:
+            peak = None
+        _PEAK_FLOPS[0] = peak
+    return _PEAK_FLOPS[0]
+
+
+_STEP_METRICS = {}  # kind -> (hist, steps, examples, eps, mfu) — the per-
+                    # step path must not pay 4 registry lookups per call
+
+
+def _step_metrics(kind):
+    m = _STEP_METRICS.get(kind)
+    if m is None:
+        labels = {"kind": kind}
+        m = (_core._REGISTRY.histogram("mxtpu_step_seconds", labels),
+             _core._REGISTRY.counter("mxtpu_steps_total", labels),
+             _core._REGISTRY.counter("mxtpu_examples_total", labels),
+             _core._REGISTRY.gauge("mxtpu_examples_per_sec", labels),
+             _core._REGISTRY.gauge("mxtpu_step_mfu", labels))
+        _STEP_METRICS[kind] = m
+    return m
+
+
+def observe_step(duration_s, examples=None, step=None, kind="train"):
+    """Record one completed training step: latency histogram, step/example
+    counters, examples/sec gauge, achieved-MFU gauge (when step FLOPs are
+    declared), plus the flight-recorder heartbeat that feeds the hang
+    watchdog."""
+    if not _core._STATE.enabled:
+        return
+    hist, c_steps, c_examples, g_eps, g_mfu = _step_metrics(kind)
+    hist.observe(duration_s)
+    c_steps.inc()
+    if examples is not None and duration_s > 0:
+        c_examples.inc(int(examples))
+        g_eps.set(examples / duration_s)
+    flops = _STEP_FLOPS[0]
+    if flops and duration_s > 0:
+        peak = _peak_flops()
+        if peak:
+            g_mfu.set((flops / duration_s) / peak)
+    record_step(step)
+
+

@@ -1,0 +1,559 @@
+"""Telemetry core: the metrics registry and its two export paths.
+
+The reference framework's observability was engine-side (profiler chrome
+traces, KVStore counters); there was no always-on metrics layer. Large-scale
+training systems (MegaScale-style production stacks, the MLPerf logging
+convention) converge on the same shape: cheap always-on counters flushed as
+machine-readable per-step records, plus an optional scrape endpoint. This
+module is that spine for mxnet_tpu:
+
+  * `counter` / `gauge` / `histogram` — a process-wide registry of named
+    metrics. The hot path is LOCK-FREE: updates are plain attribute
+    arithmetic (GIL-coalesced; a telemetry sample that loses one increment
+    under thread races is acceptable, a lock on every op dispatch is not).
+    This also makes every read path signal-safe — the flight recorder's
+    SIGUSR1 dump can snapshot metrics without risking a deadlock on a lock
+    the interrupted main thread holds. Metric creation (cold) takes the
+    registry lock once.
+  * JSONL flush — when ``MXTPU_TELEMETRY_DIR`` is set, a daemon thread
+    appends one JSON snapshot line (+ queued events) every
+    ``MXTPU_TELEMETRY_FLUSH_S`` seconds to
+    ``<dir>/telemetry-rank<R>-pid<P>.jsonl``, and once more at exit.
+  * Prometheus text exposition — when ``MXTPU_TELEMETRY_PORT`` is set, an
+    http.server daemon thread serves ``/metrics`` on ``port + rank``
+    (`start_http_server` can also be called explicitly; port 0 picks a
+    free one).
+
+Everything here is pure stdlib (no jax, no numpy) so the launcher, data
+workers and test tooling can import it for free, and nothing ever adds a
+hard dependency. ``MXTPU_TELEMETRY=0`` turns the whole layer into no-ops.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "enabled", "set_enabled", "counter", "gauge", "histogram", "get_registry",
+    "snapshot", "prometheus_text", "flush", "start_http_server", "rank",
+    "restart_generation", "telemetry_dir", "LATENCY_BOUNDS", "BYTE_BOUNDS",
+]
+
+
+def _env_flag(name, default=True):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "off", "no", "")
+
+
+class _State:
+    """Mutable module state in one place (re-read by tests / after fork)."""
+
+    def __init__(self):
+        self.enabled = _env_flag("MXTPU_TELEMETRY", True)
+        self.owner_pid = os.getpid()
+        self.flusher = None          # flusher thread (or None)
+        self.flusher_decided = False  # env checked once (hot-path guard)
+        self.http_server = None      # (server, thread, port) or None
+        self.http_decided = False
+        self.flush_fail_logged = False
+
+
+_STATE = _State()
+
+
+def enabled():
+    """Is the metrics layer active? (``MXTPU_TELEMETRY``, default on.)"""
+    return _STATE.enabled
+
+
+def set_enabled(value):
+    """Runtime toggle (the overhead microbenchmark and bench A-B rows use
+    this; processes normally configure via ``MXTPU_TELEMETRY``)."""
+    _STATE.enabled = bool(value)
+
+
+def rank():
+    """This process's rank from the launcher env protocol (no jax import —
+    telemetry must work before/without a process group)."""
+    for name in ("MXTPU_PROCESS_ID", "DMLC_WORKER_ID", "OMPI_COMM_WORLD_RANK",
+                 "PMI_RANK", "SLURM_PROCID"):
+        v = os.environ.get(name)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
+
+
+def restart_generation():
+    try:
+        return int(os.environ.get("MXTPU_RESTART_GENERATION", "0"))
+    except ValueError:
+        return 0
+
+
+def telemetry_dir():
+    """The JSONL/flight-recorder output directory, or None when unset."""
+    return os.environ.get("MXTPU_TELEMETRY_DIR") or None
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+# default histogram boundaries: step/op/collective latencies in SECONDS
+LATENCY_BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                  60.0, 120.0, 300.0)
+# payload sizes in BYTES (4KiB .. 4GiB, power-of-4)
+BYTE_BOUNDS = tuple(float(4096 * 4 ** i) for i in range(11))
+
+
+def _render_labels(labels):
+    if not labels:
+        return ""
+    inner = ",".join('%s="%s"' % (k, str(v).replace('"', '\\"'))
+                     for k, v in sorted(labels.items()))
+    return "{%s}" % inner
+
+
+class Counter:
+    """Monotonic counter (int or float). `inc` is lock-free."""
+
+    kind = "counter"
+    __slots__ = ("name", "labels", "_value")
+
+    def __init__(self, name, labels=None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._value = 0
+
+    def inc(self, amount=1):
+        if _STATE.enabled:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return {"type": "counter", "value": self._value}
+
+    def expose(self, lines):
+        lines.append("%s%s %s" % (self.name, _render_labels(self.labels),
+                                  _fmt_num(self._value)))
+
+
+class Gauge:
+    """Last-value gauge. `set`/`inc`/`dec` are lock-free."""
+
+    kind = "gauge"
+    __slots__ = ("name", "labels", "_value")
+
+    def __init__(self, name, labels=None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._value = 0.0
+
+    def set(self, value):
+        if _STATE.enabled:
+            self._value = value
+
+    def inc(self, amount=1):
+        if _STATE.enabled:
+            self._value += amount
+
+    def dec(self, amount=1):
+        if _STATE.enabled:
+            self._value -= amount
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return {"type": "gauge", "value": self._value}
+
+    def expose(self, lines):
+        lines.append("%s%s %s" % (self.name, _render_labels(self.labels),
+                                  _fmt_num(self._value)))
+
+
+class Histogram:
+    """Fixed-boundary histogram (count/sum/min/max + cumulative buckets).
+
+    `observe` touches a handful of attributes without a lock; a torn read
+    during a concurrent snapshot skews one sample, which is the accepted
+    trade for a dispatch-rate-safe hot path.
+    """
+
+    kind = "histogram"
+    __slots__ = ("name", "labels", "bounds", "_counts", "_count", "_sum",
+                 "_min", "_max")
+
+    def __init__(self, name, labels=None, bounds=None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self.bounds = tuple(bounds if bounds is not None else LATENCY_BOUNDS)
+        self._counts = [0] * (len(self.bounds) + 1)  # last: +Inf
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+
+    def observe(self, value):
+        if not _STATE.enabled:
+            return
+        i = 0
+        bounds = self.bounds
+        n = len(bounds)
+        # linear scan beats bisect for <=~24 bounds and tiny values land
+        # in the first buckets anyway
+        while i < n and value > bounds[i]:
+            i += 1
+        self._counts[i] += 1
+        self._count += 1
+        self._sum += value
+        if self._min is None or value < self._min:
+            self._min = value
+        if self._max is None or value > self._max:
+            self._max = value
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def snapshot(self):
+        buckets = {}
+        cum = 0
+        for b, c in zip(self.bounds, self._counts):
+            cum += c
+            buckets["%g" % b] = cum
+        buckets["+Inf"] = self._count
+        return {"type": "histogram", "count": self._count, "sum": self._sum,
+                "min": self._min, "max": self._max, "buckets": buckets}
+
+    def expose(self, lines):
+        base = dict(self.labels)
+        cum = 0
+        for b, c in zip(self.bounds, self._counts):
+            cum += c
+            lab = dict(base)
+            lab["le"] = "%g" % b
+            lines.append("%s_bucket%s %d" % (self.name, _render_labels(lab),
+                                             cum))
+        lab = dict(base)
+        lab["le"] = "+Inf"
+        lines.append("%s_bucket%s %d" % (self.name, _render_labels(lab),
+                                         self._count))
+        lines.append("%s_sum%s %s" % (self.name, _render_labels(base),
+                                      _fmt_num(self._sum)))
+        lines.append("%s_count%s %d" % (self.name, _render_labels(base),
+                                        self._count))
+
+
+def _fmt_num(v):
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+class _NullMetric:
+    """Shared no-op stand-in handed out when telemetry is hard-disabled at
+    process start — call sites keep working with zero cost."""
+
+    kind = "null"
+    name = "null"
+    labels: dict = {}
+    value = 0
+    count = 0
+    sum = 0.0
+
+    def inc(self, amount=1):
+        pass
+
+    def dec(self, amount=1):
+        pass
+
+    def set(self, value):
+        pass
+
+    def observe(self, value):
+        pass
+
+    def snapshot(self):
+        return {"type": "null"}
+
+    def expose(self, lines):
+        pass
+
+
+_NULL = _NullMetric()
+
+
+class Registry:
+    """Name -> metric map. Creation is locked; lookups and updates are not."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+
+    def _get_or_make(self, cls, name, labels, **kwargs):
+        key = (name, tuple(sorted((labels or {}).items())))
+        m = self._metrics.get(key)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise TypeError("telemetry metric %r already registered as %s"
+                                % (name, m.kind))
+            return m
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, labels, **kwargs)
+                self._metrics[key] = m
+        return m
+
+    def counter(self, name, labels=None):
+        return self._get_or_make(Counter, name, labels)
+
+    def gauge(self, name, labels=None):
+        return self._get_or_make(Gauge, name, labels)
+
+    def histogram(self, name, labels=None, bounds=None):
+        return self._get_or_make(Histogram, name, labels, bounds=bounds)
+
+    def metrics(self):
+        # dict copy is atomic enough under the GIL; callers iterate the copy
+        return list(self._metrics.values())
+
+    def snapshot(self):
+        out = {}
+        for m in self.metrics():
+            key = m.name + _render_labels(m.labels)
+            out[key] = m.snapshot()
+        return out
+
+    def prometheus_text(self):
+        typed = {}
+        for m in self.metrics():
+            typed.setdefault((m.name, m.kind), []).append(m)
+        lines = []
+        for (name, kind), ms in sorted(typed.items()):
+            lines.append("# TYPE %s %s" % (name, kind))
+            for m in ms:
+                m.expose(lines)
+        return "\n".join(lines) + "\n"
+
+
+_REGISTRY = Registry()
+
+
+def get_registry():
+    return _REGISTRY
+
+
+def counter(name, labels=None):
+    if not _STATE.enabled:
+        return _NULL
+    return _REGISTRY.counter(name, labels)
+
+
+def gauge(name, labels=None):
+    if not _STATE.enabled:
+        return _NULL
+    return _REGISTRY.gauge(name, labels)
+
+
+def histogram(name, labels=None, bounds=None):
+    if not _STATE.enabled:
+        return _NULL
+    return _REGISTRY.histogram(name, labels, bounds)
+
+
+def snapshot():
+    return _REGISTRY.snapshot()
+
+
+def prometheus_text():
+    return _REGISTRY.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# JSONL flush
+# ---------------------------------------------------------------------------
+
+def _jsonl_path(directory):
+    return os.path.join(directory, "telemetry-rank%d-pid%d.jsonl"
+                        % (rank(), os.getpid()))
+
+
+def flush(directory=None, reason="manual"):
+    """Append one metrics-snapshot line (plus any queued events) to the
+    telemetry JSONL file. No-op (returns None) when no directory is
+    configured; returns the path written otherwise."""
+    directory = directory or telemetry_dir()
+    if not directory or not _STATE.enabled:
+        return None
+    from . import recorder
+
+    path = _jsonl_path(directory)
+    try:
+        os.makedirs(directory, exist_ok=True)
+        lines = []
+        for ev in recorder.drain_pending_events():
+            lines.append(json.dumps(
+                {"kind": "event", "ts": ev[0], "event": ev[1],
+                 "fields": ev[2]}, default=str))
+        lines.append(json.dumps({
+            "kind": "metrics",
+            "ts": time.time(),
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "rank": rank(),
+            "pid": os.getpid(),
+            "generation": restart_generation(),
+            "reason": reason,
+            "metrics": snapshot(),
+        }, default=str))
+        with open(path, "a") as f:
+            f.write("\n".join(lines) + "\n")
+        return path
+    except OSError as e:
+        if not _STATE.flush_fail_logged:
+            _STATE.flush_fail_logged = True
+            import logging
+
+            logging.getLogger("mxnet_tpu.telemetry").warning(
+                "telemetry flush to %s failed: %s (further failures "
+                "silenced)", directory, e)
+        return None
+
+
+def _flusher_loop(period):
+    while True:
+        time.sleep(period)
+        if os.getpid() != _STATE.owner_pid:
+            return  # forked child inherited the thread state marker only
+        flush(reason="periodic")
+
+
+def ensure_flusher():
+    """Start the periodic JSONL flusher once (called lazily from the first
+    instrumented event). The env decision is cached after the first look —
+    this sits on the per-step hot path, so configure ``MXTPU_TELEMETRY_DIR``
+    before the process starts recording (launcher/env protocol), not
+    mid-run."""
+    if _STATE.flusher_decided:
+        return
+    if not _STATE.enabled or not telemetry_dir():
+        _STATE.flusher_decided = True
+        return
+    _STATE.flusher_decided = True
+    period = float(os.environ.get("MXTPU_TELEMETRY_FLUSH_S", "10"))
+    t = threading.Thread(target=_flusher_loop, args=(max(0.25, period),),
+                         name="mxtpu-telemetry-flush", daemon=True)
+    _STATE.flusher = t
+    t.start()
+
+
+@atexit.register
+def _flush_at_exit():
+    try:
+        if os.getpid() == _STATE.owner_pid:
+            flush(reason="exit")
+    except Exception:
+        pass
+
+
+def _reset_after_fork():
+    """Forked children (DataLoader workers) must not inherit flusher/http
+    thread markers pointing at threads that did not survive the fork; they
+    restart lazily in the child if configured."""
+    _STATE.owner_pid = os.getpid()
+    _STATE.flusher = None
+    _STATE.flusher_decided = False
+    _STATE.http_server = None
+    _STATE.http_decided = False
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_after_fork)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-exposition endpoint
+# ---------------------------------------------------------------------------
+
+def start_http_server(port=None, addr="0.0.0.0"):
+    """Serve `prometheus_text()` at /metrics on a daemon thread; returns the
+    bound port. Explicit-call form of the ``MXTPU_TELEMETRY_PORT`` env path
+    (port 0 binds a free port — tests). Idempotent per process."""
+    if _STATE.http_server is not None:
+        return _STATE.http_server[2]
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    if port is None:
+        raw = os.environ.get("MXTPU_TELEMETRY_PORT")
+        if raw is None:
+            return None
+        port = int(raw)
+        if port:
+            # one exporter per rank on a shared host: offset by rank
+            port += rank()
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # no access-log spam on stderr
+            pass
+
+    server = ThreadingHTTPServer((addr, port), _Handler)
+    t = threading.Thread(target=server.serve_forever,
+                         name="mxtpu-telemetry-http", daemon=True)
+    t.start()
+    bound = server.server_address[1]
+    _STATE.http_server = (server, t, bound)
+    return bound
+
+
+def ensure_http():
+    """Start the exporter if ``MXTPU_TELEMETRY_PORT`` asks for one (lazy,
+    called from the first instrumented event; env decision cached — set the
+    port before the process starts recording)."""
+    if _STATE.http_decided:
+        return
+    if not _STATE.enabled:
+        return
+    _STATE.http_decided = True
+    if os.environ.get("MXTPU_TELEMETRY_PORT") is None:
+        return
+    try:
+        start_http_server()
+    except (OSError, ValueError) as e:
+        # bind failure or a malformed MXTPU_TELEMETRY_PORT: telemetry must
+        # never take the training process down
+        import logging
+
+        logging.getLogger("mxnet_tpu.telemetry").warning(
+            "telemetry endpoint bind failed: %s (metrics endpoint disabled "
+            "for this process)", e)
+        _STATE.http_server = (None, None, None)  # don't retry every event

@@ -1,0 +1,293 @@
+"""Distributed flight recorder: recent-events ring + hang watchdog + dumps.
+
+A slow or hung distributed run is invisible from the outside: every rank is
+parked in a collective and the launcher only sees silence. This module keeps
+the last ``MXTPU_FLIGHTREC_EVENTS`` telemetry events per process in a ring
+buffer and knows how to dump them — together with every thread's current
+stack and a metrics snapshot — to a per-rank JSON file, on three triggers:
+
+  * watchdog — when ``MXTPU_WATCHDOG_TIMEOUT`` seconds pass without a
+    training step completing (armed by the first `record_step`; the first
+    step itself may compile for minutes, so nothing fires before one step
+    has finished). After dumping, the default action aborts the process
+    (exit code ``MXTPU_WATCHDOG_EXIT_CODE``, 43) so the launcher's group
+    teardown + restart machinery takes over instead of the job hanging
+    forever; ``MXTPU_WATCHDOG_ACTION=dump`` keeps the process alive and
+    re-arms.
+  * SIGUSR1 — `tools/launch.py` sends it to every worker just before its
+    SIGTERM→SIGKILL teardown escalation, so every teardown of a hung group
+    leaves one diagnosis file per rank behind. Available to operators too
+    (``kill -USR1 <pid>``).
+  * explicit — `dump(reason)` from code/tests.
+
+Dumps land in ``MXTPU_TELEMETRY_DIR`` (fallback: the system temp dir) as
+``flightrec-rank<R>-pid<P>.json``, and the path is announced on stderr —
+which the launcher prefixes per rank into its own log, so the post-mortem
+trail starts in one place. Signal-safety: the ring is a bare deque (atomic
+append), metrics are lock-free (telemetry/core.py), so dumping from inside
+a signal handler cannot deadlock on state the interrupted thread holds.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import traceback
+
+from . import core
+
+__all__ = ["record_event", "record_step", "events", "dump", "dump_path",
+           "last_step", "install_signal_handler", "drain_pending_events"]
+
+
+def _ring_size():
+    try:
+        return max(16, int(os.environ.get("MXTPU_FLIGHTREC_EVENTS", "512")))
+    except ValueError:
+        return 512
+
+
+class _RecState:
+    def __init__(self):
+        self.ring = collections.deque(maxlen=_ring_size())
+        self.pending = collections.deque(maxlen=4096)  # JSONL flush queue
+        self.last_step = None        # (step, monotonic_t, wall_t)
+        self.watchdog = None
+        self.watchdog_decided = False  # env checked once (hot-path guard)
+        self.signal_installed = False
+        self.dump_seq = 0
+
+
+_REC = _RecState()
+
+
+def _reset_after_fork():
+    st = _RecState()
+    # a forked data worker keeps the parent's history visible (harmless)
+    # but gets its own watchdog/signal/pending state
+    st.ring = _REC.ring.copy()
+    globals()["_REC"] = st
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_after_fork)
+
+
+# ---------------------------------------------------------------------------
+# events
+# ---------------------------------------------------------------------------
+
+def record_event(kind, **fields):
+    """Append a telemetry event to the flight-recorder ring (and queue it
+    for the next JSONL flush). Cheap: two deque appends."""
+    if not core._STATE.enabled:
+        return
+    ev = (time.time(), kind, fields)
+    _REC.ring.append(ev)
+    _REC.pending.append(ev)
+    core.ensure_flusher()
+    core.ensure_http()
+
+
+def drain_pending_events():
+    """Hand the queued (not-yet-flushed) events to the JSONL flusher."""
+    out = []
+    while True:
+        try:
+            out.append(_REC.pending.popleft())
+        except IndexError:
+            return out
+
+
+def events():
+    """Snapshot of the ring (oldest first)."""
+    return [{"ts": ts, "event": kind, "fields": dict(fields)}
+            for ts, kind, fields in list(_REC.ring)]
+
+
+def last_step():
+    """(step, seconds_since) of the newest recorded step, or None."""
+    ls = _REC.last_step
+    if ls is None:
+        return None
+    return ls[0], time.monotonic() - ls[1]
+
+
+def record_step(step=None):
+    """Mark a training-step completion: feeds the watchdog deadline, the
+    ring, and installs the SIGUSR1 handler / watchdog thread on first use."""
+    if not core._STATE.enabled:
+        return
+    _REC.last_step = (step, time.monotonic(), time.time())
+    _REC.ring.append((time.time(), "step", {"step": step}))
+    install_signal_handler()
+    _ensure_watchdog()
+    core.ensure_flusher()
+    core.ensure_http()
+
+
+# ---------------------------------------------------------------------------
+# dumps
+# ---------------------------------------------------------------------------
+
+def dump_path():
+    directory = core.telemetry_dir() or tempfile.gettempdir()
+    return os.path.join(directory, "flightrec-rank%d-pid%d.json"
+                        % (core.rank(), os.getpid()))
+
+
+def _thread_stacks():
+    names = {t.ident: (t.name, t.daemon) for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        name, daemon = names.get(ident, ("unknown-%d" % ident, None))
+        out.append({
+            "name": name,
+            "ident": ident,
+            "daemon": daemon,
+            "stack": [ln.rstrip("\n")
+                      for ln in traceback.format_stack(frame)],
+        })
+    out.sort(key=lambda t: (t["name"] != "MainThread", t["name"]))
+    return out
+
+
+def dump(reason, path=None):
+    """Write the flight-recorder dump (thread stacks + ring + metrics) and
+    announce its path on stderr. Returns the path, or None on failure
+    (a dump must never take the process down on its own)."""
+    try:
+        path = path or dump_path()
+        ls = last_step()
+        payload = {
+            "version": 1,
+            "reason": reason,
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "ts": time.time(),
+            "rank": core.rank(),
+            "pid": os.getpid(),
+            "generation": core.restart_generation(),
+            "argv": list(sys.argv),
+            "last_step": None if ls is None else
+                {"step": ls[0], "seconds_since": round(ls[1], 3)},
+            "threads": _thread_stacks(),
+            "events": events(),
+            "metrics": core.snapshot(),
+        }
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _REC.dump_seq += 1
+        tmp = "%s.tmp-%d" % (path, _REC.dump_seq)
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        sys.stderr.write(
+            "[flight-recorder] rank %d pid %d dumped to %s (reason: %s)\n"
+            % (core.rank(), os.getpid(), path, reason))
+        sys.stderr.flush()
+        return path
+    except Exception as e:  # diagnosis must never crash the patient
+        try:
+            sys.stderr.write("[flight-recorder] dump failed: %r\n" % (e,))
+            sys.stderr.flush()
+        except Exception:
+            pass
+        return None
+
+
+# ---------------------------------------------------------------------------
+# SIGUSR1
+# ---------------------------------------------------------------------------
+
+def _on_sigusr1(signum, frame):
+    dump("SIGUSR1")
+    prev = getattr(_on_sigusr1, "_prev", None)
+    if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL,
+                                       _on_sigusr1):
+        prev(signum, frame)
+
+
+def install_signal_handler():
+    """Install the SIGUSR1 dump handler (main thread only — elsewhere the
+    attempt is silently skipped and retried from a later main-thread call).
+    Chains any pre-existing handler."""
+    if _REC.signal_installed or not hasattr(signal, "SIGUSR1"):
+        return
+    try:
+        prev = signal.signal(signal.SIGUSR1, _on_sigusr1)
+    except ValueError:        # not the main thread
+        return
+    _on_sigusr1._prev = prev
+    _REC.signal_installed = True
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+def _watchdog_timeout():
+    raw = os.environ.get("MXTPU_WATCHDOG_TIMEOUT")
+    if not raw:
+        return None
+    try:
+        t = float(raw)
+    except ValueError:
+        return None
+    return t if t > 0 else None
+
+
+def _watchdog_loop(timeout):
+    poll = max(0.05, min(1.0, timeout / 4.0))
+    while True:
+        time.sleep(poll)
+        if os.getpid() != core._STATE.owner_pid:
+            return
+        ls = _REC.last_step
+        if ls is None:
+            continue
+        stalled = time.monotonic() - ls[1]
+        if stalled <= timeout:
+            continue
+        record_event("watchdog_fired", step=ls[0],
+                     stalled_s=round(stalled, 3), timeout_s=timeout)
+        dump("watchdog: no step completed in %.1fs (timeout %gs, last "
+             "step %s)" % (stalled, timeout, ls[0]))
+        core.flush(reason="watchdog")
+        action = os.environ.get("MXTPU_WATCHDOG_ACTION", "abort").lower()
+        if action == "dump":
+            # keep running, re-arm from now
+            _REC.last_step = (ls[0], time.monotonic(), time.time())
+            continue
+        try:
+            code = int(os.environ.get("MXTPU_WATCHDOG_EXIT_CODE", "43"))
+        except ValueError:
+            code = 43  # a typo'd exit code must not disarm the abort
+        sys.stderr.write(
+            "[flight-recorder] rank %d aborting hung process (exit %d) so "
+            "the launcher can tear down / restart the group\n"
+            % (core.rank(), code))
+        sys.stderr.flush()
+        os._exit(code)
+
+
+def _ensure_watchdog():
+    # env decision cached: this sits on the per-step hot path. Configure
+    # MXTPU_WATCHDOG_TIMEOUT before the first training step.
+    if _REC.watchdog_decided:
+        return
+    _REC.watchdog_decided = True
+    timeout = _watchdog_timeout()
+    if timeout is None:
+        return
+    t = threading.Thread(target=_watchdog_loop, args=(timeout,),
+                         name="mxtpu-watchdog", daemon=True)
+    _REC.watchdog = t
+    t.start()

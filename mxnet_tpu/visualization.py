@@ -42,11 +42,11 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
             line += str(field)
             line = line[: pos[i]]
             line += " " * (pos[i] - len(line))
-        print(line)
+        print(line)  # allow-print
 
-    print("_" * line_length)
+    print("_" * line_length)  # allow-print
     print_row(fields, positions)
-    print("=" * line_length)
+    print("=" * line_length)  # allow-print
     total_params = [0]
 
     def out_shape_of(name):
@@ -77,9 +77,9 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
                    first_conn], positions)
         for p in pre[1:]:
             print_row(["", "", "", p], positions)
-        print("_" * line_length)
-    print("Total params: %d" % total_params[0])
-    print("_" * line_length)
+        print("_" * line_length)  # allow-print
+    print("Total params: %d" % total_params[0])  # allow-print
+    print("_" * line_length)  # allow-print
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,

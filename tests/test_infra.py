@@ -372,3 +372,31 @@ def test_notebook_pandas_logger():
                                        "epoch_end_callback"}
     with _pytest.raises(ImportError, match="bokeh"):
         LiveLearningCurve()
+
+
+def test_no_bare_print_in_library(tmp_path):
+    """CI lint (ci/lint_print.py): library output goes through mxnet_tpu.log
+    / telemetry, never bare print — enforced in-suite so a violation fails
+    tier-1, not just a side CI job. Also proves the linter still CATCHES a
+    violation (a silently broken linter would pass vacuously)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lint = os.path.join(root, "ci", "lint_print.py")
+    r = subprocess.run([sys.executable, lint], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    bad_pkg = tmp_path / "mxnet_tpu"
+    bad_pkg.mkdir()
+    (bad_pkg / "bad.py").write_text(
+        'x = 1\nprint("no")\ny = 2  # print("in comment") is fine\n'
+        's = "print(also fine)"\npprint(1)\nobj.print(2)\n'
+        'print("ok")  # allow-print\n')
+    r = subprocess.run([sys.executable, lint, str(tmp_path)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout
+    assert "bad.py:2" in r.stdout, r.stdout
+    assert r.stdout.count("bad.py:") == 1, r.stdout  # only the real one

@@ -1,0 +1,287 @@
+"""Profiler dispatch-hook and multi-rank trace tests (ISSUE 3 satellites):
+
+  * timed_call records events on every dispatch surface — eager nd ops,
+    Executor.forward, autograd backward — honoring the
+    profile_imperative/profile_symbolic category gating and blocking on
+    results under profile_sync=True;
+  * stable per-thread trace ids + thread_name/process_name metadata
+    (the old `ident % 10000` tids were collision-prone);
+  * dump(finished=True) resets the aggregate table (back-to-back sessions
+    must not mix);
+  * tools/trace_merge.py on synthetic per-rank dumps yields one valid
+    chrome trace with distinct pids + process_name metadata.
+"""
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, profiler
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def clean_profiler(tmp_path):
+    """Fresh profiler session with config/state restored afterwards."""
+    saved = dict(profiler._config)
+    profiler._events.clear()
+    profiler._aggregate.clear()
+    profiler._tids.clear()
+    profiler.set_config(filename=str(tmp_path / "trace.json"),
+                        profile_all=False, profile_imperative=True,
+                        profile_symbolic=True, aggregate_stats=True,
+                        profile_sync=False)
+    yield profiler
+    profiler.set_state("stop")
+    profiler._config.update(saved)
+    profiler._events.clear()
+    profiler._aggregate.clear()
+    profiler._tids.clear()
+
+
+def _event_names(p):
+    with p._lock:
+        return [e["name"] for e in p._events]
+
+
+def test_timed_call_records_nd_ops(clean_profiler):
+    p = clean_profiler
+    p.set_state("run")
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    _ = (x * 2).asnumpy()
+    p.set_state("stop")
+    names = _event_names(p)
+    assert any("mul" in n for n in names), names
+    cats = {e["name"]: e["cat"] for e in p._events if e.get("ph") == "X"}
+    assert any(c == "imperative" for c in cats.values()), cats
+
+
+def test_timed_call_records_executor_forward_and_backward(clean_profiler):
+    p = clean_profiler
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    out = mx.sym.FullyConnected(data=data, weight=w, no_bias=True,
+                                num_hidden=2)
+    args = {"data": mx.nd.array(np.ones((2, 3), np.float32)),
+            "w": mx.nd.array(np.ones((2, 3), np.float32))}
+    grads = {"w": mx.nd.zeros((2, 3))}
+    exe = out.bind(mx.cpu(), args=args, args_grad=grads, grad_req="write")
+    p.set_state("run")
+    exe.forward(is_train=True)
+    exe.backward()
+    p.set_state("stop")
+    names = _event_names(p)
+    assert "ExecutorForward" in names, names
+    assert "ExecutorBackward" in names, names
+    cats = {e["name"]: e["cat"] for e in p._events if e.get("ph") == "X"}
+    assert cats["ExecutorForward"] == "symbolic"
+
+
+def test_timed_call_records_autograd_backward(clean_profiler):
+    p = clean_profiler
+    p.set_state("run")
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    p.set_state("stop")
+    names = _event_names(p)
+    backward = [n for n in names if n.startswith("_backward_")]
+    assert backward, names  # tape replay recorded per-node _backward_<op>
+
+
+def test_category_gating_imperative_vs_symbolic(clean_profiler):
+    p = clean_profiler
+    # imperative off: eager nd ops are NOT recorded, symbolic still is
+    p.set_config(profile_imperative=False, profile_symbolic=True)
+    p.set_state("run")
+    x = mx.nd.array([1.0, 2.0])
+    _ = (x + 1).asnumpy()
+    data = mx.sym.var("data")
+    exe = (data * 2).bind(mx.cpu(), args={"data": x})
+    exe.forward()
+    p.set_state("stop")
+    names = _event_names(p)
+    assert not any("plus" in n or "add" in n for n in names), names
+    assert "ExecutorForward" in names
+
+    # symbolic off: the reverse
+    p._events.clear()
+    p.set_config(profile_imperative=True, profile_symbolic=False)
+    p.set_state("run")
+    _ = (x + 1).asnumpy()
+    exe.forward()
+    p.set_state("stop")
+    names = _event_names(p)
+    assert any("plus" in n or "add" in n for n in names), names
+    assert "ExecutorForward" not in names
+
+    # profile_all overrides gating
+    p._events.clear()
+    p.set_config(profile_all=True, profile_imperative=False,
+                 profile_symbolic=False)
+    p.set_state("run")
+    _ = (x + 1).asnumpy()
+    p.set_state("stop")
+    assert _event_names(p), "profile_all must re-enable every category"
+
+
+def test_profile_sync_blocks_on_results(clean_profiler, monkeypatch):
+    p = clean_profiler
+    blocked = []
+    real = p._block_results
+    monkeypatch.setattr(p, "_block_results",
+                        lambda results: (blocked.append(True),
+                                         real(results))[1])
+    p.set_config(profile_sync=True)
+    p.set_state("run")
+    x = mx.nd.array([1.0, 2.0])
+    _ = (x * 3).asnumpy()
+    p.set_state("stop")
+    assert blocked, "profile_sync=True must block on op results"
+    # and with profile_sync off the block helper is not consulted
+    blocked.clear()
+    p.set_config(profile_sync=False)
+    p.set_state("run")
+    _ = (x * 3).asnumpy()
+    p.set_state("stop")
+    assert not blocked
+
+
+def test_dump_finished_resets_aggregate(clean_profiler, tmp_path):
+    p = clean_profiler
+    p.set_state("run")
+    x = mx.nd.array([1.0])
+    _ = (x * 2).asnumpy()
+    p.set_state("stop")
+    assert len(p.dumps().splitlines()) > 1  # header + >=1 row
+    p.dump(finished=True)
+    # aggregate reset: only the header remains (dump-finished semantics)
+    assert len(p.dumps().splitlines()) == 1
+    # a second session accumulates ONLY its own rows
+    p.set_state("run")
+    _ = (x + 5).asnumpy()
+    p.set_state("stop")
+    rows = p.dumps().splitlines()[1:]
+    assert rows and not any("mul" in r for r in rows), rows
+
+
+def test_dump_finished_false_keeps_state(clean_profiler):
+    p = clean_profiler
+    p.set_state("run")
+    x = mx.nd.array([1.0])
+    _ = (x * 2).asnumpy()
+    p.set_state("stop")
+    p.dump(finished=False)
+    assert len(p.dumps().splitlines()) > 1
+    assert _event_names(p)
+
+
+def test_stable_tids_and_thread_metadata(clean_profiler, tmp_path):
+    p = clean_profiler
+    p.set_state("run")
+
+    def work():
+        y = mx.nd.array([4.0, 5.0])
+        _ = (y * 2).asnumpy()
+
+    work()
+    t = threading.Thread(target=work, name="worker-thread")
+    t.start()
+    t.join()
+    p.set_state("stop")
+    p.dump(finished=False)
+    data = json.load(open(p._config["filename"]))
+    evs = data["traceEvents"]
+    # process metadata labels the rank lane
+    procs = [e for e in evs if e.get("ph") == "M"
+             and e["name"] == "process_name"]
+    assert procs and procs[0]["args"]["name"].startswith("rank 0")
+    # each thread got a small stable tid + a thread_name metadata event
+    tmeta = {e["tid"]: e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert "MainThread" in tmeta.values()
+    assert "worker-thread" in tmeta.values()
+    op_tids = {e["tid"] for e in evs if e.get("ph") == "X"}
+    assert op_tids <= set(tmeta), (op_tids, tmeta)
+    assert len(op_tids) == 2  # two threads -> two distinct lanes
+    assert all(isinstance(t_, int) and 0 < t_ < 1000 for t_ in op_tids)
+
+
+# --------------------------------------------------------------------------
+# trace merge (tools/trace_merge.py)
+# --------------------------------------------------------------------------
+
+def _load_trace_merge():
+    spec = importlib.util.spec_from_file_location(
+        "trace_merge", os.path.join(_ROOT, "tools", "trace_merge.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_merge_synthetic(tmp_path):
+    tm = _load_trace_merge()
+    # two synthetic per-rank dumps that BOTH claim pid 0 (the pre-telemetry
+    # single-process stamp) — the merge must keep them apart
+    for r in (0, 1):
+        trace = {"traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+             "args": {"name": "stale"}},
+            {"name": "step", "cat": "task", "ph": "X", "ts": 10 + r,
+             "dur": 5, "pid": 0, "tid": 1},
+            {"name": "allreduce", "cat": "task", "ph": "X", "ts": 20,
+             "dur": 2, "pid": 0, "tid": 2},
+        ]}
+        json.dump(trace, open(str(tmp_path / ("r%d.json" % r)), "w"))
+    out = str(tmp_path / "merged.json")
+    rc = tm.main([str(tmp_path / "r0.json"), str(tmp_path / "r1.json"),
+                  "-o", out])
+    assert rc == 0
+    merged = json.load(open(out))  # valid JSON chrome trace
+    evs = merged["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert pids == {0, 1}
+    names = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    # every real event survived, remapped
+    assert sum(1 for e in evs if e.get("ph") == "X") == 4
+    sorts = {e["pid"]: e["args"]["sort_index"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_sort_index"}
+    assert sorts == {0: 0, 1: 1}
+
+
+def test_trace_merge_real_profiler_dumps(tmp_path, clean_profiler):
+    """Two real profiler.dump() files (simulating two ranks) merge into one
+    perfetto-loadable timeline with per-rank process lanes."""
+    p = clean_profiler
+    paths = []
+    for r in (0, 1):
+        p._events.clear()
+        p._tids.clear()
+        p._rank_cache[0] = r  # what a launched rank-r process would stamp
+        fname = str(tmp_path / ("rank%d.json" % r))
+        p.set_config(filename=fname)
+        p.set_state("run")
+        x = mx.nd.array([float(r + 1)])
+        _ = (x * 2).asnumpy()
+        p.set_state("stop")
+        p.dump(finished=True)
+        paths.append(fname)
+    p._rank_cache[0] = None
+    tm = _load_trace_merge()
+    out = str(tmp_path / "merged.json")
+    assert tm.main(paths + ["-o", out]) == 0
+    merged = json.load(open(out))
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1}
+    names = {e["pid"]: e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert set(names) == {0, 1}

@@ -1,0 +1,411 @@
+"""Telemetry subsystem tests (ISSUE 3 acceptance):
+
+  * unit: registry metric semantics, Prometheus text exposition (served
+    over a real HTTP socket), JSONL flush format, observe_step wiring,
+    flight-recorder ring + dump contents;
+  * overhead: enabled-vs-disabled per-step cost of the full step
+    instrumentation < 2% on a CPU step-loop microbenchmark;
+  * process level: SIGUSR1 produces a dump without killing the process;
+  * END-TO-END: a 2-process launch.py group with
+    `MXTPU_FAULT_INJECT=hang@step=5,rank=1` and a short watchdog —
+    the hung rank dumps thread stacks + recent events to a per-rank file
+    and aborts, the launcher tears the group down (SIGUSR1 first), and its
+    log references the dump.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (conftest pins CPU before jax loads)
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import core as tm_core
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LAUNCH = os.path.join(_ROOT, "tools", "launch.py")
+_WORKER = os.path.join(_ROOT, "tests", "flightrec_worker.py")
+
+
+def _clean_env(**extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXTPU_TELEMETRY_DIR", None)
+    env.pop("MXTPU_WATCHDOG_TIMEOUT", None)
+    env.pop("MXTPU_FAULT_INJECT", None)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra)
+    return env
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_semantics():
+    c = telemetry.counter("t_unit_total")
+    v0 = c.value
+    c.inc()
+    c.inc(4)
+    assert c.value == v0 + 5
+    # float counters (seconds accumulators)
+    fc = telemetry.counter("t_unit_seconds_total")
+    fc.inc(0.25)
+    fc.inc(0.25)
+    assert abs(fc.value - 0.5) < 1e-9 or fc.value >= 0.5
+
+    g = telemetry.gauge("t_unit_gauge")
+    g.set(3.5)
+    assert g.value == 3.5
+    g.inc()
+    g.dec(2)
+    assert g.value == 2.5
+
+    h = telemetry.histogram("t_unit_hist_seconds")
+    for v in (0.0002, 0.0002, 0.03, 7.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert abs(snap["sum"] - 7.0304) < 1e-6
+    assert snap["min"] == 0.0002 and snap["max"] == 7.0
+    # cumulative buckets: everything <= 0.00025 counts 2, +Inf counts all
+    assert snap["buckets"]["0.00025"] == 2
+    assert snap["buckets"]["+Inf"] == 4
+
+    # same name+labels -> same object; name reuse across kinds is an error
+    assert telemetry.counter("t_unit_total") is c
+    with pytest.raises(TypeError):
+        telemetry.gauge("t_unit_total")
+    # labeled metrics are distinct series
+    a = telemetry.counter("t_unit_lab_total", {"op": "a"})
+    b = telemetry.counter("t_unit_lab_total", {"op": "b"})
+    assert a is not b
+
+
+def test_prometheus_text_and_http_endpoint():
+    telemetry.counter("t_expo_total", {"op": "x"}).inc(2)
+    telemetry.histogram("t_expo_seconds").observe(0.004)
+    text = telemetry.prometheus_text()
+    assert "# TYPE t_expo_total counter" in text
+    assert 't_expo_total{op="x"} 2' in text
+    assert "# TYPE t_expo_seconds histogram" in text
+    assert 't_expo_seconds_bucket{le="+Inf"} ' in text
+    assert "t_expo_seconds_count 1" in text
+
+    port = telemetry.start_http_server(port=0, addr="127.0.0.1")
+    assert port and port > 0
+    body = urllib.request.urlopen(
+        "http://127.0.0.1:%d/metrics" % port, timeout=10).read().decode()
+    assert 't_expo_total{op="x"}' in body
+    # idempotent: second call returns the same bound port
+    assert telemetry.start_http_server(port=0, addr="127.0.0.1") == port
+
+
+def test_jsonl_flush_and_event_queue(tmp_path):
+    telemetry.counter("t_flush_total").inc(3)
+    telemetry.record_event("unit_test_event", detail="abc")
+    path = telemetry.flush(directory=str(tmp_path), reason="unit")
+    assert path and os.path.exists(path)
+    assert os.path.basename(path) == "telemetry-rank0-pid%d.jsonl" % os.getpid()
+    records = [json.loads(ln) for ln in open(path) if ln.strip()]
+    kinds = [r["kind"] for r in records]
+    assert "metrics" in kinds and "event" in kinds
+    metrics = [r for r in records if r["kind"] == "metrics"][-1]
+    assert metrics["rank"] == 0 and metrics["reason"] == "unit"
+    assert metrics["metrics"]["t_flush_total"]["value"] >= 3
+    evs = [r for r in records if r["kind"] == "event"]
+    assert any(r["event"] == "unit_test_event"
+               and r["fields"]["detail"] == "abc" for r in evs)
+    # queue drained: a second flush re-emits metrics but not the old event
+    path2 = telemetry.flush(directory=str(tmp_path), reason="unit2")
+    records2 = [json.loads(ln) for ln in open(path2) if ln.strip()]
+    assert sum(1 for r in records2 if r["kind"] == "event"
+               and r["event"] == "unit_test_event") == 1
+
+
+def test_observe_step_and_ring():
+    steps0 = telemetry.counter("mxtpu_steps_total", {"kind": "unit"}).value
+    telemetry.observe_step(0.01, examples=64, step=11, kind="unit")
+    assert telemetry.counter("mxtpu_steps_total",
+                             {"kind": "unit"}).value == steps0 + 1
+    assert telemetry.gauge("mxtpu_examples_per_sec",
+                           {"kind": "unit"}).value == pytest.approx(6400.0)
+    last = telemetry.last_step()
+    assert last is not None and last[0] == 11
+    evs = telemetry.events()
+    assert any(e["event"] == "step" and e["fields"]["step"] == 11
+               for e in evs)
+
+
+def test_disabled_is_noop():
+    telemetry.set_enabled(False)
+    try:
+        before = telemetry.counter("t_disabled_total")
+        before.inc(5)
+        assert before.value == 0  # null metric
+        telemetry.observe_step(0.01, examples=8, step=1, kind="disabled")
+        assert telemetry.flush(directory="/nonexistent-dir-unused") is None
+    finally:
+        telemetry.set_enabled(True)
+    # the real registry never saw the disabled-phase series
+    assert "t_disabled_total" not in telemetry.snapshot()
+
+
+def test_dump_contents(tmp_path):
+    telemetry.record_event("pre_dump_marker", k=1)
+    path = telemetry.dump("unit-test", path=str(tmp_path / "d.json"))
+    data = json.load(open(path))
+    assert data["reason"] == "unit-test"
+    assert data["rank"] == 0 and data["pid"] == os.getpid()
+    names = [t["name"] for t in data["threads"]]
+    assert "MainThread" in names
+    main = data["threads"][names.index("MainThread")]
+    assert any("test_dump_contents" in ln for ln in main["stack"])
+    assert any(e["event"] == "pre_dump_marker" for e in data["events"])
+    assert "mxtpu_op_dispatch_total" in str(data["metrics"]) or data["metrics"]
+
+
+# --------------------------------------------------------------------------
+# training-path wiring
+# --------------------------------------------------------------------------
+
+def test_trainer_step_publishes_metrics():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    steps0 = telemetry.counter("mxtpu_steps_total", {"kind": "train"}).value
+    disp0 = telemetry.counter("mxtpu_op_dispatch_total",
+                              {"cat": "imperative"}).value
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    l2 = gluon.loss.L2Loss()
+    x = mx.nd.array(np.ones((4, 3), np.float32))
+    y = mx.nd.array(np.zeros((4, 2), np.float32))
+    for _ in range(2):
+        with autograd.record():
+            loss = l2(net(x), y)
+        loss.backward()
+        tr.step(4)
+    assert telemetry.counter("mxtpu_steps_total",
+                             {"kind": "train"}).value == steps0 + 2
+    assert telemetry.counter("mxtpu_op_dispatch_total",
+                             {"cat": "imperative"}).value > disp0
+    h = telemetry.histogram("mxtpu_step_seconds", {"kind": "train"})
+    assert h.count >= 2
+    # jit executable-cache accounting: lookups >= misses, both nonzero
+    lookups = telemetry.counter("mxtpu_jit_cache_lookup_total").value
+    misses = telemetry.counter("mxtpu_jit_cache_miss_total").value
+    assert lookups >= misses > 0
+
+
+def test_collectives_metrics():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import collectives
+
+    calls0 = telemetry.counter("mxtpu_collective_calls_total",
+                               {"op": "all_reduce"}).value
+    arrays = [jax.device_put(jnp.ones((8,), jnp.float32), d)
+              for d in jax.devices()[:2]]
+    out = collectives.all_reduce_arrays(arrays)
+    assert float(out[0][0]) == 2.0
+    assert telemetry.counter("mxtpu_collective_calls_total",
+                             {"op": "all_reduce"}).value == calls0 + 1
+    # bytes: 2 arrays x 8 floats x 4B
+    assert telemetry.counter("mxtpu_collective_bytes_total",
+                             {"op": "all_reduce"}).value >= 64
+
+
+def test_checkpoint_metrics(tmp_path):
+    from mxnet_tpu.parallel.resilience import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), rank0_only=False)
+    saves0 = telemetry.histogram("mxtpu_checkpoint_seconds",
+                                 {"what": "save"}).count
+    mgr.save(1, save_params=lambda p: open(p, "wb").write(b"x" * 100))
+    assert telemetry.histogram("mxtpu_checkpoint_seconds",
+                               {"what": "save"}).count == saves0 + 1
+    assert telemetry.counter("mxtpu_checkpoint_bytes_total",
+                             {"what": "save"}).value > 0
+    assert any(e["event"] == "checkpoint_save" for e in telemetry.events())
+    mgr.restore(load_params=lambda p: open(p, "rb").read())
+    assert any(e["event"] == "checkpoint_restore"
+               for e in telemetry.events())
+
+
+def test_dataloader_wait_compute_split():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    wait0 = telemetry.counter("mxtpu_data_wait_seconds_total",
+                              {"src": "dataloader"}).value
+    n0 = telemetry.counter("mxtpu_data_batches_total",
+                           {"src": "dataloader"}).value
+    ds = ArrayDataset(np.arange(32, dtype=np.float32),
+                      np.arange(32, dtype=np.float32))
+    loader = DataLoader(ds, batch_size=8)
+    seen = 0
+    for _batch in loader:
+        time.sleep(0.002)  # "compute"
+        seen += 1
+    assert seen == 4
+    assert telemetry.counter("mxtpu_data_batches_total",
+                             {"src": "dataloader"}).value == n0 + 4
+    assert telemetry.counter("mxtpu_data_wait_seconds_total",
+                             {"src": "dataloader"}).value > wait0
+    assert telemetry.counter("mxtpu_data_compute_seconds_total",
+                             {"src": "dataloader"}).value >= 0.006
+
+
+# --------------------------------------------------------------------------
+# overhead (acceptance: < 2% per step, enabled vs disabled)
+# --------------------------------------------------------------------------
+
+def test_step_instrumentation_overhead_under_2pct():
+    """Enabled-vs-disabled per-step cost of the full step instrumentation
+    (observe_step: histogram + counters/gauges + ring heartbeat) must be
+    <2% of a realistic ~1ms CPU step.
+
+    Measured as (enabled-call cost − disabled-call cost) / step time, each
+    taken as a min over many small chunks — min-filtering makes every term
+    robust to suite-load spikes, where differencing two long serially-timed
+    loops is not (a 100ms loop pair can drift 10% on a busy box while the
+    true per-step cost is ~3µs)."""
+    def per_call_cost(chunks=40, inner=500):
+        best = float("inf")
+        for c in range(chunks):
+            t0 = time.perf_counter()
+            for i in range(inner):
+                telemetry.observe_step(0.001, examples=32, step=i,
+                                       kind="bench")
+            best = min(best, (time.perf_counter() - t0) / inner)
+        return best
+
+    telemetry.observe_step(0.001, examples=32, step=0, kind="bench")  # warm
+    cost_on = per_call_cost()
+    telemetry.set_enabled(False)
+    try:
+        cost_off = per_call_cost()
+    finally:
+        telemetry.set_enabled(True)
+    cost = max(0.0, cost_on - cost_off)
+
+    # a realistic CPU training step to compare against (min over chunks)
+    a = np.random.rand(384, 384).astype(np.float32)
+    a.dot(a)
+    step = min((lambda t0=time.perf_counter(): (
+        [a.dot(a) for _ in range(10)],
+        (time.perf_counter() - t0) / 10)[1])() for _ in range(20))
+
+    overhead = cost / step
+    assert overhead < 0.02, \
+        "telemetry per-step overhead %.3f%% (cost %.2fus vs step %.0fus)" \
+        % (overhead * 100.0, cost * 1e6, step * 1e6)
+    # absolute guard too: the instrumentation itself must stay micro-scale
+    assert cost < 50e-6, "observe_step cost %.1fus" % (cost * 1e6)
+
+
+# --------------------------------------------------------------------------
+# process level: SIGUSR1 dump (no launcher, no hang)
+# --------------------------------------------------------------------------
+
+def test_sigusr1_dumps_without_killing(tmp_path):
+    if not hasattr(signal, "SIGUSR1"):
+        pytest.skip("no SIGUSR1 on this platform")
+    body = (
+        "import os, sys, time\n"
+        "import mxnet_tpu.telemetry as t\n"
+        "t.record_step(3)\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(120)\n"
+        "print('SURVIVED', flush=True)\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", body],
+        env=_clean_env(MXTPU_TELEMETRY_DIR=str(tmp_path)),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "READY" in line, line
+        proc.send_signal(signal.SIGUSR1)
+        dump = os.path.join(str(tmp_path),
+                            "flightrec-rank0-pid%d.json" % proc.pid)
+        deadline = time.time() + 30
+        while time.time() < deadline and not os.path.exists(dump):
+            assert proc.poll() is None, "process died on SIGUSR1"
+            time.sleep(0.1)
+        assert os.path.exists(dump), os.listdir(str(tmp_path))
+        data = json.load(open(dump))
+        assert data["reason"] == "SIGUSR1"
+        assert data["last_step"]["step"] == 3
+        assert any(t_["name"] == "MainThread" for t_ in data["threads"])
+        assert proc.poll() is None  # dump-on-signal, not die-on-signal
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+# --------------------------------------------------------------------------
+# END-TO-END: hang -> watchdog dump + abort -> launcher teardown
+# --------------------------------------------------------------------------
+
+def test_flight_recorder_hang_e2e(tmp_path):
+    """Acceptance: MXTPU_FAULT_INJECT=hang@step=5,rank=1 under a 2-process
+    launch.py group produces a per-rank dump (thread stacks + recent
+    events), and the launcher tears the run down with the dump referenced
+    in its log."""
+    tdir = tmp_path / "telemetry"
+    env = _clean_env(
+        MXTPU_TELEMETRY_DIR=str(tdir),
+        MXTPU_WATCHDOG_TIMEOUT="3",
+        MXTPU_FAULT_INJECT="hang@step=5,rank=1",
+        MXTPU_TEST_TOTAL_STEPS="600",
+        MXTPU_TEST_STEP_SLEEP="0.05",
+        MXTPU_TEARDOWN_GRACE="5",
+        MXTPU_DUMP_GRACE="2",
+    )
+    proc = subprocess.run(
+        [sys.executable, _LAUNCH, "-n", "2", "--",
+         sys.executable, _WORKER],
+        env=env, capture_output=True, text=True, timeout=300)
+    out = proc.stdout + proc.stderr
+    # torn down by the launcher after the watchdog abort (exit 43), never
+    # a clean exit and never a pytest-level hang
+    assert proc.returncode != 0, out[-4000:]
+
+    dumps = sorted(tdir.glob("flightrec-rank1-*.json"))
+    assert dumps, "no rank-1 flight dump; telemetry dir: %s\n%s" % (
+        sorted(os.listdir(str(tdir))) if tdir.exists() else "missing",
+        out[-4000:])
+    data = json.load(open(str(dumps[-1])))
+    assert data["rank"] == 1
+    assert "watchdog" in data["reason"]
+    assert data["last_step"]["step"] == 5
+    # thread stacks show WHERE it hung: the injected sleep inside the
+    # fault-injection hook, reached from trainer.step
+    main = next(t_ for t_ in data["threads"] if t_["name"] == "MainThread")
+    stack = "\n".join(main["stack"])
+    assert "maybe_inject_fault" in stack or "_fire" in stack, stack
+    # recent events include the completed steps
+    steps = [e["fields"].get("step") for e in data["events"]
+             if e["event"] == "step"]
+    assert 5 in steps, data["events"]
+
+    # the launcher log references the dump (the worker's announce line is
+    # rank-prefixed by the launcher pump) and shows the SIGUSR1 teardown
+    assert "[flight-recorder]" in out and "dumped to" in out, out[-4000:]
+    assert "SIGUSR1" in out, out[-4000:]
+
+    # launcher-side telemetry events landed in the shared directory
+    lev = tdir / "launcher-events.jsonl"
+    assert lev.exists()
+    kinds = [json.loads(ln)["event"] for ln in open(str(lev)) if ln.strip()]
+    assert "launcher_generation_start" in kinds
+    assert "launcher_teardown" in kinds

@@ -59,8 +59,14 @@ echo "[bench_capture] device up: $KIND" >&2
 run_one() {  # run_one <suffix> [extra ENV=VAL ...]
   local SUFFIX="$1"; shift
   local OUT="BENCH_${TAG}_${SUFFIX}.json"
+  # per-run telemetry (docs/observability.md): each bench row runs with a
+  # fresh MXTPU_TELEMETRY_DIR whose JSONL gets archived next to the
+  # BENCH artifact — step timings / jit-cache / collective counters at the
+  # exact SHA+config of every number we publish
+  local TDIR
+  TDIR=$(mktemp -d "telemetry_${TAG}_${SUFFIX}.XXXX")
   echo "[bench_capture] running $SUFFIX -> $OUT" >&2
-  env "$@" MXTPU_BENCH_DIAL_RETRY_S=300 \
+  env "$@" MXTPU_BENCH_DIAL_RETRY_S=300 MXTPU_TELEMETRY_DIR="$TDIR" \
     timeout 1800 python bench.py > "$OUT" 2> "BENCH_${TAG}_${SUFFIX}.log"
   local RC=$?
   if [ "$RC" = "124" ]; then
@@ -68,10 +74,16 @@ run_one() {  # run_one <suffix> [extra ENV=VAL ...]
     # (bench.py arms it post-dial), so one retry resumes past the
     # already-compiled executables instead of starting from zero
     echo "[bench_capture] $SUFFIX timed out; retrying once on warm cache" >&2
-    env "$@" MXTPU_BENCH_DIAL_RETRY_S=300 \
+    env "$@" MXTPU_BENCH_DIAL_RETRY_S=300 MXTPU_TELEMETRY_DIR="$TDIR" \
       timeout 1800 python bench.py > "$OUT" 2>> "BENCH_${TAG}_${SUFFIX}.log"
     RC=$?
   fi
+  # archive whatever telemetry the run flushed (concatenated across
+  # pids/ranks; empty runs leave no artifact)
+  if ls "$TDIR"/*.jsonl >/dev/null 2>&1; then
+    cat "$TDIR"/*.jsonl > "BENCH_${TAG}_${SUFFIX}_telemetry.jsonl"
+  fi
+  rm -rf "$TDIR"
   echo "[bench_capture] $SUFFIX rc=$RC $(cat "$OUT" 2>/dev/null | head -c 300)" >&2
 }
 

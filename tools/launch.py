@@ -121,6 +121,27 @@ def _log(msg):
     sys.stderr.flush()
 
 
+def _emit_event(kind, **fields):
+    """Launcher-side telemetry: append one JSON event line to
+    $MXTPU_TELEMETRY_DIR/launcher-events.jsonl (the same directory workers
+    flush their telemetry into — docs/observability.md). Deliberately
+    stdlib-only and import-free: the launcher must never pay (or depend on)
+    a framework/jax import just to supervise processes."""
+    directory = os.environ.get("MXTPU_TELEMETRY_DIR")
+    if not directory:
+        return
+    try:
+        import json
+
+        os.makedirs(directory, exist_ok=True)
+        with open(os.path.join(directory, "launcher-events.jsonl"), "a") as f:
+            f.write(json.dumps({
+                "kind": "event", "ts": time.time(), "event": kind,
+                "pid": os.getpid(), "fields": fields}) + "\n")
+    except OSError:
+        pass  # telemetry must never break supervision
+
+
 _PUMP_LOCK = threading.Lock()
 
 
@@ -157,15 +178,41 @@ def _signal_group(procs, sig):
 
 
 def _teardown(procs, grace=None):
-    """Escalating group teardown: SIGTERM everyone, give the group `grace`
-    seconds (MXTPU_TEARDOWN_GRACE, default 10) to exit cleanly — flushing
-    logs, closing checkpoints in progress — then SIGKILL the survivors. A
-    rank wedged in a collective waiting for the dead peer ignores nothing
-    after SIGKILL, so the restart loop is never blocked by a hung group."""
+    """Escalating group teardown: when MXTPU_TELEMETRY_DIR is configured,
+    SIGUSR1 first (flight-recorder dump — every survivor writes thread
+    stacks + recent telemetry events before dying, so a hung worker's
+    teardown always leaves a diagnosis behind, telemetry/recorder.py);
+    then SIGTERM, give the group `grace` seconds (MXTPU_TEARDOWN_GRACE,
+    default 10) to exit cleanly — flushing logs, closing checkpoints in
+    progress — then SIGKILL the survivors. A rank wedged in a collective
+    waiting for the dead peer ignores nothing after SIGKILL, so the
+    restart loop is never blocked by a hung group."""
     if all(p.poll() is not None for p in procs):
         return
     if grace is None:
         grace = float(os.environ.get("MXTPU_TEARDOWN_GRACE", "10"))
+    survivors = [p for p in procs if p.poll() is None]
+    # SIGUSR1 only when telemetry output is configured: mxnet_tpu installs
+    # the dump handler at import under MXTPU_TELEMETRY_DIR, so every
+    # framework worker dumps-and-survives. Without the dir (or for
+    # non-framework commands) SIGUSR1's DEFAULT action would terminate the
+    # worker instantly, robbing it of its SIGTERM cleanup grace — so the
+    # launcher skips the broadcast rather than break teardown semantics.
+    dump_first = hasattr(signal, "SIGUSR1") and \
+        bool(os.environ.get("MXTPU_TELEMETRY_DIR"))
+    _log("tearing down %d live worker(s): %sSIGTERM, SIGKILL after %.0fs"
+         % (len(survivors),
+            "SIGUSR1 (flight-recorder dump), then " if dump_first else "",
+            grace))
+    _emit_event("launcher_teardown", live=len(survivors), grace_s=grace,
+                dump_first=dump_first)
+    if dump_first:
+        _signal_group(procs, signal.SIGUSR1)
+        # let handlers write their dump files before SIGTERM lands
+        dump_grace = float(os.environ.get("MXTPU_DUMP_GRACE", "1.0"))
+        deadline = time.time() + dump_grace
+        while time.time() < deadline and any(p.poll() is None for p in procs):
+            time.sleep(0.05)
     _signal_group(procs, signal.SIGTERM)
     deadline = time.time() + grace
     while time.time() < deadline and any(p.poll() is None for p in procs):
@@ -235,17 +282,24 @@ def _spawn_and_wait(make_cmds, max_restarts=0, backoff=1.0):
     while True:
         if generation:
             _log("spawning generation %d" % generation)
+        _emit_event("launcher_generation_start", generation=generation,
+                    max_restarts=max_restarts)
         rc = _run_generation(make_cmds(generation))
+        _emit_event("launcher_generation_exit", generation=generation, rc=rc)
         if rc == 0:
             return 0
         if generation >= max_restarts:
             if max_restarts:
                 _log("group failed (rc=%d); %d restart(s) exhausted, giving "
                      "up" % (rc, max_restarts))
+            _emit_event("launcher_restarts_exhausted", generation=generation,
+                        rc=rc)
             return rc
         generation += 1
         _log("group failed (rc=%d); restarting (%d/%d) in %.1fs on a fresh "
              "rendezvous port" % (rc, generation, max_restarts, delay))
+        _emit_event("launcher_restart", generation=generation, rc=rc,
+                    backoff_s=delay)
         if delay:
             time.sleep(delay)
         delay = min(max(delay, 0.5) * 2, 60.0)

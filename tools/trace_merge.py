@@ -1,0 +1,120 @@
+"""Merge per-rank chrome-trace profiler dumps into ONE perfetto timeline.
+
+Each rank of a distributed run writes its own `profiler.dump()` file
+(pid = rank, named thread lanes — mxnet_tpu/profiler.py). This tool merges
+them into a single chrome://tracing / perfetto.dev -loadable JSON whose
+process lanes are the ranks:
+
+    python tools/trace_merge.py -o merged.json rank0.json rank1.json ...
+
+Guarantees on the output:
+  * every input file occupies a DISTINCT pid (inputs that collide — e.g.
+    single-process dumps that all stamped pid 0, or pre-telemetry traces —
+    are remapped to the first free pid, preserving each file's internal
+    pid->tid structure);
+  * each process lane carries `process_name` ("rank N") and
+    `process_sort_index` metadata, so perfetto orders and labels them;
+  * timestamps are passed through untouched by default (profiler clocks
+    are already relative to process start, which lines ranks up at step
+    granularity); `--align-start` rebases every file so its earliest event
+    sits at t=0 for clock-skewed hosts.
+
+Stdlib-only (safe on a login host with no jax).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_trace(path):
+    """Read one chrome-trace JSON (object form {traceEvents: [...]} or the
+    bare array form) and return its event list."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return data
+    events = data.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("%s: not a chrome trace (no traceEvents array)"
+                         % path)
+    return events
+
+
+def _pids_of(events):
+    return {ev.get("pid", 0) for ev in events}
+
+
+def _min_ts(events):
+    ts = [ev["ts"] for ev in events
+          if isinstance(ev.get("ts"), (int, float)) and ev.get("ph") != "M"]
+    return min(ts) if ts else 0
+
+
+def merge_traces(event_lists, align_start=False):
+    """Merge several per-process event lists into one trace dict.
+
+    Each input keeps its own pid (the profiler stamps pid=rank); when two
+    inputs claim the same pid, later ones are remapped to the first unused
+    pid so no two files ever share a process lane. process_name /
+    process_sort_index metadata is (re)written per lane as "rank <pid>"."""
+    used_pids = set()
+    merged = []
+    for events in event_lists:
+        pids = sorted(_pids_of(events))
+        remap = {}
+        for pid in pids:
+            new = pid
+            while new in used_pids:
+                new += 1
+            remap[pid] = new
+            used_pids.add(new)
+        base_ts = _min_ts(events) if align_start else 0
+        for pid in pids:
+            merged.append({"ph": "M", "name": "process_name",
+                           "pid": remap[pid], "tid": 0,
+                           "args": {"name": "rank %d" % remap[pid]}})
+            merged.append({"ph": "M", "name": "process_sort_index",
+                           "pid": remap[pid], "tid": 0,
+                           "args": {"sort_index": remap[pid]}})
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") in (
+                    "process_name", "process_sort_index"):
+                continue  # superseded by the labels above
+            out = dict(ev)
+            out["pid"] = remap.get(ev.get("pid", 0), ev.get("pid", 0))
+            if base_ts and isinstance(out.get("ts"), (int, float)):
+                out["ts"] = out["ts"] - base_ts
+            merged.append(out)
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Merge per-rank mxnet_tpu profiler dumps into one "
+                    "perfetto-loadable chrome trace")
+    parser.add_argument("inputs", nargs="+",
+                        help="per-rank profile.json files (rank order = "
+                             "argument order)")
+    parser.add_argument("-o", "--output", required=True,
+                        help="merged trace path")
+    parser.add_argument("--align-start", action="store_true",
+                        help="rebase each file's earliest event to t=0 "
+                             "(clock-skewed hosts)")
+    args = parser.parse_args(argv)
+
+    event_lists = [load_trace(p) for p in args.inputs]
+    merged = merge_traces(event_lists, align_start=args.align_start)
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    pids = sorted(_pids_of(merged["traceEvents"]))
+    sys.stderr.write(
+        "[trace_merge] wrote %s: %d events across %d process lanes "
+        "(pids %s)\n" % (args.output, len(merged["traceEvents"]),
+                         len(pids), pids))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
