@@ -1,0 +1,1 @@
+"""CI tooling package (mxlint static analysis, lint_print, sanitize)."""

@@ -62,12 +62,11 @@ def find_bare_prints(path, rel):
         yield tok.start[0], line_text.strip()
 
 
-def main(argv=None):
-    argv = sys.argv[1:] if argv is None else argv
-    root = os.path.abspath(argv[0] if argv else
-                           os.path.join(os.path.dirname(__file__), ".."))
+def iter_violations(root):
+    """Yield (rel, line, text) for every bare print under <root>/mxnet_tpu,
+    applying the allowlist. Single traversal shared by this CLI and the
+    ci.mxlint `bare-print` checker — one implementation, two frontends."""
     pkg = os.path.join(root, "mxnet_tpu")
-    violations = []
     for dirpath, dirnames, filenames in os.walk(pkg):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for name in sorted(filenames):
@@ -80,7 +79,15 @@ def main(argv=None):
             if any(rel.startswith(d + os.sep) for d in ALLOW_DIRS):
                 continue
             for line, text in find_bare_prints(path, rel) or ():
-                violations.append((rel, line, text))
+                yield rel, line, text
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    root = os.path.abspath(argv[0] if argv else
+                           os.path.join(os.path.dirname(__file__), ".."))
+    pkg = os.path.join(root, "mxnet_tpu")
+    violations = list(iter_violations(root))
     if violations:
         sys.stdout.write(
             "bare print( in library code — route through mxnet_tpu.log "

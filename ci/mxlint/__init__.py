@@ -1,0 +1,252 @@
+"""mxlint: AST-based static analysis for the mxnet_tpu tree.
+
+The reference framework enforced its runtime invariants with a dedicated
+lint/sanitizer CI layer (SURVEY §5.2 — cpplint/pylint/ASAN jobs in
+runtime_functions.sh). This package is the rebuild's equivalent for the
+invariants no general-purpose linter knows about:
+
+  * ``host-sync``       — no host synchronization (``.asnumpy()``,
+    ``float()``/``int()``/``bool()`` on array arguments, ``np.asarray``)
+    inside jit-traced code paths.
+  * ``signal-safety``   — the flight recorder's SIGUSR1/watchdog dump path
+    must stay free of locks, logging and other non-allowlisted calls.
+  * ``env-registry``    — every ``MXTPU_*`` read goes through the typed
+    ``mxnet_tpu.env`` registry, and registry ↔ ``docs/env_vars.md`` parity.
+  * ``registry-parity`` — nd/symbol op-namespace tables agree with the op
+    registry; every ``jax.custom_vjp`` has its ``defvjp`` backward wired.
+  * ``bare-print``      — no bare ``print(`` in library code (the ported
+    ``ci/lint_print.py`` rule, same allowlist semantics).
+
+Checker API (see ``checkers/``): a checker is an object with ``rule``,
+``description`` and ``run(repo) -> iterable[Finding]``; per-file AST
+visitors and whole-repo cross-file passes both fit. Suppression:
+
+  * pragma — append ``# mxlint: disable=<rule>[,<rule>...]`` to the flagged
+    line (grep-able, justification comment expected next to it);
+  * baseline — ``ci/mxlint/baseline.txt`` grandfathers pre-existing
+    findings (``--update-baseline`` regenerates; the committed file is kept
+    EMPTY — fix, don't baseline, is the default posture).
+
+Runner: ``python -m ci.mxlint [--rule R] [--list-rules]
+[--update-baseline]`` — exit 0 clean, 1 findings, 2 usage/internal error.
+Enforced in-suite by ``tests/test_infra.py::test_mxlint_clean``.
+Zero dependencies beyond the stdlib; never imports mxnet_tpu (all analysis
+is on source text/ASTs, so the lint runs without jax installed).
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+__all__ = ["Finding", "Repo", "all_checkers", "run_checkers", "main"]
+
+PRAGMA = "# mxlint: disable="
+
+
+class Finding:
+    """One violation: rule, repo-relative path, 1-based line, message."""
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule, path, line, message):
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.line = int(line)
+        self.message = message
+
+    def render(self):
+        return "%s:%d: [%s] %s" % (self.path, self.line, self.rule,
+                                   self.message)
+
+    def key(self, repo):
+        """Line-number-independent fingerprint used by the baseline file:
+        rule + path + the stripped source-line text (an edit to the flagged
+        line invalidates its grandfathering, as it should)."""
+        lines = repo.lines(self.path)
+        text = lines[self.line - 1].strip() if lines and \
+            0 < self.line <= len(lines) else ""
+        return "%s\t%s\t%s" % (self.rule, self.path, text)
+
+
+class Repo:
+    """Parsed view of the checkout: file discovery + cached ASTs."""
+
+    def __init__(self, root):
+        self.root = os.path.abspath(root)
+        self._cache = {}
+
+    def abspath(self, rel):
+        return os.path.join(self.root, rel.replace("/", os.sep))
+
+    def exists(self, rel):
+        return os.path.exists(self.abspath(rel))
+
+    def py_files(self, *tops):
+        """Repo-relative paths of .py files under the given top-level dirs
+        (or single files), sorted, ``__pycache__`` skipped."""
+        out = []
+        for top in tops:
+            path = self.abspath(top)
+            if os.path.isfile(path):
+                out.append(top)
+                continue
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for name in filenames:
+                    if name.endswith(".py"):
+                        rel = os.path.relpath(os.path.join(dirpath, name),
+                                              self.root)
+                        out.append(rel.replace(os.sep, "/"))
+        return sorted(set(out))
+
+    def read(self, rel):
+        try:
+            with open(self.abspath(rel), "rb") as f:
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return None
+
+    def _load(self, rel):
+        if rel not in self._cache:
+            src = self.read(rel)
+            if src is None:
+                self._cache[rel] = (None, None)
+            else:
+                try:
+                    tree = ast.parse(src, filename=rel)
+                except SyntaxError:
+                    tree = None
+                self._cache[rel] = (tree, src.splitlines())
+        return self._cache[rel]
+
+    def tree(self, rel):
+        """Parsed AST for the file, or None (missing / syntax error)."""
+        return self._load(rel)[0]
+
+    def lines(self, rel):
+        """Source lines for the file, or None when missing."""
+        return self._load(rel)[1]
+
+
+def _pragma_rules(line_text):
+    """Rules disabled by a ``# mxlint: disable=a,b`` pragma on this line."""
+    idx = line_text.find(PRAGMA)
+    if idx < 0:
+        return ()
+    spec = line_text[idx + len(PRAGMA):].split("#")[0]
+    return tuple(r.strip() for r in spec.split(",") if r.strip())
+
+
+def all_checkers():
+    from .checkers import CHECKERS
+
+    return list(CHECKERS)
+
+
+def load_baseline(path):
+    """Baseline fingerprints as a multiset (each entry forgives ONE
+    finding with that fingerprint)."""
+    counts = {}
+    if path and os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            for raw in f:
+                line = raw.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                counts[line] = counts.get(line, 0) + 1
+    return counts
+
+
+def run_checkers(repo, checkers, baseline=None):
+    """Run checkers, apply pragma + baseline suppression.
+
+    Returns (kept, suppressed_pragma, suppressed_baseline)."""
+    baseline = dict(baseline or {})
+    kept, by_pragma, by_baseline = [], [], []
+    for checker in checkers:
+        for finding in checker.run(repo):
+            lines = repo.lines(finding.path)
+            text = lines[finding.line - 1] if lines and \
+                0 < finding.line <= len(lines) else ""
+            if finding.rule in _pragma_rules(text):
+                by_pragma.append(finding)
+                continue
+            key = finding.key(repo)
+            if baseline.get(key, 0) > 0:
+                baseline[key] -= 1
+                by_baseline.append(finding)
+                continue
+            kept.append(finding)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept, by_pragma, by_baseline
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m ci.mxlint",
+        description="AST-based static analysis for the mxnet_tpu tree "
+                    "(docs/static_analysis.md).")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: the checkout containing "
+                             "this package)")
+    parser.add_argument("--rule", action="append", default=None,
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: ci/mxlint/"
+                             "baseline.txt under the root)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to grandfather every "
+                             "current finding, then exit 0")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    repo = Repo(root)
+    checkers = all_checkers()
+    if args.list_rules:
+        for c in checkers:
+            sys.stdout.write("%-16s %s\n" % (c.rule, c.description))
+        return 0
+    if args.rule:
+        unknown = set(args.rule) - {c.rule for c in checkers}
+        if unknown:
+            sys.stderr.write("mxlint: unknown rule(s): %s\n"
+                             % ", ".join(sorted(unknown)))
+            return 2
+        checkers = [c for c in checkers if c.rule in args.rule]
+
+    baseline_path = args.baseline or os.path.join(root, "ci", "mxlint",
+                                                  "baseline.txt")
+    kept, by_pragma, by_baseline = run_checkers(
+        repo, checkers, load_baseline(baseline_path))
+
+    if args.update_baseline:
+        entries = [f.key(repo) for f in kept + by_baseline]
+        if args.rule:
+            # only the selected rules were re-run: keep every other rule's
+            # grandfathered entries instead of silently discarding them
+            selected = set(args.rule)
+            for key, count in load_baseline(baseline_path).items():
+                if key.split("\t", 1)[0] not in selected:
+                    entries.extend([key] * count)
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write("# mxlint baseline — grandfathered findings "
+                    "(rule<TAB>path<TAB>line text).\n"
+                    "# Regenerate: python -m ci.mxlint --update-baseline. "
+                    "Keep this empty: fix, don't baseline.\n")
+            for key in sorted(entries):
+                f.write(key + "\n")
+        sys.stdout.write("mxlint: baseline updated (%d entries) at %s\n"
+                         % (len(entries), baseline_path))
+        return 0
+
+    for finding in kept:
+        sys.stdout.write(finding.render() + "\n")
+    sys.stdout.write(
+        "mxlint: %d finding(s) across %d rule(s) (%d pragma-suppressed, "
+        "%d baselined)\n" % (len(kept), len(checkers), len(by_pragma),
+                             len(by_baseline)))
+    return 1 if kept else 0

@@ -1,0 +1,90 @@
+"""Small shared AST helpers for mxlint checkers."""
+from __future__ import annotations
+
+import ast
+
+FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted(node):
+    """Dotted-name string for a Name/Attribute chain (``jax.jit``), or None
+    for anything not a plain chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node):
+    """The value of a string-literal node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def keyword_value(call, name):
+    """The value node of keyword ``name`` in a Call, or None."""
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def iter_functions(tree):
+    """Yield every FunctionDef/AsyncFunctionDef in the tree (any depth)."""
+    for node in ast.walk(tree):
+        if isinstance(node, FUNC_DEFS):
+            yield node
+
+
+def body_walk(func):
+    """Walk a function's body WITHOUT descending into nested function
+    definitions (those are separate call-graph nodes)."""
+    stack = list(func.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FUNC_DEFS):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def called_names(func):
+    """Bare names this function calls (``f(...)`` — not attribute calls),
+    nested defs excluded."""
+    out = set()
+    for node in body_walk(func):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            out.add(node.func.id)
+    # a nested def immediately returned/passed still belongs to this scope's
+    # graph; its CALLS are its own (handled when the nested def is visited)
+    return out
+
+
+def arrayish_params(func):
+    """Parameter names that hold arrays by the repo's arrays-first op
+    convention: positional params with no default or a ``None`` default
+    (a non-None default marks a static attr — mirrors
+    ndarray/register.py's classification). Includes ``*args``."""
+    args = func.args
+    pos = list(args.posonlyargs) + list(args.args)
+    defaults = list(args.defaults)
+    pad = [None] * (len(pos) - len(defaults))
+    out = set()
+    for a, d in zip(pos, pad + defaults):
+        if a.arg in ("self", "cls"):
+            continue
+        if d is None or (isinstance(d, ast.Constant) and d.value is None):
+            out.add(a.arg)
+    if args.vararg is not None:
+        out.add(args.vararg.arg)
+    return out
+
+
+def names_in(node):
+    """All bare Name ids appearing in an expression subtree."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}

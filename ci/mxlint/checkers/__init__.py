@@ -1,0 +1,21 @@
+"""mxlint checkers — one module per rule.
+
+A checker exposes ``rule`` (kebab-case id), ``description`` (one line) and
+``run(repo) -> iterable[Finding]``. Register new checkers in ``CHECKERS``
+below (docs/static_analysis.md walks through adding one).
+"""
+from __future__ import annotations
+
+from .bare_print import BarePrintChecker
+from .env_registry import EnvRegistryChecker
+from .host_sync import HostSyncChecker
+from .registry_parity import RegistryParityChecker
+from .signal_safety import SignalSafetyChecker
+
+CHECKERS = (
+    HostSyncChecker(),
+    SignalSafetyChecker(),
+    EnvRegistryChecker(),
+    RegistryParityChecker(),
+    BarePrintChecker(),
+)

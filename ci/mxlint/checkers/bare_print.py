@@ -1,0 +1,31 @@
+"""bare-print: no bare ``print(`` in library code.
+
+The ported ``ci/lint_print.py`` rule (PR 3) as an mxlint checker, sharing
+the original's tokenizer and allowlist semantics verbatim by importing
+them — one implementation, two frontends (the old standalone CLI keeps
+working; ``tests/test_mxlint.py`` pins that with a regression test).
+
+Allowlist (from ci/lint_print.py): ``mxnet_tpu/test_utils.py``,
+``mxnet_tpu/notebook/``, and lines marked ``# allow-print``. The mxlint
+pragma ``# mxlint: disable=bare-print`` also works, but prefer
+``# allow-print`` so both frontends agree.
+"""
+from __future__ import annotations
+
+from .. import Finding
+
+
+class BarePrintChecker:
+    rule = "bare-print"
+    description = ("library output goes through mxnet_tpu.log/telemetry, "
+                   "never bare print( (ci/lint_print.py semantics)")
+
+    def run(self, repo):
+        from ci import lint_print
+
+        for rel, line, text in lint_print.iter_violations(repo.root):
+            yield Finding(
+                self.rule, rel, line,
+                "bare print( in library code — route through "
+                "mxnet_tpu.log (+ telemetry for numbers) or mark "
+                "an explicit display surface with `# allow-print`")

@@ -1,0 +1,185 @@
+"""host-sync: no host synchronization inside jit-traced code.
+
+A ``.asnumpy()`` / ``float()`` / ``int()`` / ``bool()`` / ``np.asarray``
+on an array value inside code that jax traces either aborts the trace
+(ConcretizationTypeError at first compile — late, and only on the paths a
+test happens to compile) or, worse, silently runs on a concrete value at
+trace time and bakes a constant into the executable. This checker finds the
+construct statically, on every path.
+
+What counts as traced (the roots), per file:
+
+  * functions decorated with ``jax.jit`` / ``pjit`` (bare or via
+    ``functools.partial(jax.jit, ...)``) or ``jax.custom_vjp``;
+  * functions passed by name to ``jax.jit`` / ``jax.vjp`` / ``jax.grad`` /
+    ``jax.eval_shape`` / ``pl.pallas_call`` (kernel bodies) or to a
+    ``*.defvjp(fwd, bwd)`` backward-wiring call — this covers the
+    ``ops._jitted`` / ``autograd._bwd_jitted`` cache builders and the
+    Executor's jit closures, whose inner functions are built for tracing;
+  * op functions registered via ``@register(...)`` in ``mxnet_tpu/ops/``
+    (every registered op is eager-jitted and inlined into outer traces)
+    unless registered ``host=True`` (the dgl-style host ops).
+
+Tracedness then propagates through same-file bare-name calls to a fixpoint
+(a helper called from a traced function is traced).
+
+Inside traced functions the checker flags:
+
+  * any ``X.asnumpy()`` call;
+  * ``float(p)`` / ``int(p)`` / ``bool(p)`` where ``p`` is an *array*
+    parameter of the function — positional with no default or a ``None``
+    default, the repo's arrays-first convention (a non-None default marks a
+    static attr, so ``int(axis)``-style attr coercions never fire). This
+    check runs only on ROOT traced functions (op functions / jit-decorated
+    bodies), where the arrays-first convention is the signature contract;
+    propagated helpers take attrs as plain positionals (``_bn_act(...,
+    eps, momentum)``) and would false-positive;
+  * ``np.asarray`` / ``np.array`` (host numpy, any alias) whose argument
+    expression touches an array parameter (root functions, same reason).
+
+Suppress a deliberate eager-only site with ``# mxlint: disable=host-sync``
+and a justifying comment.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from ..astutil import (arrayish_params, body_walk, called_names, dotted,
+                       iter_functions, keyword_value, names_in)
+
+# callables whose first positional argument is traced
+_TRACE_TAKING = {
+    "jax.jit", "jit", "jax.pjit", "pjit", "jax.vjp", "jax.grad",
+    "jax.value_and_grad", "jax.eval_shape", "jax.custom_vjp", "custom_vjp",
+    "pl.pallas_call", "pallas_call", "jax.checkpoint", "jax.remat",
+}
+_JIT_DECOS = {
+    "jax.jit", "jit", "jax.pjit", "pjit", "jax.custom_vjp", "custom_vjp",
+}
+_PARTIALS = {"functools.partial", "partial"}
+_SYNC_CASTS = {"float", "int", "bool"}
+_NP_ROOTS = {"np", "_np", "onp", "numpy"}
+
+
+def _register_deco(deco):
+    """The Call node of an op-registering decorator (@register(...) /
+    @_ops.register(...)), else None."""
+    if isinstance(deco, ast.Call):
+        name = dotted(deco.func)
+        if name == "register" or (name or "").endswith(".register"):
+            return deco
+    return None
+
+
+class HostSyncChecker:
+    rule = "host-sync"
+    description = ("no .asnumpy()/float()/int()/bool()/np.asarray on array "
+                   "values reachable from jit-traced code")
+
+    def run(self, repo):
+        for rel in repo.py_files("mxnet_tpu"):
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            yield from self._check_file(rel, tree)
+
+    # -- per file ----------------------------------------------------------
+    def _check_file(self, rel, tree):
+        funcs = list(iter_functions(tree))
+        by_name = {}
+        for fn in funcs:
+            by_name.setdefault(fn.name, []).append(fn)
+
+        traced = {}  # func node -> reason
+        is_ops_file = rel.startswith("mxnet_tpu/ops/")
+
+        for fn in funcs:
+            for deco in fn.decorator_list:
+                name = dotted(deco)
+                if name in _JIT_DECOS:
+                    traced.setdefault(fn, "decorated @%s" % name)
+                elif isinstance(deco, ast.Call):
+                    cname = dotted(deco.func)
+                    if cname in _JIT_DECOS:
+                        traced.setdefault(fn, "decorated @%s(...)" % cname)
+                    elif cname in _PARTIALS and deco.args and \
+                            dotted(deco.args[0]) in _JIT_DECOS:
+                        traced.setdefault(
+                            fn, "decorated @partial(%s, ...)"
+                            % dotted(deco.args[0]))
+                    elif is_ops_file:
+                        reg = _register_deco(deco)
+                        if reg is not None:
+                            host = keyword_value(reg, "host")
+                            if not (isinstance(host, ast.Constant)
+                                    and host.value is True):
+                                traced.setdefault(
+                                    fn, "registered op function")
+
+        # functions passed by name to tracing entry points
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = dotted(node.func)
+            targets = ()
+            if cname in _TRACE_TAKING and node.args:
+                targets = (node.args[0],)
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "defvjp":
+                targets = tuple(node.args)
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    for fn in by_name.get(t.id, ()):
+                        traced.setdefault(
+                            fn, "passed to %s" % (cname or "defvjp"))
+
+        # propagate through same-file bare-name calls to a fixpoint
+        calls = {fn: called_names(fn) for fn in funcs}
+        roots = set(traced)
+        changed = True
+        while changed:
+            changed = False
+            for fn, reason in list(traced.items()):
+                for callee_name in calls[fn]:
+                    for callee in by_name.get(callee_name, ()):
+                        if callee not in traced:
+                            traced[callee] = "called from traced `%s`" \
+                                % fn.name
+                            changed = True
+
+        for fn, reason in traced.items():
+            yield from self._check_traced_fn(rel, fn, reason,
+                                             is_root=fn in roots)
+
+    # -- per traced function ----------------------------------------------
+    def _check_traced_fn(self, rel, fn, reason, is_root):
+        arrays = arrayish_params(fn) if is_root else set()
+        for node in body_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "asnumpy":
+                yield Finding(
+                    self.rule, rel, node.lineno,
+                    "`.asnumpy()` host sync inside jit-traced `%s` (%s)"
+                    % (fn.name, reason))
+                continue
+            cname = dotted(node.func)
+            if cname in _SYNC_CASTS and len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in arrays:
+                yield Finding(
+                    self.rule, rel, node.lineno,
+                    "`%s(%s)` forces a host sync of an array argument "
+                    "inside jit-traced `%s` (%s)"
+                    % (cname, node.args[0].id, fn.name, reason))
+                continue
+            if cname is not None and "." in cname:
+                root, _, attr = cname.rpartition(".")
+                if root in _NP_ROOTS and attr in ("asarray", "array") and \
+                        node.args and (names_in(node.args[0]) & arrays):
+                    yield Finding(
+                        self.rule, rel, node.lineno,
+                        "host `%s` on array argument inside jit-traced "
+                        "`%s` (%s)" % (cname, fn.name, reason))

@@ -1,0 +1,214 @@
+"""registry-parity: the op registry, its nd/symbol frontends, and every
+backward hook stay wired to each other.
+
+Both ``mx.nd`` and ``mx.sym`` generate their op functions from the same
+``mxnet_tpu.ops`` registry, so drift shows up at the edges that are
+maintained BY HAND:
+
+  * ``symbol/register.py``'s per-op tables (``_INPUT_SLOTS``,
+    ``_OPTIONAL_DROP``, ``_ARG_SHAPE_RULES``, ``_SHAPE_TRANSPARENT``) key
+    on op-name strings — a renamed/removed op leaves a stale entry that
+    silently stops auto-creating weight vars or inferring shapes;
+  * the two ``populate()`` functions route ops into sub-namespaces by name
+    prefix and install namespace attributes — if one frontend learns a
+    prefix/namespace the other doesn't, ``mx.nd.X.op`` exists while
+    ``mx.sym.X.op`` doesn't (the reference kept these in lockstep by
+    generating both from one table);
+  * a ``@jax.custom_vjp`` function without its ``defvjp(fwd, bwd)`` call is
+    a differentiable op whose backward hook is not wired — the forward
+    works until the first gradient, which then fails (or worse, a later
+    re-definition shadows a wired pair).
+
+Op names are collected from ``@register("name", aliases=(...))``
+decorators across ``mxnet_tpu/ops/*.py`` — pure AST, no import.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from ..astutil import FUNC_DEFS, dotted, keyword_value, str_const
+
+_ND_REGISTER = "mxnet_tpu/ndarray/register.py"
+_SYM_REGISTER = "mxnet_tpu/symbol/register.py"
+_TABLES = ("_INPUT_SLOTS", "_OPTIONAL_DROP", "_ARG_SHAPE_RULES")
+_SET_TABLES = ("_SHAPE_TRANSPARENT",)
+
+
+def registered_ops(repo):
+    """All op names + aliases from @register decorators in mxnet_tpu/ops."""
+    def collect(call):
+        cname = dotted(call.func) or ""
+        if cname != "register" and not cname.endswith(".register"):
+            return
+        if call.args:
+            name = str_const(call.args[0])
+            if name:
+                names.add(name)
+        aliases = keyword_value(call, "aliases")
+        if isinstance(aliases, (ast.Tuple, ast.List)):
+            for el in aliases.elts:
+                alias = str_const(el)
+                if alias:
+                    names.add(alias)
+
+    names = set()
+    for rel in repo.py_files("mxnet_tpu/ops"):
+        tree = repo.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            # decorator form: @register("name", ...)
+            if isinstance(node, FUNC_DEFS):
+                for deco in node.decorator_list:
+                    if isinstance(deco, ast.Call):
+                        collect(deco)
+            # direct-call form: register("name", ...)(lambda ...: ...)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Call):
+                collect(node.func)
+    return names
+
+
+def _module_assign(tree, name):
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+    return None
+
+
+def _populate_prefixes(tree):
+    """(startswith-prefix set, namespace-key set) used by populate()."""
+    prefixes, namespaces = set(), set()
+    populate = None
+    for node in tree.body:
+        if isinstance(node, FUNC_DEFS) and node.name == "populate":
+            populate = node
+    if populate is None:
+        return prefixes, namespaces
+    for node in ast.walk(populate):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "startswith" and node.args:
+            p = str_const(node.args[0])
+            # routing prefixes are `_family_`-shaped; a lone "_" is the
+            # private-name check, not namespace routing
+            if p and len(p) > 1 and p.endswith("_"):
+                prefixes.add(p)
+        # target_module_dict["contrib"] = ... / .setdefault("image", ...)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    key = str_const(t.slice)
+                    if key:
+                        namespaces.add(key)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "setdefault" and node.args and \
+                len(node.args) >= 2:
+            # literal keys are namespace installs; per-op function
+            # installs pass the loop variable `name` (str_const -> None)
+            key = str_const(node.args[0])
+            if key:
+                namespaces.add(key)
+    return prefixes, namespaces
+
+
+class RegistryParityChecker:
+    rule = "registry-parity"
+    description = ("nd/symbol op namespaces agree with the op registry; "
+                   "every custom_vjp has its defvjp backward wired")
+
+    def run(self, repo):
+        ops = registered_ops(repo)
+        if not ops:
+            yield Finding(self.rule, "mxnet_tpu/ops/__init__.py", 1,
+                          "no @register(...) op definitions found — "
+                          "registry scan broken")
+            return
+
+        # 1. symbol-side hand tables key on real op names
+        sym_tree = repo.tree(_SYM_REGISTER)
+        if sym_tree is not None:
+            for table in _TABLES:
+                value = _module_assign(sym_tree, table)
+                if not isinstance(value, ast.Dict):
+                    continue
+                for key in value.keys:
+                    name = str_const(key)
+                    if name and name not in ops:
+                        yield Finding(
+                            self.rule, _SYM_REGISTER, key.lineno,
+                            "%s entry %r is not a registered op (stale "
+                            "after a rename/removal?)" % (table, name))
+            for table in _SET_TABLES:
+                value = _module_assign(sym_tree, table)
+                if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+                    for el in value.elts:
+                        name = str_const(el)
+                        if name and name not in ops:
+                            yield Finding(
+                                self.rule, _SYM_REGISTER, el.lineno,
+                                "%s entry %r is not a registered op"
+                                % (table, name))
+
+        # 2. nd vs symbol namespace routing parity
+        nd_tree = repo.tree(_ND_REGISTER)
+        if nd_tree is not None and sym_tree is not None:
+            nd_p, nd_ns = _populate_prefixes(nd_tree)
+            sym_p, sym_ns = _populate_prefixes(sym_tree)
+            for p in sorted(nd_p ^ sym_p):
+                where = "ndarray" if p in nd_p else "symbol"
+                other = "symbol" if p in nd_p else "ndarray"
+                yield Finding(
+                    self.rule, _SYM_REGISTER, 1,
+                    "op-name prefix %r is routed by the %s frontend but "
+                    "not the %s frontend — nd/sym namespaces diverge"
+                    % (p, where, other))
+            for ns in sorted(nd_ns ^ sym_ns):
+                where = "ndarray" if ns in nd_ns else "symbol"
+                other = "symbol" if ns in nd_ns else "ndarray"
+                yield Finding(
+                    self.rule, _SYM_REGISTER, 1,
+                    "namespace %r is installed by the %s frontend but not "
+                    "the %s frontend" % (ns, where, other))
+
+        # 3. every custom_vjp has a defvjp backward wiring, library-wide
+        for rel in repo.py_files("mxnet_tpu"):
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            yield from self._check_defvjp(rel, tree)
+
+    def _check_defvjp(self, rel, tree):
+        wired = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "defvjp":
+                base = dotted(node.func.value)
+                if base:
+                    wired.add(base)
+        for node in ast.walk(tree):
+            if not isinstance(node, FUNC_DEFS):
+                continue
+            for deco in node.decorator_list:
+                name = dotted(deco)
+                is_cvjp = name in ("jax.custom_vjp", "custom_vjp")
+                if isinstance(deco, ast.Call):
+                    cname = dotted(deco.func) or ""
+                    if cname in ("jax.custom_vjp", "custom_vjp"):
+                        is_cvjp = True
+                    elif cname in ("functools.partial", "partial") and \
+                            deco.args and dotted(deco.args[0]) in \
+                            ("jax.custom_vjp", "custom_vjp"):
+                        is_cvjp = True
+                if is_cvjp and node.name not in wired:
+                    yield Finding(
+                        self.rule, rel, node.lineno,
+                        "`%s` is @jax.custom_vjp but has no "
+                        "`%s.defvjp(fwd, bwd)` — the backward hook is "
+                        "unwired and the first gradient through it fails"
+                        % (node.name, node.name))

@@ -1,0 +1,261 @@
+"""signal-safety: the flight-recorder dump path must stay async-signal-safe.
+
+``telemetry/recorder.py``'s ``_on_sigusr1`` runs between two arbitrary
+bytecodes of the interrupted main thread; the watchdog's ``dump`` runs while
+every other thread is parked mid-anything. Anything in that reachable set
+that takes a lock the interrupted thread might hold — the logging module's
+handler lock being the classic — deadlocks exactly the hung process the
+flight recorder exists to diagnose. (This is why telemetry metrics are
+lock-free by design: docs/observability.md.)
+
+The checker walks the call graph from the entry points (``_on_sigusr1`` and
+``dump`` in ``mxnet_tpu/telemetry/recorder.py``) across the telemetry
+package (+ ``mxnet_tpu/env.py``, which the package reads config through)
+and enforces a default-deny policy on every call it cannot resolve into
+that analyzed set:
+
+  * allowed: calls into {os, sys, time, json, traceback, tempfile,
+    collections, math, io} and a builtin allowlist; ``threading.enumerate``
+    / ``current_thread`` / ``main_thread`` (read-only introspection);
+    method calls on local data (``list.append``, ``str.rstrip``, ...).
+  * forbidden: anything ``logging``-rooted or ``*.getLogger``; the rest of
+    ``threading`` (locks, thread starts); blocking method names
+    (``acquire``/``wait``/``notify``/``join``/logger methods); bare
+    ``print``; ``with``-acquiring anything whose name mentions a lock;
+    calls to dynamic/local callables the walker cannot see into.
+
+Justified exceptions carry ``# mxlint: disable=signal-safety`` plus a
+comment at the call site.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from ..astutil import FUNC_DEFS, body_walk, dotted
+
+_SCOPE_FILES = (
+    "mxnet_tpu/telemetry/recorder.py",
+    "mxnet_tpu/telemetry/core.py",
+    "mxnet_tpu/telemetry/__init__.py",
+    "mxnet_tpu/env.py",
+)
+_ENTRY = (("mxnet_tpu/telemetry/recorder.py", "_on_sigusr1"),
+          ("mxnet_tpu/telemetry/recorder.py", "dump"))
+
+_SAFE_ROOTS = {"os", "sys", "time", "json", "traceback", "tempfile",
+               "collections", "math", "io"}
+_SAFE_THREADING = {"enumerate", "current_thread", "main_thread",
+                   "get_ident"}
+_SAFE_BUILTINS = {
+    "abs", "bool", "bytes", "callable", "dict", "enumerate", "filter",
+    "float", "format", "frozenset", "getattr", "hasattr", "id", "int",
+    "isinstance", "issubclass", "iter", "len", "list", "map", "max", "min",
+    "next", "open", "range", "repr", "reversed", "round", "set", "setattr",
+    "sorted", "str", "sum", "tuple", "type", "vars", "zip",
+    # raising/constructing an exception allocates, it doesn't block
+    "Exception", "KeyError", "ValueError", "TypeError", "RuntimeError",
+    "OSError", "IndexError", "AttributeError", "NotImplementedError",
+}
+_FORBIDDEN_METHODS = {
+    "acquire", "wait", "notify", "notify_all", "join", "start", "getLogger",
+    "log", "warning", "info", "debug", "error", "exception", "critical",
+}
+
+
+def _name_parts(expr):
+    """Every bare-Name id and attribute name in an expression subtree."""
+    out = []
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.append(n.attr)
+    return out
+
+
+class _Module:
+    """Per-file symbol tables the walker resolves against."""
+
+    def __init__(self, rel, tree):
+        self.rel = rel
+        self.functions = {}    # module-level name -> FunctionDef
+        self.classes = {}      # class name -> {method name -> FunctionDef}
+        self.mod_aliases = {}  # local alias -> module key ("core", "env")
+        self.instances = {}    # module-level name -> class name
+        for node in tree.body:
+            if isinstance(node, FUNC_DEFS):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = {
+                    m.name: m for m in node.body if isinstance(m, FUNC_DEFS)}
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                cname = dotted(node.value.func)
+                if cname:
+                    self.instances[node.targets[0].id] = cname
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    self.mod_aliases[alias.asname or alias.name] = alias.name
+
+
+class SignalSafetyChecker:
+    rule = "signal-safety"
+    description = ("flight-recorder SIGUSR1/watchdog dump path is free of "
+                   "locks, logging and non-allowlisted calls")
+
+    def run(self, repo):
+        modules = {}
+        for rel in _SCOPE_FILES:
+            tree = repo.tree(rel)
+            if tree is not None:
+                key = rel.rsplit("/", 1)[-1][:-3]  # recorder/core/env/...
+                modules[key] = _Module(rel, tree)
+        if "recorder" not in modules:
+            return []
+
+        findings = []
+        visited = set()
+
+        def visit(mod, func, via):
+            if (mod.rel, func.name, func.lineno) in visited:
+                return
+            visited.add((mod.rel, func.name, func.lineno))
+            for node in body_walk(func):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        # any name/attribute mentioning a lock in the
+                        # context expr — dotted() alone misses computed
+                        # receivers like `self._locks[i]`
+                        lockish = [p for p in _name_parts(item.context_expr)
+                                   if "lock" in p.lower()]
+                        if lockish:
+                            findings.append(Finding(
+                                self.rule, mod.rel, node.lineno,
+                                "lock acquisition `with ...%s...` reachable "
+                                "from the dump path (via %s)"
+                                % (lockish[0], via)))
+                if isinstance(node, FUNC_DEFS):
+                    # nested def: its body runs only if called — calls to
+                    # it resolve through the bare-name case below
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                self._check_call(mod, func, node, via, modules, findings,
+                                 visit)
+
+        by_rel = {m.rel: m for m in modules.values()}
+        for rel, name in _ENTRY:
+            mod = by_rel.get(rel)
+            entry = mod.functions.get(name) if mod is not None else None
+            if entry is not None:
+                visit(mod, entry, "%s()" % name)
+            else:
+                findings.append(Finding(
+                    self.rule, rel, 1,
+                    "signal-safety entry point `%s` not found in %s — the "
+                    "dump path is unanalyzed (renamed? update _ENTRY)"
+                    % (name, rel)))
+        return findings
+
+    # -- one call site -----------------------------------------------------
+    def _check_call(self, mod, func, node, via, modules, findings, visit):
+        chain = "%s -> %s" % (via, func.name) if via.split("()")[0] != \
+            func.name else via
+
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+            if name in _SAFE_BUILTINS:
+                return
+            if name == "print":
+                findings.append(Finding(
+                    self.rule, mod.rel, node.lineno,
+                    "print() in the dump path (via %s) — write to "
+                    "sys.stderr instead" % chain))
+                return
+            target = mod.functions.get(name)
+            if target is not None:
+                visit(mod, target, chain)
+                return
+            cls = mod.classes.get(name)
+            if cls is not None:
+                init = cls.get("__init__")
+                if init is not None:
+                    visit(mod, init, chain)
+                return
+            # nested function defined in this scope?
+            for inner in ast.walk(func):
+                if isinstance(inner, FUNC_DEFS) and inner.name == name \
+                        and inner is not func:
+                    visit(mod, inner, chain)
+                    return
+            findings.append(Finding(
+                self.rule, mod.rel, node.lineno,
+                "call to dynamic/non-allowlisted `%s(...)` in the dump "
+                "path (via %s) — the walker cannot prove it signal-safe"
+                % (name, chain)))
+            return
+
+        cname = dotted(node.func)
+        if cname is None:
+            # computed receiver (subscript/call result): the method name is
+            # all we can judge — screen it, since `self._locks[i].acquire()`
+            # is exactly the deadlock class this rule exists for
+            receiver = node.func.value if isinstance(node.func,
+                                                     ast.Attribute) else None
+            # a string-literal receiver (",".join(...), f"...".format) is
+            # never a lock/thread/logger
+            str_recv = isinstance(receiver, ast.JoinedStr) or (
+                isinstance(receiver, ast.Constant)
+                and isinstance(receiver.value, str))
+            if isinstance(node.func, ast.Attribute) and not str_recv and \
+                    node.func.attr in _FORBIDDEN_METHODS:
+                findings.append(Finding(
+                    self.rule, mod.rel, node.lineno,
+                    "blocking/logging method `.%s(...)` on a computed "
+                    "receiver in the dump path (via %s)"
+                    % (node.func.attr, chain)))
+            return
+        root, _, attr = cname.partition(".")
+        tail = cname.rsplit(".", 1)[-1]
+
+        if root == "logging" or tail == "getLogger":
+            findings.append(Finding(
+                self.rule, mod.rel, node.lineno,
+                "logging call `%s` in the dump path (via %s) — the logging "
+                "module takes handler locks the interrupted thread may "
+                "hold" % (cname, chain)))
+            return
+        if root == "threading":
+            if attr not in _SAFE_THREADING:
+                findings.append(Finding(
+                    self.rule, mod.rel, node.lineno,
+                    "`%s` in the dump path (via %s) — only read-only "
+                    "threading introspection is allowed" % (cname, chain)))
+            return
+        if root in _SAFE_ROOTS:
+            return
+        # module alias into the analyzed scope (core.rank, _env.raw, ...)
+        alias = mod.mod_aliases.get(root, root)
+        target_mod = modules.get(alias)
+        if target_mod is not None and "." not in attr and attr:
+            target = target_mod.functions.get(attr)
+            if target is not None:
+                visit(target_mod, target, chain)
+                return
+        if tail in _FORBIDDEN_METHODS:
+            findings.append(Finding(
+                self.rule, mod.rel, node.lineno,
+                "blocking/logging method `%s` in the dump path (via %s)"
+                % (cname, chain)))
+            return
+        # instance of an analyzed class (_REGISTRY.snapshot()) or a duck-
+        # typed method call: visit every same-named method in scope
+        for m in modules.values():
+            for methods in m.classes.values():
+                target = methods.get(tail)
+                if target is not None:
+                    visit(m, target, chain)
+        # plain method call on local data (append/sort/write/...) — allowed

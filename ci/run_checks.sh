@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Single static-analysis entry point (SURVEY §5.2 — the reference's lint +
+# sanitizer CI layer): mxlint (AST checks: host-sync, signal-safety,
+# env-registry, registry-parity, bare-print — docs/static_analysis.md)
+# followed by the native-runtime sanitizers (ASan/UBSan + TSan).
+#
+# Usage: ci/run_checks.sh [--lint-only]
+# Exit nonzero on the first failing layer.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== mxlint =="
+python -m ci.mxlint
+
+if [[ "${1:-}" != "--lint-only" ]]; then
+    ./ci/sanitize.sh
+fi
+
+echo "ALL CHECKS CLEAN"

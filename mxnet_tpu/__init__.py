@@ -16,6 +16,7 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+from . import env
 from .base import (MXNetError, enable_persistent_compile_cache,
                    honor_explicit_cpu_platform)
 
@@ -67,7 +68,7 @@ if "kvstore_server" in globals() and _os.environ.get("DMLC_ROLE") in (
 # get the SIGUSR1 flight-recorder dump handler from import time, so even a
 # hang BEFORE the first training step (rendezvous, compile) is diagnosable
 # via the launcher's SIGUSR1-then-SIGTERM teardown
-if "telemetry" in globals() and _os.environ.get("MXTPU_TELEMETRY_DIR"):
+if "telemetry" in globals() and env.is_set("MXTPU_TELEMETRY_DIR"):
     telemetry.install_signal_handler()  # noqa: F821
 
 if "symbol" in globals():

@@ -58,7 +58,9 @@ def enable_persistent_compile_cache():
     executables simply miss the cache."""
     import os
 
-    choice = os.environ.get("MXTPU_COMPILE_CACHE", "")
+    from . import env as _env
+
+    choice = _env.raw("MXTPU_COMPILE_CACHE") or ""
     if not choice or choice.lower() in ("0", "off", "none", "disable",
                                         "false", "no"):
         return

@@ -1,0 +1,412 @@
+"""Typed registry of every ``MXTPU_*`` environment variable.
+
+The reference framework read its ~71 ``MXNET_*`` knobs through one choke
+point (``dmlc::GetEnv`` — typed, defaulted, greppable). Three generations of
+runtime machinery here (Pallas fusion, elastic fault tolerance, telemetry)
+had instead accumulated ad-hoc ``os.environ`` reads scattered across the
+library, and the docs table drifted from the code. This module is the single
+authority: every MXTPU variable is declared once — name, type, default,
+documentation — and library code reads it through the typed accessors below.
+
+Static enforcement: ``ci/mxlint``'s ``env-registry`` checker fails the tree
+when library code reads an ``MXTPU_*`` name through raw ``os.environ`` /
+``os.getenv``, when a read name is missing from this registry, or when the
+registry and the ``docs/env_vars.md`` table disagree (the table's Framework
+section is GENERATED from this registry: ``python -m mxnet_tpu.env
+--markdown``).
+
+Accessors (registered names only — an unregistered name raises ``KeyError``
+eagerly, the runtime arm of the lint guarantee):
+
+  * ``raw(name)``    -> exactly ``os.environ.get(name)`` (``None`` if unset)
+    — for call sites with bespoke parsing (tri-state gates, on/off synonym
+    sets) that must keep their historical semantics bit-for-bit.
+  * ``is_set(name)`` -> set to a non-empty string.
+  * ``get(name, default=...)`` -> value parsed per the registered type, with
+    the registered default (or the per-call override) when unset or
+    malformed. Malformed-falls-back matches the library's defensive reads
+    (a typo'd ``MXTPU_FLIGHTREC_EVENTS`` must not take training down).
+
+Types: ``str`` (returned verbatim), ``int`` / ``float`` (parsed, fallback on
+``ValueError``), ``bool`` (unset/empty/``0``/``false``/``off``/``no`` are
+False, anything else True — the superset of the ``not in ("", "0")`` idiom
+the scattered reads used).
+
+Pure stdlib, imports nothing from the package — ``telemetry.core`` (which
+must stay jax/numpy-free) imports it during early package init.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["EnvVar", "registry", "names", "raw", "is_set", "get",
+           "markdown_table"]
+
+_FALSY = ("", "0", "false", "off", "no")
+
+
+class EnvVar:
+    """One registered variable: name, type, default, documentation."""
+
+    __slots__ = ("name", "vtype", "default", "doc")
+
+    def __init__(self, name, vtype, default, doc):
+        self.name = name
+        self.vtype = vtype
+        self.default = default
+        self.doc = doc
+
+    def parse(self, value):
+        """Parse a raw env string per this var's type; ValueError on a
+        value the type can't hold (``get`` turns that into the default)."""
+        if self.vtype == "bool":
+            return value.strip().lower() not in _FALSY
+        if self.vtype == "int":
+            return int(value)
+        if self.vtype == "float":
+            return float(value)
+        return value
+
+    def default_str(self):
+        """Rendering of the default for the generated docs table."""
+        if self.default is None:
+            return "unset"
+        if self.vtype == "bool":
+            return "`1`" if self.default else "`0`"
+        return "`%s`" % (self.default,)
+
+
+_REGISTRY: dict = {}  # name -> EnvVar, insertion-ordered (= docs-table order)
+
+
+def _var(name, vtype, default, doc):
+    assert name.startswith("MXTPU_") and name not in _REGISTRY, name
+    _REGISTRY[name] = EnvVar(name, vtype, default, doc)
+
+
+def registry():
+    """The full name -> EnvVar mapping (insertion-ordered copy)."""
+    return dict(_REGISTRY)
+
+
+def names():
+    """Registered names, in declaration (= documentation) order."""
+    return list(_REGISTRY)
+
+
+def _check(name):
+    var = _REGISTRY.get(name)
+    if var is None:
+        raise KeyError(
+            "environment variable %r is not in the mxnet_tpu.env registry; "
+            "declare it there (with type/default/doc) before reading it"
+            % (name,))
+    return var
+
+
+def raw(name):
+    """``os.environ.get(name)`` for a registered name (None when unset)."""
+    _check(name)
+    return os.environ.get(name)
+
+
+def is_set(name):
+    """Registered name is set to a non-empty string."""
+    _check(name)
+    return bool(os.environ.get(name))
+
+
+_UNSET = object()
+
+
+def get(name, default=_UNSET):
+    """Typed read: parse per the registered type; the registered default
+    (or the per-call ``default`` override) when unset or malformed."""
+    var = _check(name)
+    fallback = var.default if default is _UNSET else default
+    value = os.environ.get(name)
+    if value is None:
+        return fallback
+    try:
+        return var.parse(value)
+    except ValueError:
+        return fallback
+
+
+# ---------------------------------------------------------------------------
+# the registry — declaration order is the docs/env_vars.md table order
+# ---------------------------------------------------------------------------
+
+# -- runtime / compile ------------------------------------------------------
+_var("MXTPU_NO_NATIVE", "bool", False,
+     "Disable the native C++ runtime (recordio/prefetch/buffer pool); "
+     "pure-Python fallbacks are used.")
+_var("MXTPU_COMPILE_CACHE", "str", None,
+     "Opt-in persistent XLA compilation cache (`base.enable_persistent_"
+     "compile_cache`): a directory path, or `1` for the repo-local "
+     "`.jax_cache` default; `0`/`off`/`none` (or unset) disables. "
+     "Executables are cached keyed by HLO+backend so repeated bench/capture "
+     "runs skip recompiles; deliberately NOT default-on (XLA:CPU AOT "
+     "reloads can SIGILL across machine-feature mismatches), `bench.py` "
+     "arms it for accelerator runs.")
+_var("MXTPU_PY_RECORDIO", "bool", False,
+     "Force the Python recordio reader/writer even when the native library "
+     "is built (used by rec2idx for `tell()` positions).")
+
+# -- fused kernels ----------------------------------------------------------
+_var("MXTPU_PALLAS_LSTM", "str", "auto",
+     "Fused Pallas LSTM layer (`ops/pallas_kernels.lstm_layer`): `auto` = "
+     "on for TPU, `1` forces it everywhere (interpret mode on CPU — "
+     "tests), `0` disables (lax.scan fallback).")
+_var("MXTPU_PALLAS_CONV_EPILOGUE", "str", "auto",
+     "Fused conv-epilogue kernels (BN batch-stats + normalize + ReLU + "
+     "residual add as one Pallas kernel pair, `ops/pallas_kernels."
+     "conv_epilogue`): `auto` = on for single-device TPU runs (pallas_call "
+     "has no SPMD partitioning rule, so sharded multi-device runs keep the "
+     "jnp psum sync-BN path), `1` forces it everywhere (interpret mode on "
+     "CPU — tests; any device count), `0` disables (pure-jnp custom-vjp BN "
+     "+ separate add/relu). Channels-last (NHWC) training path only; "
+     "channels-first always uses the jnp fallback. Any non-`0` value also "
+     "makes the model-zoo ResNets BUILD the fused graph (BatchNormRelu/"
+     "BatchNormAddRelu ops; parameter names unchanged). Read at first "
+     "compile of each op/attrs combination — flip it between processes (as "
+     "`tools/bench_capture.sh` A-B rows do), not mid-process.")
+_var("MXTPU_S2D_STEM", "bool", False,
+     "`1` builds model-zoo ResNets with the space-to-depth stem (7×7/s2 "
+     "over 3ch → 4×4/s1 over 12ch; weight-space transform `resnet."
+     "stem_weight_to_s2d`, checkpoint converter `resnet."
+     "convert_stem_params`).")
+
+# -- profiler ---------------------------------------------------------------
+_var("MXTPU_PROFILE_SYNC", "bool", False,
+     "Profiler records true device time by blocking per op, instead of "
+     "(async) dispatch time. Equivalent of the reference engine's "
+     "profiling stamps.")
+_var("MXTPU_STEP_TRACE_DIR", "str", "step_trace",
+     "Output directory for `tools/step_profile.py` XLA (xplane) step "
+     "traces.")
+
+# -- bench.py ---------------------------------------------------------------
+_var("MXTPU_BENCH_BATCH", "int", 32, "bench.py batch size.")
+_var("MXTPU_BENCH_WARMUP", "int", 3, "bench.py warmup iterations.")
+_var("MXTPU_BENCH_ITERS", "int", 10, "bench.py measured iterations.")
+_var("MXTPU_BENCH_MODE", "str", "train",
+     "bench.py mode: `train`, `score` (reference benchmark_score.py "
+     "analogue), `score_int8` (quantize_model int8 deployment path), "
+     "`bert` (BERT-base tokens/sec + MFU), `lstm` (word-LM).")
+_var("MXTPU_BENCH_NET", "str", "resnet50",
+     "model for train/score modes (`resnet152`, `inception_v3` for score; "
+     "`inception_v3`, `alexnet` for train — the BASELINE.md V100 rows).")
+_var("MXTPU_BENCH_LAYOUT", "str", "NCHW",
+     "`NHWC` builds the bench net channels-last (layout_scope) and feeds "
+     "NHWC batches.")
+_var("MXTPU_BENCH_DTYPE", "str", "bfloat16",
+     "bench compute precision (`float32` for the fp32 path).")
+_var("MXTPU_BENCH_SEQLEN", "int", 512,
+     "sequence length for the `bert` bench mode.")
+_var("MXTPU_BENCH_DIAL_RETRY_S", "int", 900,
+     "bench watchdog: total seconds to keep retrying a wedged accelerator "
+     "dial before failing with a JSON error line.")
+_var("MXTPU_BENCH_FORCE_DIAL_FAIL", "bool", False,
+     "test hook: exercise the unreachable-device JSON contract (incl. the "
+     "stale-capture fallback) without a wedged tunnel.")
+_var("MXTPU_BENCH_SEGMENTS", "str", "1",
+     "train-mode MFU segment decomposition (matmul ceiling / fwd / "
+     "fwd+dgrad fields). `0` disables; `force` bypasses the TPU-only gate "
+     "(contract tests).")
+_var("MXTPU_BENCH_SEG_MM_N", "int", 8192,
+     "matrix side for the segment matmul-ceiling measurement.")
+_var("MXTPU_BENCH_SWEEP_BATCH", "int", 256,
+     "large-batch sweep point 1 batch size (fields `sweep_*`; `0` "
+     "disables).")
+_var("MXTPU_BENCH_SWEEP_BATCH2", "int", 512,
+     "large-batch sweep point 2 batch size (fields `sweep2_*`; `0` "
+     "disables).")
+_var("MXTPU_BENCH_PROFILE", "bool", False,
+     "`1` captures an XLA (xplane) trace of a few steady-state bench steps "
+     "next to the JSON artifact (the docs/perf_notes.md MFU-gap evidence "
+     "path).")
+_var("MXTPU_BENCH_PROFILE_DIR", "str", None,
+     "Output directory for the `MXTPU_BENCH_PROFILE` trace (default "
+     "`bench_trace_<mode>`).")
+
+# -- data loading -----------------------------------------------------------
+_var("MXTPU_DATALOADER_CTX", "str", "fork",
+     "multiprocessing start method for DataLoader worker processes "
+     "(`spawn` needs a `__main__` guard).")
+_var("MXTPU_DATALOADER_TIMEOUT", "float", 300.0,
+     "seconds to wait for a worker batch before raising (dead-worker "
+     "detection).")
+_var("MXTPU_DATALOADER_PROBE_TIMEOUT", "float", 20.0,
+     "seconds the DataLoader's worker-viability probe (one sample round-"
+     "tripped through a real worker process) may take before the loader "
+     "falls back to in-process loading; the legit probe path touches no "
+     "jax and returns in well under a second.")
+
+# -- test suite -------------------------------------------------------------
+_var("MXTPU_TEST_TPU", "bool", False,
+     "`1` lets the pytest conftest keep the real accelerator (the `-m "
+     "tpu` smoke suite); default runs pin CPU.")
+_var("MXTPU_TEST_SEED", "int", None,
+     "fixed seed for `test_utils.with_seed` tests (printed on failure for "
+     "replay; tools/flakiness_checker.py sets both this and "
+     "`MXNET_TEST_SEED`).")
+_var("MXTPU_TEST_EXAMPLES_FULL", "bool", False,
+     "`1` runs the examples CI at full configs instead of the <60s smoke "
+     "configs.")
+_var("MXTPU_TEST_LARGE_FULL", "bool", False,
+     "`1` runs the allocation-heavy (>2 GiB) large-tensor tests (the "
+     "reference keeps these in tests/nightly); default runs keep only the "
+     "allocation-free checks.")
+_var("MXTPU_TEST_CONVERGENCE_FULL", "bool", False,
+     "`1` runs the long eager convergence fits (SSD, NLP models) the "
+     "default suite skips.")
+_var("MXTPU_TEST_TOTAL_STEPS", "int", None,
+     "resilience/flight-recorder test workers: total training steps "
+     "(worker-specific defaults).")
+_var("MXTPU_TEST_STEP_SLEEP", "float", 0.05,
+     "flight-recorder test worker: per-step sleep (hang-detection "
+     "timing base).")
+_var("MXTPU_TEST_CKPT_EVERY", "int", 2,
+     "resilience test worker: checkpoint period in steps.")
+_var("MXTPU_WALLTIME_FILE", "str", None,
+     "if set, the pytest conftest appends a JSON record of suite wall time "
+     "vs. the tier-1 budget to this file (always printed in the terminal "
+     "summary).")
+
+# -- probe / diagnosis tools ------------------------------------------------
+_var("MXTPU_PROBE_BATCH", "int", 256,
+     "tools/mfu_probe.py, conv_probe.py, int8_probe.py, bn_bisect.py "
+     "measurement batch size.")
+_var("MXTPU_PROBE_ITERS", "int", None,
+     "probe-tool measured iterations (tool-specific defaults: mfu 10, "
+     "bn_bisect 20, int8 200, conv 400).")
+_var("MXTPU_DIAG_TIMEOUT_S", "int", 60,
+     "tools/diagnose.py accelerator-dial probe timeout.")
+_var("MXTPU_PROBE_TIMEOUT", "int", 120,
+     "tools/bench_capture.sh: per-attempt accelerator-dial probe timeout "
+     "(seconds).")
+_var("MXTPU_PROBE_INTERVAL", "int", 60,
+     "tools/bench_capture.sh: initial sleep between accelerator probes "
+     "(doubles up to `MXTPU_PROBE_INTERVAL_MAX`).")
+_var("MXTPU_PROBE_DEADLINE", "int", 1800,
+     "tools/bench_capture.sh accelerator-probe loop: total wall-clock "
+     "budget before writing a stale-labeled `BENCH_<tag>_stale.json` and "
+     "exiting.")
+_var("MXTPU_PROBE_INTERVAL_MAX", "int", 300,
+     "cap on the bench_capture probe loop's doubling backoff (seconds).")
+
+# -- distributed: rendezvous + launcher -------------------------------------
+_var("MXTPU_COORDINATOR", "str", None,
+     "multi-process rendezvous coordinator address, emitted by "
+     "`tools/launch.py` and consumed by `parallel.collectives."
+     "init_process_group`.")
+_var("MXTPU_NUM_WORKERS", "int", None,
+     "process-group size for the rendezvous protocol (alias: "
+     "`DMLC_NUM_WORKER`).")
+_var("MXTPU_PROCESS_ID", "int", None,
+     "this process's rank in the rendezvous protocol (alias: "
+     "`DMLC_WORKER_ID`).")
+_var("MXTPU_RENDEZVOUS_TIMEOUT", "int", 300,
+     "seconds `init_process_group` / `kv.create('dist_sync')` waits for "
+     "the group to assemble before raising a diagnosable `MXNetError` "
+     "(instead of hanging on a peer that never arrives — "
+     "docs/fault_tolerance.md §2).")
+_var("MXTPU_RENDEZVOUS_RETRIES", "int", 0,
+     "redial count (exponential backoff) for *transient* rendezvous "
+     "errors; deadline expiries are not retried.")
+_var("MXTPU_RESTART_GENERATION", "int", 0,
+     "set by the `tools/launch.py --max-restarts` supervisor: which "
+     "respawn generation this worker belongs to (`parallel.resilience."
+     "restart_generation()`; fault injection defaults to generation 0 "
+     "only).")
+_var("MXTPU_TEARDOWN_GRACE", "float", 10.0,
+     "launcher escalation window: seconds between group SIGTERM and "
+     "SIGKILL on first failure.")
+_var("MXTPU_CPU_COLLECTIVES", "str", "gloo",
+     "cross-process collectives implementation selected when the platform "
+     "is explicitly CPU (multi-process CPU groups need one; `none` "
+     "disables).")
+
+# -- resilience -------------------------------------------------------------
+_var("MXTPU_FAULT_INJECT", "str", None,
+     "deterministic fault injection at the trainer step boundary, e.g. "
+     "`kill@step=7,rank=1`, `exc@step=3`, `hang@step=5,rank=1` (park the "
+     "rank forever — watchdog/flight-recorder test vector), "
+     "`corrupt_ckpt@step=5,dir=/ckpts` (docs/fault_tolerance.md §4).")
+_var("MXTPU_CKPT_DIR", "str", None,
+     "default checkpoint directory for the `corrupt_ckpt` injection "
+     "action (tests' resilience workers also read it).")
+
+# -- telemetry / flight recorder --------------------------------------------
+_var("MXTPU_TELEMETRY", "bool", True,
+     "master switch for the always-on metrics/flight-recorder layer "
+     "(docs/observability.md); `0` turns every counter/event into a "
+     "no-op.")
+_var("MXTPU_TELEMETRY_DIR", "str", None,
+     "directory for telemetry output: periodic per-process "
+     "`telemetry-rank<R>-pid<P>.jsonl` snapshots, `launcher-events.jsonl` "
+     "(tools/launch.py supervision events) and `flightrec-*.json` hang "
+     "dumps. Also arms the import-time SIGUSR1 dump handler. Read once at "
+     "first use — set before the process starts recording.")
+_var("MXTPU_TELEMETRY_FLUSH_S", "float", 10.0,
+     "period of the JSONL flusher thread (a final flush always runs at "
+     "exit).")
+_var("MXTPU_TELEMETRY_PORT", "int", None,
+     "base port for the Prometheus text-exposition endpoint; each rank "
+     "serves `/metrics` on `port + rank` (stdlib http.server; default off "
+     "— metrics-on/endpoint-off posture).")
+_var("MXTPU_WATCHDOG_TIMEOUT", "float", None,
+     "hang watchdog: seconds without a completed training step (armed by "
+     "the FIRST completed step, so initial compile never trips it) before "
+     "the flight recorder dumps all-thread stacks + recent events.")
+_var("MXTPU_WATCHDOG_ACTION", "str", "abort",
+     "what follows a watchdog dump: `abort` exits the process (code "
+     "`MXTPU_WATCHDOG_EXIT_CODE`, 43) so the launcher tears down/restarts "
+     "the group; `dump` keeps the process alive and re-arms.")
+_var("MXTPU_WATCHDOG_EXIT_CODE", "int", 43,
+     "exit status of a watchdog abort (distinct from the fault-injection "
+     "code 42).")
+_var("MXTPU_FLIGHTREC_EVENTS", "int", 512,
+     "flight-recorder ring size (recent telemetry events kept per process "
+     "for dumps).")
+_var("MXTPU_DUMP_GRACE", "float", 1.0,
+     "launcher teardown: seconds between the SIGUSR1 (flight-recorder "
+     "dump) broadcast and SIGTERM. The broadcast only happens when "
+     "`MXTPU_TELEMETRY_DIR` is set (the same condition that installs the "
+     "worker-side dump handler at import); otherwise teardown starts "
+     "directly at SIGTERM.")
+_var("MXTPU_STEP_FLOPS", "float", None,
+     "model FLOPs per training step; when set, `observe_step` publishes "
+     "achieved MFU (`mxtpu_step_mfu`) against `runtime.chip_peak_tflops` "
+     "× local device count (API spelling: `telemetry.set_step_flops`).")
+
+
+# ---------------------------------------------------------------------------
+# docs generation
+# ---------------------------------------------------------------------------
+
+def markdown_table():
+    """The docs/env_vars.md Framework table, generated from the registry
+    (one row per variable, declaration order). The env-registry lint
+    checker proves the committed table matches this registry."""
+    lines = ["| Variable | Default | Effect |", "|---|---|---|"]
+    for var in _REGISTRY.values():
+        doc = " ".join(var.doc.split())
+        lines.append("| `%s` | %s | %s |" % (var.name, var.default_str(),
+                                             doc))
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    import sys
+
+    args = sys.argv[1:]
+    if args in ([], ["--markdown"]):
+        sys.stdout.write(markdown_table())
+    elif args == ["--names"]:
+        sys.stdout.write("\n".join(names()) + "\n")
+    else:
+        sys.stderr.write("usage: python -m mxnet_tpu.env "
+                         "[--markdown | --names]\n")
+        sys.exit(2)

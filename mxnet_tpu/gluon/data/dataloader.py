@@ -185,8 +185,9 @@ def _host_safe_probe(dataset, pool_factory, timeout=None):
     (MXTPU_DATALOADER_PROBE_TIMEOUT, default 20s — the legit probe path
     touches no jax and returns in well under a second)."""
     if timeout is None:
-        timeout = float(os.environ.get("MXTPU_DATALOADER_PROBE_TIMEOUT",
-                                       20.0))
+        from ... import env as _env
+
+        timeout = _env.get("MXTPU_DATALOADER_PROBE_TIMEOUT")
     try:
         pickle.dumps(dataset)
     except Exception:
@@ -266,8 +267,9 @@ class _MultiWorkerIter:
         # bounded wait: a worker killed mid-task (OOM, native segfault)
         # leaves its AsyncResult forever pending — surface an error instead
         # of hanging the training loop
-        timeout = float(__import__("os").environ.get(
-            "MXTPU_DATALOADER_TIMEOUT", "300"))
+        from ... import env as _env
+
+        timeout = _env.get("MXTPU_DATALOADER_TIMEOUT")
         try:
             single, desc = result.get(timeout=timeout)
         except Exception as e:
@@ -305,9 +307,9 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
                  thread_pool=False, ctx=None):
-        import os as _os
+        from ... import env as _env
 
-        self._mp_ctx = ctx or _os.environ.get("MXTPU_DATALOADER_CTX", "fork")
+        self._mp_ctx = ctx or _env.get("MXTPU_DATALOADER_CTX")
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:

@@ -21,8 +21,8 @@ reference graph; both are checkpoint-compatible — see each flag):
 """
 from __future__ import annotations
 
-import os
 
+from .... import env as _env
 from ....base import MXNetError
 from ....ops.nn import _channels_last
 from ...block import HybridBlock
@@ -41,13 +41,15 @@ def _fuse_epilogue_default(flag):
     same env decide Pallas vs pure-jnp lowering — see ops/nn.py)."""
     if flag is not None:
         return bool(flag)
-    return os.environ.get("MXTPU_PALLAS_CONV_EPILOGUE", "") not in ("", "0")
+    # NOT get(): the zoo gate is set-and-not-"0" (`auto` builds the fused
+    # graph too — the op layer then decides Pallas vs jnp lowering)
+    return (_env.raw("MXTPU_PALLAS_CONV_EPILOGUE") or "") not in ("", "0")
 
 
 def _stem_s2d_default(flag):
     if flag is not None:
         return bool(flag)
-    return os.environ.get("MXTPU_S2D_STEM", "") not in ("", "0")
+    return _env.get("MXTPU_S2D_STEM")
 
 
 def _conv3x3(channels, stride, in_channels):

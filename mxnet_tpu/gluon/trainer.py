@@ -10,9 +10,9 @@ sharded copy of each parameter on the mesh and lets XLA insert the
 all-reduces inside the compiled step (SURVEY §2.3 row 1)."""
 from __future__ import annotations
 
-import os
 import time
 
+from .. import env as _env
 from ..base import MXNetError
 from .. import optimizer as opt
 from .. import telemetry
@@ -132,7 +132,7 @@ class Trainer:
                                examples=batch_size, step=self._step_count)
         # step-boundary fault hook; the env guard keeps the hot path free
         # of even the import lookup when injection is unarmed
-        if os.environ.get("MXTPU_FAULT_INJECT"):
+        if _env.is_set("MXTPU_FAULT_INJECT"):
             from ..parallel import resilience
 
             resilience.maybe_inject_fault(self._step_count)

@@ -60,7 +60,9 @@ class _Loader:
         with self._lock:
             if self._lib is None and not self._tried:
                 self._tried = True
-                if os.environ.get("MXTPU_NO_NATIVE"):
+                from .. import env as _env
+
+                if _env.get("MXTPU_NO_NATIVE"):
                     return None
                 path = self._build()
                 if path is not None:

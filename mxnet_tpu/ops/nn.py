@@ -529,9 +529,9 @@ def _conv_epilogue_enabled():
     rule, so under pjit with a sharded batch axis it would force XLA to
     gather each BN's full activation per layer — the jnp fallback keeps
     the documented free-psum sync-BN behavior there."""
-    import os
+    from .. import env as _env_mod
 
-    env = os.environ.get("MXTPU_PALLAS_CONV_EPILOGUE", "auto")
+    env = _env_mod.get("MXTPU_PALLAS_CONV_EPILOGUE")
     if env == "0":
         return False
     if env == "1":

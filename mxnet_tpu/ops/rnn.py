@@ -93,9 +93,9 @@ def _cell_step(mode, H):
 def _pallas_lstm_enabled():
     """Fused Pallas LSTM layer: default on for TPU; MXTPU_PALLAS_LSTM=1
     forces it elsewhere (interpret mode), =0 disables everywhere."""
-    import os
+    from .. import env as _env_mod
 
-    env = os.environ.get("MXTPU_PALLAS_LSTM", "auto")
+    env = _env_mod.get("MXTPU_PALLAS_LSTM")
     if env == "0":
         return False
     if env == "1":

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import time as _time_mod
 
+from .. import env as _env
 from ..telemetry import core as _telemetry
 from ..telemetry import recorder as _recorder
 
@@ -185,7 +186,7 @@ def _enable_cpu_collectives(jax):
     Must run before backend init, i.e. alongside the rendezvous."""
     import os
 
-    impl = os.environ.get("MXTPU_CPU_COLLECTIVES", "gloo")
+    impl = _env.get("MXTPU_CPU_COLLECTIVES")
     if impl == "none":
         return
     plats = (jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS")
@@ -238,10 +239,16 @@ def init_process_group(coordinator_address=None, num_processes=None,
     from ..base import MXNetError
 
     def _env_int(*names):
+        """Protocol-fallback read: first set name wins, MXTPU leg routed
+        through the typed registry. A malformed value falls through to the
+        next source (registry contract: never crash rendezvous on a typo)."""
         for n in names:
-            v = os.environ.get(n)
+            v = _env.raw(n) if n.startswith("MXTPU_") else os.environ.get(n)
             if v is not None:
-                return int(v)
+                try:
+                    return int(v)
+                except ValueError:
+                    continue
         return None
 
     # Size/rank resolution order: our protocol, the reference's DMLC
@@ -260,7 +267,7 @@ def init_process_group(coordinator_address=None, num_processes=None,
     if num_processes <= 1:
         return
     if coordinator_address is None:
-        coordinator_address = os.environ.get("MXTPU_COORDINATOR")
+        coordinator_address = _env.raw("MXTPU_COORDINATOR")
     if process_id is None:
         process_id = _env_int("MXTPU_PROCESS_ID", "DMLC_WORKER_ID",
                               "OMPI_COMM_WORLD_RANK", "PMI_RANK",
@@ -268,15 +275,14 @@ def init_process_group(coordinator_address=None, num_processes=None,
     if _group_initialized(jax):
         return  # idempotent re-entry
     if timeout is None:
-        timeout = _env_int("MXTPU_RENDEZVOUS_TIMEOUT")
-        if timeout is None:
-            timeout = 300  # explicit 0 means "fail immediately", keep it
+        # registry default 300; explicit 0 means "fail immediately"
+        timeout = _env.get("MXTPU_RENDEZVOUS_TIMEOUT")
     if retries is None:
         # default 0: total time to a clear failure stays within ONE timeout
         # (+ margin) — the acceptance bar for a never-arriving peer. Set
         # MXTPU_RENDEZVOUS_RETRIES>0 for flaky fabrics where a second dial
         # (with backoff) is worth paying the extra timeout windows.
-        retries = _env_int("MXTPU_RENDEZVOUS_RETRIES") or 0
+        retries = _env.get("MXTPU_RENDEZVOUS_RETRIES")
     # NOTE: must run before the first jax computation — the backend snapshots
     # the process group at creation (call this before importing anything
     # that touches jax arrays, or at worker start; tools/launch.py pattern)

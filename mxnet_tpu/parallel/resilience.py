@@ -33,6 +33,7 @@ import tempfile
 import time
 import zlib
 
+from .. import env as _env
 from ..base import MXNetError, atomic_writer, _fsync_dir
 from .. import telemetry
 
@@ -51,10 +52,7 @@ def restart_generation():
     """Which supervision generation this process belongs to (0 = first
     launch). tools/launch.py exports MXTPU_RESTART_GENERATION on every
     worker it respawns after a failure."""
-    try:
-        return int(os.environ.get("MXTPU_RESTART_GENERATION", "0"))
-    except ValueError:
-        return 0
+    return _env.get("MXTPU_RESTART_GENERATION")
 
 
 def _current_rank():
@@ -63,7 +61,8 @@ def _current_rank():
     heavy and wrong before init_process_group)."""
     for name in ("MXTPU_PROCESS_ID", "DMLC_WORKER_ID", "OMPI_COMM_WORLD_RANK",
                  "PMI_RANK", "SLURM_PROCID"):
-        v = os.environ.get(name)
+        v = _env.raw(name) if name.startswith("MXTPU_") \
+            else os.environ.get(name)
         if v is not None:
             try:
                 return int(v)
@@ -391,7 +390,7 @@ def fault_spec(env=None):
     """Parse MXTPU_FAULT_INJECT into a list of {action, step, rank, gen,
     code, dir} dicts. Malformed entries raise MXNetError eagerly — a typo'd
     injection silently never firing would invalidate the test using it."""
-    raw = os.environ.get("MXTPU_FAULT_INJECT", "") if env is None else env
+    raw = (_env.raw("MXTPU_FAULT_INJECT") or "") if env is None else env
     entries = []
     for part in raw.replace(";", " ").split():
         action, _, conds = part.partition("@")
@@ -425,7 +424,7 @@ def maybe_inject_fault(step):
     of the update that just completed."""
     global _fault_cache
     if _fault_cache is _UNPARSED:
-        _fault_cache = fault_spec() if os.environ.get("MXTPU_FAULT_INJECT") \
+        _fault_cache = fault_spec() if _env.is_set("MXTPU_FAULT_INJECT") \
             else []
     if not _fault_cache:
         return
@@ -467,7 +466,7 @@ def _fire(entry, step, rank):
         while True:
             _t.sleep(3600)
     if action == "corrupt_ckpt":
-        directory = entry["dir"] or os.environ.get("MXTPU_CKPT_DIR")
+        directory = entry["dir"] or _env.raw("MXTPU_CKPT_DIR")
         if not directory:
             raise MXNetError("corrupt_ckpt needs dir=... or MXTPU_CKPT_DIR")
         _corrupt_latest(directory)

@@ -16,10 +16,10 @@ the analogue of the reference's engine-side start/end stamps.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 
+from . import env as _env
 from .base import MXNetError
 from .telemetry import core as _telemetry
 
@@ -40,7 +40,7 @@ _config = {
     "profile_memory": False,
     "profile_api": False,
     "aggregate_stats": False,
-    "profile_sync": os.environ.get("MXTPU_PROFILE_SYNC", "") not in ("", "0"),
+    "profile_sync": _env.get("MXTPU_PROFILE_SYNC"),
 }
 _state = {"running": False, "paused": False}
 _t0 = time.perf_counter()

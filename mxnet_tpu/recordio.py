@@ -14,6 +14,7 @@ import struct
 
 import numpy as _np
 
+from . import env as _env
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
@@ -41,13 +42,13 @@ class MXRecordIO:
         self._native = None
         if self.flag == "w":
             self.writable = True
-            if _native.available() and not os.environ.get("MXTPU_PY_RECORDIO"):
+            if _native.available() and not _env.get("MXTPU_PY_RECORDIO"):
                 self._native = _native.RecordWriter(self.uri)
             else:
                 self.handle = open(self.uri, "wb")
         elif self.flag == "r":
             self.writable = False
-            if _native.available() and not os.environ.get("MXTPU_PY_RECORDIO"):
+            if _native.available() and not _env.get("MXTPU_PY_RECORDIO"):
                 self._native = _native.RecordReader(self.uri)
             else:
                 self.handle = open(self.uri, "rb")

@@ -50,7 +50,7 @@ _INPUT_SLOTS = {
 # shape assignment walks through them to reach the var
 _SHAPE_TRANSPARENT = {
     "_contrib_quantize_v2", "quantize_v2", "_contrib_quantize", "quantize",
-    "Cast", "cast", "amp_cast", "BlockGrad", "identity", "_copy",
+    "Cast", "cast", "BlockGrad", "identity", "_copy",
 }
 
 # ops whose optional trailing array inputs are dropped by a flag

@@ -18,8 +18,8 @@ peak-FLOPs lookup), metrics default ON, exporters default OFF.
 """
 from __future__ import annotations
 
-import os
 
+from .. import env as _env
 from .core import (  # noqa: F401
     BYTE_BOUNDS, LATENCY_BOUNDS, counter, enabled, flush, gauge,
     get_registry, histogram, prometheus_text, rank, restart_generation,
@@ -58,11 +58,10 @@ def set_step_flops(flops):
     _STEP_FLOPS[0] = float(flops) if flops else None
 
 
-if os.environ.get("MXTPU_STEP_FLOPS"):
-    try:
-        set_step_flops(float(os.environ["MXTPU_STEP_FLOPS"]))
-    except ValueError:
-        pass
+if _env.is_set("MXTPU_STEP_FLOPS"):
+    _step_flops_env = _env.get("MXTPU_STEP_FLOPS")
+    if _step_flops_env is not None:  # malformed value falls back to unset
+        set_step_flops(_step_flops_env)
 
 
 def _peak_flops():

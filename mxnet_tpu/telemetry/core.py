@@ -36,6 +36,8 @@ import os
 import threading
 import time
 
+from .. import env as _env
+
 __all__ = [
     "enabled", "set_enabled", "counter", "gauge", "histogram", "get_registry",
     "snapshot", "prometheus_text", "flush", "start_http_server", "rank",
@@ -43,18 +45,11 @@ __all__ = [
 ]
 
 
-def _env_flag(name, default=True):
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v.strip().lower() not in ("0", "false", "off", "no", "")
-
-
 class _State:
     """Mutable module state in one place (re-read by tests / after fork)."""
 
     def __init__(self):
-        self.enabled = _env_flag("MXTPU_TELEMETRY", True)
+        self.enabled = _env.get("MXTPU_TELEMETRY")
         self.owner_pid = os.getpid()
         self.flusher = None          # flusher thread (or None)
         self.flusher_decided = False  # env checked once (hot-path guard)
@@ -82,7 +77,10 @@ def rank():
     telemetry must work before/without a process group)."""
     for name in ("MXTPU_PROCESS_ID", "DMLC_WORKER_ID", "OMPI_COMM_WORLD_RANK",
                  "PMI_RANK", "SLURM_PROCID"):
-        v = os.environ.get(name)
+        # MXTPU leg through the typed registry; scheduler vars stay raw
+        # (they're other systems' protocol, not ours to register)
+        v = _env.raw(name) if name.startswith("MXTPU_") \
+            else os.environ.get(name)
         if v is not None:
             try:
                 return int(v)
@@ -92,15 +90,12 @@ def rank():
 
 
 def restart_generation():
-    try:
-        return int(os.environ.get("MXTPU_RESTART_GENERATION", "0"))
-    except ValueError:
-        return 0
+    return _env.get("MXTPU_RESTART_GENERATION")
 
 
 def telemetry_dir():
     """The JSONL/flight-recorder output directory, or None when unset."""
-    return os.environ.get("MXTPU_TELEMETRY_DIR") or None
+    return _env.raw("MXTPU_TELEMETRY_DIR") or None
 
 
 # ---------------------------------------------------------------------------
@@ -458,7 +453,7 @@ def ensure_flusher():
         _STATE.flusher_decided = True
         return
     _STATE.flusher_decided = True
-    period = float(os.environ.get("MXTPU_TELEMETRY_FLUSH_S", "10"))
+    period = _env.get("MXTPU_TELEMETRY_FLUSH_S")
     t = threading.Thread(target=_flusher_loop, args=(max(0.25, period),),
                          name="mxtpu-telemetry-flush", daemon=True)
     _STATE.flusher = t
@@ -502,10 +497,10 @@ def start_http_server(port=None, addr="0.0.0.0"):
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     if port is None:
-        raw = os.environ.get("MXTPU_TELEMETRY_PORT")
+        raw = _env.raw("MXTPU_TELEMETRY_PORT")
         if raw is None:
             return None
-        port = int(raw)
+        port = int(raw)  # malformed -> ValueError, caught by ensure_http
         if port:
             # one exporter per rank on a shared host: offset by rank
             port += rank()
@@ -544,7 +539,7 @@ def ensure_http():
     if not _STATE.enabled:
         return
     _STATE.http_decided = True
-    if os.environ.get("MXTPU_TELEMETRY_PORT") is None:
+    if _env.raw("MXTPU_TELEMETRY_PORT") is None:
         return
     try:
         start_http_server()

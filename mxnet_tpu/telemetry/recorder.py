@@ -39,6 +39,7 @@ import threading
 import time
 import traceback
 
+from .. import env as _env
 from . import core
 
 __all__ = ["record_event", "record_step", "events", "dump", "dump_path",
@@ -46,10 +47,7 @@ __all__ = ["record_event", "record_step", "events", "dump", "dump_path",
 
 
 def _ring_size():
-    try:
-        return max(16, int(os.environ.get("MXTPU_FLIGHTREC_EVENTS", "512")))
-    except ValueError:
-        return 512
+    return max(16, _env.get("MXTPU_FLIGHTREC_EVENTS"))
 
 
 class _RecState:
@@ -212,7 +210,10 @@ def _on_sigusr1(signum, frame):
     prev = getattr(_on_sigusr1, "_prev", None)
     if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL,
                                        _on_sigusr1):
-        prev(signum, frame)
+        # chaining the handler someone installed before us preserves their
+        # behavior; its safety is theirs to guarantee (it would have run in
+        # this same signal context had we never replaced it)
+        prev(signum, frame)  # mxlint: disable=signal-safety
 
 
 def install_signal_handler():
@@ -234,14 +235,8 @@ def install_signal_handler():
 # ---------------------------------------------------------------------------
 
 def _watchdog_timeout():
-    raw = os.environ.get("MXTPU_WATCHDOG_TIMEOUT")
-    if not raw:
-        return None
-    try:
-        t = float(raw)
-    except ValueError:
-        return None
-    return t if t > 0 else None
+    t = _env.get("MXTPU_WATCHDOG_TIMEOUT")
+    return t if t is not None and t > 0 else None
 
 
 def _watchdog_loop(timeout):
@@ -261,15 +256,13 @@ def _watchdog_loop(timeout):
         dump("watchdog: no step completed in %.1fs (timeout %gs, last "
              "step %s)" % (stalled, timeout, ls[0]))
         core.flush(reason="watchdog")
-        action = os.environ.get("MXTPU_WATCHDOG_ACTION", "abort").lower()
+        action = _env.get("MXTPU_WATCHDOG_ACTION").lower()
         if action == "dump":
             # keep running, re-arm from now
             _REC.last_step = (ls[0], time.monotonic(), time.time())
             continue
-        try:
-            code = int(os.environ.get("MXTPU_WATCHDOG_EXIT_CODE", "43"))
-        except ValueError:
-            code = 43  # a typo'd exit code must not disarm the abort
+        # a typo'd exit code must not disarm the abort (get falls back)
+        code = _env.get("MXTPU_WATCHDOG_EXIT_CODE")
         sys.stderr.write(
             "[flight-recorder] rank %d aborting hung process (exit %d) so "
             "the launcher can tear down / restart the group\n"

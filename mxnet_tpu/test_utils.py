@@ -364,8 +364,10 @@ def with_seed(seed=None):
         def wrapper(*args, **kwargs):
             this_seed = seed
             if this_seed is None:
+                from . import env as _env_mod
+
                 env = os.environ.get("MXNET_TEST_SEED") \
-                    or os.environ.get("MXTPU_TEST_SEED")
+                    or _env_mod.raw("MXTPU_TEST_SEED")
                 this_seed = int(env) if env else np.random.randint(0, 2 ** 31)
             np.random.seed(this_seed)
             _pyrandom.seed(this_seed)

@@ -1,0 +1,551 @@
+"""Tests for ci/mxlint — the AST static-analysis suite.
+
+Each checker gets fixture trees with known violations (positive), known-good
+code (negative), pragma suppression, and the baseline workflow; plus the
+regression that the pre-mxlint ``ci/lint_print.py`` CLI still works
+standalone. The real-tree cleanliness gate lives in
+``test_infra.py::test_mxlint_clean`` (tier-1).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT) if ROOT not in sys.path else None
+
+from ci.mxlint import Repo, load_baseline, run_checkers  # noqa: E402
+from ci.mxlint.checkers import CHECKERS  # noqa: E402
+from ci.mxlint.checkers.env_registry import EnvRegistryChecker  # noqa: E402
+from ci.mxlint.checkers.host_sync import HostSyncChecker  # noqa: E402
+from ci.mxlint.checkers.registry_parity import RegistryParityChecker  # noqa: E402
+from ci.mxlint.checkers.signal_safety import SignalSafetyChecker  # noqa: E402
+from ci.mxlint.checkers.bare_print import BarePrintChecker  # noqa: E402
+
+
+def _tree(tmp_path, files):
+    for rel, content in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(content))
+    return Repo(str(tmp_path))
+
+
+def _findings(checker, repo):
+    return list(checker.run(repo))
+
+
+def _lines(findings):
+    return sorted((f.path, f.line) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+def test_host_sync_positive_roots_and_propagation(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/ops/myops.py": """\
+        import functools
+        import jax
+        import numpy as _np
+        from . import register
+
+        @register("badop")
+        def badop(x, axis=0):
+            return float(x)            # line 8: cast of array param
+
+        @jax.jit
+        def jitted(x):
+            return x.asnumpy()         # line 12: asnumpy under jit
+
+        def helper(y):
+            return y.asnumpy()         # line 15: traced via caller
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def outer(x, n):
+            return helper(x)
+
+        def fwd(x):
+            return _np.asarray(x)      # line 22: traced via defvjp
+
+        def bwd(res, g):
+            return (g,)
+
+        @jax.custom_vjp
+        def diffop(x):
+            return x
+        diffop.defvjp(fwd, bwd)
+        """})
+    got = _lines(_findings(HostSyncChecker(), repo))
+    assert ("mxnet_tpu/ops/myops.py", 8) in got
+    assert ("mxnet_tpu/ops/myops.py", 12) in got
+    assert ("mxnet_tpu/ops/myops.py", 15) in got
+    assert ("mxnet_tpu/ops/myops.py", 22) in got
+
+
+def test_host_sync_negative(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/ops/okops.py": """\
+        import jax
+        import numpy as _np
+        from . import register
+
+        @register("hostop", host=True)
+        def hostop(csr):
+            return csr.asnumpy()       # host op: eager by design
+
+        @register("okop")
+        def okop(x, axis=0, k=1):
+            pad = _np.asarray(-_np.inf, x.dtype)  # static constant
+            return x + int(axis) + int(k)         # attr coercions
+
+        def eager_helper(arr):
+            return arr.asnumpy()       # never traced: no jit root calls it
+        """})
+    assert _findings(HostSyncChecker(), repo) == []
+
+
+def test_host_sync_pallas_kernel_body(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/ops/pk.py": """\
+        import jax.experimental.pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...].asnumpy()  # line 4
+
+        def launch(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """})
+    got = _lines(_findings(HostSyncChecker(), repo))
+    assert ("mxnet_tpu/ops/pk.py", 4) in got
+
+
+# ---------------------------------------------------------------------------
+# signal-safety
+# ---------------------------------------------------------------------------
+
+_CORE_OK = """\
+    def snapshot():
+        return {}
+
+    def rank():
+        import os
+        return 0
+"""
+
+
+def test_signal_safety_positive(tmp_path):
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/telemetry/core.py": _CORE_OK,
+        "mxnet_tpu/telemetry/recorder.py": """\
+        import logging
+        import threading
+        from . import core
+
+        _lock = threading.Lock()
+
+        def dump(reason):
+            logging.getLogger("x").warning("dumping")   # line 8
+            with _lock:                                 # line 9
+                pass
+            t = threading.Thread(target=dump)           # line 11
+            core.snapshot()
+            unknowable()                                # line 13
+
+        def _on_sigusr1(signum, frame):
+            dump("sig")
+        """})
+    got = _lines(_findings(SignalSafetyChecker(), repo))
+    for line in (8, 9, 11, 13):
+        assert ("mxnet_tpu/telemetry/recorder.py", line) in got, got
+
+
+def test_signal_safety_computed_receiver_and_subscripted_lock(tmp_path):
+    """Regression: a lock reached through a computed receiver
+    (`self._locks[i].acquire()`, `with _LOCKS[0]:`) must still be flagged —
+    dotted-name resolution alone cannot see it."""
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/telemetry/core.py": _CORE_OK,
+        "mxnet_tpu/telemetry/recorder.py": """\
+        from . import core
+
+        _LOCKS = [None]
+
+        def dump(reason):
+            _LOCKS[0].acquire()        # line 6: computed receiver
+            with _LOCKS[0]:            # line 7: subscripted lock
+                pass
+
+        def _on_sigusr1(signum, frame):
+            dump("sig")
+        """})
+    got = _lines(_findings(SignalSafetyChecker(), repo))
+    assert ("mxnet_tpu/telemetry/recorder.py", 6) in got, got
+    assert ("mxnet_tpu/telemetry/recorder.py", 7) in got, got
+
+
+def test_signal_safety_negative_and_pragma(tmp_path):
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/telemetry/core.py": _CORE_OK,
+        "mxnet_tpu/telemetry/recorder.py": """\
+        import json
+        import os
+        import sys
+        import threading
+        import time
+        from . import core
+
+        def _stacks():
+            return [t.name for t in threading.enumerate()]
+
+        def dump(reason):
+            payload = {"r": reason, "s": _stacks(), "m": core.snapshot(),
+                       "t": time.time(), "rank": core.rank()}
+            with open(os.path.join("/tmp", "d.json"), "w") as f:
+                json.dump(payload, f)
+            sys.stderr.write("dumped\\n")
+            cb = getattr(dump, "_cb", None)
+            if callable(cb):
+                cb(reason)  # mxlint: disable=signal-safety
+
+        def _on_sigusr1(signum, frame):
+            dump("sig")
+        """})
+    findings = _findings(SignalSafetyChecker(), repo)
+    kept, by_pragma, _ = run_checkers(repo, [SignalSafetyChecker()])
+    assert kept == [] and len(by_pragma) == 1, _lines(findings)
+
+
+# ---------------------------------------------------------------------------
+# env-registry
+# ---------------------------------------------------------------------------
+
+_ENV_PY = """\
+    _REGISTRY = {}
+
+    def _var(name, vtype, default, doc):
+        _REGISTRY[name] = (vtype, default, doc)
+
+    _var("MXTPU_KNOWN", "str", None, "a documented knob")
+    _var("MXTPU_ORPHAN", "int", 3, "registered but undocumented")
+"""
+
+_DOCS_MD = """\
+    # Environment variables
+
+    ## Framework (`MXTPU_*`)
+
+    | Variable | Default | Effect |
+    |---|---|---|
+    | `MXTPU_KNOWN` | unset | a documented knob |
+    | `MXTPU_GHOST` | `1` | documented but not registered |
+
+    ## Other
+"""
+
+
+def test_env_registry_all_directions(tmp_path):
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/env.py": _ENV_PY,
+        "docs/env_vars.md": _DOCS_MD,
+        "mxnet_tpu/lib.py": """\
+        import os
+        from . import env as _env
+
+        raw = os.environ.get("MXTPU_RAW_READ")        # line 4: raw read
+        sub = os.environ["MXTPU_SUB_READ"]            # line 5: raw read
+        ok = _env.get("MXTPU_KNOWN")                  # fine
+        bad = _env.get("MXTPU_UNDECLARED")            # line 7: unregistered
+        os.environ["MXTPU_WRITE_OK"] = "1"            # writes are fine
+        """,
+        "tools/probe.py": """\
+        import os
+        x = os.environ.get("MXTPU_TOOL_ONLY")         # line 2: unregistered
+        y = os.environ.get("MXTPU_KNOWN", "d")        # registered: fine
+        """,
+        "bench.py": "import os\nz = os.environ.get('MXTPU_KNOWN')\n",
+    })
+    findings = _findings(EnvRegistryChecker(), repo)
+    got = _lines(findings)
+    assert ("mxnet_tpu/lib.py", 4) in got
+    assert ("mxnet_tpu/lib.py", 5) in got
+    assert ("mxnet_tpu/lib.py", 7) in got
+    assert ("tools/probe.py", 2) in got
+    messages = "\n".join(f.message for f in findings)
+    assert "MXTPU_ORPHAN" in messages      # registered, undocumented
+    assert "MXTPU_GHOST" in messages       # documented, unregistered
+    assert "MXTPU_WRITE_OK" not in messages
+    assert len(findings) == 6, got
+
+
+def test_env_registry_clean_tree(tmp_path):
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/env.py": _ENV_PY.replace(
+            '_var("MXTPU_ORPHAN", "int", 3, "registered but undocumented")',
+            ""),
+        "docs/env_vars.md": _DOCS_MD.replace(
+            "| `MXTPU_GHOST` | `1` | documented but not registered |\n", ""),
+        "mxnet_tpu/lib.py":
+            "from . import env as _env\nv = _env.raw('MXTPU_KNOWN')\n",
+    })
+    assert _findings(EnvRegistryChecker(), repo) == []
+
+
+# ---------------------------------------------------------------------------
+# registry-parity
+# ---------------------------------------------------------------------------
+
+_OPS_PY = """\
+    from . import register
+
+    @register("Convolution", aliases=("conv2d",))
+    def convolution(data, weight, bias=None, kernel=()):
+        return data
+
+    register("identity", aliases=("_copy",))(lambda data: data)
+"""
+
+
+def test_registry_parity_stale_table_and_unwired_vjp(tmp_path):
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/ops/nn.py": _OPS_PY,
+        "mxnet_tpu/symbol/register.py": """\
+        _INPUT_SLOTS = {
+            "Convolution": (["data", "weight", "bias"], []),
+            "Deconvolution": (["data", "weight"], []),
+        }
+        _SHAPE_TRANSPARENT = {"identity", "_copy", "amp_cast"}
+        _OPTIONAL_DROP = {}
+        _ARG_SHAPE_RULES = {"conv2d": None}
+
+        def populate(d):
+            for name in ("Convolution",):
+                if name.startswith("_contrib_"):
+                    pass
+            d["contrib"] = 1
+        """,
+        "mxnet_tpu/ndarray/register.py": """\
+        def populate(d):
+            for name in ("Convolution",):
+                if name.startswith("_contrib_"):
+                    pass
+                if name.startswith("_linalg_"):
+                    pass
+            d["contrib"] = 1
+            d["linalg"] = 1
+        """,
+        "mxnet_tpu/ops/vjp.py": """\
+        import functools
+        import jax
+
+        @jax.custom_vjp
+        def wired(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(res, g):
+            return (g,)
+        wired.defvjp(fwd, bwd)
+
+        @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+        def unwired(x, n):
+            return x
+        """})
+    findings = _findings(RegistryParityChecker(), repo)
+    messages = "\n".join(f.message for f in findings)
+    assert "Deconvolution" in messages           # stale _INPUT_SLOTS key
+    assert "amp_cast" in messages                # stale transparent entry
+    assert "'_linalg_'" in messages              # prefix routed nd-only
+    assert "'linalg'" in messages                # namespace nd-only
+    assert "`unwired`" in messages and "defvjp" in messages
+    assert "wired`" not in messages.replace("`unwired`", "")
+    assert "identity" not in messages            # call-form registration seen
+    assert "conv2d" not in messages              # alias resolved
+
+
+# ---------------------------------------------------------------------------
+# bare-print (ported lint_print) + old CLI regression
+# ---------------------------------------------------------------------------
+
+_PRINTY = """\
+    x = 1
+    print("no")
+    y = 2  # print("in comment") is fine
+    s = "print(also fine)"
+    pprint(1)
+    obj.print(2)
+    print("ok")  # allow-print
+"""
+
+
+def test_bare_print_checker_semantics(tmp_path):
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/bad.py": _PRINTY,
+        "mxnet_tpu/notebook/show.py": "print('notebook display ok')\n",
+        "mxnet_tpu/test_utils.py": "print('harness ok')\n",
+    })
+    got = _lines(_findings(BarePrintChecker(), repo))
+    assert got == [("mxnet_tpu/bad.py", 2)]
+
+
+def test_lint_print_old_cli_still_catches(tmp_path):
+    """Satellite regression: the standalone ci/lint_print.py CLI (pre-mxlint
+    interface, used by external scripts) still exits nonzero on a bare
+    print and 0 on a clean tree."""
+    bad = tmp_path / "mxnet_tpu"
+    bad.mkdir()
+    (bad / "bad.py").write_text(textwrap.dedent(_PRINTY))
+    lint = os.path.join(ROOT, "ci", "lint_print.py")
+    r = subprocess.run([sys.executable, lint, str(tmp_path)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1 and "bad.py:2" in r.stdout, r.stdout
+    (bad / "bad.py").write_text("x = 1\n")
+    r = subprocess.run([sys.executable, lint, str(tmp_path)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# runner: pragmas, baseline, CLI
+# ---------------------------------------------------------------------------
+
+def test_pragma_suppresses_only_named_rule(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/p.py": """\
+        import os
+        a = os.environ.get("MXTPU_X")  # mxlint: disable=env-registry
+        b = os.environ.get("MXTPU_Y")  # mxlint: disable=host-sync
+        """,
+        "mxnet_tpu/env.py": "def _var(n, t, d, doc):\n    pass\n"
+                            "_var('MXTPU_Q', 'str', None, 'q')\n",
+        "docs/env_vars.md": "## Framework (`MXTPU_*`)\n\n"
+                            "| Variable | Default | Effect |\n|---|---|---|\n"
+                            "| `MXTPU_Q` | unset | q |\n"})
+    kept, by_pragma, _ = run_checkers(repo, [EnvRegistryChecker()])
+    assert [(f.path, f.line) for f in kept] == [("mxnet_tpu/p.py", 3)]
+    assert len(by_pragma) == 1
+
+
+def test_baseline_grandfathers_and_expires_on_edit(tmp_path):
+    files = {
+        "mxnet_tpu/env.py": "def _var(n, t, d, doc):\n    pass\n"
+                            "_var('MXTPU_Q', 'str', None, 'q')\n",
+        "docs/env_vars.md": "## Framework (`MXTPU_*`)\n\n"
+                            "| Variable | Default | Effect |\n|---|---|---|\n"
+                            "| `MXTPU_Q` | unset | q |\n",
+        "mxnet_tpu/old.py": "import os\nv = os.environ.get('MXTPU_LEGACY')\n",
+    }
+    repo = _tree(tmp_path, files)
+    checker = EnvRegistryChecker()
+    (kept, _, _) = run_checkers(repo, [checker])
+    assert len(kept) == 1
+    baseline_file = tmp_path / "baseline.txt"
+    baseline_file.write_text(kept[0].key(repo) + "\n")
+    baseline = load_baseline(str(baseline_file))
+    kept2, _, by_baseline = run_checkers(repo, [checker], baseline)
+    assert kept2 == [] and len(by_baseline) == 1
+    # editing the flagged line invalidates its grandfathering
+    (tmp_path / "mxnet_tpu/old.py").write_text(
+        "import os\nv = os.environ.get('MXTPU_LEGACY2')\n")
+    repo2 = Repo(str(tmp_path))
+    kept3, _, by3 = run_checkers(repo2, [checker], baseline)
+    assert len(kept3) == 1 and by3 == []
+
+
+def test_update_baseline_with_rule_keeps_other_rules(tmp_path):
+    """Regression: `--rule X --update-baseline` must not discard other
+    rules' grandfathered entries."""
+    _tree(tmp_path, {
+        "mxnet_tpu/env.py": "def _var(n, t, d, doc):\n    pass\n"
+                            "_var('MXTPU_Q', 'str', None, 'q')\n",
+        "docs/env_vars.md": "## Framework (`MXTPU_*`)\n\n"
+                            "| Variable | Default | Effect |\n|---|---|---|\n"
+                            "| `MXTPU_Q` | unset | q |\n",
+        "mxnet_tpu/v.py": "import os\nv = os.environ.get('MXTPU_V')\n",
+    })
+    base = tmp_path / "b.txt"
+    base.write_text("host-sync\tmxnet_tpu/other.py\tx.asnumpy()\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "ci.mxlint", "--root", str(tmp_path),
+         "--rule", "env-registry", "--baseline", str(base),
+         "--update-baseline"],
+        capture_output=True, text=True, cwd=ROOT, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    content = base.read_text()
+    assert "host-sync\tmxnet_tpu/other.py" in content, content  # preserved
+    assert "env-registry\tmxnet_tpu/v.py" in content, content   # added
+
+
+@pytest.mark.parametrize("args,expect_rc", [
+    (["--list-rules"], 0),
+    (["--rule", "definitely-not-a-rule"], 2),
+])
+def test_cli_modes(args, expect_rc):
+    r = subprocess.run([sys.executable, "-m", "ci.mxlint"] + args,
+                       capture_output=True, text=True, cwd=ROOT, timeout=240)
+    assert r.returncode == expect_rc, r.stdout + r.stderr
+    if expect_rc == 0:
+        for rule in ("host-sync", "signal-safety", "env-registry",
+                     "registry-parity", "bare-print"):
+            assert rule in r.stdout
+
+
+def test_cli_nonzero_on_violation_and_update_baseline(tmp_path):
+    _tree(tmp_path, {
+        "mxnet_tpu/env.py": "def _var(n, t, d, doc):\n    pass\n"
+                            "_var('MXTPU_Q', 'str', None, 'q')\n",
+        "docs/env_vars.md": "## Framework (`MXTPU_*`)\n\n"
+                            "| Variable | Default | Effect |\n|---|---|---|\n"
+                            "| `MXTPU_Q` | unset | q |\n",
+        "mxnet_tpu/v.py": "import os\nv = os.environ.get('MXTPU_V')\n",
+    })
+    base = str(tmp_path / "b.txt")
+    cmd = [sys.executable, "-m", "ci.mxlint", "--root", str(tmp_path),
+           "--rule", "env-registry", "--baseline", base]
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=ROOT,
+                       timeout=240)
+    assert r.returncode == 1 and "MXTPU_V" in r.stdout, r.stdout
+    r = subprocess.run(cmd + ["--update-baseline"], capture_output=True,
+                       text=True, cwd=ROOT, timeout=240)
+    assert r.returncode == 0, r.stdout
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=ROOT,
+                       timeout=240)
+    assert r.returncode == 0 and "1 baselined" in r.stdout, r.stdout
+
+
+# ---------------------------------------------------------------------------
+# the typed env registry itself
+# ---------------------------------------------------------------------------
+
+def test_env_module_typed_accessors(monkeypatch):
+    from mxnet_tpu import env
+
+    monkeypatch.delenv("MXTPU_FLIGHTREC_EVENTS", raising=False)
+    assert env.get("MXTPU_FLIGHTREC_EVENTS") == 512
+    monkeypatch.setenv("MXTPU_FLIGHTREC_EVENTS", "64")
+    assert env.get("MXTPU_FLIGHTREC_EVENTS") == 64
+    monkeypatch.setenv("MXTPU_FLIGHTREC_EVENTS", "junk")
+    assert env.get("MXTPU_FLIGHTREC_EVENTS") == 512  # malformed -> default
+    monkeypatch.setenv("MXTPU_TELEMETRY", "off")
+    assert env.get("MXTPU_TELEMETRY") is False
+    monkeypatch.setenv("MXTPU_TELEMETRY", "1")
+    assert env.get("MXTPU_TELEMETRY") is True
+    assert env.raw("MXTPU_TELEMETRY") == "1"
+    monkeypatch.setenv("MXTPU_CKPT_DIR", "")
+    assert not env.is_set("MXTPU_CKPT_DIR")
+    with pytest.raises(KeyError):
+        env.get("MXTPU_NOT_REGISTERED")
+    with pytest.raises(KeyError):
+        env.raw("MXTPU_NOT_REGISTERED")
+    assert env.get("MXTPU_PROBE_ITERS", default=400) == 400  # per-site dflt
+    table = env.markdown_table()
+    assert table.splitlines()[0] == "| Variable | Default | Effect |"
+    assert all("| `MXTPU_" in line for line in table.splitlines()[2:])
+
+
+def test_env_registry_covers_every_checker_rule():
+    """Meta: the shipped checker set is exactly the documented five."""
+    assert sorted(c.rule for c in CHECKERS) == [
+        "bare-print", "env-registry", "host-sync", "registry-parity",
+        "signal-safety"]
