@@ -41,7 +41,7 @@ _SUBMODULES = [
     "model", "profiler", "runtime", "test_utils", "visualization", "monitor",
     "parallel", "attribute", "name", "operator", "contrib", "rtc",
     "torch_bridge", "registry", "log", "libinfo", "util",
-    "kvstore_server", "executor_manager", "rnn",
+    "kvstore_server", "executor_manager", "rnn", "serving",
     # legacy-name shims (reference top-level module map)
     "misc", "ndarray_doc", "symbol_doc", "torch",
 ]

@@ -15,7 +15,7 @@ import tempfile
 import numpy as _np
 
 __all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
-           "atomic_writer"]
+           "atomic_writer", "unpad_outputs"]
 
 # Host-array mode: when True, host-side pipeline stages (image decode,
 # dataset __getitem__) hand back plain numpy instead of NDArray. Set in
@@ -156,6 +156,23 @@ def _fsync_dir(path):
         pass
     finally:
         os.close(fd)
+
+
+def unpad_outputs(outputs, pad, copy=False):
+    """Drop the trailing ``pad`` rows from every array in ``outputs``.
+
+    The shared unpad for every padded-batch consumer: a DataIter's last
+    batch carries ``pad`` filler rows (module predict/iter_predict), and the
+    serving micro-batcher pads coalesced batches up to a power-of-two bucket
+    (serving/batcher.py). Works on anything row-sliceable (NDArray, numpy).
+    ``copy=True`` detaches each slice from the padded buffer (callers that
+    retain results past the next forward need it).
+    """
+    out = []
+    for o in outputs:
+        s = o[0:o.shape[0] - pad] if pad else o
+        out.append(s.copy() if copy else s)
+    return out
 
 
 string_types = (str,)

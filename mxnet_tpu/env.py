@@ -338,6 +338,29 @@ _var("MXTPU_CKPT_DIR", "str", None,
      "default checkpoint directory for the `corrupt_ckpt` injection "
      "action (tests' resilience workers also read it).")
 
+# -- serving ----------------------------------------------------------------
+_var("MXTPU_SERVE_MAX_BATCH", "int", 32,
+     "serving (`mxnet_tpu.serving`): maximum examples coalesced into one "
+     "inference batch; also the terminal padding bucket (buckets are the "
+     "powers of two up to this value — docs/serving.md).")
+_var("MXTPU_SERVE_MAX_DELAY_MS", "float", 5.0,
+     "serving: longest the micro-batcher holds an admitted request open "
+     "waiting for coalescing partners before dispatching a partial batch.")
+_var("MXTPU_SERVE_QUEUE_DEPTH", "int", 256,
+     "serving admission control: bounded per-model request queue; a "
+     "submit beyond this depth is rejected immediately (HTTP 429).")
+_var("MXTPU_SERVE_TIMEOUT_MS", "float", 2000.0,
+     "serving: default per-request deadline (queue wait + compute); an "
+     "expired request is dropped and answered HTTP 504. A request body "
+     "may override it via its `timeout_ms` field.")
+_var("MXTPU_SERVE_PORT", "int", 8500,
+     "serving: default HTTP port for `tools/serve.py` / `ServingServer` "
+     "(0 binds a free port — tests and serve_bench).")
+_var("MXTPU_SERVE_DRAIN_TIMEOUT_S", "float", 30.0,
+     "serving: graceful-shutdown budget — how long SIGTERM/`/drainz` "
+     "waits for queued + in-flight requests to finish before the server "
+     "stops (docs/serving.md drain semantics).")
+
 # -- telemetry / flight recorder --------------------------------------------
 _var("MXTPU_TELEMETRY", "bool", True,
      "master switch for the always-on metrics/flight-recorder layer "

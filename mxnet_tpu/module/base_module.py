@@ -12,7 +12,7 @@ import time
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, unpad_outputs
 from .. import metric as metric_mod
 from .. import io as io_mod
 from .. import ndarray as nd
@@ -105,7 +105,7 @@ class BaseModule(object):
                 break
             self.forward(eval_batch, is_train=False)
             pad = getattr(eval_batch, "pad", 0) or 0
-            outs = [o[0:o.shape[0] - pad].copy() for o in self.get_outputs()]
+            outs = unpad_outputs(self.get_outputs(), pad, copy=True)
             output_list.append(outs)
         if not output_list:
             return output_list
@@ -131,7 +131,7 @@ class BaseModule(object):
                 break
             self.forward(eval_batch, is_train=False)
             pad = getattr(eval_batch, "pad", 0) or 0
-            outs = self.get_outputs()
+            outs = unpad_outputs(self.get_outputs(), pad)
             yield outs, nbatch, eval_batch
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",

@@ -12,6 +12,8 @@ across calls (the predict API's raison d'être: cheap repeated forward).
 """
 from __future__ import annotations
 
+import os as _os
+
 import numpy as _np
 
 from . import ndarray as nd
@@ -263,8 +265,10 @@ class CompiledPredictor:
         import jax.export
 
         raw = path_or_bytes
-        if isinstance(raw, str):
-            with open(raw, "rb") as f:
+        if isinstance(raw, (str, _os.PathLike)):
+            # os.fspath: pathlib.Path artifacts load like str paths instead
+            # of falling through to the bad-magic branch below
+            with open(_os.fspath(raw), "rb") as f:
                 raw = f.read()
         if not raw.startswith(_MXC_MAGIC):
             raise MXNetError("not a compiled predictor artifact (bad magic)")

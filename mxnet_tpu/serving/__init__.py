@@ -1,0 +1,37 @@
+"""mxnet_tpu.serving — dynamic-batching inference serving.
+
+The serving layer that turns the single-request predict API
+(`mxnet_tpu.predict`, the c_predict_api rebuild) into sustained
+high-occupancy inference (docs/serving.md):
+
+  * `DynamicBatcher` — coalesces concurrent requests into padded,
+    power-of-two-bucketed batches so every bucket hits one cached XLA
+    executable (batcher.py);
+  * `ModelRepository` / `ServedModel` — versioned multi-model registry
+    over export prefixes and compiled ``.mxc`` artifacts, bucket warmup
+    at load, hot load/unload with in-flight draining
+    (model_repository.py);
+  * `ServingServer` — stdlib `ThreadingHTTPServer` frontend with
+    deterministic admission control: 429 on queue overflow, 504 on
+    deadline expiry, graceful SIGTERM drain (server.py).
+
+Launch with ``python tools/serve.py``; load-test with
+``python tools/serve_bench.py``. All knobs are typed ``MXTPU_SERVE_*``
+variables in `mxnet_tpu.env` (docs/env_vars.md).
+"""
+from __future__ import annotations
+
+from .batcher import (  # noqa: F401
+    DeadlineExceededError, DrainingError, DynamicBatcher,
+    ModelUnavailableError, QueueFullError, ServeRequest, ServingError,
+    bucket_for, power_of_two_buckets,
+)
+from .model_repository import ModelRepository, ServedModel  # noqa: F401
+from .server import ServingServer  # noqa: F401
+
+__all__ = [
+    "DynamicBatcher", "ServeRequest", "ModelRepository", "ServedModel",
+    "ServingServer", "ServingError", "QueueFullError",
+    "DeadlineExceededError", "ModelUnavailableError", "DrainingError",
+    "power_of_two_buckets", "bucket_for",
+]

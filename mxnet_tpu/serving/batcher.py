@@ -1,0 +1,413 @@
+"""Dynamic micro-batcher: the request queue at the heart of the serving
+subsystem (docs/serving.md).
+
+The predict API (`predict.py`) was built for the one-caller-one-forward
+case; between requests the accelerator idles. This module closes that gap
+with the request/micro-batch design of clipper/triton-style model servers:
+
+  * concurrent requests land in a bounded queue (admission control:
+    ``MXTPU_SERVE_QUEUE_DEPTH``, overflow is rejected immediately — the
+    HTTP layer maps that to 429);
+  * a single worker thread coalesces them into one batch, closing it when
+    it reaches ``MXTPU_SERVE_MAX_BATCH`` examples or when the oldest
+    admitted request has waited ``MXTPU_SERVE_MAX_DELAY_MS``;
+  * the batch is padded up to a POWER-OF-TWO bucket so every bucket maps
+    to exactly one cached XLA executable (the Executor caches one
+    compiled forward per input signature) — steady state never
+    recompiles, whatever batch sizes arrive;
+  * results are unpadded and split back per request (the shared
+    `base.unpad_outputs` helper — same code path as module predict's
+    last-batch unpad).
+
+One worker thread per batcher means the underlying predictor is only ever
+driven single-threaded — executor forward needs no locking — while any
+number of frontend threads block cheaply on their request's event.
+
+Everything here is framework-agnostic: the ``runner`` callable owns the
+model; numpy in, numpy out.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as _np
+
+from .. import env as _env
+from .. import telemetry
+from ..base import MXNetError, unpad_outputs
+
+__all__ = [
+    "ServingError", "QueueFullError", "DeadlineExceededError",
+    "ModelUnavailableError", "DrainingError", "power_of_two_buckets",
+    "bucket_for", "DynamicBatcher",
+]
+
+
+class ServingError(MXNetError):
+    """Base serving-layer error; `status` is the HTTP mapping."""
+
+    status = 500
+
+
+class QueueFullError(ServingError):
+    """Admission control: the bounded request queue is full."""
+
+    status = 429
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired before a result was produced."""
+
+    status = 504
+
+
+class ModelUnavailableError(ServingError):
+    """No such model/version (or it has been unloaded)."""
+
+    status = 404
+
+
+class DrainingError(ServingError):
+    """The server/model is draining and admits no new work."""
+
+    status = 503
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+def power_of_two_buckets(max_batch):
+    """The padding buckets for a given max batch: every power of two below
+    ``max_batch``, plus ``max_batch`` itself as the terminal bucket (so a
+    non-power-of-two max still gets exactly one executable)."""
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise MXNetError("max_batch must be >= 1, got %d" % max_batch)
+    buckets, b = [], 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return buckets
+
+
+def bucket_for(n, buckets):
+    """Smallest bucket holding ``n`` examples (None when n overflows)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+# ---------------------------------------------------------------------------
+# requests
+# ---------------------------------------------------------------------------
+
+class ServeRequest:
+    """One admitted inference request: ``arrays`` is a dict of input name ->
+    numpy array whose leading dim is this request's example count."""
+
+    __slots__ = ("arrays", "n", "deadline", "outputs", "error", "bucket",
+                 "_event", "_t_submit", "queue_seconds", "compute_seconds")
+
+    def __init__(self, arrays, n, deadline):
+        self.arrays = arrays
+        self.n = n
+        self.deadline = deadline
+        self.outputs = None
+        self.error = None
+        self.bucket = None
+        self.queue_seconds = None
+        self.compute_seconds = None
+        self._event = threading.Event()
+        self._t_submit = time.monotonic()
+
+    def done(self):
+        return self._event.is_set()
+
+    def wait(self, timeout=None):
+        """Block until the batcher resolves this request (or the wait times
+        out / the deadline passes). Returns the per-request output list or
+        raises the ServingError the batcher recorded."""
+        self._event.wait(timeout)
+        if not self._event.is_set():
+            raise DeadlineExceededError(
+                "request expired after %.0f ms in queue"
+                % ((time.monotonic() - self._t_submit) * 1e3))
+        if self.error is not None:
+            raise self.error
+        return self.outputs
+
+    def _resolve(self, outputs=None, error=None):
+        if self._event.is_set():
+            return  # first resolution wins (a late error must not clobber
+            #         a result a waiter may already be reading)
+        self.outputs = outputs
+        self.error = error
+        self._event.set()
+
+
+# ---------------------------------------------------------------------------
+# the batcher
+# ---------------------------------------------------------------------------
+
+class DynamicBatcher:
+    """Coalesce concurrent requests into padded, bucketed batches.
+
+    Parameters
+    ----------
+    runner : callable(batch_arrays, bucket, n) -> list of numpy arrays
+        Runs one padded batch (leading dim == bucket) and returns the model
+        outputs, each with leading dim == bucket. Called only from the
+        batcher's single worker thread.
+    buckets : list of int
+        Ascending padding buckets; the last is the max coalesced batch.
+    max_delay_ms / queue_depth : admission + coalescing knobs
+        Default to ``MXTPU_SERVE_MAX_DELAY_MS`` / ``MXTPU_SERVE_QUEUE_DEPTH``.
+    name : str
+        Telemetry label (``model="<name>"`` on every serving metric).
+    """
+
+    def __init__(self, runner, buckets, max_delay_ms=None, queue_depth=None,
+                 name="default"):
+        self._runner = runner
+        self.buckets = sorted(int(b) for b in buckets)
+        if not self.buckets:
+            raise MXNetError("need at least one bucket")
+        self.max_batch = self.buckets[-1]
+        if max_delay_ms is None:
+            max_delay_ms = _env.get("MXTPU_SERVE_MAX_DELAY_MS")
+        if queue_depth is None:
+            queue_depth = _env.get("MXTPU_SERVE_QUEUE_DEPTH")
+        self.max_delay_s = max(0.0, float(max_delay_ms)) / 1e3
+        self.queue_depth = max(1, int(queue_depth))
+        self.name = name
+
+        self._queue = collections.deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._draining = False
+        self._inflight = 0          # requests popped but not yet resolved
+
+        labels = {"model": name}
+        self._m_queue = telemetry.gauge("mxtpu_serve_queue_depth", labels)
+        self._m_reqs = telemetry.counter("mxtpu_serve_requests_total", labels)
+        self._m_examples = telemetry.counter("mxtpu_serve_examples_total",
+                                             labels)
+        self._m_batches = telemetry.counter("mxtpu_serve_batches_total",
+                                            labels)
+        self._m_rej_full = telemetry.counter(
+            "mxtpu_serve_rejected_total", {"model": name, "reason": "queue_full"})
+        self._m_rej_dead = telemetry.counter(
+            "mxtpu_serve_rejected_total", {"model": name, "reason": "deadline"})
+        # how full each dispatched bucket was (n / bucket): the occupancy
+        # evidence serve_bench reports
+        self._m_occupancy = telemetry.histogram(
+            "mxtpu_serve_batch_occupancy", labels,
+            bounds=tuple(i / 10.0 for i in range(1, 11)))
+        self._m_batch_size = telemetry.histogram(
+            "mxtpu_serve_batch_size", labels,
+            bounds=tuple(float(b) for b in self.buckets))
+        # queue-wait vs compute split per request — the first thing to read
+        # when serving latency is off (is it admission or the model?)
+        self._m_queue_s = telemetry.histogram("mxtpu_serve_queue_seconds",
+                                              labels)
+        self._m_compute_s = telemetry.histogram("mxtpu_serve_compute_seconds",
+                                                labels)
+
+        self._worker = threading.Thread(
+            target=self._loop, name="mxtpu-serve-batcher-%s" % name,
+            daemon=True)
+        self._worker.start()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, arrays, deadline=None):
+        """Admit one request. ``arrays``: dict name -> numpy array, leading
+        dim = example count (1..max_batch). Returns a `ServeRequest` whose
+        ``wait()`` yields the unpadded per-request outputs."""
+        ns = {int(a.shape[0]) for a in arrays.values()}
+        if not ns:
+            raise MXNetError("request carries no input arrays")
+        if len(ns) != 1:
+            raise MXNetError("inconsistent leading dims across inputs: %s"
+                             % sorted(ns))
+        n = ns.pop()
+        if n < 1 or n > self.max_batch:
+            raise MXNetError(
+                "request carries %d examples; this model serves 1..%d per "
+                "request (MXTPU_SERVE_MAX_BATCH)" % (n, self.max_batch))
+        req = ServeRequest(arrays, n, deadline)
+        with self._cv:
+            if self._stop or self._draining:
+                raise DrainingError("model %r is draining" % self.name)
+            if len(self._queue) >= self.queue_depth:
+                self._m_rej_full.inc()
+                raise QueueFullError(
+                    "queue for model %r is full (%d requests; "
+                    "MXTPU_SERVE_QUEUE_DEPTH)" % (self.name, self.queue_depth))
+            self._queue.append(req)
+            self._m_queue.set(len(self._queue))
+            self._m_reqs.inc()
+            self._cv.notify()
+        return req
+
+    def pending(self):
+        """Queued + in-flight request count (drain progress)."""
+        with self._cv:
+            return len(self._queue) + self._inflight
+
+    # -- shutdown ----------------------------------------------------------
+    def drain(self, timeout=None):
+        """Stop admitting, let the worker finish everything queued, and wait
+        up to ``timeout`` seconds (default `MXTPU_SERVE_DRAIN_TIMEOUT_S` —
+        a wedged model must not hang shutdown forever). Returns True when
+        fully drained."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        if timeout is None:
+            timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_S")
+        deadline = time.monotonic() + timeout
+        while self.pending():
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+    def close(self, drain=True, timeout=None):
+        """Drain (optionally) then stop the worker thread."""
+        drained = self.drain(timeout) if drain else False
+        with self._cv:
+            self._stop = True
+            self._draining = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5.0)
+        # anything still queued after a failed/skipped drain gets an answer
+        with self._cv:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._m_queue.set(0)
+        for req in leftovers:
+            req._resolve(error=DrainingError(
+                "model %r shut down before this request ran" % self.name))
+        return drained
+
+    # -- the worker --------------------------------------------------------
+    def _pop_live(self, max_n=None):
+        """Pop the next request that is still live (expired ones are
+        resolved 504 on the spot) AND fits within ``max_n`` examples — the
+        fit check must be applied to the request actually popped, not the
+        pre-expiry queue head. Returns None when the queue is empty or the
+        next live request would overflow. Caller holds _cv."""
+        now = time.monotonic()
+        while self._queue:
+            req = self._queue[0]
+            if req.deadline is not None and now >= req.deadline:
+                self._queue.popleft()
+                self._m_queue.set(len(self._queue))
+                self._m_rej_dead.inc()
+                req._resolve(error=DeadlineExceededError(
+                    "deadline expired after %.0f ms in queue"
+                    % ((now - req._t_submit) * 1e3)))
+                continue
+            if max_n is not None and req.n > max_n:
+                return None  # stays queued for the next batch
+            self._queue.popleft()
+            self._m_queue.set(len(self._queue))
+            self._inflight += 1
+            return req
+        return None
+
+    def _loop(self):
+        while True:
+            batch = []
+            total = 0
+            with self._cv:
+                while not self._queue:
+                    if self._stop:
+                        return
+                    self._cv.wait(0.05)
+                first = self._pop_live()
+            if first is None:
+                continue
+            batch.append(first)
+            total = first.n
+            close_at = time.monotonic() + self.max_delay_s
+            # coalesce until the bucket ceiling or the delay window closes;
+            # when draining, take whatever is queued without waiting
+            while total < self.max_batch:
+                with self._cv:
+                    req = self._pop_live(self.max_batch - total)
+                    if req is None:
+                        if self._queue:
+                            break  # live head would overflow: next batch's
+                        if self._draining or self._stop:
+                            break
+                        remaining = close_at - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(min(remaining, 0.05))
+                        continue
+                batch.append(req)
+                total += req.n
+            try:
+                self._dispatch(batch, total)
+            except Exception as e:  # the lone worker must NEVER die
+                telemetry.record_event("serve_batcher_error",
+                                       model=self.name, error=repr(e))
+                err = ServingError("batcher for %r failed: %r"
+                                   % (self.name, e))
+                for req in batch:
+                    req._resolve(error=err)
+
+    def _dispatch(self, batch, total):
+        t0 = time.monotonic()
+        bucket = bucket_for(total, self.buckets)
+        try:
+            names = batch[0].arrays.keys()
+            padded = {}
+            for name in names:
+                parts = [r.arrays[name] for r in batch]
+                a = parts[0] if len(parts) == 1 else _np.concatenate(parts)
+                if a.shape[0] < bucket:
+                    pad = _np.zeros((bucket - a.shape[0],) + a.shape[1:],
+                                    dtype=a.dtype)
+                    a = _np.concatenate([a, pad])
+                padded[name] = a
+            outs = self._runner(padded, bucket, total)
+            compute_s = time.monotonic() - t0
+            # strip the bucket padding once (shared helper — the same
+            # unpad as module predict's last-batch path), then split the
+            # remaining rows back per request
+            outs = unpad_outputs(outs, bucket - total)
+            offset = 0
+            for req in batch:
+                req.bucket = bucket
+                req.queue_seconds = t0 - req._t_submit
+                req.compute_seconds = compute_s
+                self._m_queue_s.observe(req.queue_seconds)
+                per_req = [o[offset:offset + req.n].copy() for o in outs]
+                offset += req.n
+                req._resolve(outputs=per_req)
+        except ServingError as e:
+            for req in batch:
+                req._resolve(error=e)
+        except Exception as e:  # a model failure answers 500, never hangs
+            err = ServingError("model %r failed: %r" % (self.name, e))
+            err.__cause__ = e
+            telemetry.record_event("serve_batch_error", model=self.name,
+                                   error=repr(e))
+            for req in batch:
+                req._resolve(error=err)
+        finally:
+            with self._cv:
+                self._inflight -= len(batch)
+            self._m_examples.inc(total)
+            self._m_batches.inc()
+            self._m_batch_size.observe(total)
+            if bucket:  # None can't happen post-admission; stay unkillable
+                self._m_occupancy.observe(total / float(bucket))
+            self._m_compute_s.observe(time.monotonic() - t0)

@@ -1,0 +1,413 @@
+"""Versioned multi-model registry for the serving subsystem.
+
+A repository maps ``name/version`` to a `ServedModel`: a loaded inference
+artifact plus its per-bucket executables and its own `DynamicBatcher`
+(one worker thread per served model — the executor is only ever driven
+single-threaded; any number of HTTP threads block on their request event).
+
+Two artifact kinds load (the same two the deployment layer produces):
+
+  * ``prefix`` -> ``prefix-symbol.json`` + ``prefix-%04d.params``
+    (`HybridBlock.export` / `model.save_checkpoint`): a live `Predictor`
+    is bound per padding bucket, every clone SHARING the prototype's
+    device weight buffers (the `predict._clone_with` mechanism — the
+    reference's MXPredCreateMultiThread semantics) so N buckets cost one
+    copy of the weights plus N small IO buffers.
+  * ``*.mxc`` / ``MXTPUAOT1`` blobs (`Predictor.export_compiled`): a
+    `CompiledPredictor` whose geometry is frozen at build — its frozen
+    batch size is the single padding bucket.
+
+Loading WARMS every bucket (one forward of zeros per bucket) before the
+model is published, so the executable cache is fully populated and steady-
+state traffic never sees a compile. Unloading drains the model's queue
+and in-flight work before dropping it (hot load/unload).
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+
+import numpy as _np
+
+from .. import env as _env
+from .. import telemetry
+from ..base import MXNetError
+from .batcher import (DynamicBatcher, ModelUnavailableError,
+                      power_of_two_buckets)
+
+__all__ = ["ServedModel", "ModelRepository"]
+
+
+class ServedModel:
+    """One ``name/version``: bucketed forward + dynamic batcher.
+
+    ``runner(batch_arrays, bucket, n) -> [numpy outputs]`` owns the actual
+    model; the constructors below build it from deployment artifacts, and
+    tests may inject a stub (the repository only needs this interface).
+    """
+
+    def __init__(self, name, version, runner, buckets, example_shapes,
+                 input_dtypes=None, meta=None, max_delay_ms=None,
+                 queue_depth=None):
+        self.name = str(name)
+        self.version = int(version)
+        self.example_shapes = {k: tuple(v) for k, v in example_shapes.items()}
+        self.input_dtypes = {k: _np.dtype(input_dtypes[k])
+                             if input_dtypes and k in input_dtypes
+                             else _np.dtype(_np.float32)
+                             for k in self.example_shapes}
+        self.meta = dict(meta or {})
+        self.loaded_at = time.time()
+        self.warmed = False
+        self.warm_seconds = None
+        self._runner = runner
+        self._batcher = DynamicBatcher(
+            runner, buckets, max_delay_ms=max_delay_ms,
+            queue_depth=queue_depth,
+            name="%s/%d" % (self.name, self.version))
+
+    # -- construction from artifacts --------------------------------------
+    @staticmethod
+    def from_path(name, version, path, input_shapes=None, input_dtypes=None,
+                  ctx=None, max_batch=None, max_delay_ms=None,
+                  queue_depth=None):
+        """Load a deployment artifact: a ``*.mxc``/``MXTPUAOT1`` compiled
+        blob, or an export ``prefix`` (with ``input_shapes`` = per-example
+        shapes, batch dim EXCLUDED)."""
+        kind, parts = _resolve_artifact(path)
+        if kind == "compiled":
+            return ServedModel._from_compiled(
+                name, version, parts, max_delay_ms=max_delay_ms,
+                queue_depth=queue_depth)
+        symbol_file, param_file = parts
+        return ServedModel._from_symbol(
+            name, version, symbol_file, param_file,
+            input_shapes=input_shapes, input_dtypes=input_dtypes, ctx=ctx,
+            max_batch=max_batch, max_delay_ms=max_delay_ms,
+            queue_depth=queue_depth)
+
+    @staticmethod
+    def _from_symbol(name, version, symbol_file, param_file, input_shapes,
+                     input_dtypes=None, ctx=None, max_batch=None,
+                     max_delay_ms=None, queue_depth=None):
+        from ..predict import Predictor, _clone_with
+
+        if not input_shapes:
+            raise MXNetError(
+                "symbol/params models need input_shapes (per-example, "
+                "batch dim excluded), e.g. {'data': (8,)}")
+        example_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        if max_batch is None:
+            max_batch = _env.get("MXTPU_SERVE_MAX_BATCH")
+        buckets = power_of_two_buckets(max_batch)
+
+        def shapes_at(b):
+            return {k: (b,) + s for k, s in example_shapes.items()}
+
+        # one Predictor per bucket, all sharing the prototype's device
+        # weight buffers — N buckets cost one weight copy + N IO buffers
+        proto = Predictor(symbol_file, param_file, ctx=ctx,
+                          input_shapes=shapes_at(buckets[-1]),
+                          input_dtypes=input_dtypes)
+        by_bucket = {buckets[-1]: proto}
+        for b in buckets[:-1]:
+            by_bucket[b] = _clone_with(proto, shapes_at(b), shared=proto)
+        num_outputs = proto.num_outputs
+
+        def runner(arrays, bucket, n):
+            pred = by_bucket[bucket]
+            pred.forward(**arrays)
+            return [pred.get_output(i).asnumpy() for i in range(num_outputs)]
+
+        model = ServedModel(name, version, runner, buckets, example_shapes,
+                            input_dtypes=input_dtypes,
+                            meta={"artifact": "symbol",
+                                  "symbol_file": str(symbol_file),
+                                  "param_file": str(param_file)},
+                            max_delay_ms=max_delay_ms,
+                            queue_depth=queue_depth)
+        model._by_bucket = by_bucket
+        return model
+
+    @staticmethod
+    def _from_compiled(name, version, path, max_delay_ms=None,
+                       queue_depth=None):
+        from ..predict import CompiledPredictor
+
+        comp = CompiledPredictor.load(path)
+        shapes = comp._input_shapes
+        batches = {s[0] for s in shapes.values() if s}
+        if len(batches) != 1:
+            raise MXNetError(
+                "compiled artifact has ambiguous batch dim across inputs: "
+                "%s" % shapes)
+        frozen = batches.pop()
+        example_shapes = {k: tuple(s[1:]) for k, s in shapes.items()}
+        dtypes = {k: comp._input_dtypes.get(k, _np.dtype(_np.float32))
+                  for k in shapes}
+
+        def runner(arrays, bucket, n):
+            comp.forward(**arrays)
+            return [comp.get_output(i).asnumpy()
+                    for i in range(comp.num_outputs)]
+
+        # geometry is frozen at build (TensorRT-engine semantics): the
+        # frozen batch is the one and only padding bucket
+        return ServedModel(name, version, runner, [frozen], example_shapes,
+                           input_dtypes=dtypes,
+                           meta={"artifact": "compiled", "path": str(path),
+                                 "platforms": list(comp.platforms)},
+                           max_delay_ms=max_delay_ms,
+                           queue_depth=queue_depth)
+
+    # -- serving surface ---------------------------------------------------
+    @property
+    def buckets(self):
+        return list(self._batcher.buckets)
+
+    @property
+    def max_batch(self):
+        return self._batcher.max_batch
+
+    def validate(self, arrays):
+        """Check names/shapes/dtypes against the model signature; returns
+        the (cast) arrays. Raises MXNetError on mismatch (HTTP 400)."""
+        want = set(self.example_shapes)
+        got = set(arrays)
+        if want != got:
+            raise MXNetError("inputs %s != model inputs %s"
+                             % (sorted(got), sorted(want)))
+        out = {}
+        for k, a in arrays.items():
+            a = _np.asarray(a, dtype=self.input_dtypes[k])
+            if tuple(a.shape[1:]) != self.example_shapes[k]:
+                raise MXNetError(
+                    "input %r per-example shape %s != declared %s"
+                    % (k, tuple(a.shape[1:]), self.example_shapes[k]))
+            out[k] = a
+        return out
+
+    def predict(self, arrays, timeout_ms=None):
+        """Validate, admit, and wait: returns the list of per-request
+        output arrays. Raises QueueFullError / DeadlineExceededError /
+        DrainingError per the admission-control contract."""
+        arrays = self.validate(arrays)
+        if timeout_ms is None:
+            timeout_ms = _env.get("MXTPU_SERVE_TIMEOUT_MS")
+        deadline = None
+        if timeout_ms and timeout_ms > 0:
+            deadline = time.monotonic() + float(timeout_ms) / 1e3
+        req = self._batcher.submit(arrays, deadline)
+        timeout = None if deadline is None \
+            else max(0.0, deadline - time.monotonic())
+        return req.wait(timeout)
+
+    def warm(self):
+        """One zeros-forward per bucket: populates the executable cache so
+        steady-state traffic never compiles. Emits one
+        ``serve_bucket_warm`` event per bucket."""
+        t_all = time.monotonic()
+        for b in self._batcher.buckets:
+            zeros = {k: _np.zeros((b,) + s, dtype=self.input_dtypes[k])
+                     for k, s in self.example_shapes.items()}
+            t0 = time.monotonic()
+            self._runner(zeros, b, b)
+            telemetry.record_event(
+                "serve_bucket_warm", model=self.name, version=self.version,
+                bucket=b, seconds=round(time.monotonic() - t0, 4))
+        self.warm_seconds = time.monotonic() - t_all
+        self.warmed = True
+        return self.warm_seconds
+
+    def pending(self):
+        return self._batcher.pending()
+
+    def drain(self, timeout=None):
+        return self._batcher.drain(timeout)
+
+    def close(self, drain=True, timeout=None):
+        return self._batcher.close(drain=drain, timeout=timeout)
+
+    def describe(self):
+        return {
+            "name": self.name,
+            "version": self.version,
+            "buckets": self.buckets,
+            "max_batch": self.max_batch,
+            "inputs": {k: {"shape": list(s),
+                           "dtype": self.input_dtypes[k].name}
+                       for k, s in self.example_shapes.items()},
+            "warmed": self.warmed,
+            "warm_seconds": self.warm_seconds,
+            "pending": self.pending(),
+            "loaded_at": self.loaded_at,
+            "meta": self.meta,
+        }
+
+
+# ---------------------------------------------------------------------------
+# artifact resolution
+# ---------------------------------------------------------------------------
+
+_PARAMS_RE = re.compile(r"-(\d{4})\.params$")
+
+
+def _resolve_artifact(path):
+    """Classify ``path``: ('compiled', file) for .mxc/MXTPUAOT blobs,
+    ('symbol', (symbol_json, params)) for an export prefix."""
+    from ..predict import _MXC_MAGIC
+
+    path = os.fspath(path)
+    if os.path.isfile(path):
+        with open(path, "rb") as f:
+            magic = f.read(len(_MXC_MAGIC))
+        if magic == _MXC_MAGIC:
+            return "compiled", path
+        if path.endswith("-symbol.json"):
+            path = path[:-len("-symbol.json")]  # accept the json itself
+        else:
+            raise MXNetError(
+                "%r is neither a compiled (.mxc) artifact nor a "
+                "*-symbol.json / export prefix" % path)
+    symbol_file = path + "-symbol.json"
+    if not os.path.exists(symbol_file):
+        raise MXNetError("no artifact at %r (expected %s or a compiled "
+                         ".mxc file)" % (path, symbol_file))
+    directory, base = os.path.split(path)
+    candidates = []
+    for fn in os.listdir(directory or "."):
+        if fn.startswith(base + "-"):
+            m = _PARAMS_RE.search(fn)
+            if m and fn == "%s-%s.params" % (base, m.group(1)):
+                candidates.append((int(m.group(1)), fn))
+    if not candidates:
+        raise MXNetError("no %s-NNNN.params next to %s" % (base, symbol_file))
+    _, newest = max(candidates)
+    return "symbol", (symbol_file, os.path.join(directory, newest))
+
+
+# ---------------------------------------------------------------------------
+# the repository
+# ---------------------------------------------------------------------------
+
+class ModelRepository:
+    """name/version -> ServedModel, with hot load/unload.
+
+    Loading warms before publishing (a half-warm model never serves);
+    unloading marks the version draining, waits for queued + in-flight
+    work, then drops it. `get` resolves ``version=None`` to the highest
+    published version.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}   # name -> {version: ServedModel}
+        self._loading = set()  # (name, version) reservations mid-load
+        self._m_loaded = telemetry.gauge("mxtpu_serve_models_loaded")
+
+    def load(self, name, path, version=None, input_shapes=None,
+             input_dtypes=None, ctx=None, max_batch=None, max_delay_ms=None,
+             queue_depth=None, warm=True):
+        """Load an artifact as ``name/version`` (auto-increment when
+        ``version`` is None) and publish it after warmup. The version is
+        RESERVED for the whole load, so two concurrent loads of the same
+        name never collide after both paid bind+warm; a failed load tears
+        its half-built model (and batcher thread) down."""
+        with self._lock:
+            have = self._models.get(name, {})
+            reserved = [v for (n, v) in self._loading if n == name]
+            if version is None:
+                version = max(list(have) + reserved, default=0) + 1
+            version = int(version)
+            if version in have or (name, version) in self._loading:
+                raise MXNetError("model %s/%d is already loaded"
+                                 % (name, version))
+            self._loading.add((name, version))
+        try:
+            model = ServedModel.from_path(
+                name, version, path, input_shapes=input_shapes,
+                input_dtypes=input_dtypes, ctx=ctx, max_batch=max_batch,
+                max_delay_ms=max_delay_ms, queue_depth=queue_depth)
+            try:
+                if warm:
+                    model.warm()
+                return self.add(model)
+            except Exception:
+                model.close(drain=False, timeout=0)  # no thread/weight leak
+                raise
+        finally:
+            with self._lock:
+                self._loading.discard((name, version))
+
+    def add(self, model):
+        """Publish an already-built ServedModel (tests inject stubs here)."""
+        with self._lock:
+            versions = self._models.setdefault(model.name, {})
+            if model.version in versions:
+                raise MXNetError("model %s/%d is already loaded"
+                                 % (model.name, model.version))
+            versions[model.version] = model
+            self._m_loaded.set(sum(len(v) for v in self._models.values()))
+        telemetry.record_event("serve_model_load", model=model.name,
+                               version=model.version)
+        return model
+
+    def get(self, name, version=None):
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelUnavailableError("no model named %r" % (name,))
+            if version is None:
+                return versions[max(versions)]
+            model = versions.get(int(version))
+            if model is None:
+                raise ModelUnavailableError(
+                    "model %r has no version %s (have %s)"
+                    % (name, version, sorted(versions)))
+            return model
+
+    def unload(self, name, version=None, timeout=None):
+        """Drain and drop ``name/version`` (newest when None). Returns True
+        when the drain completed within ``timeout``."""
+        model = self.get(name, version)
+        with self._lock:
+            versions = self._models.get(name, {})
+            versions.pop(model.version, None)
+            if not versions:
+                self._models.pop(name, None)
+            self._m_loaded.set(sum(len(v) for v in self._models.values()))
+        if timeout is None:
+            timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_S")
+        drained = model.close(drain=True, timeout=timeout)
+        telemetry.record_event("serve_model_unload", model=model.name,
+                               version=model.version, drained=drained)
+        return drained
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def models(self):
+        """Flat list of every published ServedModel."""
+        with self._lock:
+            return [m for vs in self._models.values()
+                    for _, m in sorted(vs.items())]
+
+    def describe(self):
+        return {"models": [m.describe() for m in self.models()]}
+
+    def pending(self):
+        return sum(m.pending() for m in self.models())
+
+    def drain_all(self, timeout=None):
+        """Drain every model (graceful-shutdown path). Returns True when
+        everything finished in time."""
+        if timeout is None:
+            timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_S")
+        deadline = time.monotonic() + timeout
+        ok = True
+        for m in self.models():
+            ok = m.drain(max(0.0, deadline - time.monotonic())) and ok
+        return ok

@@ -1,0 +1,297 @@
+"""HTTP frontend for the serving subsystem (docs/serving.md).
+
+Same stdlib pattern as the telemetry Prometheus endpoint
+(telemetry/core.py `start_http_server`): a `ThreadingHTTPServer`, one
+handler thread per connection, zero new dependencies. Handler threads
+block cheaply on their request's event while the per-model batcher
+worker drives the accelerator.
+
+Routes (triton/KServe-shaped):
+
+  * ``POST /v1/models/<name>:predict``            (newest version)
+  * ``POST /v1/models/<name>/versions/<v>:predict``
+      body: ``{"inputs": {"<input>": <nested list>}, "timeout_ms": opt}``
+      or ``{"instances": <nested list>}`` for single-input models;
+      reply: ``{"outputs": [...], "model": ..., "version": ...}``.
+  * ``GET /v1/models``        repository listing (buckets, signatures,
+      warm state, pending counts)
+  * ``GET /v1/models/<name>`` one model (``?version=``)
+  * ``GET /healthz``          200 ``ok`` / 503 ``draining``
+  * ``GET|POST /drainz``      start draining (idempotent); reply shows
+      remaining pending work — poll until 0
+
+Admission control is deterministic: a full queue answers 429
+(`MXTPU_SERVE_QUEUE_DEPTH`), an expired deadline answers 504
+(`MXTPU_SERVE_TIMEOUT_MS`, per-request override via ``timeout_ms``),
+draining answers 503, an unknown model 404, a malformed request 400.
+SIGTERM (via `install_signal_handlers`) drains queued + in-flight
+requests, then stops the server so the launcher sees exit 0.
+"""
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+
+import numpy as _np
+
+from .. import env as _env
+from .. import telemetry
+from ..base import MXNetError
+from .batcher import DrainingError, ServingError
+
+__all__ = ["ServingServer"]
+
+
+def _int_version(raw):
+    """URL version component -> int; malformed is the CLIENT's error
+    (400), not a 500 from a bare ValueError."""
+    try:
+        return int(raw)
+    except ValueError:
+        raise MXNetError("version %r is not an integer" % (raw,))
+
+
+class ServingServer:
+    """The HTTP frontend over a `ModelRepository`."""
+
+    def __init__(self, repository, port=None, addr="0.0.0.0"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.repository = repository
+        self._draining = False
+        self._drain_thread = None
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._m_codes = {}
+        if port is None:
+            port = _env.get("MXTPU_SERVE_PORT")
+
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: steady clients reuse their connection
+            # (and its handler thread) instead of paying TCP setup + a
+            # thread spawn per request; every reply carries Content-Length
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                outer._route(self, "GET")
+
+            def do_POST(self):
+                outer._route(self, "POST")
+
+            def log_message(self, fmt, *args):  # no per-request stderr spam
+                pass
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # stdlib default backlog is 5: a burst of concurrent clients
+            # overflows the accept queue and eats 1-3s TCP SYN retransmits
+            request_queue_size = 128
+
+        self._http = _Server((addr, int(port)), _Handler)
+        self.port = self._http.server_address[1]
+        self._serve_thread = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve_forever(self):
+        """Block serving requests until `shutdown` (tools/serve.py)."""
+        self._http.serve_forever(poll_interval=0.1)
+
+    def start(self):
+        """Serve on a daemon thread (tests, serve_bench). Returns self."""
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, name="mxtpu-serve-http", daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def shutdown(self):
+        self._http.shutdown()
+        self._http.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5.0)
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self, timeout=None, shutdown=False):
+        """Stop admitting work, wait for queued + in-flight requests (and
+        their handler threads) to finish, optionally stop the server.
+        Returns True when everything completed within ``timeout``."""
+        self._draining = True
+        if timeout is None:
+            timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_S")
+        telemetry.record_event("serve_drain_start",
+                               pending=self.repository.pending())
+        deadline = time.monotonic() + timeout
+        ok = self.repository.drain_all(timeout)
+        while self._inflight and time.monotonic() < deadline:
+            time.sleep(0.01)  # let handler threads finish writing replies
+        ok = ok and not self._inflight
+        telemetry.record_event("serve_drain_done", complete=ok)
+        if shutdown:
+            self.shutdown()
+        return ok
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        """Graceful-drain on SIGTERM/SIGINT: the handler only spawns the
+        drain thread (signal context stays trivial); `serve_forever`
+        returns once the drain finishes and the caller exits 0."""
+
+        def _on_signal(signum, frame):
+            if self._drain_thread is None:
+                telemetry.record_event("serve_signal", signum=signum)
+                self._drain_thread = threading.Thread(
+                    target=self.drain, kwargs={"shutdown": True},
+                    name="mxtpu-serve-drain", daemon=True)
+                self._drain_thread.start()
+
+        for s in signals:
+            signal.signal(s, _on_signal)
+
+    # -- routing -----------------------------------------------------------
+    def _route(self, handler, method):
+        try:
+            path = handler.path.split("?", 1)[0]
+            query = handler.path[len(path) + 1:] if "?" in handler.path else ""
+            if path.rstrip("/") == "/healthz" and method == "GET":
+                if self._draining:
+                    self._text(handler, 503, "draining\n")
+                else:
+                    self._text(handler, 200, "ok\n")
+            elif path.rstrip("/") == "/drainz":
+                if self._drain_thread is None:
+                    self._drain_thread = threading.Thread(
+                        target=self.drain, name="mxtpu-serve-drain",
+                        daemon=True)
+                    self._drain_thread.start()
+                self._json(handler, 200, {
+                    "draining": True,
+                    "pending": self.repository.pending(),
+                    "inflight": self._inflight,
+                })
+            elif path == "/v1/models" and method == "GET":
+                self._json(handler, 200, self.repository.describe())
+            elif path.startswith("/v1/models/"):
+                self._model_route(handler, method, path[len("/v1/models/"):],
+                                  query)
+            else:
+                self._json(handler, 404, {"error": "no route %s %s"
+                                          % (method, path)})
+        except BrokenPipeError:
+            pass  # client went away mid-reply
+        except ServingError as e:
+            self._json(handler, e.status, {"error": str(e)})
+        except MXNetError as e:
+            self._json(handler, 400, {"error": str(e)})
+        except Exception as e:  # the server must answer, never unwind
+            self._json(handler, 500, {"error": "%s: %s"
+                                      % (type(e).__name__, e)})
+
+    def _model_route(self, handler, method, rest, query):
+        version = None
+        if ":" in rest:
+            rest, verb = rest.split(":", 1)
+        else:
+            verb = None
+        if "/versions/" in rest:
+            rest, v = rest.split("/versions/", 1)
+            version = _int_version(v)
+        name = rest.strip("/")
+        if version is None and query.startswith("version="):
+            version = _int_version(query.split("=", 1)[1].split("&")[0])
+        if verb == "predict" and method == "POST":
+            self._predict(handler, name, version)
+        elif verb is None and method == "GET":
+            model = self.repository.get(name, version)
+            self._json(handler, 200, model.describe())
+        else:
+            self._json(handler, 404, {"error": "no route %s /v1/models/%s%s"
+                                      % (method, name,
+                                         ":" + verb if verb else "")})
+
+    # -- predict -----------------------------------------------------------
+    def _predict(self, handler, name, version):
+        # consume the body FIRST: replying before the read would desync a
+        # keep-alive connection (next request line = leftover body bytes)
+        length = int(handler.headers.get("Content-Length") or 0)
+        raw_body = handler.rfile.read(length) if length > 0 else b""
+        if self._draining:
+            raise DrainingError("server is draining")
+        model = self.repository.get(name, version)
+        if not raw_body:
+            raise MXNetError("empty request body")
+        try:
+            body = json.loads(raw_body.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise MXNetError("request body is not JSON: %s" % e)
+        if "inputs" in body:
+            raw = body["inputs"]
+            if not isinstance(raw, dict):
+                raise MXNetError("'inputs' must be an object of "
+                                 "input-name -> array")
+        elif "instances" in body:
+            names = sorted(model.example_shapes)
+            if len(names) != 1:
+                raise MXNetError(
+                    "'instances' shorthand needs a single-input model; "
+                    "%r has inputs %s — use 'inputs'" % (name, names))
+            raw = {names[0]: body["instances"]}
+        else:
+            raise MXNetError("request needs 'inputs' or 'instances'")
+        try:
+            arrays = {k: _np.asarray(v, dtype=model.input_dtypes.get(k))
+                      for k, v in raw.items()}
+        except (ValueError, TypeError, KeyError) as e:
+            raise MXNetError("malformed input array: %s" % e)
+        timeout_ms = body.get("timeout_ms")
+        if timeout_ms is not None:
+            timeout_ms = float(timeout_ms)
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            outputs = model.predict(arrays, timeout_ms=timeout_ms)
+            self._json(handler, 200, {
+                "model": model.name,
+                "version": model.version,
+                "outputs": [o.tolist() for o in outputs],
+            })
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    # -- replies -----------------------------------------------------------
+    def _count(self, code):
+        m = self._m_codes.get(code)
+        if m is None:
+            m = telemetry.counter("mxtpu_serve_http_requests_total",
+                                  {"code": str(code)})
+            self._m_codes[code] = m
+        m.inc()
+
+    def _text(self, handler, code, text):
+        body = text.encode()
+        self._count(code)
+        handler.send_response(code)
+        handler.send_header("Content-Type", "text/plain; charset=utf-8")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _json(self, handler, code, payload):
+        body = (json.dumps(payload) + "\n").encode()
+        self._count(code)
+        if code >= 400:
+            # error replies may precede a full body read on some routes;
+            # closing keeps the keep-alive stream from desyncing
+            handler.close_connection = True
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        if code == 429:
+            handler.send_header("Retry-After", "1")
+        handler.end_headers()
+        handler.wfile.write(body)

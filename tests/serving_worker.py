@@ -1,0 +1,39 @@
+"""Subprocess worker for tests/test_serving.py's SIGTERM-drain e2e.
+
+Serves one stub model whose every batch sleeps ``--step-delay`` seconds, so
+the test can land SIGTERM while a request is in flight and assert the
+graceful-drain contract: the in-flight request still answers 200, the
+server then stops, and the process exits 0 (tools/serve.py shape).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--step-delay", type=float, default=0.4)
+    args = p.parse_args()
+
+    from mxnet_tpu.serving import ModelRepository, ServedModel, ServingServer
+
+    def runner(arrays, bucket, n):
+        time.sleep(args.step_delay)
+        return [arrays["x"] * 2.0]
+
+    repo = ModelRepository()
+    repo.add(ServedModel("echo", 1, runner, [1, 2, 4], {"x": (2,)},
+                         max_delay_ms=1.0))
+    server = ServingServer(repo, port=0, addr="127.0.0.1")
+    server.install_signal_handlers()
+    print("PORT %d" % server.port, flush=True)
+    server.serve_forever()  # returns once the SIGTERM drain finished
+    print("DRAINED pending=%d" % repo.pending(), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

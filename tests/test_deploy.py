@@ -229,6 +229,87 @@ def test_multithread_clone_shares_weight_buffers(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
 
+def test_compiled_predictor_load_accepts_pathlike(tmp_path):
+    """Regression: `CompiledPredictor.load` with a pathlib.Path used to fall
+    through to the bad-magic branch (only `str` hit the open() path)."""
+    from pathlib import Path
+
+    from mxnet_tpu.predict import CompiledPredictor, Predictor
+
+    net = _make_net()
+    net.hybridize()
+    x = mx.nd.array(np.random.uniform(-1, 1, (2, 8)).astype(np.float32))
+    net(x)
+    prefix = str(tmp_path / "plike")
+    net.export(prefix, epoch=0)
+    pred = Predictor(prefix + "-symbol.json", prefix + "-0000.params",
+                     input_shapes={"data": (2, 8)})
+    ref = pred.forward(data=x).get_output(0).asnumpy()
+    artifact = tmp_path / "plike.mxc"  # a Path, never str()'d
+    pred.export_compiled(str(artifact))
+
+    comp = CompiledPredictor.load(artifact)
+    assert isinstance(artifact, Path)
+    got = comp.forward(data=x).get_output(0).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # real bytes (not a path) still load; garbage still raises bad-magic
+    comp2 = CompiledPredictor.load(artifact.read_bytes())
+    assert comp2.get_output_shape(0) == comp.get_output_shape(0)
+    with pytest.raises(MXNetError, match="bad magic"):
+        CompiledPredictor.load(b"not an artifact")
+
+
+def test_predictor_clones_concurrent_no_buffer_bleed(tmp_path):
+    """N client threads driving per-thread Predictor clones (the shared-
+    weights/private-IO mechanism, predict._capi_clone_shared): concurrent
+    forwards must never bleed inputs/outputs across threads."""
+    import threading
+
+    from mxnet_tpu.predict import _capi_clone_shared
+
+    net = _make_net()
+    net.hybridize()
+    warm = mx.nd.array(np.zeros((2, 8), np.float32))
+    net(warm)
+    prefix = str(tmp_path / "mtc")
+    net.export(prefix, epoch=0)
+    proto = Predictor(prefix + "-symbol.json", prefix + "-0000.params",
+                      input_shapes={"data": (2, 8)})
+    proto.forward(data=warm)  # compile the signature once, before the race
+
+    n_threads, iters = 4, 20
+    rng = np.random.RandomState(7)
+    inputs = [rng.uniform(-1, 1, (2, 8)).astype(np.float32)
+              for _ in range(n_threads)]
+    expected = [proto.forward(data=x).get_output(0).asnumpy().copy()
+                for x in inputs]
+    clones = [_capi_clone_shared(proto) for _ in range(n_threads)]
+
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def worker(tid):
+        try:
+            clone, x, want = clones[tid], inputs[tid], expected[tid]
+            barrier.wait(timeout=30)
+            for it in range(iters):
+                got = clone.forward(data=x).get_output(0).asnumpy()
+                np.testing.assert_allclose(
+                    got, want, rtol=1e-5, atol=1e-6,
+                    err_msg="thread %d iter %d: cross-request bleed"
+                            % (tid, it))
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append((tid, e))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[0]
+
+
 def test_export_compiled_preserves_input_dtype(tmp_path):
     """ADVICE r2: AOT export traces inputs at their live dtype (int32
     token ids for embedding models), not a blanket float32."""

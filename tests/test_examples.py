@@ -37,6 +37,7 @@ EXAMPLES = [
     ("gan/dcgan.py", ["--steps", "6"], []),
     ("ctc/lstm_ocr.py", ["--steps", "12", "--batch", "8"], []),
     ("sparse/linear_classification.py", ["--steps", "60"], []),
+    ("serving/serve_mlp.py", ["--requests", "12", "--clients", "4"], []),
 ]
 
 

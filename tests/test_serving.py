@@ -1,0 +1,527 @@
+"""mxnet_tpu.serving tests: micro-batcher semantics, bucketed warm
+repository, HTTP admission control (429/504), hot load/unload draining,
+and the SIGTERM graceful-drain e2e.
+
+Everything runs on CPU with tiny models and small buckets — the tier-1
+budget has no headroom (ROADMAP.md), so drain timeouts and batch delays
+here are milliseconds, not the production defaults.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, telemetry
+from mxnet_tpu.base import MXNetError, unpad_outputs
+from mxnet_tpu.serving import (
+    DeadlineExceededError, DynamicBatcher, ModelRepository,
+    ModelUnavailableError, QueueFullError, ServedModel, ServingServer,
+    bucket_for, power_of_two_buckets,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "serving_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# units: buckets + shared unpad helper
+# ---------------------------------------------------------------------------
+
+def test_bucket_math():
+    assert power_of_two_buckets(32) == [1, 2, 4, 8, 16, 32]
+    assert power_of_two_buckets(1) == [1]
+    # non-power-of-two max still gets exactly one terminal bucket
+    assert power_of_two_buckets(12) == [1, 2, 4, 8, 12]
+    buckets = power_of_two_buckets(8)
+    assert bucket_for(1, buckets) == 1
+    assert bucket_for(3, buckets) == 4
+    assert bucket_for(8, buckets) == 8
+    assert bucket_for(9, buckets) is None
+    with pytest.raises(MXNetError):
+        power_of_two_buckets(0)
+
+
+def test_unpad_outputs_shared_helper():
+    """The one unpad used by module predict AND the batcher (satellite:
+    factored from the two duplicated slices in base_module.py)."""
+    a = np.arange(12).reshape(6, 2)
+    (out,) = unpad_outputs([a], 2)
+    assert out.shape == (4, 2) and np.all(out == a[:4])
+    # pad=0 keeps everything; copy=True detaches from the padded buffer
+    (alias,) = unpad_outputs([a], 0)
+    assert alias is a
+    (copied,) = unpad_outputs([a], 0, copy=True)
+    assert copied is not a and np.all(copied == a)
+    nd_out = unpad_outputs([mx.nd.array(a.astype(np.float32))], 3, copy=True)
+    assert nd_out[0].shape == (3, 2)
+
+
+def test_module_predict_uses_unpad(tmp_path):
+    """module predict slices DataIter pad through the shared helper."""
+    from mxnet_tpu import io as mxio
+    from mxnet_tpu import module as mxmod
+
+    x = np.random.rand(10, 4).astype(np.float32)
+    y = np.zeros((10,), np.float32)
+    it = mxio.NDArrayIter(x, y, batch_size=4)  # 10 % 4 -> last batch pad 2
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    mod = mxmod.Module(net, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=it.provide_data, for_training=False)
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    out = mod.predict(it)
+    assert out.shape == (10, 3)  # pad rows dropped, batches merged
+    for outs, _, batch in mod.iter_predict(it):
+        n = 4 - (getattr(batch, "pad", 0) or 0)
+        assert outs[0].shape[0] == n  # iter_predict now unpads too
+
+
+# ---------------------------------------------------------------------------
+# batcher
+# ---------------------------------------------------------------------------
+
+def test_batcher_coalesces_pads_and_splits():
+    calls = []
+
+    def runner(arrays, bucket, n):
+        calls.append((bucket, n, arrays["x"].shape[0]))
+        return [arrays["x"] * 2.0, arrays["x"].sum(axis=1, keepdims=True)]
+
+    b = DynamicBatcher(runner, power_of_two_buckets(8), max_delay_ms=20,
+                       queue_depth=64, name="unit")
+    reqs = []
+    for i in range(3):  # mixed per-request example counts: 1 + 2 + 3 = 6
+        n = i + 1
+        reqs.append(b.submit({"x": np.full((n, 2), float(i))},
+                             deadline=time.monotonic() + 5))
+    outs = [r.wait(5) for r in reqs]
+    try:
+        for i, o in enumerate(outs):
+            assert o[0].shape == (i + 1, 2) and np.all(o[0] == 2.0 * i)
+            assert o[1].shape == (i + 1, 1) and np.all(o[1] == 2.0 * i)
+        # all three coalesced into ONE padded bucket-8 dispatch
+        assert calls == [(8, 6, 8)], calls
+        assert reqs[0].bucket == 8
+    finally:
+        b.close()
+
+
+def test_batcher_never_overfills_max_batch():
+    sizes = []
+
+    def runner(arrays, bucket, n):
+        sizes.append((bucket, n))
+        return [arrays["x"]]
+
+    b = DynamicBatcher(runner, power_of_two_buckets(4), max_delay_ms=20,
+                       queue_depth=64, name="unit2")
+    reqs = [b.submit({"x": np.zeros((3, 1), np.float32)}) for _ in range(3)]
+    for r in reqs:
+        r.wait(5)
+    b.close()
+    # 3+3 > 4: requests never split, so each 3-example request dispatches
+    # alone in a bucket-4 batch
+    assert sizes == [(4, 3)] * 3, sizes
+
+
+def test_batcher_input_validation():
+    b = DynamicBatcher(lambda a, bkt, n: [a["x"]], [1, 2], max_delay_ms=1,
+                       queue_depth=4, name="unit3")
+    try:
+        with pytest.raises(MXNetError, match="1..2"):
+            b.submit({"x": np.zeros((3, 1))})  # overflows max_batch
+        with pytest.raises(MXNetError, match="inconsistent"):
+            b.submit({"x": np.zeros((1, 1)), "y": np.zeros((2, 1))})
+        with pytest.raises(MXNetError, match="no input"):
+            b.submit({})
+    finally:
+        b.close()
+
+
+def test_batcher_queue_overflow_and_deadline():
+    gate = threading.Event()
+
+    def runner(arrays, bucket, n):
+        gate.wait(10)
+        return [arrays["x"]]
+
+    b = DynamicBatcher(runner, [1], max_delay_ms=1, queue_depth=2,
+                       name="unit4")
+    try:
+        first = b.submit({"x": np.zeros((1, 1), np.float32)})
+        time.sleep(0.05)  # worker pops `first` and parks in the runner
+        queued = [b.submit({"x": np.zeros((1, 1), np.float32)},
+                           deadline=time.monotonic() + 0.05)
+                  for _ in range(2)]
+        # bounded queue: depth 2 is full -> immediate rejection
+        with pytest.raises(QueueFullError):
+            b.submit({"x": np.zeros((1, 1), np.float32)})
+        # deadline: the queued requests expire while the worker is stuck
+        with pytest.raises(DeadlineExceededError):
+            queued[0].wait(0.2)
+        gate.set()
+        assert first.wait(5)[0].shape == (1, 1)
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_batcher_expired_head_never_overfills_batch():
+    """Review regression: the fit check must apply to the request actually
+    popped — an expired queue head followed by a large live request used to
+    overfill past max_batch (bucket=None -> 500s + dead worker thread)."""
+    gate = threading.Event()
+    sizes = []
+
+    def runner(arrays, bucket, n):
+        gate.wait(10)
+        sizes.append((bucket, n))
+        return [arrays["x"] * 2.0]
+
+    b = DynamicBatcher(runner, power_of_two_buckets(4), max_delay_ms=30,
+                       queue_depth=16, name="overfill")
+    try:
+        warm = b.submit({"x": np.zeros((1, 1), np.float32)})
+        time.sleep(0.05)  # worker parks in the gated runner
+        d = b.submit({"x": np.full((1, 1), 3.0, np.float32)})
+        e = b.submit({"x": np.zeros((2, 1), np.float32)},
+                     deadline=time.monotonic() + 0.01)  # will expire queued
+        f = b.submit({"x": np.full((4, 1), 5.0, np.float32)})
+        time.sleep(0.05)  # e's deadline passes while the worker is stuck
+        gate.set()
+        assert np.all(d.wait(5)[0] == 6.0)
+        with pytest.raises(DeadlineExceededError):
+            e.wait(5)
+        assert np.all(f.wait(5)[0] == 10.0)  # served alone, next batch
+        warm.wait(5)
+        assert all(n <= bkt <= 4 for bkt, n in sizes), sizes
+        # and the worker survived: a follow-up request still runs
+        again = b.submit({"x": np.ones((1, 1), np.float32)})
+        assert np.all(again.wait(5)[0] == 2.0)
+    finally:
+        gate.set()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# repository: load/warm/predict/unload
+# ---------------------------------------------------------------------------
+
+def _export_dense(tmp_path, seed=0, tag="m"):
+    net = gluon.nn.HybridSequential(prefix="srv%s_" % tag)
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2 + seed),
+                   ctx=mx.cpu())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(seed)
+                    .uniform(-1, 1, (2, 6)).astype(np.float32))
+    net(x)
+    prefix = str(tmp_path / ("model%s" % tag))
+    net.export(prefix, epoch=0)
+    return prefix, net
+
+
+def test_repository_load_warm_predict_versions(tmp_path):
+    prefix, net = _export_dense(tmp_path, seed=0, tag="a")
+    prefix_b, net_b = _export_dense(tmp_path, seed=1, tag="b")
+    repo = ModelRepository()
+    builds = telemetry.get_registry().counter(
+        "mxtpu_executor_build_total", {"what": "forward"})
+
+    m1 = repo.load("mlp", prefix, input_shapes={"data": (6,)}, max_batch=4,
+                   max_delay_ms=1)
+    assert m1.version == 1 and m1.warmed and m1.buckets == [1, 2, 4]
+    after_warm = builds.value
+
+    x = np.random.RandomState(2).uniform(-1, 1, (3, 6)).astype(np.float32)
+    ref = net(mx.nd.array(x)).asnumpy()
+    for _ in range(3):  # mixed sizes: 3 -> bucket 4, 1 -> bucket 1
+        got = repo.get("mlp").predict({"data": x})[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        one = repo.get("mlp").predict({"data": x[:1]})[0]
+        np.testing.assert_allclose(one, ref[:1], rtol=1e-5, atol=1e-6)
+    # warmup covered every bucket: steady-state traffic compiled NOTHING
+    assert builds.value == after_warm
+
+    # hot load a second version: get() resolves newest; pinned still works
+    m2 = repo.load("mlp", prefix_b, input_shapes={"data": (6,)}, max_batch=2,
+                   max_delay_ms=1)
+    assert m2.version == 2
+    ref_b = net_b(mx.nd.array(x[:2])).asnumpy()
+    np.testing.assert_allclose(repo.get("mlp").predict({"data": x[:2]})[0],
+                               ref_b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        repo.get("mlp", version=1).predict({"data": x[:2]})[0],
+        ref[:2], rtol=1e-5, atol=1e-6)
+
+    desc = repo.describe()
+    assert [m["version"] for m in desc["models"]] == [1, 2]
+    with pytest.raises(ModelUnavailableError):
+        repo.get("nope")
+    with pytest.raises(ModelUnavailableError):
+        repo.get("mlp", version=9)
+    # bad input shape is a validation error (HTTP 400), not a crash
+    with pytest.raises(MXNetError, match="per-example"):
+        repo.get("mlp").predict({"data": np.zeros((1, 5), np.float32)})
+    repo.unload("mlp", version=1, timeout=2)
+    with pytest.raises(ModelUnavailableError):
+        repo.get("mlp", version=1)
+    assert repo.get("mlp").version == 2
+
+
+def test_repository_unload_drains_inflight():
+    done = []
+
+    def runner(arrays, bucket, n):
+        time.sleep(0.05)
+        done.append(n)
+        return [arrays["x"]]
+
+    repo = ModelRepository()
+    repo.add(ServedModel("slow", 1, runner, [1], {"x": (1,)},
+                         max_delay_ms=1, queue_depth=16))
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(
+            repo.get("slow").predict({"x": np.ones((1, 1), np.float32)},
+                                     timeout_ms=5000)))
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)  # requests admitted, some still queued
+    assert repo.unload("slow", timeout=5) is True  # drained, not dropped
+    for t in threads:
+        t.join(timeout=5)
+    assert len(results) == 4 and len(done) == 4
+    with pytest.raises(ModelUnavailableError):
+        repo.get("slow")
+
+
+def test_compiled_artifact_is_served_at_frozen_bucket(tmp_path):
+    from mxnet_tpu.predict import Predictor
+
+    prefix, net = _export_dense(tmp_path, seed=3, tag="c")
+    pred = Predictor(prefix + "-symbol.json", prefix + "-0000.params",
+                     input_shapes={"data": (4, 6)})
+    path = tmp_path / "model.mxc"
+    pred.export_compiled(str(path))
+
+    repo = ModelRepository()
+    m = repo.load("aot", path, max_delay_ms=1)  # pathlib.Path artifact
+    assert m.buckets == [4]  # geometry frozen at build = the only bucket
+    assert m.meta["artifact"] == "compiled"
+    x = np.random.RandomState(4).uniform(-1, 1, (2, 6)).astype(np.float32)
+    ref = net(mx.nd.array(x)).asnumpy()
+    got = m.predict({"data": x})[0]  # 2 examples padded up to 4, unpadded
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend
+# ---------------------------------------------------------------------------
+
+def _post_json(url, payload, timeout=10):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=body,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_e2e(tmp_path):
+    prefix, net = _export_dense(tmp_path, seed=5, tag="h")
+    repo = ModelRepository()
+    repo.load("mlp", prefix, input_shapes={"data": (6,)}, max_batch=4,
+              max_delay_ms=1)
+    srv = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    url = "http://127.0.0.1:%d" % srv.port
+    try:
+        assert urllib.request.urlopen(url + "/healthz").read() == b"ok\n"
+
+        x = np.random.RandomState(6).uniform(-1, 1, (3, 6)).astype(np.float32)
+        ref = net(mx.nd.array(x)).asnumpy()
+        code, resp = _post_json(url + "/v1/models/mlp:predict",
+                                {"inputs": {"data": x.tolist()}})
+        assert code == 200 and resp["model"] == "mlp" and resp["version"] == 1
+        np.testing.assert_allclose(np.asarray(resp["outputs"][0]), ref,
+                                   rtol=1e-4, atol=1e-5)
+        # 'instances' shorthand + explicit-version route
+        code, resp = _post_json(
+            url + "/v1/models/mlp/versions/1:predict",
+            {"instances": x.tolist()})
+        assert code == 200
+        np.testing.assert_allclose(np.asarray(resp["outputs"][0]), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+        listing = json.loads(urllib.request.urlopen(url + "/v1/models").read())
+        assert [m["name"] for m in listing["models"]] == ["mlp"]
+        assert listing["models"][0]["buckets"] == [1, 2, 4]
+        one = json.loads(urllib.request.urlopen(url + "/v1/models/mlp").read())
+        assert one["inputs"]["data"]["shape"] == [6]
+
+        for path, payload, want in (
+                ("/v1/models/nope:predict", {"instances": [[0] * 6]}, 404),
+                ("/v1/models/mlp:predict", {"instances": [[0] * 5]}, 400),
+                ("/v1/models/mlp:predict", {"bogus": 1}, 400),
+                ("/v1/models/mlp:predict", {"instances": [[0] * 6] * 9}, 400),
+                # review regression: malformed version is a 400, not a 500
+                ("/v1/models/mlp/versions/abc:predict",
+                 {"instances": [[0] * 6]}, 400),
+        ):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post_json(url + path, payload)
+            assert ei.value.code == want, (path, ei.value.code)
+            assert "error" in json.loads(ei.value.read())
+    finally:
+        srv.shutdown()
+
+
+def test_http_admission_control_429_504_and_drainz():
+    gate = threading.Event()
+
+    def runner(arrays, bucket, n):
+        gate.wait(10)
+        return [arrays["x"]]
+
+    repo = ModelRepository()
+    repo.add(ServedModel("gated", 1, runner, [1], {"x": (1,)},
+                         max_delay_ms=1, queue_depth=2))
+    srv = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    url = "http://127.0.0.1:%d" % srv.port
+    payload = {"inputs": {"x": [[1.0]]}, "timeout_ms": 4000}
+    codes = []
+
+    def fire(p=payload):
+        try:
+            codes.append(_post_json(url + "/v1/models/gated:predict", p)[0])
+        except urllib.error.HTTPError as e:
+            e.read()
+            codes.append(e.code)
+
+    try:
+        t1 = threading.Thread(target=fire)  # worker parks in the runner
+        t1.start()
+        time.sleep(0.1)
+        # deterministic deadline: queued behind the stuck batch, expires in
+        # ~50ms -> 504 long before the gate opens (the expired request still
+        # holds its queue slot until the worker pops it)
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(url + "/v1/models/gated:predict",
+                       dict(payload, timeout_ms=50))
+        assert ei.value.code == 504
+        assert time.monotonic() - t0 < 2.0
+        ei.value.read()
+        t2 = threading.Thread(target=fire)  # fills the second queue slot
+        t2.start()
+        time.sleep(0.1)
+        # deterministic overload: full queue answers 429 immediately
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(url + "/v1/models/gated:predict", payload)
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After")
+        ei.value.read()
+
+        gate.set()
+        t1.join(timeout=5)
+        t2.join(timeout=5)
+        assert sorted(codes) == [200, 200], codes
+
+        # /drainz flips health and reports progress; draining rejects 503
+        assert json.loads(urllib.request.urlopen(
+            url + "/drainz").read())["draining"] is True
+        deadline = time.monotonic() + 5
+        while not srv.draining and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/healthz")
+        assert ei.value.code == 503
+        ei.value.read()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(url + "/v1/models/gated:predict", payload)
+        assert ei.value.code == 503
+        ei.value.read()
+    finally:
+        gate.set()
+        srv.shutdown()
+
+
+def test_serving_telemetry_metrics():
+    """The observability contract (docs/observability.md): queue gauge,
+    occupancy/latency histograms and request counters all publish."""
+    def runner(arrays, bucket, n):
+        return [arrays["x"]]
+
+    repo = ModelRepository()
+    repo.add(ServedModel("tele", 7, runner, [1, 2], {"x": (1,)},
+                         max_delay_ms=1, queue_depth=8))
+    m = repo.get("tele")
+    for _ in range(5):
+        m.predict({"x": np.ones((2, 1), np.float32)}, timeout_ms=2000)
+    snap = telemetry.snapshot()
+    lbl = '{model="tele/7"}'
+    assert snap["mxtpu_serve_requests_total" + lbl]["value"] == 5
+    assert snap["mxtpu_serve_examples_total" + lbl]["value"] == 10
+    assert snap["mxtpu_serve_batches_total" + lbl]["value"] == 5
+    assert snap["mxtpu_serve_batch_occupancy" + lbl]["count"] == 5
+    assert snap["mxtpu_serve_queue_seconds" + lbl]["count"] == 5
+    assert snap["mxtpu_serve_compute_seconds" + lbl]["count"] == 5
+    assert "mxtpu_serve_models_loaded" in snap
+
+
+# ---------------------------------------------------------------------------
+# process level: SIGTERM graceful drain (tools/serve.py contract)
+# ---------------------------------------------------------------------------
+
+def test_sigterm_drains_inflight_then_exits_zero():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+    env.pop("MXTPU_TELEMETRY_DIR", None)
+    proc = subprocess.Popen(
+        [sys.executable, _WORKER, "--step-delay", "0.5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+        url = "http://127.0.0.1:%d" % port
+
+        result = {}
+
+        def fire():
+            try:
+                result["resp"] = _post_json(
+                    url + "/v1/models/echo:predict",
+                    {"inputs": {"x": [[1.0, 2.0]]}, "timeout_ms": 10000},
+                    timeout=15)
+            except Exception as e:  # surfaced in the assert below
+                result["error"] = e
+
+        t = threading.Thread(target=fire)
+        t.start()
+        time.sleep(0.15)  # request admitted; runner sleeping mid-batch
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=20)
+        # the in-flight request was served, not dropped
+        assert result.get("resp"), result
+        code, resp = result["resp"]
+        assert code == 200 and resp["outputs"][0] == [[2.0, 4.0]]
+        out, _ = proc.communicate(timeout=30)
+        assert proc.returncode == 0, out  # drained then exited 0
+        assert "DRAINED" in out, out
+        # and the server really is gone
+        with pytest.raises(Exception):
+            urllib.request.urlopen(url + "/healthz", timeout=2)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)

@@ -129,6 +129,22 @@ run_one bert            MXTPU_BENCH_MODE=bert
 run_one lstm            MXTPU_BENCH_MODE=lstm
 run_one lstm_scan       MXTPU_BENCH_MODE=lstm MXTPU_PALLAS_LSTM=0
 
+# serving: dynamic-batching inference over resnet18 (docs/serving.md) —
+# closed-loop speedup vs sequential, open-loop latency, batch occupancy,
+# and the zero-recompile-after-warmup proof, with the full telemetry JSONL
+# (queue depth / occupancy / jit events) archived next to the artifact
+echo "[bench_capture] serve bench (resnet18)" >&2
+SERVE_TDIR=$(mktemp -d "telemetry_${TAG}_serve.XXXX")
+env MXTPU_TELEMETRY_DIR="$SERVE_TDIR" PYTHONPATH=".:${PYTHONPATH:-}" \
+  timeout 1500 python tools/serve_bench.py --net resnet18 \
+  --clients 32 --requests 12 --open-rate 100 \
+  > "BENCH_${TAG}_serve_resnet18.json" 2> "BENCH_${TAG}_serve_resnet18.log"
+echo "[bench_capture] serve bench rc=$?" >&2
+if ls "$SERVE_TDIR"/*.jsonl >/dev/null 2>&1; then
+  cat "$SERVE_TDIR"/*.jsonl > "BENCH_${TAG}_serve_resnet18_telemetry.jsonl"
+fi
+rm -rf "$SERVE_TDIR"
+
 echo "[bench_capture] running tpu smoke suite" >&2
 MXTPU_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_smoke.py -v \
   > "TPU_SMOKE_${TAG}.log" 2>&1

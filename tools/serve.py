@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""serve: launch the mxnet_tpu dynamic-batching inference server
+(docs/serving.md).
+
+Loads one or more deployment artifacts into a `ModelRepository`, warms
+every padding bucket (so steady-state traffic never compiles), and serves
+the `/v1/models` HTTP surface until SIGTERM — which drains queued and
+in-flight requests before exiting 0.
+
+Model specs (repeatable ``--model``):
+
+  name=PREFIX@input=DIMS[:dtype][,input2=...]   export prefix
+      (PREFIX-symbol.json + PREFIX-NNNN.params; DIMS are the PER-EXAMPLE
+      dims, 'x'-separated, batch dim excluded)
+  name=PATH.mxc                                  compiled AOT artifact
+      (geometry frozen at build; its batch size is the padding bucket)
+
+Examples:
+
+  python tools/serve.py --model mlp=/models/mlp/model@data=8
+  python tools/serve.py --model rn18=/models/rn18/model@data=3x224x224 \\
+                        --model rn18mxc=/models/rn18.mxc --port 8500
+
+Knobs default to the typed ``MXTPU_SERVE_*`` registry (docs/env_vars.md);
+CLI flags override per process.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def parse_model_spec(spec):
+    """``name=path[@in=DIMS[:dtype][,in2=...]]`` -> (name, path, shapes,
+    dtypes); shapes/dtypes are None for compiled artifacts."""
+    if "=" not in spec:
+        raise ValueError("model spec %r needs name=path" % spec)
+    name, rest = spec.split("=", 1)
+    if "@" not in rest:
+        return name, rest, None, None
+    path, sig = rest.split("@", 1)
+    shapes, dtypes = {}, {}
+    for part in sig.split(","):
+        if "=" not in part:
+            raise ValueError("input spec %r needs input=DIMS" % part)
+        iname, dims = part.split("=", 1)
+        if ":" in dims:
+            dims, dtype = dims.split(":", 1)
+            dtypes[iname] = dtype
+        shapes[iname] = tuple(int(d) for d in dims.split("x") if d)
+    return name, path, shapes, (dtypes or None)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--model", action="append", required=True,
+                   metavar="NAME=PATH[@IN=DIMS[:DTYPE],...]",
+                   help="artifact to serve (repeatable)")
+    p.add_argument("--port", type=int, default=None,
+                   help="HTTP port (default MXTPU_SERVE_PORT; 0 = free port)")
+    p.add_argument("--addr", default="0.0.0.0")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="override MXTPU_SERVE_MAX_BATCH")
+    p.add_argument("--delay-ms", type=float, default=None,
+                   help="override MXTPU_SERVE_MAX_DELAY_MS")
+    p.add_argument("--queue-depth", type=int, default=None,
+                   help="override MXTPU_SERVE_QUEUE_DEPTH")
+    p.add_argument("--no-warm", action="store_true",
+                   help="skip bucket warmup at load (first requests compile)")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[serve] %(asctime)s %(levelname)s %(message)s")
+    log = logging.getLogger("mxnet_tpu.serving")
+
+    from mxnet_tpu.serving import ModelRepository, ServingServer
+
+    repo = ModelRepository()
+    for spec in args.model:
+        name, path, shapes, dtypes = parse_model_spec(spec)
+        log.info("loading %s from %s ...", name, path)
+        model = repo.load(name, path, input_shapes=shapes,
+                          input_dtypes=dtypes, max_batch=args.max_batch,
+                          max_delay_ms=args.delay_ms,
+                          queue_depth=args.queue_depth,
+                          warm=not args.no_warm)
+        log.info("loaded %s/%d buckets=%s warm=%.2fs", model.name,
+                 model.version, model.buckets, model.warm_seconds or 0.0)
+
+    server = ServingServer(repo, port=args.port, addr=args.addr)
+    server.install_signal_handlers()
+    log.info("serving %s on %s:%d (SIGTERM drains and exits 0)",
+             repo.names(), args.addr, server.port)
+    server.serve_forever()  # returns after the SIGTERM drain
+    log.info("drained; bye")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,389 @@
+#!/usr/bin/env python
+"""serve_bench: open/closed-loop load generator for the serving subsystem
+(docs/serving.md load-test playbook).
+
+Builds (or loads) a model, serves it in-process through the real HTTP
+stack (`ServingServer` on 127.0.0.1), and measures four phases:
+
+  1. ``sequential`` — one closed-loop client, single-example requests:
+     the predict-API baseline the batcher must beat.
+  2. ``batched`` — N closed-loop clients, single-example requests: the
+     dynamic-batching payoff at the SAME per-request deadline budget.
+  3. ``mixed`` — N clients with varying per-request example counts:
+     exercises every padding bucket; the executable-cache proof is that
+     ZERO ``jit_compile`` events fire in this phase (warmup covered all
+     buckets).
+  4. ``open`` (optional, ``--open-rate``) — Poisson arrivals at a fixed
+     rate: latency under a load the server does not control.
+
+Emits one JSON document on stdout: p50/p99 latency, throughput,
+speedup over sequential, batch occupancy, error counts by status, and
+the jit-compile-after-warmup count. Run under a fresh
+``MXTPU_TELEMETRY_DIR`` to archive the full metrics JSONL next to the
+result (tools/bench_capture.sh `serve_resnet18` row does).
+
+Offline evidence (CPU):
+
+  JAX_PLATFORMS=cpu python tools/serve_bench.py > BENCH_serve.json
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ---------------------------------------------------------------------------
+# model builders
+# ---------------------------------------------------------------------------
+
+def _build_mlp(tmpdir):
+    """A BLAS-bound MLP: per-call overhead dominates single-request serving,
+    so batching headroom is visible even on CPU."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential(prefix="bench_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(1024, activation="relu"),
+                gluon.nn.Dense(1024, activation="relu"),
+                gluon.nn.Dense(1024, activation="relu"),
+                gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    net(mx.nd.array(np.zeros((1, 64), np.float32)))
+    prefix = os.path.join(tmpdir, "mlp")
+    net.export(prefix, epoch=0)
+    return prefix, {"data": (64,)}
+
+
+def _build_resnet18(tmpdir, image_size):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1()
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    shape = (3, image_size, image_size)
+    net(mx.nd.array(np.zeros((1,) + shape, np.float32)))
+    prefix = os.path.join(tmpdir, "resnet18")
+    net.export(prefix, epoch=0)
+    return prefix, {"data": shape}
+
+
+# ---------------------------------------------------------------------------
+# load phases
+# ---------------------------------------------------------------------------
+
+def _percentile(sorted_ms, q):
+    if not sorted_ms:
+        return None
+    i = min(len(sorted_ms) - 1, int(round(q * (len(sorted_ms) - 1))))
+    return sorted_ms[i]
+
+
+class _Client:
+    """One persistent keep-alive connection (the realistic steady-client
+    shape: no TCP setup or server thread spawn per request)."""
+
+    def __init__(self, host, port, path, timeout_s):
+        self.host, self.port, self.path = host, port, path
+        self.timeout_s = timeout_s
+        self.conn = None
+
+    def post(self, body):
+        t0 = time.perf_counter()
+        try:
+            if self.conn is None:
+                self.conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout_s)
+            self.conn.request("POST", self.path, body=body,
+                              headers={"Content-Type": "application/json"})
+            r = self.conn.getresponse()
+            r.read()
+            code = r.status
+            if r.will_close:
+                self.conn.close()
+                self.conn = None
+        except Exception:
+            code = -1
+            if self.conn is not None:
+                self.conn.close()
+                self.conn = None
+        return (time.perf_counter() - t0) * 1e3, code
+
+    def close(self):
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
+
+
+def _closed_loop(endpoint, payloads, clients, requests_each, timeout_s):
+    """`clients` threads, each firing `requests_each` back-to-back posts
+    over its own persistent connection."""
+    lats, codes, lock = [], {}, threading.Lock()
+
+    def worker(wid):
+        cli = _Client(*endpoint, timeout_s=timeout_s)
+        mine = []
+        my_codes = {}
+        for i in range(requests_each):
+            ms, code = cli.post(payloads[(wid + i) % len(payloads)])
+            mine.append(ms)
+            my_codes[code] = my_codes.get(code, 0) + 1
+        cli.close()
+        with lock:
+            lats.extend(mine)
+            for c, n in my_codes.items():
+                codes[c] = codes.get(c, 0) + n
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lats.sort()
+    total = clients * requests_each
+    return {
+        "requests": total,
+        "wall_s": round(wall, 3),
+        "rps": round(total / wall, 2),
+        "p50_ms": round(_percentile(lats, 0.50), 3),
+        "p99_ms": round(_percentile(lats, 0.99), 3),
+        "mean_ms": round(sum(lats) / len(lats), 3),
+        "codes": {str(k): v for k, v in sorted(codes.items())},
+    }
+
+
+def _open_loop(endpoint, payloads, rate, duration, timeout_s):
+    """Poisson arrivals at `rate`/s for `duration`s (bounded concurrency)."""
+    lats, codes, lock = [], {}, threading.Lock()
+    sem = threading.Semaphore(256)
+    threads = []
+    rng = random.Random(0)
+
+    def one(body):
+        try:
+            cli = _Client(*endpoint, timeout_s=timeout_s)
+            ms, code = cli.post(body)
+            cli.close()
+            with lock:
+                lats.append(ms)
+                codes[code] = codes.get(code, 0) + 1
+        finally:
+            sem.release()
+
+    t0 = time.perf_counter()
+    next_t = t0
+    i = 0
+    while True:
+        next_t += rng.expovariate(rate)
+        if next_t - t0 > duration:
+            break
+        delay = next_t - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        sem.acquire()
+        t = threading.Thread(target=one, args=(payloads[i % len(payloads)],),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+        i += 1
+    for t in threads:
+        t.join(timeout=timeout_s + 5)
+    wall = time.perf_counter() - t0
+    lats.sort()
+    return {
+        "target_rate": rate,
+        "duration_s": duration,
+        "requests": len(lats),
+        "achieved_rps": round(len(lats) / wall, 2) if lats else 0.0,
+        "p50_ms": round(_percentile(lats, 0.50), 3) if lats else None,
+        "p99_ms": round(_percentile(lats, 0.99), 3) if lats else None,
+        "codes": {str(k): v for k, v in sorted(codes.items())},
+    }
+
+
+def _payload(arr, timeout_ms):
+    return json.dumps({"inputs": {"data": arr.tolist()},
+                       "timeout_ms": timeout_ms}).encode()
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--net", choices=("mlp", "resnet18"), default="mlp")
+    p.add_argument("--model", default=None,
+                   help="serve an existing artifact instead of building one "
+                        "(export prefix or .mxc; needs --input for a prefix)")
+    p.add_argument("--input", default=None, metavar="NAME=DIMS",
+                   help="per-example input signature for --model prefixes, "
+                        "e.g. data=3x224x224")
+    p.add_argument("--image-size", type=int, default=32,
+                   help="resnet18 spatial size (32 keeps CPU runs fast)")
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--delay-ms", type=float, default=5.0)
+    p.add_argument("--clients", type=int, default=16)
+    p.add_argument("--requests", type=int, default=25,
+                   help="closed-loop requests PER CLIENT per phase")
+    p.add_argument("--seq-requests", type=int, default=None,
+                   help="sequential-phase request count "
+                        "(default: clients*requests capped at 200)")
+    p.add_argument("--timeout-ms", type=float, default=30000.0,
+                   help="per-request deadline used by EVERY phase (equal "
+                        "latency budget across sequential and batched)")
+    p.add_argument("--open-rate", type=float, default=0.0,
+                   help="open-loop phase arrival rate per second (0 = skip)")
+    p.add_argument("--open-duration", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    import mxnet_tpu  # noqa: F401  (package init pins platform handling)
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import ModelRepository, ServingServer
+
+    log = lambda msg: print("[serve_bench] " + msg, file=sys.stderr)  # noqa: E731
+
+    tmpdir = tempfile.mkdtemp(prefix="serve_bench_")
+    input_shapes = None
+    if args.model:
+        prefix = args.model
+        if args.input:
+            iname, dims = args.input.split("=", 1)
+            input_shapes = {iname: tuple(int(d) for d in dims.split("x"))}
+    elif args.net == "resnet18":
+        log("building resnet18_v1 (%dx%d) ..." % (args.image_size,
+                                                  args.image_size))
+        prefix, input_shapes = _build_resnet18(tmpdir, args.image_size)
+    else:
+        log("building mlp ...")
+        prefix, input_shapes = _build_mlp(tmpdir)
+
+    repo = ModelRepository()
+    t0 = time.perf_counter()
+    model = repo.load("bench", prefix, input_shapes=input_shapes,
+                      max_batch=args.max_batch, max_delay_ms=args.delay_ms,
+                      queue_depth=max(1024, args.clients * 4))
+    load_s = time.perf_counter() - t0
+    log("loaded buckets=%s warm=%.2fs" % (model.buckets,
+                                          model.warm_seconds or 0.0))
+
+    # executable-cache evidence: executor builds BEFORE traffic (warmup
+    # compiles one forward per bucket; steady state must add zero)
+    builds = telemetry.get_registry().counter(
+        "mxtpu_executor_build_total", {"what": "forward"})
+    builds_after_warm = builds.value
+
+    server = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    endpoint = ("127.0.0.1", server.port, "/v1/models/bench:predict")
+    timeout_s = args.timeout_ms / 1e3 + 10.0
+    shape = next(iter(input_shapes.values()))
+    rng = np.random.RandomState(0)
+
+    one = [_payload(rng.uniform(-1, 1, (1,) + shape).astype(np.float32),
+                    args.timeout_ms) for _ in range(8)]
+
+    seq_n = args.seq_requests or min(200, args.clients * args.requests)
+    log("phase 1/3: sequential x%d ..." % seq_n)
+    seq = _closed_loop(endpoint, one, clients=1, requests_each=seq_n,
+                       timeout_s=timeout_s)
+    log("  sequential: %.1f req/s p50=%.1fms p99=%.1fms"
+        % (seq["rps"], seq["p50_ms"], seq["p99_ms"]))
+
+    log("phase 2/3: batched closed-loop %d clients x%d ..."
+        % (args.clients, args.requests))
+    batched = _closed_loop(endpoint, one, clients=args.clients,
+                           requests_each=args.requests, timeout_s=timeout_s)
+    log("  batched: %.1f req/s p50=%.1fms p99=%.1fms"
+        % (batched["rps"], batched["p50_ms"], batched["p99_ms"]))
+
+    # mixed per-request example counts: every bucket gets traffic, and the
+    # executable cache must already hold them all
+    sizes = [s for s in (1, 2, 3, 4, 5, 7, 8) if s <= model.max_batch]
+    mix_rng = random.Random(0)
+    mixed_payloads = [
+        _payload(rng.uniform(-1, 1, (mix_rng.choice(sizes),) + shape)
+                 .astype(np.float32), args.timeout_ms)
+        for _ in range(32)]
+    builds_before_mixed = builds.value
+    log("phase 3/3: mixed sizes %s ..." % sizes)
+    mixed = _closed_loop(endpoint, mixed_payloads, clients=args.clients,
+                         requests_each=max(4, args.requests // 2),
+                         timeout_s=timeout_s)
+    jit_after_warm = builds.value - builds_after_warm
+    jit_in_mixed = builds.value - builds_before_mixed
+    log("  mixed: %.1f req/s; jit compiles during traffic: %d"
+        % (mixed["rps"], jit_after_warm))
+
+    open_phase = None
+    if args.open_rate > 0:
+        log("open loop @ %.0f req/s for %.0fs ..." % (args.open_rate,
+                                                      args.open_duration))
+        open_phase = _open_loop(endpoint, one, args.open_rate, args.open_duration,
+                                timeout_s)
+
+    # occupancy evidence from the serving metrics themselves
+    snap = telemetry.snapshot()
+    label = '{model="%s/%d"}' % (model.name, model.version)
+    occ = snap.get("mxtpu_serve_batch_occupancy" + label, {})
+    bsz = snap.get("mxtpu_serve_batch_size" + label, {})
+    batches = snap.get("mxtpu_serve_batches_total" + label, {}).get("value", 0)
+    examples = snap.get("mxtpu_serve_examples_total" + label,
+                        {}).get("value", 0)
+
+    speedup = round(batched["rps"] / seq["rps"], 2) if seq["rps"] else None
+    result = {
+        "mode": "serve_bench",
+        "net": os.path.basename(args.model) if args.model else args.net,
+        "device": "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu"
+                  else "default",
+        "buckets": model.buckets,
+        "max_batch": model.max_batch,
+        "delay_ms": args.delay_ms,
+        "timeout_ms": args.timeout_ms,
+        "load_s": round(load_s, 2),
+        "warm_s": round(model.warm_seconds or 0.0, 2),
+        "sequential": seq,
+        "batched": dict(batched, clients=args.clients),
+        "mixed": dict(mixed, sizes=sizes),
+        "open": open_phase,
+        "speedup_batched_vs_sequential": speedup,
+        "jit_compiles_after_warmup": jit_after_warm,
+        "jit_compiles_in_mixed_phase": jit_in_mixed,
+        "occupancy": {
+            "batches": batches,
+            "examples": examples,
+            "mean_batch": round(examples / batches, 2) if batches else None,
+            "mean_fill": round(occ["sum"] / occ["count"], 3)
+                         if occ.get("count") else None,
+            "batch_size_hist": bsz.get("buckets"),
+        },
+    }
+    server.drain(shutdown=True)
+    telemetry.flush(reason="serve_bench")  # archive JSONL when dir is set
+    json.dump(result, sys.stdout, indent=1)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
