@@ -816,27 +816,17 @@ def _device_watchdog(timeout_s=None):
     exit non-zero so the captured artifact explains itself.
 
     A transiently-wedged tunnel at t=0 may come back — the dial is retried
-    (the probe thread stays blocked in the same jax.devices() call, which
-    completes whenever the tunnel answers; we just keep waiting) with a
-    progress note every 60s, up to MXTPU_BENCH_DIAL_RETRY_S total (default
-    900s) before declaring the device unreachable."""
+    (runtime.dial_devices parks its probe thread in the same jax.devices()
+    call, which completes whenever the tunnel answers; repeated calls just
+    keep waiting on it) with a progress note every 60s, up to
+    MXTPU_BENCH_DIAL_RETRY_S total (default 900s) before declaring the
+    device unreachable. The shared dial also brackets every attempt with
+    flight-recorder events and refreshes the MXTPU_TOPOLOGY_CACHE file on
+    success, so a later stale artifact can name the hardware it missed."""
     import sys
-    import threading
 
     if timeout_s is None:
         timeout_s = int(os.environ.get("MXTPU_BENCH_DIAL_RETRY_S", 900))
-
-    done = threading.Event()
-    err = []
-
-    def probe():
-        try:
-            import jax
-
-            jax.devices()
-        except Exception as e:  # noqa: BLE001 — reported, not swallowed
-            err.append(str(e))
-        done.set()
 
     metric = {"score": "%s_score_bs%d_imgs_per_sec" % (NET, BATCH),
               "score_int8": "%s_score_int8_bs%d_imgs_per_sec" % (NET, BATCH),
@@ -848,23 +838,29 @@ def _device_watchdog(timeout_s=None):
         # stale-fallback path) without needing an actually-wedged tunnel
         _fail_json(metric, "forced dial failure "
                            "(MXTPU_BENCH_FORCE_DIAL_FAIL test hook)")
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
+    from mxnet_tpu import runtime as _runtime
+    from mxnet_tpu.base import MXNetError
+
     waited = 0
-    ok = done.wait(min(60, timeout_s))
-    while not ok and waited + 60 < timeout_s:
-        waited += 60
-        print("bench: accelerator dial still blocked after %ds; retrying "
-              "(up to %ds, MXTPU_BENCH_DIAL_RETRY_S)" % (waited, timeout_s),
-              file=sys.stderr, flush=True)
-        ok = done.wait(min(60, timeout_s - waited))
-    if not ok:
-        _fail_json(metric,
-                   "accelerator tunnel unreachable: jax.devices() still "
-                   "blocked after %ds (axon PJRT dial hang); bench "
-                   "aborted rather than timing out silently" % timeout_s)
-    if err:
-        _fail_json(metric, "jax backend init failed: %s" % err[0][:500])
+    while True:
+        slice_s = max(1, min(60, timeout_s - waited))
+        try:
+            _runtime.dial_devices(timeout_s=slice_s)
+            return
+        except MXNetError as e:
+            if "backend init failed" in str(e):
+                _fail_json(metric, "jax backend init failed: %s"
+                                   % str(e)[:500])
+            waited += slice_s
+            if waited >= timeout_s:
+                _fail_json(
+                    metric,
+                    "accelerator tunnel unreachable: jax.devices() still "
+                    "blocked after %ds (axon PJRT dial hang); bench "
+                    "aborted rather than timing out silently" % timeout_s)
+            print("bench: accelerator dial still blocked after %ds; "
+                  "retrying (up to %ds, MXTPU_BENCH_DIAL_RETRY_S)"
+                  % (waited, timeout_s), file=sys.stderr, flush=True)
 
 
 def main():

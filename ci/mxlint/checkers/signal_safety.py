@@ -8,11 +8,20 @@ handler lock being the classic — deadlocks exactly the hung process the
 flight recorder exists to diagnose. (This is why telemetry metrics are
 lock-free by design: docs/observability.md.)
 
-The checker walks the call graph from the entry points (``_on_sigusr1`` and
-``dump`` in ``mxnet_tpu/telemetry/recorder.py``) across the telemetry
-package (+ ``mxnet_tpu/env.py``, which the package reads config through)
-and enforces a default-deny policy on every call it cannot resolve into
-that analyzed set:
+The serving layer's signal handlers are held to the same bar: the replica
+worker's SIGTERM handler (``_on_term`` in ``serving/supervisor.py``) and
+the serving frontend's drain handler (``_on_signal``, nested inside
+``ServingServer.install_signal_handlers``) both run between two arbitrary
+bytecodes of a main thread that spawns threads and takes locks of its
+own — a handler that called ``Thread.start()`` could deadlock on the
+threading module's internals. Both are therefore flag-flip/Event-set
+only, and walked from here so they stay that way.
+
+The checker walks the call graph from the entry points (``_on_sigusr1``
+and ``dump`` in ``mxnet_tpu/telemetry/recorder.py``, plus the serving
+handlers above) across the telemetry package (+ ``mxnet_tpu/env.py``,
+which the package reads config through) and enforces a default-deny
+policy on every call it cannot resolve into that analyzed set:
 
   * allowed: calls into {os, sys, time, json, traceback, tempfile,
     collections, math, io} and a builtin allowlist; ``threading.enumerate``
@@ -39,9 +48,16 @@ _SCOPE_FILES = (
     "mxnet_tpu/telemetry/core.py",
     "mxnet_tpu/telemetry/__init__.py",
     "mxnet_tpu/env.py",
+    "mxnet_tpu/serving/supervisor.py",
+    "mxnet_tpu/serving/server.py",
 )
+# entry names may be nested defs (the serving drain handler is defined
+# inside install_signal_handlers); resolution falls back to a whole-tree
+# search when the name is not module-level
 _ENTRY = (("mxnet_tpu/telemetry/recorder.py", "_on_sigusr1"),
-          ("mxnet_tpu/telemetry/recorder.py", "dump"))
+          ("mxnet_tpu/telemetry/recorder.py", "dump"),
+          ("mxnet_tpu/serving/supervisor.py", "_on_term"),
+          ("mxnet_tpu/serving/server.py", "_on_signal"))
 
 _SAFE_ROOTS = {"os", "sys", "time", "json", "traceback", "tempfile",
                "collections", "math", "io"}
@@ -79,6 +95,7 @@ class _Module:
 
     def __init__(self, rel, tree):
         self.rel = rel
+        self.tree = tree
         self.functions = {}    # module-level name -> FunctionDef
         self.classes = {}      # class name -> {method name -> FunctionDef}
         self.mod_aliases = {}  # local alias -> module key ("core", "env")
@@ -149,7 +166,15 @@ class SignalSafetyChecker:
         by_rel = {m.rel: m for m in modules.values()}
         for rel, name in _ENTRY:
             mod = by_rel.get(rel)
-            entry = mod.functions.get(name) if mod is not None else None
+            if mod is None:
+                continue  # optional scope file absent (serving not built)
+            entry = mod.functions.get(name)
+            if entry is None:
+                # nested handler (defined inside the installer method)
+                for node in ast.walk(mod.tree):
+                    if isinstance(node, FUNC_DEFS) and node.name == name:
+                        entry = node
+                        break
             if entry is not None:
                 visit(mod, entry, "%s()" % name)
             else:

@@ -356,10 +356,59 @@ _var("MXTPU_SERVE_TIMEOUT_MS", "float", 2000.0,
 _var("MXTPU_SERVE_PORT", "int", 8500,
      "serving: default HTTP port for `tools/serve.py` / `ServingServer` "
      "(0 binds a free port — tests and serve_bench).")
-_var("MXTPU_SERVE_DRAIN_TIMEOUT_S", "float", 30.0,
-     "serving: graceful-shutdown budget — how long SIGTERM/`/drainz` "
-     "waits for queued + in-flight requests to finish before the server "
-     "stops (docs/serving.md drain semantics).")
+_var("MXTPU_SERVE_DRAIN_TIMEOUT_MS", "float", 30000.0,
+     "serving: graceful-shutdown budget in ms — how long SIGTERM/`/drainz` "
+     "waits for queued + in-flight requests to finish. A wedged executor "
+     "must not wedge shutdown forever: on expiry the drain FORCE-completes "
+     "every stranded request with a deterministic 503 and the process "
+     "exits nonzero (docs/serving.md drain semantics; replaced the "
+     "seconds-typed `MXTPU_SERVE_DRAIN_TIMEOUT_S`).")
+_var("MXTPU_SERVE_DRAIN_TIMEOUT_S", "float", None,
+     "DEPRECATED serving drain budget (seconds-typed predecessor of "
+     "`MXTPU_SERVE_DRAIN_TIMEOUT_MS`). Still honored — with a startup "
+     "warning — when set and the `_MS` name is not, so existing "
+     "deployments' drain settings survive the rename.")
+_var("MXTPU_SERVE_REPLICAS", "int", 0,
+     "serving: replica worker processes per served model (`tools/serve.py "
+     "--replicas`). 0 runs the model in-process (no pool); N >= 1 runs N "
+     "supervised replica processes with health-checked failover "
+     "(docs/serving.md resilience).")
+_var("MXTPU_SERVE_HEARTBEAT_MS", "float", 1000.0,
+     "serving replica pool: health-check heartbeat deadline. An idle "
+     "replica that misses a ping/pong round trip by this much — or a busy "
+     "one silent past its batch deadline plus this grace — is declared "
+     "wedged, ejected (process-group teardown) and respawned.")
+_var("MXTPU_SERVE_WEDGE_TIMEOUT_MS", "float", 10000.0,
+     "serving replica pool: compute-budget FLOOR for busy-replica wedge "
+     "detection. A busy replica is ejected only after staying silent past "
+     "max(batch deadline budget, this floor) plus the heartbeat grace — "
+     "decoupling wedge detection from client deadlines so a model whose "
+     "forward legitimately outlasts a request budget is not SIGKILLed "
+     "mid-compute (deadline-less batches use the floor alone).")
+_var("MXTPU_SERVE_POOL_TOKEN", "str", None,
+     "serving replica pool: INTERNAL per-pool handshake secret. Set by "
+     "the pool in each replica worker's environment; a connecting worker "
+     "must present it before any pickled frame is read, so another local "
+     "user cannot reach the router's unpickler or hijack a replica slot. "
+     "Not meant to be set by operators.")
+_var("MXTPU_SERVE_RESTART_BACKOFF_MS", "float", 200.0,
+     "serving replica pool: initial delay before respawning an ejected "
+     "replica (doubles per consecutive restart of the same replica, "
+     "capped at 60s; resets once a generation serves a batch cleanly).")
+
+# -- accelerator dial -------------------------------------------------------
+_var("MXTPU_DIAL_TIMEOUT_S", "float", 60.0,
+     "`runtime.dial_devices`: seconds the PJRT device dial (`jax."
+     "devices()`) may block before the deadline probe raises a diagnosable "
+     "MXNetError (a wedged axon tunnel otherwise blocks forever — the "
+     "ROADMAP item-5 failure class). Flight-recorder events bracket every "
+     "dial.")
+_var("MXTPU_TOPOLOGY_CACHE", "str", None,
+     "path of the device-topology cache file `runtime.dial_devices` "
+     "writes after a successful non-CPU dial (platform/device kind/count/"
+     "timestamp JSON). A later failed dial reports the last known "
+     "topology instead of nothing; `tools/bench_capture.sh` arms it so "
+     "stale artifacts are labeled with the hardware they missed.")
 
 # -- telemetry / flight recorder --------------------------------------------
 _var("MXTPU_TELEMETRY", "bool", True,

@@ -37,8 +37,8 @@ from .. import env as _env
 from ..base import MXNetError, atomic_writer, _fsync_dir
 from .. import telemetry
 
-__all__ = ["CheckpointManager", "maybe_inject_fault", "fault_spec",
-           "restart_generation"]
+__all__ = ["CheckpointManager", "maybe_inject_fault",
+           "maybe_inject_serving_fault", "fault_spec", "restart_generation"]
 
 _LOG = logging.getLogger("mxnet_tpu.resilience")
 
@@ -374,31 +374,54 @@ class CheckpointManager:
 #                                                   garble the newest
 #                                                   checkpoint's params file
 #
-# Conditions: step (required), rank (default: any), gen (supervision
-# generation, default 0 so a restarted run does NOT re-trigger), code (exit
-# status for kill, default 42), dir (corrupt_ckpt target; falls back to
-# $MXTPU_CKPT_DIR). The hook sits at the trainer step boundary — after the
-# optimizer update for `step` completes, before anything later runs — which
-# is exactly the crash window that loses un-checkpointed progress.
+# Serving actions (fired by the replica worker at its batch boundary —
+# mxnet_tpu/serving/supervisor.py; `batch=` replaces `step=` as the
+# when-condition, `replica=` replaces `rank=` as the where-condition):
+#
+#   MXTPU_FAULT_INJECT="kill_replica@batch=3,replica=0"   hard replica death
+#                                                   (SIGKILL/OOM stand-in)
+#   MXTPU_FAULT_INJECT="wedge_replica@batch=5,replica=1"  park the replica
+#                                                   forever mid-batch (the
+#                                                   heartbeat-ejection test
+#                                                   vector)
+#   MXTPU_FAULT_INJECT="slow_reply@batch=2,ms=500"  delay one reply by ms=
+#                                                   (deadline-propagation
+#                                                   test vector)
+#
+# Conditions: step (required for training actions) / batch (required for
+# serving actions), rank / replica (default: any), gen (supervision or
+# replica-respawn generation, default 0 so a restarted run or respawned
+# replica does NOT re-trigger), code (exit status for kill/kill_replica,
+# default 42), ms (slow_reply delay, default 1000), dir (corrupt_ckpt
+# target; falls back to $MXTPU_CKPT_DIR). The training hook sits at the
+# trainer step boundary — after the optimizer update for `step` completes,
+# before anything later runs — which is exactly the crash window that loses
+# un-checkpointed progress.
 
 _FAULT_EXIT_CODE = 42
+_TRAIN_ACTIONS = ("kill", "exc", "hang", "corrupt_ckpt")
+_SERVE_ACTIONS = ("kill_replica", "wedge_replica", "slow_reply")
 _UNPARSED = object()
 _fault_cache = _UNPARSED
 
 
 def fault_spec(env=None):
     """Parse MXTPU_FAULT_INJECT into a list of {action, step, rank, gen,
-    code, dir} dicts. Malformed entries raise MXNetError eagerly — a typo'd
-    injection silently never firing would invalidate the test using it."""
+    code, dir, batch, replica, ms} dicts. Malformed entries raise MXNetError
+    eagerly — a typo'd injection silently never firing would invalidate the
+    test using it."""
     raw = (_env.raw("MXTPU_FAULT_INJECT") or "") if env is None else env
     entries = []
     for part in raw.replace(";", " ").split():
         action, _, conds = part.partition("@")
-        if action not in ("kill", "exc", "hang", "corrupt_ckpt"):
+        if action not in _TRAIN_ACTIONS + _SERVE_ACTIONS:
             raise MXNetError("MXTPU_FAULT_INJECT: unknown action %r in %r "
-                             "(kill|exc|hang|corrupt_ckpt)" % (action, part))
+                             "(%s)" % (action, part,
+                                       "|".join(_TRAIN_ACTIONS
+                                                + _SERVE_ACTIONS)))
         entry = {"action": action, "step": None, "rank": None,
-                 "gen": 0, "code": _FAULT_EXIT_CODE, "dir": None}
+                 "gen": 0, "code": _FAULT_EXIT_CODE, "dir": None,
+                 "batch": None, "replica": None, "ms": 1000}
         for cond in filter(None, conds.split(",")):
             k, eq, v = cond.partition("=")
             if not eq or k not in entry or k == "action":
@@ -410,11 +433,36 @@ def fault_spec(env=None):
                 raise MXNetError(
                     "MXTPU_FAULT_INJECT: %s= wants an integer, got %r in %r"
                     % (k, v, part)) from None
-        if entry["step"] is None:
-            raise MXNetError("MXTPU_FAULT_INJECT: %r needs a step= condition"
-                             % (part,))
+        when = "batch" if action in _SERVE_ACTIONS else "step"
+        if entry[when] is None:
+            raise MXNetError("MXTPU_FAULT_INJECT: %r needs a %s= condition"
+                             % (part, when))
         entries.append(entry)
     return entries
+
+
+def _entries():
+    """Parse-and-memoize the MXTPU_FAULT_INJECT spec — shared by the
+    trainer-step and replica-batch hooks so the no-op path stays one
+    cached-empty check."""
+    global _fault_cache
+    if _fault_cache is _UNPARSED:
+        _fault_cache = fault_spec() if _env.is_set("MXTPU_FAULT_INJECT") \
+            else []
+    return _fault_cache
+
+
+def _exit_hard(code):
+    """Hard death, no cleanup handlers — models SIGKILL/OOM/preemption.
+    stdio is flushed so the log prefix trail ends at the right line."""
+    import sys
+
+    for s in (sys.stdout, sys.stderr):
+        try:
+            s.flush()
+        except Exception:
+            pass
+    os._exit(code)
 
 
 def maybe_inject_fault(step):
@@ -422,15 +470,13 @@ def maybe_inject_fault(step):
     MXTPU_FAULT_INJECT is set. Called by gluon.Trainer.step,
     DistributedTrainer.step and the module.fit batch loop with the number
     of the update that just completed."""
-    global _fault_cache
-    if _fault_cache is _UNPARSED:
-        _fault_cache = fault_spec() if _env.is_set("MXTPU_FAULT_INJECT") \
-            else []
-    if not _fault_cache:
+    if not _entries():
         return
     gen = restart_generation()
     rank = _current_rank()
-    for e in _fault_cache:
+    for e in _entries():
+        if e["action"] in _SERVE_ACTIONS:
+            continue  # fired by the replica-worker batch hook, not trainers
         if e["step"] != step or e["gen"] != gen:
             continue
         if e["rank"] is not None and e["rank"] != rank:
@@ -438,21 +484,52 @@ def maybe_inject_fault(step):
         _fire(e, step, rank)
 
 
+def maybe_inject_serving_fault(batch, replica):
+    """Replica-worker batch-boundary hook (serving/supervisor.py): fires
+    the serving actions (`kill_replica` / `wedge_replica` / `slow_reply`)
+    when this replica's batch sequence number matches. `gen=` matches the
+    replica's respawn generation (MXTPU_RESTART_GENERATION, set by the pool
+    supervisor exactly like the elastic launcher sets it), default 0 — so
+    a respawned replica does NOT re-trigger and recovery is observable."""
+    if not _entries():
+        return
+    gen = restart_generation()
+    for e in _entries():
+        if e["action"] not in _SERVE_ACTIONS:
+            continue
+        if e["batch"] != batch or e["gen"] != gen:
+            continue
+        if e["replica"] is not None and e["replica"] != replica:
+            continue
+        _fire_serving(e, batch, replica)
+
+
+def _fire_serving(entry, batch, replica):
+    action = entry["action"]
+    _LOG.warning("MXTPU_FAULT_INJECT firing: %s at batch=%d replica=%d "
+                 "gen=%d", action, batch, replica, restart_generation())
+    if action == "kill_replica":
+        _exit_hard(entry["code"])
+    if action == "wedge_replica":
+        # park mid-batch forever: the router must detect the silence on the
+        # heartbeat deadline, eject this replica (process-group teardown)
+        # and fail the batch over — SIGKILL is the only way out
+        import time as _t
+
+        while True:
+            _t.sleep(3600)
+    if action == "slow_reply":
+        import time as _t
+
+        _t.sleep(entry["ms"] / 1e3)
+
+
 def _fire(entry, step, rank):
     action = entry["action"]
     _LOG.warning("MXTPU_FAULT_INJECT firing: %s at step=%d rank=%d gen=%d",
                  action, step, rank, restart_generation())
     if action == "kill":
-        # hard death, no cleanup handlers — models SIGKILL/OOM/preemption.
-        # stdio is flushed so the log prefix trail ends at the right line.
-        import sys
-
-        for s in (sys.stdout, sys.stderr):
-            try:
-                s.flush()
-            except Exception:
-                pass
-        os._exit(entry["code"])
+        _exit_hard(entry["code"])
     if action == "exc":
         raise MXNetError("injected fault (MXTPU_FAULT_INJECT) at step %d "
                          "rank %d" % (step, rank))

@@ -1,17 +1,34 @@
-"""Runtime feature introspection.
+"""Runtime feature introspection + the bounded accelerator dial.
 
 TPU-native equivalent of the reference's `python/mxnet/runtime.py` +
 `src/libinfo.cc` (build-feature flags queryable at runtime: `Features()`,
 `feature_list()`, `is_enabled` — reference runtime.py:28). Features here
 describe the JAX/XLA backend actually present in the process instead of
 compile-time `USE_*` flags.
+
+`dial_devices` is the fast-fail front door to `jax.devices()`: a wedged
+axon PJRT tunnel blocks the bare call forever (the ROADMAP item-5 failure
+class — 900s burned per bench row), so the dial runs on a deadline thread
+(the PR-2 bounded-rendezvous pattern), brackets itself with
+flight-recorder events, and caches the device topology to a JSON file
+(``MXTPU_TOPOLOGY_CACHE``) so a later failed dial can still say what
+hardware went missing.
 """
 from __future__ import annotations
 
 import collections
+import json
+import os
+import threading
+import time
+
+from . import env as _env
+from . import telemetry
+from .base import MXNetError, atomic_writer
 
 __all__ = ["Feature", "Features", "feature_list",
-           "PEAK_BF16_TFLOPS", "chip_peak_tflops"]
+           "PEAK_BF16_TFLOPS", "chip_peak_tflops",
+           "dial_devices", "cached_topology"]
 
 Feature = collections.namedtuple("Feature", ["name", "enabled"])
 
@@ -122,3 +139,109 @@ def chip_peak_tflops(device):
         if kind.startswith(name.lower()):
             return peak
     return None
+
+
+# ---------------------------------------------------------------------------
+# bounded accelerator dial (ROADMAP item 5)
+# ---------------------------------------------------------------------------
+
+def _topology_cache_path():
+    return _env.raw("MXTPU_TOPOLOGY_CACHE") or None
+
+
+def cached_topology(path=None):
+    """The last successfully dialed device topology (platform, device
+    kind, count, timestamp) from the `MXTPU_TOPOLOGY_CACHE` file, or None
+    when no cache exists / the var is unset."""
+    path = path or _topology_cache_path()
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_topology(devices, path=None):
+    path = path or _topology_cache_path()
+    if not path:
+        return
+    try:
+        with atomic_writer(path, "w") as f:
+            json.dump({
+                "platform": devices[0].platform,
+                "device_kind": getattr(devices[0], "device_kind", None),
+                "device_count": len(devices),
+                "time": time.time(),
+            }, f, indent=1)
+    except OSError:
+        pass  # the cache is best-effort; never fail a successful dial
+
+
+def dial_devices(timeout_s=None, cache=True):
+    """`jax.devices()` behind a fail-fast deadline.
+
+    The PJRT dial over a wedged axon tunnel blocks forever; XLA offers no
+    client-side timeout. Same structure as the PR-2 bounded rendezvous:
+    the dial runs on a daemon thread, we wait `timeout_s`
+    (``MXTPU_DIAL_TIMEOUT_S``), and on expiry raise a diagnosable
+    `MXNetError` — including the last cached topology, so the caller can
+    label its artifact with the hardware that went missing — while the
+    probe thread stays parked in the dial (it completes or dies with the
+    process; a second `dial_devices` call re-waits on the same dial).
+
+    Every dial is bracketed with flight-recorder events
+    (``pjrt_dial_start`` / ``_ok`` / ``_timeout`` / ``_error``), and a
+    successful non-CPU dial refreshes the ``MXTPU_TOPOLOGY_CACHE`` file.
+    """
+    if timeout_s is None:
+        timeout_s = _env.get("MXTPU_DIAL_TIMEOUT_S")
+    done = threading.Event()
+    result, err = [], []
+
+    def probe():
+        try:
+            import jax
+
+            result.extend(jax.devices())
+        except Exception as e:  # noqa: BLE001 — reported to the caller
+            err.append(e)
+        done.set()
+
+    telemetry.record_event("pjrt_dial_start", timeout_s=timeout_s,
+                           pid=os.getpid())
+    t0 = time.monotonic()
+    with _DIAL_LOCK:
+        # reuse a still-parked (or successfully completed) dial thread; a
+        # FAILED past dial is dropped so the retry actually redials
+        if _DIAL_THREAD and _DIAL_THREAD[0][1].is_set() and _DIAL_THREAD[0][3]:
+            _DIAL_THREAD.clear()
+        if not _DIAL_THREAD:
+            t = threading.Thread(target=probe, daemon=True,
+                                 name="mxtpu-pjrt-dial")
+            _DIAL_THREAD.append((t, done, result, err))
+            t.start()
+        else:
+            _, done, result, err = _DIAL_THREAD[0]
+    if not done.wait(timeout_s):
+        cached = cached_topology()
+        telemetry.record_event("pjrt_dial_timeout", timeout_s=timeout_s,
+                               cached_topology=cached)
+        raise MXNetError(
+            "accelerator dial (jax.devices()) still blocked after %.0fs "
+            "(MXTPU_DIAL_TIMEOUT_S; wedged PJRT tunnel?). Last known "
+            "topology: %s" % (timeout_s, cached or "none cached"))
+    if err:
+        telemetry.record_event("pjrt_dial_error", error=str(err[0])[:500])
+        raise MXNetError("jax backend init failed: %s" % err[0]) from err[0]
+    telemetry.record_event(
+        "pjrt_dial_ok", seconds=round(time.monotonic() - t0, 3),
+        platform=result[0].platform, device_count=len(result))
+    if cache and result and result[0].platform != "cpu":
+        _write_topology(result)
+    return list(result)
+
+
+_DIAL_LOCK = threading.Lock()
+_DIAL_THREAD = []  # at most one parked dial thread per process

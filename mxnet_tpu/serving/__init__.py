@@ -13,25 +13,36 @@ high-occupancy inference (docs/serving.md):
     (model_repository.py);
   * `ServingServer` — stdlib `ThreadingHTTPServer` frontend with
     deterministic admission control: 429 on queue overflow, 504 on
-    deadline expiry, graceful SIGTERM drain (server.py).
+    deadline expiry, bounded graceful SIGTERM drain (server.py);
+  * `ReplicaPool` + `supervisor` — the resilience layer: N supervised
+    replica worker processes per model with heartbeat health checks,
+    ejection + respawn (restart generations, exponential backoff,
+    process-group teardown), exactly-once batch failover, deterministic
+    load shedding (503 + Retry-After scaled to healthy replicas) and
+    per-request deadline propagation (replica_pool.py / supervisor.py).
 
-Launch with ``python tools/serve.py``; load-test with
-``python tools/serve_bench.py``. All knobs are typed ``MXTPU_SERVE_*``
-variables in `mxnet_tpu.env` (docs/env_vars.md).
+Launch with ``python tools/serve.py`` (``--replicas N`` for a pool);
+load-test with ``python tools/serve_bench.py`` (``--failover`` for the
+chaos row). All knobs are typed ``MXTPU_SERVE_*`` variables in
+`mxnet_tpu.env` (docs/env_vars.md).
 """
 from __future__ import annotations
 
 from .batcher import (  # noqa: F401
     DeadlineExceededError, DrainingError, DynamicBatcher,
-    ModelUnavailableError, QueueFullError, ServeRequest, ServingError,
-    bucket_for, power_of_two_buckets,
+    ModelUnavailableError, OverloadedError, QueueFullError, ServeRequest,
+    ServingError, bucket_for, pad_batch, power_of_two_buckets,
 )
-from .model_repository import ModelRepository, ServedModel  # noqa: F401
+from .model_repository import (  # noqa: F401
+    ModelRepository, ServedModel, build_runner,
+)
+from .replica_pool import ReplicaPool  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
     "DynamicBatcher", "ServeRequest", "ModelRepository", "ServedModel",
-    "ServingServer", "ServingError", "QueueFullError",
+    "ServingServer", "ReplicaPool", "ServingError", "QueueFullError",
     "DeadlineExceededError", "ModelUnavailableError", "DrainingError",
-    "power_of_two_buckets", "bucket_for",
+    "OverloadedError", "power_of_two_buckets", "bucket_for", "pad_batch",
+    "build_runner",
 ]

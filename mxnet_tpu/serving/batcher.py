@@ -29,6 +29,7 @@ model; numpy in, numpy out.
 from __future__ import annotations
 
 import collections
+import logging
 import threading
 import time
 
@@ -40,21 +41,49 @@ from ..base import MXNetError, unpad_outputs
 
 __all__ = [
     "ServingError", "QueueFullError", "DeadlineExceededError",
-    "ModelUnavailableError", "DrainingError", "power_of_two_buckets",
-    "bucket_for", "DynamicBatcher",
+    "ModelUnavailableError", "DrainingError", "OverloadedError",
+    "power_of_two_buckets", "bucket_for", "pad_batch", "DynamicBatcher",
+    "drain_timeout_s",
 ]
+
+_LOG = logging.getLogger("mxnet_tpu.serving.batcher")
+_warned_drain_s = False
+
+
+def drain_timeout_s():
+    """Effective graceful-drain budget in seconds: the
+    `MXTPU_SERVE_DRAIN_TIMEOUT_MS` default, honoring the deprecated
+    seconds-typed `MXTPU_SERVE_DRAIN_TIMEOUT_S` (with a one-time warning)
+    when only the old name is set — one fallback shared by every drain
+    reader, so a deployment's configured budget survives the rename no
+    matter which drain path runs."""
+    global _warned_drain_s
+    timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_MS") / 1e3
+    if not _env.is_set("MXTPU_SERVE_DRAIN_TIMEOUT_MS") \
+            and _env.is_set("MXTPU_SERVE_DRAIN_TIMEOUT_S"):
+        timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_S")
+        if not _warned_drain_s:
+            _warned_drain_s = True
+            _LOG.warning(
+                "MXTPU_SERVE_DRAIN_TIMEOUT_S is deprecated; set "
+                "MXTPU_SERVE_DRAIN_TIMEOUT_MS=%d instead (honoring the "
+                "old value as %.0fs)", int(timeout * 1e3), timeout)
+    return timeout
 
 
 class ServingError(MXNetError):
-    """Base serving-layer error; `status` is the HTTP mapping."""
+    """Base serving-layer error; `status` is the HTTP mapping and
+    `retry_after` (seconds, optional) becomes a ``Retry-After`` header."""
 
     status = 500
+    retry_after = None
 
 
 class QueueFullError(ServingError):
     """Admission control: the bounded request queue is full."""
 
     status = 429
+    retry_after = 1
 
 
 class DeadlineExceededError(ServingError):
@@ -73,6 +102,19 @@ class DrainingError(ServingError):
     """The server/model is draining and admits no new work."""
 
     status = 503
+
+
+class OverloadedError(ServingError):
+    """Deterministic load shedding: the model's replica pool is degraded
+    and taking this request would queue it into a black hole. The reply
+    carries ``Retry-After`` scaled to the healthy-replica count
+    (docs/serving.md resilience section)."""
+
+    status = 503
+
+    def __init__(self, msg, retry_after=1):
+        super().__init__(msg)
+        self.retry_after = max(1, int(retry_after))
 
 
 # ---------------------------------------------------------------------------
@@ -102,6 +144,25 @@ def bucket_for(n, buckets):
     return None
 
 
+def pad_batch(batch, total, buckets):
+    """Concatenate the requests' input arrays and zero-pad up to the
+    smallest bucket holding ``total`` examples. Returns ``(padded_arrays,
+    bucket)``. Shared by the inline runner path and the replica-pool
+    dispatchers (each pads in its own thread)."""
+    bucket = bucket_for(total, buckets)
+    names = batch[0].arrays.keys()
+    padded = {}
+    for name in names:
+        parts = [r.arrays[name] for r in batch]
+        a = parts[0] if len(parts) == 1 else _np.concatenate(parts)
+        if a.shape[0] < bucket:
+            pad = _np.zeros((bucket - a.shape[0],) + a.shape[1:],
+                            dtype=a.dtype)
+            a = _np.concatenate([a, pad])
+        padded[name] = a
+    return padded, bucket
+
+
 # ---------------------------------------------------------------------------
 # requests
 # ---------------------------------------------------------------------------
@@ -111,7 +172,8 @@ class ServeRequest:
     numpy array whose leading dim is this request's example count."""
 
     __slots__ = ("arrays", "n", "deadline", "outputs", "error", "bucket",
-                 "_event", "_t_submit", "queue_seconds", "compute_seconds")
+                 "_event", "_rlock", "_t_submit", "queue_seconds",
+                 "compute_seconds", "retried")
 
     def __init__(self, arrays, n, deadline):
         self.arrays = arrays
@@ -122,7 +184,9 @@ class ServeRequest:
         self.bucket = None
         self.queue_seconds = None
         self.compute_seconds = None
+        self.retried = False  # failover re-enqueue happened (exactly once)
         self._event = threading.Event()
+        self._rlock = threading.Lock()
         self._t_submit = time.monotonic()
 
     def done(self):
@@ -142,12 +206,17 @@ class ServeRequest:
         return self.outputs
 
     def _resolve(self, outputs=None, error=None):
-        if self._event.is_set():
-            return  # first resolution wins (a late error must not clobber
-            #         a result a waiter may already be reading)
-        self.outputs = outputs
-        self.error = error
-        self._event.set()
+        # first resolution wins, ATOMICALLY: a replica dispatch thread, the
+        # drain thread's abort_pending and the worker's expiry path can race
+        # here, and an unlocked check-then-act could interleave their writes
+        # so a waiter wakes to outputs=None, error=None
+        with self._rlock:
+            if self._event.is_set():
+                return  # a late error must not clobber a result a waiter
+                #         may already be reading
+            self.outputs = outputs
+            self.error = error
+            self._event.set()
 
 
 # ---------------------------------------------------------------------------
@@ -169,11 +238,23 @@ class DynamicBatcher:
         Default to ``MXTPU_SERVE_MAX_DELAY_MS`` / ``MXTPU_SERVE_QUEUE_DEPTH``.
     name : str
         Telemetry label (``model="<name>"`` on every serving metric).
+    dispatcher : callable(batch, total), optional
+        Takes over batch execution (the replica pool's hook): called from
+        the worker thread with an assembled, expiry-filtered batch; the
+        dispatcher must eventually route every request through
+        `resolve_batch` / `fail_batch` / `requeue` so in-flight accounting
+        closes. When None (default), batches run inline on ``runner``.
+    admission_gate : callable(queued_len) -> ServingError or None, optional
+        Consulted under the queue lock on every submit BEFORE the depth
+        check — the replica pool sheds load here when degraded (an error
+        return is raised to the caller; the request never queues).
     """
 
     def __init__(self, runner, buckets, max_delay_ms=None, queue_depth=None,
-                 name="default"):
+                 name="default", dispatcher=None, admission_gate=None):
         self._runner = runner
+        self._dispatcher = dispatcher
+        self._admission_gate = admission_gate
         self.buckets = sorted(int(b) for b in buckets)
         if not self.buckets:
             raise MXNetError("need at least one bucket")
@@ -190,7 +271,9 @@ class DynamicBatcher:
         self._cv = threading.Condition()
         self._stop = False
         self._draining = False
-        self._inflight = 0          # requests popped but not yet resolved
+        # requests popped but not yet resolved — a SET (not a count) so a
+        # forced drain can resolve work stuck inside a wedged runner
+        self._inflight = set()
 
         labels = {"model": name}
         self._m_queue = telemetry.gauge("mxtpu_serve_queue_depth", labels)
@@ -203,6 +286,8 @@ class DynamicBatcher:
             "mxtpu_serve_rejected_total", {"model": name, "reason": "queue_full"})
         self._m_rej_dead = telemetry.counter(
             "mxtpu_serve_rejected_total", {"model": name, "reason": "deadline"})
+        self._m_rej_shed = telemetry.counter(
+            "mxtpu_serve_rejected_total", {"model": name, "reason": "shed"})
         # how full each dispatched bucket was (n / bucket): the occupancy
         # evidence serve_bench reports
         self._m_occupancy = telemetry.histogram(
@@ -243,6 +328,11 @@ class DynamicBatcher:
         with self._cv:
             if self._stop or self._draining:
                 raise DrainingError("model %r is draining" % self.name)
+            if self._admission_gate is not None:
+                err = self._admission_gate(len(self._queue))
+                if err is not None:
+                    self._m_rej_shed.inc()
+                    raise err
             if len(self._queue) >= self.queue_depth:
                 self._m_rej_full.inc()
                 raise QueueFullError(
@@ -257,25 +347,45 @@ class DynamicBatcher:
     def pending(self):
         """Queued + in-flight request count (drain progress)."""
         with self._cv:
-            return len(self._queue) + self._inflight
+            return len(self._queue) + len(self._inflight)
 
     # -- shutdown ----------------------------------------------------------
     def drain(self, timeout=None):
         """Stop admitting, let the worker finish everything queued, and wait
-        up to ``timeout`` seconds (default `MXTPU_SERVE_DRAIN_TIMEOUT_S` —
+        up to ``timeout`` seconds (default `MXTPU_SERVE_DRAIN_TIMEOUT_MS` —
         a wedged model must not hang shutdown forever). Returns True when
         fully drained."""
         with self._cv:
             self._draining = True
             self._cv.notify_all()
         if timeout is None:
-            timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_S")
+            timeout = drain_timeout_s()
         deadline = time.monotonic() + timeout
         while self.pending():
             if time.monotonic() >= deadline:
                 return False
             time.sleep(0.005)
         return True
+
+    def abort_pending(self, error=None):
+        """Force-resolve every queued AND in-flight request (bounded-drain
+        escape hatch: a wedged runner must not strand its waiters — they
+        get a deterministic 503 instead of a connection reset when the
+        process exits). Safe against late runner completion: first
+        resolution wins. Returns how many requests were force-resolved."""
+        if error is None:
+            error = DrainingError(
+                "model %r drain timed out; request force-completed"
+                % self.name)
+        with self._cv:
+            victims = [r for r in self._queue] + \
+                [r for r in self._inflight if not r.done()]
+            self._queue.clear()
+            self._inflight.clear()
+            self._m_queue.set(0)
+        for req in victims:
+            req._resolve(error=error)
+        return len(victims)
 
     def close(self, drain=True, timeout=None):
         """Drain (optionally) then stop the worker thread."""
@@ -286,13 +396,8 @@ class DynamicBatcher:
             self._cv.notify_all()
         self._worker.join(timeout=5.0)
         # anything still queued after a failed/skipped drain gets an answer
-        with self._cv:
-            leftovers = list(self._queue)
-            self._queue.clear()
-            self._m_queue.set(0)
-        for req in leftovers:
-            req._resolve(error=DrainingError(
-                "model %r shut down before this request ran" % self.name))
+        self.abort_pending(DrainingError(
+            "model %r shut down before this request ran" % self.name))
         return drained
 
     # -- the worker --------------------------------------------------------
@@ -308,18 +413,47 @@ class DynamicBatcher:
             if req.deadline is not None and now >= req.deadline:
                 self._queue.popleft()
                 self._m_queue.set(len(self._queue))
-                self._m_rej_dead.inc()
-                req._resolve(error=DeadlineExceededError(
-                    "deadline expired after %.0f ms in queue"
-                    % ((now - req._t_submit) * 1e3)))
+                self._expire(req, now, locked=True)
                 continue
             if max_n is not None and req.n > max_n:
                 return None  # stays queued for the next batch
             self._queue.popleft()
             self._m_queue.set(len(self._queue))
-            self._inflight += 1
+            self._inflight.add(req)
             return req
         return None
+
+    def _expire(self, req, now=None, locked=False):
+        """Resolve one request 504 and close its in-flight accounting.
+        ``locked=True`` when the caller already holds ``_cv`` (it is not
+        reentrant)."""
+        if now is None:
+            now = time.monotonic()
+        if locked:
+            self._inflight.discard(req)
+        else:
+            with self._cv:
+                self._inflight.discard(req)
+        self._m_rej_dead.inc()
+        req._resolve(error=DeadlineExceededError(
+            "deadline expired after %.0f ms in queue"
+            % ((now - req._t_submit) * 1e3)))
+
+    def _prune_expired(self, batch):
+        """Drop (and 504) every already-expired request from an assembled
+        batch — the batch may have aged in the coalescing window or a
+        dispatcher queue since its members were popped live. Returns the
+        still-live remainder. Spending executor time on an answer nobody is
+        waiting for is exactly the work a degraded pool cannot afford."""
+        now = time.monotonic()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now >= req.deadline \
+                    and not req.done():
+                self._expire(req, now)
+            elif not req.done():
+                live.append(req)
+        return live
 
     def _loop(self):
         while True:
@@ -353,61 +487,133 @@ class DynamicBatcher:
                         continue
                 batch.append(req)
                 total += req.n
+            # assembly-time expiry: members can age out during the
+            # coalescing window (or while queued behind a long batch) —
+            # 504 them NOW instead of spending executor time on answers
+            # nobody is waiting for
+            batch = self._prune_expired(batch)
+            total = sum(r.n for r in batch)
+            if not batch:
+                continue
             try:
-                self._dispatch(batch, total)
+                if self._dispatcher is not None:
+                    self._dispatcher(batch, total)
+                else:
+                    self._dispatch(batch, total)
             except Exception as e:  # the lone worker must NEVER die
                 telemetry.record_event("serve_batcher_error",
                                        model=self.name, error=repr(e))
-                err = ServingError("batcher for %r failed: %r"
-                                   % (self.name, e))
-                for req in batch:
-                    req._resolve(error=err)
+                self.fail_batch(batch, ServingError(
+                    "batcher for %r failed: %r" % (self.name, e)))
+
+    # -- batch resolution (shared by the inline path and pool dispatchers) -
+    def resolve_batch(self, batch, outputs, bucket, total, compute_s):
+        """Unpad `outputs` (leading dim == bucket), split them back per
+        request, resolve every request, and close in-flight accounting.
+        `batch` must be the exact request list the outputs were computed
+        for (order preserved)."""
+        now = time.monotonic()
+        outs = unpad_outputs(outputs, bucket - total)
+        offset = 0
+        for req in batch:
+            req.bucket = bucket
+            req.queue_seconds = max(0.0, now - compute_s - req._t_submit)
+            req.compute_seconds = compute_s
+            self._m_queue_s.observe(req.queue_seconds)
+            per_req = [o[offset:offset + req.n].copy() for o in outs]
+            offset += req.n
+            req._resolve(outputs=per_req)
+        with self._cv:
+            self._inflight.difference_update(batch)
+        self._m_examples.inc(total)
+        self._m_batches.inc()
+        self._m_batch_size.observe(total)
+        if bucket:
+            self._m_occupancy.observe(total / float(bucket))
+        self._m_compute_s.observe(compute_s)
+
+    def fail_batch(self, batch, error, compute_s=None):
+        """Resolve every request in `batch` with `error` and close
+        accounting (already-resolved members are left alone). Failed
+        batches still count toward the dispatch-volume metrics —
+        batches/examples flatlining during an incident would read as "no
+        traffic" on a dashboard, and compute burned on batches that then
+        error must stay visible (occupancy is success-only: the bucket
+        is not always known on the failure path)."""
+        for req in batch:
+            req._resolve(error=error)
+        with self._cv:
+            self._inflight.difference_update(batch)
+        total = sum(r.n for r in batch)
+        self._m_examples.inc(total)
+        self._m_batches.inc()
+        self._m_batch_size.observe(total)
+        if compute_s is not None:
+            self._m_compute_s.observe(compute_s)
+
+    def requeue(self, batch):
+        """Failover path: push a dead replica's in-flight batch back to the
+        FRONT of the queue, EXACTLY ONCE per request (predict is
+        idempotent, so one retry is safe; unbounded retries could double
+        work without bound). Expired members are 504ed; members that
+        already failed over once get a retryable 503 instead of a second
+        ride. Returns the number of requests actually requeued."""
+        now = time.monotonic()
+        requeued = 0
+        # requests requeued by THIS call — `req.retried` alone cannot tell
+        # "just went back on the queue" from "already used its one retry
+        # on an earlier failover" (the latter must get the 503 below, not
+        # be skipped unresolved)
+        taken = set()
+        with self._cv:
+            self._inflight.difference_update(batch)
+            accept = not (self._stop or self._draining)
+            for req in reversed(batch):
+                if req.done():
+                    continue
+                if req.deadline is not None and now >= req.deadline:
+                    continue  # expired: resolved below, outside the lock
+                if req.retried or not accept:
+                    continue
+                req.retried = True
+                taken.add(req)
+                self._queue.appendleft(req)
+                requeued += 1
+            self._m_queue.set(len(self._queue))
+            if requeued:
+                self._cv.notify()
+        for req in batch:
+            if req in taken or req.done():
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._expire(req, now)
+            elif req.retried:
+                # second replica death under the same request: answer a
+                # retryable 503 rather than loop the failover
+                req._resolve(error=OverloadedError(
+                    "request already failed over once on model %r"
+                    % self.name))
+            else:
+                # never retried, but the batcher stopped accepting: the
+                # 503 is about draining, not a failover the request never
+                # had
+                req._resolve(error=OverloadedError(
+                    "model %r is draining; in-flight request not retried"
+                    % self.name))
+        return requeued
 
     def _dispatch(self, batch, total):
         t0 = time.monotonic()
-        bucket = bucket_for(total, self.buckets)
         try:
-            names = batch[0].arrays.keys()
-            padded = {}
-            for name in names:
-                parts = [r.arrays[name] for r in batch]
-                a = parts[0] if len(parts) == 1 else _np.concatenate(parts)
-                if a.shape[0] < bucket:
-                    pad = _np.zeros((bucket - a.shape[0],) + a.shape[1:],
-                                    dtype=a.dtype)
-                    a = _np.concatenate([a, pad])
-                padded[name] = a
+            padded, bucket = pad_batch(batch, total, self.buckets)
             outs = self._runner(padded, bucket, total)
-            compute_s = time.monotonic() - t0
-            # strip the bucket padding once (shared helper — the same
-            # unpad as module predict's last-batch path), then split the
-            # remaining rows back per request
-            outs = unpad_outputs(outs, bucket - total)
-            offset = 0
-            for req in batch:
-                req.bucket = bucket
-                req.queue_seconds = t0 - req._t_submit
-                req.compute_seconds = compute_s
-                self._m_queue_s.observe(req.queue_seconds)
-                per_req = [o[offset:offset + req.n].copy() for o in outs]
-                offset += req.n
-                req._resolve(outputs=per_req)
+            self.resolve_batch(batch, outs, bucket, total,
+                               time.monotonic() - t0)
         except ServingError as e:
-            for req in batch:
-                req._resolve(error=e)
+            self.fail_batch(batch, e, compute_s=time.monotonic() - t0)
         except Exception as e:  # a model failure answers 500, never hangs
             err = ServingError("model %r failed: %r" % (self.name, e))
             err.__cause__ = e
             telemetry.record_event("serve_batch_error", model=self.name,
                                    error=repr(e))
-            for req in batch:
-                req._resolve(error=err)
-        finally:
-            with self._cv:
-                self._inflight -= len(batch)
-            self._m_examples.inc(total)
-            self._m_batches.inc()
-            self._m_batch_size.observe(total)
-            if bucket:  # None can't happen post-admission; stay unkillable
-                self._m_occupancy.observe(total / float(bucket))
-            self._m_compute_s.observe(time.monotonic() - t0)
+            self.fail_batch(batch, err, compute_s=time.monotonic() - t0)

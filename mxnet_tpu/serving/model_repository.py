@@ -35,9 +35,9 @@ from .. import env as _env
 from .. import telemetry
 from ..base import MXNetError
 from .batcher import (DynamicBatcher, ModelUnavailableError,
-                      power_of_two_buckets)
+                      drain_timeout_s, power_of_two_buckets)
 
-__all__ = ["ServedModel", "ModelRepository"]
+__all__ = ["ServedModel", "ModelRepository", "build_runner"]
 
 
 class ServedModel:
@@ -50,7 +50,7 @@ class ServedModel:
 
     def __init__(self, name, version, runner, buckets, example_shapes,
                  input_dtypes=None, meta=None, max_delay_ms=None,
-                 queue_depth=None):
+                 queue_depth=None, pool=None):
         self.name = str(name)
         self.version = int(version)
         self.example_shapes = {k: tuple(v) for k, v in example_shapes.items()}
@@ -63,12 +63,72 @@ class ServedModel:
         self.warmed = False
         self.warm_seconds = None
         self._runner = runner
-        self._batcher = DynamicBatcher(
-            runner, buckets, max_delay_ms=max_delay_ms,
-            queue_depth=queue_depth,
-            name="%s/%d" % (self.name, self.version))
+        self._pool = pool
+        if pool is not None:
+            # resilient mode: batches are dispatched to the replica pool's
+            # worker processes; admission runs through the pool's
+            # load-shedding gate (docs/serving.md §resilience)
+            self._batcher = DynamicBatcher(
+                None, buckets, max_delay_ms=max_delay_ms,
+                queue_depth=queue_depth,
+                name="%s/%d" % (self.name, self.version),
+                dispatcher=pool.dispatch_batch,
+                admission_gate=pool.admission_gate)
+            pool.bind(self._batcher)
+        else:
+            self._batcher = DynamicBatcher(
+                runner, buckets, max_delay_ms=max_delay_ms,
+                queue_depth=queue_depth,
+                name="%s/%d" % (self.name, self.version))
 
     # -- construction from artifacts --------------------------------------
+    @staticmethod
+    def pooled(name, version, path, replicas, input_shapes=None,
+               input_dtypes=None, max_batch=None, max_delay_ms=None,
+               queue_depth=None, heartbeat_ms=None, backoff_ms=None,
+               extra_env=None, spawn_timeout_s=120.0, teardown_grace=None,
+               worker_args=None, wedge_timeout_ms=None):
+        """Serve an artifact through a supervised `ReplicaPool` of
+        ``replicas`` worker processes (docs/serving.md §resilience).
+        ``worker_args`` overrides the artifact argv entirely (tests pass
+        ``--stub`` specs). The pool spawns, loads and warms every replica
+        BEFORE the model is returned — a half-warm pool never publishes."""
+        from .replica_pool import ReplicaPool
+
+        if worker_args is None:
+            if path is None:
+                raise MXNetError("pooled() needs an artifact path (or "
+                                 "explicit worker_args)")
+            worker_args = ["--artifact", os.fspath(path)]
+            for iname, dims in (input_shapes or {}).items():
+                spec = "%s=%s" % (iname, "x".join(str(d) for d in dims))
+                if input_dtypes and iname in input_dtypes:
+                    spec += ":%s" % input_dtypes[iname]
+                worker_args += ["--input", spec]
+            if max_batch is not None:
+                worker_args += ["--max-batch", str(max_batch)]
+        pool = ReplicaPool("%s/%d" % (name, int(version)), worker_args,
+                           replicas, heartbeat_ms=heartbeat_ms,
+                           backoff_ms=backoff_ms, extra_env=extra_env,
+                           spawn_timeout_s=spawn_timeout_s,
+                           teardown_grace=teardown_grace,
+                           wedge_timeout_ms=wedge_timeout_ms)
+        try:
+            info = pool.wait_ready(spawn_timeout_s)
+        except Exception:
+            pool.close()
+            raise
+        model = ServedModel(
+            name, version, None, info["buckets"], info["example_shapes"],
+            input_dtypes=info.get("input_dtypes"),
+            meta={"artifact": "pooled", "path": None if path is None
+                  else os.fspath(path), "replicas": int(replicas)},
+            max_delay_ms=max_delay_ms, queue_depth=queue_depth, pool=pool)
+        # every replica warmed its buckets before reporting ready
+        model.warmed = True
+        model.warm_seconds = info.get("warm_seconds")
+        return model
+
     @staticmethod
     def from_path(name, version, path, input_shapes=None, input_dtypes=None,
                   ctx=None, max_batch=None, max_delay_ms=None,
@@ -92,77 +152,31 @@ class ServedModel:
     def _from_symbol(name, version, symbol_file, param_file, input_shapes,
                      input_dtypes=None, ctx=None, max_batch=None,
                      max_delay_ms=None, queue_depth=None):
-        from ..predict import Predictor, _clone_with
-
-        if not input_shapes:
-            raise MXNetError(
-                "symbol/params models need input_shapes (per-example, "
-                "batch dim excluded), e.g. {'data': (8,)}")
-        example_shapes = {k: tuple(v) for k, v in input_shapes.items()}
-        if max_batch is None:
-            max_batch = _env.get("MXTPU_SERVE_MAX_BATCH")
-        buckets = power_of_two_buckets(max_batch)
-
-        def shapes_at(b):
-            return {k: (b,) + s for k, s in example_shapes.items()}
-
-        # one Predictor per bucket, all sharing the prototype's device
-        # weight buffers — N buckets cost one weight copy + N IO buffers
-        proto = Predictor(symbol_file, param_file, ctx=ctx,
-                          input_shapes=shapes_at(buckets[-1]),
-                          input_dtypes=input_dtypes)
-        by_bucket = {buckets[-1]: proto}
-        for b in buckets[:-1]:
-            by_bucket[b] = _clone_with(proto, shapes_at(b), shared=proto)
-        num_outputs = proto.num_outputs
-
-        def runner(arrays, bucket, n):
-            pred = by_bucket[bucket]
-            pred.forward(**arrays)
-            return [pred.get_output(i).asnumpy() for i in range(num_outputs)]
-
-        model = ServedModel(name, version, runner, buckets, example_shapes,
-                            input_dtypes=input_dtypes,
-                            meta={"artifact": "symbol",
-                                  "symbol_file": str(symbol_file),
-                                  "param_file": str(param_file)},
-                            max_delay_ms=max_delay_ms,
-                            queue_depth=queue_depth)
-        model._by_bucket = by_bucket
-        return model
+        runner, buckets, example_shapes, dtypes, meta = _symbol_runner(
+            symbol_file, param_file, input_shapes,
+            input_dtypes=input_dtypes, ctx=ctx, max_batch=max_batch)
+        return ServedModel(name, version, runner, buckets, example_shapes,
+                           input_dtypes=dtypes, meta=meta,
+                           max_delay_ms=max_delay_ms,
+                           queue_depth=queue_depth)
 
     @staticmethod
     def _from_compiled(name, version, path, max_delay_ms=None,
                        queue_depth=None):
-        from ..predict import CompiledPredictor
-
-        comp = CompiledPredictor.load(path)
-        shapes = comp._input_shapes
-        batches = {s[0] for s in shapes.values() if s}
-        if len(batches) != 1:
-            raise MXNetError(
-                "compiled artifact has ambiguous batch dim across inputs: "
-                "%s" % shapes)
-        frozen = batches.pop()
-        example_shapes = {k: tuple(s[1:]) for k, s in shapes.items()}
-        dtypes = {k: comp._input_dtypes.get(k, _np.dtype(_np.float32))
-                  for k in shapes}
-
-        def runner(arrays, bucket, n):
-            comp.forward(**arrays)
-            return [comp.get_output(i).asnumpy()
-                    for i in range(comp.num_outputs)]
-
-        # geometry is frozen at build (TensorRT-engine semantics): the
-        # frozen batch is the one and only padding bucket
-        return ServedModel(name, version, runner, [frozen], example_shapes,
-                           input_dtypes=dtypes,
-                           meta={"artifact": "compiled", "path": str(path),
-                                 "platforms": list(comp.platforms)},
+        runner, buckets, example_shapes, dtypes, meta = \
+            _compiled_runner(path)
+        return ServedModel(name, version, runner, buckets, example_shapes,
+                           input_dtypes=dtypes, meta=meta,
                            max_delay_ms=max_delay_ms,
                            queue_depth=queue_depth)
 
     # -- serving surface ---------------------------------------------------
+    @property
+    def pool(self):
+        """The model's `ReplicaPool` (None when served in-process).
+        serve_bench's failover row kills/observes replicas through it."""
+        return self._pool
+
     @property
     def buckets(self):
         return list(self._batcher.buckets)
@@ -208,6 +222,11 @@ class ServedModel:
         """One zeros-forward per bucket: populates the executable cache so
         steady-state traffic never compiles. Emits one
         ``serve_bucket_warm`` event per bucket."""
+        if self._pool is not None:
+            # pooled models warm replica-side before each replica reports
+            # ready (supervisor.worker_main) — nothing to do here
+            self.warmed = True
+            return self.warm_seconds
         t_all = time.monotonic()
         for b in self._batcher.buckets:
             zeros = {k: _np.zeros((b,) + s, dtype=self.input_dtypes[k])
@@ -227,11 +246,19 @@ class ServedModel:
     def drain(self, timeout=None):
         return self._batcher.drain(timeout)
 
+    def abort_pending(self, error=None):
+        """Force-complete every queued + in-flight request (bounded-drain
+        escape hatch); returns how many were force-resolved."""
+        return self._batcher.abort_pending(error)
+
     def close(self, drain=True, timeout=None):
-        return self._batcher.close(drain=drain, timeout=timeout)
+        drained = self._batcher.close(drain=drain, timeout=timeout)
+        if self._pool is not None:
+            self._pool.close()
+        return drained
 
     def describe(self):
-        return {
+        out = {
             "name": self.name,
             "version": self.version,
             "buckets": self.buckets,
@@ -245,6 +272,92 @@ class ServedModel:
             "loaded_at": self.loaded_at,
             "meta": self.meta,
         }
+        if self._pool is not None:
+            out["pool"] = self._pool.describe()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# artifact loading — shared by ServedModel (in-process) and the replica
+# worker (mxnet_tpu/serving/supervisor.py), which needs a bucketed runner
+# WITHOUT a batcher attached
+# ---------------------------------------------------------------------------
+
+def build_runner(path, input_shapes=None, input_dtypes=None, ctx=None,
+                 max_batch=None):
+    """Load a deployment artifact into a bucketed ``runner(arrays, bucket,
+    n) -> [numpy outputs]``. Returns ``(runner, buckets, example_shapes,
+    input_dtypes, meta)``."""
+    kind, parts = _resolve_artifact(path)
+    if kind == "compiled":
+        return _compiled_runner(parts)
+    symbol_file, param_file = parts
+    return _symbol_runner(symbol_file, param_file, input_shapes,
+                          input_dtypes=input_dtypes, ctx=ctx,
+                          max_batch=max_batch)
+
+
+def _symbol_runner(symbol_file, param_file, input_shapes, input_dtypes=None,
+                   ctx=None, max_batch=None):
+    from ..predict import Predictor, _clone_with
+
+    if not input_shapes:
+        raise MXNetError(
+            "symbol/params models need input_shapes (per-example, "
+            "batch dim excluded), e.g. {'data': (8,)}")
+    example_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+    if max_batch is None:
+        max_batch = _env.get("MXTPU_SERVE_MAX_BATCH")
+    buckets = power_of_two_buckets(max_batch)
+
+    def shapes_at(b):
+        return {k: (b,) + s for k, s in example_shapes.items()}
+
+    # one Predictor per bucket, all sharing the prototype's device
+    # weight buffers — N buckets cost one weight copy + N IO buffers
+    proto = Predictor(symbol_file, param_file, ctx=ctx,
+                      input_shapes=shapes_at(buckets[-1]),
+                      input_dtypes=input_dtypes)
+    by_bucket = {buckets[-1]: proto}
+    for b in buckets[:-1]:
+        by_bucket[b] = _clone_with(proto, shapes_at(b), shared=proto)
+    num_outputs = proto.num_outputs
+
+    def runner(arrays, bucket, n):
+        pred = by_bucket[bucket]
+        pred.forward(**arrays)
+        return [pred.get_output(i).asnumpy() for i in range(num_outputs)]
+
+    meta = {"artifact": "symbol", "symbol_file": str(symbol_file),
+            "param_file": str(param_file)}
+    return runner, buckets, example_shapes, input_dtypes, meta
+
+
+def _compiled_runner(path):
+    from ..predict import CompiledPredictor
+
+    comp = CompiledPredictor.load(path)
+    shapes = comp._input_shapes
+    batches = {s[0] for s in shapes.values() if s}
+    if len(batches) != 1:
+        raise MXNetError(
+            "compiled artifact has ambiguous batch dim across inputs: "
+            "%s" % shapes)
+    frozen = batches.pop()
+    example_shapes = {k: tuple(s[1:]) for k, s in shapes.items()}
+    dtypes = {k: comp._input_dtypes.get(k, _np.dtype(_np.float32))
+              for k in shapes}
+
+    def runner(arrays, bucket, n):
+        comp.forward(**arrays)
+        return [comp.get_output(i).asnumpy()
+                for i in range(comp.num_outputs)]
+
+    # geometry is frozen at build (TensorRT-engine semantics): the
+    # frozen batch is the one and only padding bucket
+    meta = {"artifact": "compiled", "path": str(path),
+            "platforms": list(comp.platforms)}
+    return runner, [frozen], example_shapes, dtypes, meta
 
 
 # ---------------------------------------------------------------------------
@@ -309,12 +422,17 @@ class ModelRepository:
 
     def load(self, name, path, version=None, input_shapes=None,
              input_dtypes=None, ctx=None, max_batch=None, max_delay_ms=None,
-             queue_depth=None, warm=True):
+             queue_depth=None, warm=True, replicas=0, **pool_kwargs):
         """Load an artifact as ``name/version`` (auto-increment when
         ``version`` is None) and publish it after warmup. The version is
         RESERVED for the whole load, so two concurrent loads of the same
         name never collide after both paid bind+warm; a failed load tears
-        its half-built model (and batcher thread) down."""
+        its half-built model (and batcher thread) down.
+
+        ``replicas`` > 0 serves the model through a supervised replica
+        pool (`ServedModel.pooled`; ``pool_kwargs`` — heartbeat_ms,
+        backoff_ms, extra_env, spawn_timeout_s, teardown_grace — pass
+        through) instead of in-process."""
         with self._lock:
             have = self._models.get(name, {})
             reserved = [v for (n, v) in self._loading if n == name]
@@ -326,10 +444,17 @@ class ModelRepository:
                                  % (name, version))
             self._loading.add((name, version))
         try:
-            model = ServedModel.from_path(
-                name, version, path, input_shapes=input_shapes,
-                input_dtypes=input_dtypes, ctx=ctx, max_batch=max_batch,
-                max_delay_ms=max_delay_ms, queue_depth=queue_depth)
+            if replicas and replicas > 0:
+                model = ServedModel.pooled(
+                    name, version, path, replicas,
+                    input_shapes=input_shapes, input_dtypes=input_dtypes,
+                    max_batch=max_batch, max_delay_ms=max_delay_ms,
+                    queue_depth=queue_depth, **pool_kwargs)
+            else:
+                model = ServedModel.from_path(
+                    name, version, path, input_shapes=input_shapes,
+                    input_dtypes=input_dtypes, ctx=ctx, max_batch=max_batch,
+                    max_delay_ms=max_delay_ms, queue_depth=queue_depth)
             try:
                 if warm:
                     model.warm()
@@ -379,7 +504,7 @@ class ModelRepository:
                 self._models.pop(name, None)
             self._m_loaded.set(sum(len(v) for v in self._models.values()))
         if timeout is None:
-            timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_S")
+            timeout = drain_timeout_s()
         drained = model.close(drain=True, timeout=timeout)
         telemetry.record_event("serve_model_unload", model=model.name,
                                version=model.version, drained=drained)
@@ -405,9 +530,14 @@ class ModelRepository:
         """Drain every model (graceful-shutdown path). Returns True when
         everything finished in time."""
         if timeout is None:
-            timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_S")
+            timeout = drain_timeout_s()
         deadline = time.monotonic() + timeout
         ok = True
         for m in self.models():
             ok = m.drain(max(0.0, deadline - time.monotonic())) and ok
         return ok
+
+    def abort_pending(self):
+        """Force-complete every model's stranded requests (the bounded
+        SIGTERM drain's escape hatch). Returns the total force-resolved."""
+        return sum(m.abort_pending() for m in self.models())

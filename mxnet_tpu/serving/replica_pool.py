@@ -1,0 +1,609 @@
+"""Supervised replica pool: the serving resilience router.
+
+`DynamicBatcher` assembles batches; this pool routes them to N replica
+worker processes (`supervisor.py`) and keeps the endpoint answering
+through the failures PR 2's fault harness and PR 3's flight recorder were
+built to expose (docs/serving.md §resilience):
+
+  * **health**: every replica is watched on a heartbeat deadline
+    (``MXTPU_SERVE_HEARTBEAT_MS``). An idle replica is ping/pong'd; a
+    busy one is silent-bounded by its batch deadline plus the heartbeat
+    grace. A dead (process exit) or wedged (deadline missed) replica is
+    EJECTED — process-group teardown — and respawned with exponential
+    backoff on a fresh generation.
+  * **failover**: the ejected replica's in-flight batch is pushed back to
+    the front of the queue EXACTLY ONCE per request
+    (`DynamicBatcher.requeue`; predict is idempotent so one retry is
+    safe — the duplicate-work bound is one forward per failed-over
+    request). Expired members 504, twice-unlucky members get a
+    retryable 503.
+  * **load shedding**: the admission gate sheds deterministically when
+    the pool is degraded — with h of N replicas healthy only
+    ``h/N`` of the queue depth is admitted, and beyond it (or at h=0)
+    clients get 503 + ``Retry-After`` scaled to the healthy count
+    instead of queueing into a black hole.
+  * **deadline propagation**: each dispatched batch carries its remaining
+    deadline budget; the replica cancels (``expired``) instead of
+    computing answers nobody is waiting for.
+
+Pool state is wired through telemetry (healthy-replica gauge, failover /
+restart / shed counters, per-replica in-flight gauge) and every ejection
+emits a flight-recorder event (docs/observability.md).
+
+Weight sharing note: WITHIN a replica the padding buckets share one copy
+of the weights (`predict._clone_with`); ACROSS co-located replica
+processes each loads its own copy — device-memory sharing across PJRT
+client processes is not portable, an accepted divergence recorded in
+docs/serving.md.
+"""
+from __future__ import annotations
+
+import hmac
+import math
+import queue
+import secrets
+import socket
+import threading
+import time
+
+from .. import env as _env
+from .. import telemetry
+from ..base import MXNetError
+from .batcher import OverloadedError, ServingError, pad_batch
+from .supervisor import (TOKEN_LEN, ReplicaProcess, backoff_s, recv_msg,
+                         send_msg)
+
+__all__ = ["ReplicaPool"]
+
+# replica slot states
+_SPAWNING = "spawning"   # process launched, not yet ready
+_READY = "ready"         # healthy, idle
+_BUSY = "busy"           # healthy, running a batch
+_DEAD = "dead"           # ejected, awaiting respawn backoff
+
+
+class _Slot:
+    """Mutable state for one replica slot (owned by its dispatch thread;
+    `state`/`conn` transitions are published under the pool lock)."""
+
+    def __init__(self, replica_id, proc):
+        self.id = replica_id
+        self.proc = proc          # ReplicaProcess (generation counter)
+        self.state = _DEAD
+        self.conn = None
+        self.conn_event = threading.Event()  # a connection arrived
+        self.ready_info = None
+        self.consecutive_restarts = 0
+        self.msg_id = 0
+
+
+class ReplicaPool:
+    """Router + supervisor for one served model's replica processes.
+
+    Parameters
+    ----------
+    model : str
+        Telemetry/flight-recorder label (usually ``name/version``).
+    worker_args : list of str
+        Argv tail for ``python -m mxnet_tpu.serving.supervisor`` —
+        what to serve (``--artifact``/``--input``/``--stub`` flags).
+    replicas : int
+        Pool size (>= 1).
+    heartbeat_ms / backoff_ms / wedge_timeout_ms : float, optional
+        Override ``MXTPU_SERVE_HEARTBEAT_MS`` /
+        ``MXTPU_SERVE_RESTART_BACKOFF_MS`` /
+        ``MXTPU_SERVE_WEDGE_TIMEOUT_MS``.
+    extra_env : dict, optional
+        Extra environment for replica processes only (tests inject
+        ``MXTPU_FAULT_INJECT`` serving actions here so the router itself
+        stays fault-free).
+    spawn_timeout_s : float
+        Budget for one replica spawn → ready (includes model load + full
+        bucket warm; compiles can be slow).
+    teardown_grace : float, optional
+        Seconds between SIGTERM and SIGKILL at ejection (default
+        ``MXTPU_TEARDOWN_GRACE``; tests shrink it).
+    """
+
+    def __init__(self, model, worker_args, replicas, heartbeat_ms=None,
+                 backoff_ms=None, extra_env=None, spawn_timeout_s=120.0,
+                 teardown_grace=None, wedge_timeout_ms=None):
+        if replicas < 1:
+            raise MXNetError("replica pool needs >= 1 replicas, got %d"
+                             % replicas)
+        self.model = str(model)
+        self.size = int(replicas)
+        if heartbeat_ms is None:
+            heartbeat_ms = _env.get("MXTPU_SERVE_HEARTBEAT_MS")
+        self.heartbeat_s = max(0.01, float(heartbeat_ms) / 1e3)
+        if wedge_timeout_ms is None:
+            wedge_timeout_ms = _env.get("MXTPU_SERVE_WEDGE_TIMEOUT_MS")
+        self.wedge_timeout_s = max(0.05, float(wedge_timeout_ms) / 1e3)
+        self._backoff_ms = backoff_ms
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self._batcher = None
+        self._stop = False
+        self._lock = threading.Lock()
+        # BOUNDED handoff (one buffered batch per replica): when every
+        # replica is busy and the buffer is full, dispatch_batch blocks
+        # the batcher worker, the request queue backs up, and the existing
+        # 429/degraded-503 admission checks fire — an unbounded buffer
+        # here would hide the backlog from admission control entirely
+        self._work = queue.Queue(maxsize=max(1, self.size))
+
+        labels = {"model": self.model}
+        self._m_healthy = telemetry.gauge("mxtpu_serve_pool_healthy", labels)
+        self._m_size = telemetry.gauge("mxtpu_serve_pool_size", labels)
+        self._m_size.set(self.size)
+        self._m_failover = telemetry.counter("mxtpu_serve_failover_total",
+                                             labels)
+        self._m_requeued = telemetry.counter(
+            "mxtpu_serve_failover_requeued_total", labels)
+        self._m_restarts = telemetry.counter(
+            "mxtpu_serve_replica_restart_total", labels)
+        self._m_inflight = {}  # replica id -> per-replica in-flight gauge
+
+        # per-pool handshake secret: a connection must present it before
+        # the accept loop will unpickle a single frame (localhost TCP is
+        # reachable by every local user; pickle is not)
+        self._token = secrets.token_hex(TOKEN_LEN // 2)
+
+        # one listener for every replica generation; workers CONNECT to it
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(replicas * 2)
+        self._listener.settimeout(0.25)
+        addr = self._listener.getsockname()
+
+        self._slots = []
+        for k in range(replicas):
+            proc = ReplicaProcess(self.model, k, (addr[0], addr[1]),
+                                  worker_args, extra_env=extra_env,
+                                  teardown_grace=teardown_grace,
+                                  token=self._token)
+            slot = _Slot(k, proc)
+            self._m_inflight[k] = telemetry.gauge(
+                "mxtpu_serve_replica_inflight",
+                {"model": self.model, "replica": str(k)})
+            self._slots.append(slot)
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="mxtpu-pool-accept-%s" % self.model)
+        self._accept_thread.start()
+        self._threads = []
+        for slot in self._slots:
+            t = threading.Thread(target=self._replica_loop, args=(slot,),
+                                 daemon=True,
+                                 name="mxtpu-pool-%s-r%d" % (self.model,
+                                                             slot.id))
+            self._threads.append(t)
+            t.start()
+
+    # -- batcher wiring ----------------------------------------------------
+    def bind(self, batcher):
+        """Attach the model's DynamicBatcher (its dispatcher hook feeds
+        `dispatch_batch`; its admission gate is `admission_gate`)."""
+        self._batcher = batcher
+
+    def dispatch_batch(self, batch, total):
+        """DynamicBatcher dispatcher hook (runs on the batcher worker
+        thread): hand the assembled batch to the replica dispatch threads
+        — N replicas run N batches concurrently. Blocks while the bounded
+        handoff buffer is full so overload backpressure reaches the
+        batcher's admission checks instead of piling up here; expired
+        members are pruned replica-side at dispatch."""
+        while not self._stop:
+            try:
+                self._work.put((batch, total), timeout=0.05)
+                return
+            except queue.Full:
+                continue
+        # pool shut down under the batch: resolve, don't strand
+        self._batcher.fail_batch(batch, OverloadedError(
+            "model %r replica pool shut down before dispatch" % self.model))
+
+    def admission_gate(self, queued_len):
+        """Deterministic load shedding, consulted under the batcher queue
+        lock on every submit. Healthy pool: admit (the depth check still
+        applies). Degraded pool: scale the admissible queue to the healthy
+        fraction. Dead pool: shed everything, Retry-After = the respawn
+        backoff horizon."""
+        healthy = self.healthy_count
+        if healthy >= self.size:
+            return None
+        if healthy == 0:
+            eta = max((backoff_s(s.consecutive_restarts, self._backoff_ms)
+                       for s in self._slots), default=1.0)
+            return OverloadedError(
+                "model %r has no healthy replicas (respawn in progress)"
+                % self.model, retry_after=max(1.0, eta))
+        # max(1, ...): a degraded-but-alive pool must keep admitting —
+        # small queue depths would otherwise floor the quota to 0 and turn
+        # a single-replica loss into a total outage
+        allowed = max(1, int(self._batcher.queue_depth * healthy
+                             / self.size)) \
+            if self._batcher is not None else 0
+        if queued_len >= allowed:
+            return OverloadedError(
+                "model %r is degraded (%d/%d replicas healthy; queue "
+                "scaled to %d)" % (self.model, healthy, self.size, allowed),
+                retry_after=math.ceil(self.size / healthy))
+        return None
+
+    # -- state -------------------------------------------------------------
+    @property
+    def healthy_count(self):
+        with self._lock:
+            return sum(1 for s in self._slots
+                       if s.state in (_READY, _BUSY))
+
+    def wait_ready(self, timeout=None):
+        """Block until every replica reported ready once (load + warm).
+        Returns the first replica's ready info (buckets, shapes, dtypes).
+        Raises MXNetError on timeout."""
+        if timeout is None:
+            timeout = self._spawn_timeout_s
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                infos = [s.ready_info for s in self._slots]
+            if all(i is not None for i in infos):
+                return infos[0]
+            time.sleep(0.01)
+        raise MXNetError(
+            "replica pool %r: %d/%d replicas ready within %.0fs"
+            % (self.model, self.healthy_count, self.size, timeout))
+
+    def describe(self):
+        with self._lock:
+            return {
+                "replicas": self.size,
+                "healthy": sum(1 for s in self._slots
+                               if s.state in (_READY, _BUSY)),
+                "states": {s.id: s.state for s in self._slots},
+                "generations": {s.id: s.proc.generation
+                                for s in self._slots},
+            }
+
+    def replica_pid(self, replica_id):
+        """Pid of a replica's current process (serve_bench chaos hook)."""
+        return self._slots[replica_id].proc.pid
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, timeout=5.0):
+        """Stop dispatching, shut every replica down (shutdown message,
+        then escalating teardown) and join the pool threads."""
+        self._stop = True
+        for _ in self._slots:
+            try:
+                self._work.put_nowait(None)  # wake idle dispatch threads
+            except queue.Full:
+                break  # full buffer: threads notice _stop on get timeout
+        for t in self._threads:
+            t.join(timeout=timeout)
+        for slot in self._slots:
+            conn = slot.conn
+            if conn is not None:
+                try:
+                    send_msg(conn, {"kind": "shutdown"})
+                except OSError:
+                    pass
+            slot.proc.teardown()
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._set_healthy_gauge()
+
+    # -- accept loop -------------------------------------------------------
+    def _read_token(self, conn, timeout=5.0):
+        """Read the fixed-length handshake secret — raw bytes, never
+        pickled — and constant-time compare it to the pool's. False on
+        short read, timeout, or mismatch."""
+        conn.settimeout(timeout)
+        buf = bytearray()
+        try:
+            while len(buf) < TOKEN_LEN:
+                chunk = conn.recv(TOKEN_LEN - len(buf))
+                if not chunk:
+                    return False
+                buf.extend(chunk)
+        except (OSError, socket.timeout):
+            return False
+        return hmac.compare_digest(bytes(buf), self._token.encode("ascii"))
+
+    def _accept_loop(self):
+        """Accept replica connections, require the pool handshake secret
+        BEFORE unpickling anything, match the hello to a slot and the
+        slot's CURRENT generation (a zombie from a torn-down generation is
+        refused), then hand the socket to the slot's dispatch thread."""
+        while not self._stop:
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if not self._read_token(conn):
+                    conn.close()
+                    continue
+                hello = recv_msg(conn, first_timeout=5.0)
+            except (OSError, socket.timeout):
+                conn.close()
+                continue
+            if not isinstance(hello, dict) or hello.get("kind") != "hello":
+                conn.close()
+                continue
+            k = hello.get("replica")
+            gen = hello.get("generation")
+            with self._lock:
+                slot = self._slots[k] if isinstance(k, int) \
+                    and 0 <= k < self.size else None
+                if slot is None or gen != slot.proc.generation \
+                        or slot.conn is not None:
+                    slot = None
+                else:
+                    slot.conn = conn
+                    slot.conn_event.set()
+            if slot is None:
+                conn.close()
+
+    # -- per-replica dispatch / health loop --------------------------------
+    def _replica_loop(self, slot):
+        """One thread per replica slot: spawn → wait ready → serve batches
+        (with idle heartbeats) → on death/wedge: eject, fail over, respawn
+        with backoff. The loop body is guarded: this thread IS the slot's
+        supervision — an escaped exception would silently shrink the pool
+        forever (no eject event, no respawn), so any surprise ejects and
+        respawns like a replica death."""
+        while not self._stop:
+            try:
+                # spawn the next generation
+                slot.conn_event.clear()
+                with self._lock:
+                    slot.conn = None
+                    slot.state = _SPAWNING
+                gen = slot.proc.spawn()
+                telemetry.record_event(
+                    "serve_replica_spawn", model=self.model,
+                    replica=slot.id, generation=gen, pid=slot.proc.pid)
+                if not self._await_ready(slot):
+                    if self._stop:
+                        return
+                    self._eject(slot, "spawn_failed", batch=None)
+                    continue
+                # serve until ejection or shutdown
+                reason = self._serve_generation(slot)
+                if self._stop or reason is None:
+                    return
+                self._eject(slot, reason[0], batch=reason[1])
+            except Exception as e:
+                if self._stop:
+                    return
+                telemetry.record_event(
+                    "serve_replica_loop_error", model=self.model,
+                    replica=slot.id, error=repr(e))
+                try:
+                    self._eject(slot, "internal_error", batch=None)
+                except Exception:
+                    pass  # keep supervising even when ejection misfires
+
+    def _await_ready(self, slot):
+        """Wait for this generation's connection + ready message (load +
+        warm happen replica-side first). True on success."""
+        deadline = time.monotonic() + self._spawn_timeout_s
+        while time.monotonic() < deadline and not self._stop:
+            if slot.conn_event.wait(timeout=0.1):
+                break
+            if not slot.proc.alive():
+                return False  # died before connecting (bad artifact, OOM)
+        if self._stop or slot.conn is None:
+            return False
+        try:
+            msg = recv_msg(slot.conn,
+                           first_timeout=max(0.1,
+                                             deadline - time.monotonic()),
+                           rest_timeout=30.0)
+        except (OSError, socket.timeout):
+            return False
+        if not isinstance(msg, dict) or msg.get("kind") != "ready":
+            return False
+        with self._lock:
+            slot.ready_info = msg
+            slot.state = _READY
+            # consecutive_restarts is NOT reset here: an artifact that
+            # warms on zeros but crashes on real input would otherwise
+            # respawn at the constant initial backoff forever — the reset
+            # waits until the generation serves a batch cleanly
+        self._set_healthy_gauge()
+        telemetry.record_event(
+            "serve_replica_ready", model=self.model, replica=slot.id,
+            generation=slot.proc.generation,
+            warm_seconds=round(msg.get("warm_seconds") or 0.0, 3))
+        return True
+
+    def _serve_generation(self, slot):
+        """Dispatch batches on this replica until it dies or wedges.
+        Returns (reason, batch_or_None) for ejection, or None on clean
+        pool shutdown."""
+        while not self._stop:
+            try:
+                item = self._work.get(timeout=self.heartbeat_s / 2)
+            except queue.Empty:
+                # idle: liveness first (cheap), then a ping/pong round trip
+                # bounded by the heartbeat deadline
+                if not slot.proc.alive():
+                    return ("died", None)
+                if not self._ping(slot):
+                    return ("heartbeat_missed", None)
+                continue
+            if item is None:
+                return None  # close() sentinel
+            batch, total = item
+            # the batch may have aged in the work queue while every
+            # replica was busy — do not ship expired members
+            batch = self._batcher._prune_expired(batch)
+            total = sum(r.n for r in batch)
+            if not batch:
+                continue
+            try:
+                outcome = self._run_batch(slot, batch, total)
+            except Exception as e:
+                # unexpected (bad output shapes in resolve_batch, a
+                # pad_batch surprise): eject WITH the batch so its live
+                # members ride the exactly-once failover instead of
+                # hanging until their own deadlines
+                telemetry.record_event(
+                    "serve_replica_error", model=self.model,
+                    replica=slot.id, error=repr(e))
+                return ("internal_error", batch)
+            if outcome is not None:
+                return (outcome, batch)
+        return None
+
+    def _ping(self, slot):
+        slot.msg_id += 1
+        try:
+            send_msg(slot.conn, {"kind": "ping", "id": slot.msg_id})
+            msg = recv_msg(slot.conn, first_timeout=self.heartbeat_s,
+                           rest_timeout=self.heartbeat_s)
+        except (OSError, socket.timeout):
+            return False
+        return isinstance(msg, dict) and msg.get("kind") == "pong"
+
+    def _run_batch(self, slot, batch, total):
+        """Ship one batch to the replica and wait (bounded) for the
+        answer. Returns None when the batch resolved (success, expiry or
+        model error), or an ejection reason string when the replica died
+        or went silent past its deadline."""
+        padded, bucket = pad_batch(batch, total, self._batcher.buckets)
+        # remaining budget: the LATEST member deadline (a replica only
+        # cancels when nobody is waiting anymore); None if any member has
+        # no deadline at all
+        now = time.monotonic()
+        remaining = None
+        deadlines = [r.deadline for r in batch]
+        if all(d is not None for d in deadlines):
+            remaining = max(0.0, max(deadlines) - now)
+        slot.msg_id += 1
+        msg_id = slot.msg_id
+        with self._lock:
+            slot.state = _BUSY
+        self._m_inflight[slot.id].set(total)
+        t0 = time.monotonic()
+        # silence bound: max(batch deadline budget, the wedge floor) plus
+        # the heartbeat grace. The floor (`MXTPU_SERVE_WEDGE_TIMEOUT_MS`)
+        # decouples wedge detection from client deadlines — a forward that
+        # legitimately outlasts a request budget must not be SIGKILLed
+        # mid-compute; deadline-less batches use the floor alone
+        budget = self.wedge_timeout_s if remaining is None \
+            else max(remaining, self.wedge_timeout_s)
+        silence_deadline = t0 + budget + self.heartbeat_s
+        try:
+            send_msg(slot.conn, {
+                "kind": "predict", "id": msg_id, "arrays": padded,
+                "bucket": bucket, "n": total, "remaining": remaining})
+            while True:
+                try:
+                    msg = recv_msg(slot.conn, first_timeout=0.1,
+                                   rest_timeout=max(1.0, self.heartbeat_s))
+                except socket.timeout:
+                    if not slot.proc.alive():
+                        return "died_mid_batch"
+                    if time.monotonic() >= silence_deadline:
+                        return "wedged"
+                    continue
+                if msg is None:
+                    return "died_mid_batch"  # EOF under an in-flight batch
+                break
+        except OSError:
+            return "died_mid_batch"
+        finally:
+            self._m_inflight[slot.id].set(0)
+            with self._lock:
+                if slot.state == _BUSY:
+                    slot.state = _READY
+        kind = msg.get("kind")
+        if kind == "result" and msg.get("id") == msg_id:
+            self._batcher.resolve_batch(batch, msg["outputs"], bucket,
+                                        total, msg.get("seconds") or
+                                        (time.monotonic() - t0))
+            # the generation proved itself on real input: the exponential
+            # respawn backoff resets only now, so a warm-but-crash-on-input
+            # artifact still escalates toward the 60s cap
+            if slot.consecutive_restarts:
+                with self._lock:
+                    slot.consecutive_restarts = 0
+            return None
+        if kind == "expired" and msg.get("id") == msg_id:
+            # replica cancelled past-deadline work; expire what's expired,
+            # anything still live gets a retryable 503 (clock skew) — a
+            # 504 would blame a deadline that never actually passed
+            live = self._batcher._prune_expired(batch)
+            if live:
+                self._batcher.fail_batch(live, OverloadedError(
+                    "replica %d cancelled the batch as past-deadline but "
+                    "%d member(s) are still live; retry"
+                    % (slot.id, len(live)), retry_after=1.0))
+            return None
+        if kind == "error":
+            self._batcher.fail_batch(batch, ServingError(
+                "model %r replica %d failed: %s"
+                % (self.model, slot.id, msg.get("error"))))
+            return None
+        # protocol desync (stale pong, wrong id): the socket's framing
+        # can no longer be trusted — eject and fail over
+        return "protocol_desync"
+
+    # -- ejection / failover ----------------------------------------------
+    def _eject(self, slot, reason, batch=None):
+        """Tear the replica's process group down, fail its in-flight batch
+        over (exactly-once re-enqueue), publish telemetry + the
+        flight-recorder event, and back off before the next spawn."""
+        with self._lock:
+            slot.state = _DEAD
+            slot.ready_info = None
+            conn, slot.conn = slot.conn, None
+            slot.conn_event.clear()
+            slot.consecutive_restarts += 1
+            restarts = slot.consecutive_restarts
+        self._set_healthy_gauge()
+        exit_code = slot.proc.exit_code()
+        slot.proc.teardown()
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        requeued = 0
+        if batch:
+            requeued = self._batcher.requeue(batch)
+            self._m_failover.inc()
+            self._m_requeued.inc(requeued)
+        self._m_restarts.inc()
+        delay = backoff_s(restarts, self._backoff_ms)
+        # the flight-recorder event every ejection must leave behind
+        telemetry.record_event(
+            "serve_replica_eject", model=self.model, replica=slot.id,
+            generation=slot.proc.generation, reason=reason,
+            exit_code=exit_code, requeued=requeued,
+            backoff_s=round(delay, 3))
+        if batch:
+            telemetry.record_event(
+                "serve_failover", model=self.model, replica=slot.id,
+                requeued=requeued, dropped=len(batch) - requeued)
+        deadline = time.monotonic() + delay
+        while time.monotonic() < deadline and not self._stop:
+            time.sleep(0.02)
+
+    def _set_healthy_gauge(self):
+        self._m_healthy.set(self.healthy_count)

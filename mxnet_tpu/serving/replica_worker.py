@@ -1,0 +1,11 @@
+"""``python -m mxnet_tpu.serving.replica_worker`` — the replica worker
+entry point, split from `supervisor` so runpy never re-executes a module
+the serving package already imported (the sys.modules RuntimeWarning)."""
+from __future__ import annotations
+
+from .supervisor import worker_main
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(worker_main())

@@ -39,7 +39,7 @@ import numpy as _np
 from .. import env as _env
 from .. import telemetry
 from ..base import MXNetError
-from .batcher import DrainingError, ServingError
+from .batcher import DrainingError, ServingError, drain_timeout_s
 
 __all__ = ["ServingServer"]
 
@@ -61,10 +61,23 @@ class ServingServer:
 
         self.repository = repository
         self._draining = False
-        self._drain_thread = None
+        self._drain_failed = False
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self._m_codes = {}
+        # the drain WAITER is pre-started so the SIGTERM handler only has
+        # to set an Event: the main thread spawns handler threads inside
+        # `serve_forever` (ThreadingHTTPServer), so a handler that called
+        # Thread.start() itself could deadlock on the threading module's
+        # own locks if the signal landed mid-spawn. mxlint's signal-safety
+        # checker walks `_on_signal` to keep it that trivial.
+        self._closed = False
+        self._drain_shutdown = False
+        self._drain_event = threading.Event()
+        self._drain_waiter = threading.Thread(
+            target=self._drain_when_signaled, name="mxtpu-serve-drain",
+            daemon=True)
+        self._drain_waiter.start()
         if port is None:
             port = _env.get("MXTPU_SERVE_PORT")
 
@@ -108,6 +121,8 @@ class ServingServer:
         return self
 
     def shutdown(self):
+        self._closed = True
+        self._drain_event.set()  # release an idle drain waiter
         self._http.shutdown()
         self._http.server_close()
         if self._serve_thread is not None:
@@ -120,10 +135,19 @@ class ServingServer:
     def drain(self, timeout=None, shutdown=False):
         """Stop admitting work, wait for queued + in-flight requests (and
         their handler threads) to finish, optionally stop the server.
-        Returns True when everything completed within ``timeout``."""
+        Returns True when everything completed within ``timeout``.
+
+        The wait is BOUNDED (`MXTPU_SERVE_DRAIN_TIMEOUT_MS`): a wedged
+        executor must not wedge shutdown forever. On expiry every stranded
+        request is force-completed with a deterministic 503 (the waiter
+        gets an answer, not a connection reset), `drain_failed` is set, and
+        the `tools/serve.py` process exits nonzero so the supervisor knows
+        the drain was not clean."""
         self._draining = True
         if timeout is None:
-            timeout = _env.get("MXTPU_SERVE_DRAIN_TIMEOUT_S")
+            # drain_timeout_s honors the deprecated seconds-typed
+            # MXTPU_SERVE_DRAIN_TIMEOUT_S with a one-time warning
+            timeout = drain_timeout_s()
         telemetry.record_event("serve_drain_start",
                                pending=self.repository.pending())
         deadline = time.monotonic() + timeout
@@ -131,23 +155,59 @@ class ServingServer:
         while self._inflight and time.monotonic() < deadline:
             time.sleep(0.01)  # let handler threads finish writing replies
         ok = ok and not self._inflight
+        if not ok:
+            aborted = self.repository.abort_pending()
+            self._drain_failed = True
+            telemetry.record_event("serve_drain_forced", aborted=aborted,
+                                   timeout_s=timeout)
+            # the 503s are resolved; give handler threads a moment to
+            # write them out before the listener dies
+            force_deadline = time.monotonic() + 2.0
+            while self._inflight and time.monotonic() < force_deadline:
+                time.sleep(0.01)
         telemetry.record_event("serve_drain_done", complete=ok)
         if shutdown:
             self.shutdown()
         return ok
 
+    @property
+    def drain_failed(self):
+        """True when a drain timed out and force-completed requests (the
+        process should exit nonzero)."""
+        return self._drain_failed
+
+    def _drain_when_signaled(self):
+        """The pre-started drain waiter: parked on `_drain_event` until a
+        signal handler or `/drainz` releases it, then runs the (bounded)
+        drain — with shutdown when the trigger was a signal. Loops after a
+        `/drainz` drain so a later SIGTERM still shuts the server down; a
+        signal landing mid-drain re-sets the event and is picked up on the
+        next lap."""
+        while True:
+            self._drain_event.wait()
+            if self._closed:
+                return  # plain shutdown(), nothing to drain
+            self._drain_event.clear()
+            shutdown = self._drain_shutdown
+            telemetry.record_event("serve_drain_triggered",
+                                   shutdown=shutdown)
+            self.drain(shutdown=shutdown)
+            if shutdown or self._closed:
+                return
+
     def install_signal_handlers(self, signals=(signal.SIGTERM, signal.SIGINT)):
-        """Graceful-drain on SIGTERM/SIGINT: the handler only spawns the
-        drain thread (signal context stays trivial); `serve_forever`
-        returns once the drain finishes and the caller exits 0."""
+        """Graceful-drain on SIGTERM/SIGINT: the handler only flips a flag
+        and sets the Event the pre-started waiter parks on — it is walked
+        by the mxlint signal-safety checker, so it must stay free of
+        locks, logging, allocation and thread starts (the interrupted
+        main thread spawns HTTP handler threads, so Thread.start() here
+        could deadlock on the threading module's internals).
+        `serve_forever` returns once the drain finishes and the caller
+        exits 0 (or nonzero when `drain_failed`)."""
 
         def _on_signal(signum, frame):
-            if self._drain_thread is None:
-                telemetry.record_event("serve_signal", signum=signum)
-                self._drain_thread = threading.Thread(
-                    target=self.drain, kwargs={"shutdown": True},
-                    name="mxtpu-serve-drain", daemon=True)
-                self._drain_thread.start()
+            self._drain_shutdown = True
+            self._drain_event.set()
 
         for s in signals:
             signal.signal(s, _on_signal)
@@ -163,11 +223,7 @@ class ServingServer:
                 else:
                     self._text(handler, 200, "ok\n")
             elif path.rstrip("/") == "/drainz":
-                if self._drain_thread is None:
-                    self._drain_thread = threading.Thread(
-                        target=self.drain, name="mxtpu-serve-drain",
-                        daemon=True)
-                    self._drain_thread.start()
+                self._drain_event.set()  # idempotent: wakes the waiter
                 self._json(handler, 200, {
                     "draining": True,
                     "pending": self.repository.pending(),
@@ -184,7 +240,8 @@ class ServingServer:
         except BrokenPipeError:
             pass  # client went away mid-reply
         except ServingError as e:
-            self._json(handler, e.status, {"error": str(e)})
+            self._json(handler, e.status, {"error": str(e)},
+                       retry_after=e.retry_after)
         except MXNetError as e:
             self._json(handler, 400, {"error": str(e)})
         except Exception as e:  # the server must answer, never unwind
@@ -281,7 +338,7 @@ class ServingServer:
         handler.end_headers()
         handler.wfile.write(body)
 
-    def _json(self, handler, code, payload):
+    def _json(self, handler, code, payload, retry_after=None):
         body = (json.dumps(payload) + "\n").encode()
         self._count(code)
         if code >= 400:
@@ -291,7 +348,11 @@ class ServingServer:
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(body)))
-        if code == 429:
-            handler.send_header("Retry-After", "1")
+        if retry_after is None and code == 429:
+            retry_after = 1
+        if retry_after is not None:
+            # load-shed contract: 503s carry a Retry-After scaled to the
+            # healthy-replica count (OverloadedError.retry_after)
+            handler.send_header("Retry-After", str(int(retry_after)))
         handler.end_headers()
         handler.wfile.write(body)

@@ -1,0 +1,435 @@
+"""Replica process supervision + the replica worker entry point.
+
+The serving resilience layer (docs/serving.md §resilience) runs each
+served model as N *replica worker processes* so a wedged executor, a
+poisoned request, or an OOM kills one process, not the endpoint. This
+module is the process half of that design; the routing half is
+`replica_pool.ReplicaPool`.
+
+It deliberately reuses the `tools/launch.py` supervision machinery's
+shape (docs/fault_tolerance.md): workers are spawned as session leaders
+so teardown can signal the whole process GROUP (grandchildren die too),
+teardown escalates SIGTERM → SIGKILL over `MXTPU_TEARDOWN_GRACE`, every
+respawn bumps a per-replica restart *generation* exported as
+`MXTPU_RESTART_GENERATION` (the same variable the elastic launcher uses,
+so `MXTPU_FAULT_INJECT`'s ``gen=`` condition gates replica faults exactly
+like trainer faults — a respawned replica does NOT re-fire its fault),
+and respawns back off exponentially (`MXTPU_SERVE_RESTART_BACKOFF_MS`,
+doubling, capped at 60s).
+
+Worker side (``python -m mxnet_tpu.serving.supervisor``): loads an
+artifact (or a test stub), warms every padding bucket, CONNECTS to the
+pool's localhost listener, and serves length-prefixed pickled messages:
+
+    router -> replica   {kind: predict, id, arrays, bucket, n, remaining}
+                        {kind: ping, id} | {kind: shutdown}
+    replica -> router   {kind: hello, replica, generation, pid}
+                        {kind: ready, warm_seconds}
+                        {kind: result, id, outputs, seconds}
+                        {kind: expired, id} | {kind: error, id, error}
+                        {kind: pong, id}
+
+``remaining`` is the batch deadline budget in seconds (per-request
+deadlines are process-local monotonic times, so the ROUTER converts to a
+remaining budget before the wire): a replica that wakes up past it —
+e.g. after a ``slow_reply`` injection — answers ``expired`` and never
+runs the forward, so a slow replica cancels work instead of computing
+answers nobody is waiting for.
+
+SIGTERM asks the worker to finish its current batch and exit 0; the
+handler (`_on_term`) only flips a flag — it is walked by the mxlint
+signal-safety checker, so it must stay free of locks/logging/allocation
+beyond a list-slot store.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+from .. import env as _env
+
+_LOG = logging.getLogger("mxnet_tpu.serving.supervisor")
+
+_HDR = struct.Struct("!I")
+_MAX_MSG = 1 << 30  # 1 GiB framing sanity bound
+TOKEN_LEN = 32      # hex chars of the per-pool handshake secret
+
+
+# ---------------------------------------------------------------------------
+# wire protocol (shared by router and worker)
+# ---------------------------------------------------------------------------
+
+def send_msg(sock, obj):
+    """One length-prefixed pickle frame. Pickle over a TCP socket is only
+    safe because the router refuses to unpickle ANYTHING from a connection
+    that has not first presented the pool's per-process handshake secret
+    (`MXTPU_SERVE_POOL_TOKEN`, random per pool, handed to workers via
+    their environment — the moral equivalent of multiprocessing's
+    authkey); without it, any local user who found the 127.0.0.1 port
+    could run code in the serving process via a crafted frame."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def recv_msg(sock, first_timeout=None, rest_timeout=30.0):
+    """Receive one frame. ``first_timeout`` bounds the wait for the FIRST
+    byte (None = block); once a message has started, ``rest_timeout``
+    bounds each subsequent chunk so a half-written frame from a dying peer
+    cannot park us forever. Returns None on clean EOF before a frame
+    starts. socket.timeout is raised ONLY before a frame starts (the
+    stream is intact and a retry is safe); once bytes of a frame were
+    consumed, a stall raises plain OSError — the framing can no longer be
+    trusted, so callers that retry socket.timeout (the router's poll loop)
+    must never resume reading mid-frame garbage."""
+    sock.settimeout(first_timeout)
+    try:
+        first = sock.recv(_HDR.size)
+    except socket.timeout:
+        raise
+    if not first:
+        return None
+    sock.settimeout(rest_timeout)
+    buf = bytearray(first)
+    try:
+        while len(buf) < _HDR.size:
+            chunk = sock.recv(_HDR.size - len(buf))
+            if not chunk:
+                raise OSError("peer closed mid-header")
+            buf.extend(chunk)
+        (length,) = _HDR.unpack(bytes(buf))
+        if length > _MAX_MSG:
+            raise OSError("oversized frame (%d bytes)" % length)
+        data = bytearray()
+        while len(data) < length:
+            chunk = sock.recv(min(1 << 20, length - len(data)))
+            if not chunk:
+                raise OSError("peer closed mid-message")
+            data.extend(chunk)
+    except socket.timeout:
+        raise OSError("peer stalled mid-frame (rest_timeout %.1fs)"
+                      % rest_timeout) from None
+    return pickle.loads(bytes(data))
+
+
+# ---------------------------------------------------------------------------
+# router side: one supervised replica process
+# ---------------------------------------------------------------------------
+
+def _signal_pg(proc, sig):
+    """Signal the worker's whole process group (it was spawned a session
+    leader), falling back to the single pid."""
+    if proc.poll() is not None:
+        return
+    try:
+        os.killpg(proc.pid, sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.send_signal(sig)
+        except OSError:
+            pass
+
+
+def teardown(proc, grace=None):
+    """Escalating SIGTERM → SIGKILL process-group teardown (the
+    tools/launch.py `_teardown` contract for a single worker): give the
+    group `grace` seconds (`MXTPU_TEARDOWN_GRACE`) to exit cleanly, then
+    SIGKILL the survivors — a replica wedged in a forward ignores nothing
+    after SIGKILL, so ejection can never hang the router."""
+    if proc.poll() is not None:
+        return
+    if grace is None:
+        grace = _env.get("MXTPU_TEARDOWN_GRACE")
+    _signal_pg(proc, signal.SIGTERM)
+    deadline = time.monotonic() + max(0.0, grace)
+    while time.monotonic() < deadline and proc.poll() is None:
+        time.sleep(0.02)
+    if proc.poll() is None:
+        _signal_pg(proc, signal.SIGKILL)
+    try:
+        proc.wait(timeout=10)
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+
+
+def _pump(stream, label):
+    """Prefix a replica's merged stdout/stderr per line (the launch.py
+    rank-prefix pattern) so a multi-replica post-mortem stays readable."""
+    prefix = ("[%s] " % label).encode()
+    out = getattr(sys.stderr, "buffer", None)
+    for line in iter(stream.readline, b""):
+        if out is not None:
+            out.write(prefix + line)
+            out.flush()
+        else:
+            sys.stderr.write((prefix + line).decode("utf-8", "replace"))
+            sys.stderr.flush()
+    stream.close()
+
+
+class ReplicaProcess:
+    """Spawn/teardown state for one replica slot.
+
+    ``worker_args`` is the argv tail describing WHAT to serve (artifact or
+    stub flags); this class owns generation counting, the env protocol and
+    the process-group lifecycle. A fresh `spawn()` after `teardown()`
+    starts the next generation.
+    """
+
+    def __init__(self, model, replica_id, connect_addr, worker_args,
+                 extra_env=None, teardown_grace=None, token=None):
+        self.model = str(model)
+        self.replica_id = int(replica_id)
+        self.connect_addr = connect_addr
+        self.worker_args = list(worker_args)
+        self.extra_env = dict(extra_env or {})
+        self.teardown_grace = teardown_grace
+        self.token = token
+        self.generation = -1  # no spawn yet
+        self.proc = None
+        self._pump_thread = None
+
+    @property
+    def pid(self):
+        return self.proc.pid if self.proc is not None else None
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def spawn(self):
+        """Start the next generation of this replica (session leader, own
+        process group, line-prefixed output). Returns the generation."""
+        self.generation += 1
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        # the launcher env protocol: generation gates fault injection and
+        # labels flight-recorder events in the worker
+        env["MXTPU_RESTART_GENERATION"] = str(self.generation)
+        if self.token:
+            # handshake secret via the environment (same-UID readable
+            # only — argv would leak it to every user via /proc)
+            env["MXTPU_SERVE_POOL_TOKEN"] = self.token
+        # a replica must never inherit the parent's serving port/telemetry
+        # HTTP endpoint (port collisions across respawns)
+        env.pop("MXTPU_TELEMETRY_PORT", None)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        argv = [sys.executable, "-m", "mxnet_tpu.serving.replica_worker",
+                "--connect", "%s:%d" % self.connect_addr,
+                "--replica", str(self.replica_id),
+                "--generation", str(self.generation)] + self.worker_args
+        self.proc = subprocess.Popen(
+            argv, env=env, start_new_session=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        self._pump_thread = threading.Thread(
+            target=_pump, args=(self.proc.stdout,
+                                "%s/r%d.g%d" % (self.model, self.replica_id,
+                                                self.generation)),
+            daemon=True)
+        self._pump_thread.start()
+        return self.generation
+
+    def teardown(self):
+        if self.proc is not None:
+            teardown(self.proc, self.teardown_grace)
+
+    def exit_code(self):
+        return self.proc.poll() if self.proc is not None else None
+
+
+def backoff_s(consecutive_restarts, initial_ms=None):
+    """Exponential respawn backoff: initial * 2^(n-1), capped at 60s."""
+    if initial_ms is None:
+        initial_ms = _env.get("MXTPU_SERVE_RESTART_BACKOFF_MS")
+    if consecutive_restarts <= 0:
+        return 0.0
+    return min(60.0, (initial_ms / 1e3) * (2 ** (consecutive_restarts - 1)))
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+# SIGTERM flag: a one-slot list the handler stores into. The handler is an
+# mxlint signal-safety entry point — no locks, no logging, no Event.set().
+_STOP = [False]
+
+
+def _on_term(signum, frame):
+    _STOP[0] = True
+
+
+def _build_stub_runner(args):
+    """Test stubs (numpy-only, no artifact): `echo` answers x*2; a
+    positive --stub-delay-ms sleeps per batch (holds batches in flight so
+    tests can land faults deterministically)."""
+    import numpy as np
+
+    delay = max(0.0, args.stub_delay_ms) / 1e3
+
+    def runner(arrays, bucket, n):
+        if delay:
+            time.sleep(delay)
+        name = sorted(arrays)[0]
+        return [np.asarray(arrays[name]) * 2.0]
+
+    return runner
+
+
+def _parse_inputs(specs):
+    shapes, dtypes = {}, {}
+    for spec in specs or ():
+        name, _, dims = spec.partition("=")
+        if ":" in dims:
+            dims, dtype = dims.split(":", 1)
+            dtypes[name] = dtype
+        shapes[name] = tuple(int(d) for d in dims.split("x") if d)
+    return shapes, (dtypes or None)
+
+
+def worker_main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="serving replica worker (spawned by ReplicaPool)")
+    p.add_argument("--connect", required=True, metavar="HOST:PORT")
+    p.add_argument("--replica", type=int, required=True)
+    p.add_argument("--generation", type=int, default=0)
+    p.add_argument("--artifact", default=None,
+                   help="export prefix or .mxc path (tools/serve.py spec)")
+    p.add_argument("--input", action="append", default=[],
+                   metavar="NAME=DIMS[:DTYPE]")
+    p.add_argument("--max-batch", type=int, default=None)
+    p.add_argument("--stub", choices=("echo",), default=None,
+                   help="serve a numpy stub instead of an artifact (tests)")
+    p.add_argument("--stub-delay-ms", type=float, default=0.0)
+    p.add_argument("--no-warm", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(message)s", stream=sys.stderr)
+    signal.signal(signal.SIGTERM, _on_term)
+
+    from ..parallel.resilience import maybe_inject_serving_fault
+    from .batcher import power_of_two_buckets
+
+    max_batch = args.max_batch
+    if max_batch is None:
+        max_batch = _env.get("MXTPU_SERVE_MAX_BATCH")
+    if args.stub:
+        runner = _build_stub_runner(args)
+        example_shapes, input_dtypes = _parse_inputs(args.input)
+        buckets = power_of_two_buckets(max_batch)
+    elif args.artifact:
+        from .model_repository import build_runner
+
+        example_shapes, input_dtypes = _parse_inputs(args.input)
+        runner, buckets, example_shapes, input_dtypes, _meta = build_runner(
+            args.artifact, input_shapes=example_shapes or None,
+            input_dtypes=input_dtypes, max_batch=max_batch)
+    else:
+        p.error("need --artifact or --stub")
+
+    host, _, port = args.connect.rpartition(":")
+    sock = socket.create_connection((host, int(port)), timeout=30)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # authenticate BEFORE the first pickled frame: the router unpickles
+    # nothing from a connection that has not presented the pool secret
+    token = (_env.raw("MXTPU_SERVE_POOL_TOKEN") or "").encode("ascii")
+    sock.sendall(token.ljust(TOKEN_LEN, b"\0")[:TOKEN_LEN])
+    send_msg(sock, {"kind": "hello", "replica": args.replica,
+                    "generation": args.generation, "pid": os.getpid()})
+
+    # warm every bucket BEFORE ready: a replica never joins the pool with a
+    # cold executable cache (the same publish-after-warm rule as in-process
+    # models, docs/serving.md)
+    warm_s = 0.0
+    if not args.no_warm:
+        import numpy as np
+
+        t0 = time.monotonic()
+        for b in buckets:
+            zeros = {k: np.zeros((b,) + tuple(s),
+                                 dtype=(input_dtypes or {}).get(k, "float32"))
+                     for k, s in example_shapes.items()}
+            runner(zeros, b, b)
+        warm_s = time.monotonic() - t0
+    send_msg(sock, {"kind": "ready", "replica": args.replica,
+                    "generation": args.generation, "warm_seconds": warm_s,
+                    "buckets": list(buckets),
+                    "example_shapes": {k: tuple(v)
+                                       for k, v in example_shapes.items()},
+                    "input_dtypes": {k: str(v) for k, v in
+                                     (input_dtypes or {}).items()} or None})
+    _LOG.info("replica %d gen %d ready (warm %.2fs, buckets %s)",
+              args.replica, args.generation, warm_s, list(buckets))
+
+    seq = 0
+    while not _STOP[0]:
+        try:
+            msg = recv_msg(sock, first_timeout=0.25)
+        except socket.timeout:
+            continue
+        except OSError:
+            break  # router went away: nothing to serve into
+        if msg is None:
+            break  # clean EOF
+        kind = msg.get("kind")
+        if kind == "shutdown":
+            break
+        if kind == "ping":
+            send_msg(sock, {"kind": "pong", "id": msg.get("id")})
+            continue
+        if kind != "predict":
+            _LOG.warning("replica %d: unknown message kind %r",
+                         args.replica, kind)
+            continue
+        seq += 1
+        t_batch = time.monotonic()
+        deadline = None if msg.get("remaining") is None \
+            else t_batch + float(msg["remaining"])
+        # fault hook at the batch boundary (kill_replica / wedge_replica /
+        # slow_reply — docs/fault_tolerance.md §4)
+        maybe_inject_serving_fault(seq, args.replica)
+        # deadline propagation: a replica that wakes up past the batch
+        # budget (slow_reply, GC pause, CPU contention) cancels instead of
+        # computing an answer nobody is waiting for
+        if deadline is not None and time.monotonic() >= deadline:
+            send_msg(sock, {"kind": "expired", "id": msg["id"]})
+            continue
+        try:
+            outs = runner(msg["arrays"], msg["bucket"], msg["n"])
+        except Exception as e:  # model failure (incl. OSError from the
+            try:                # runner itself): answer, never die
+                send_msg(sock, {"kind": "error", "id": msg["id"],
+                                "error": "%s: %s" % (type(e).__name__, e)})
+            except OSError:
+                break  # router went away mid-reply
+            continue
+        try:
+            send_msg(sock, {"kind": "result", "id": msg["id"],
+                            "outputs": outs,
+                            "seconds": time.monotonic() - t_batch})
+        except OSError:
+            break  # router went away: nothing to serve into
+    try:
+        sock.close()
+    except OSError:
+        pass
+    _LOG.info("replica %d gen %d exiting after %d batches",
+              args.replica, args.generation, seq)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

@@ -216,6 +216,72 @@ def test_signal_safety_negative_and_pragma(tmp_path):
     assert kept == [] and len(by_pragma) == 1, _lines(findings)
 
 
+_RECORDER_OK = """\
+    from . import core
+
+    def dump(reason):
+        return core.snapshot()
+
+    def _on_sigusr1(signum, frame):
+        dump("sig")
+"""
+
+
+def test_signal_safety_serving_handlers(tmp_path):
+    """ISSUE-6 satellite: the serving signal handlers (the replica
+    worker's module-level `_on_term` and the frontend's NESTED
+    `_on_signal`) are entry points too — a thread start or logging call
+    smuggled into either is flagged; the real flag-flip/Event-set shape
+    passes clean."""
+    dirty = _tree(tmp_path / "dirty", {
+        "mxnet_tpu/telemetry/core.py": _CORE_OK,
+        "mxnet_tpu/telemetry/recorder.py": _RECORDER_OK,
+        "mxnet_tpu/serving/supervisor.py": """\
+        import logging
+
+        _STOP = [False]
+
+        def _on_term(signum, frame):
+            logging.getLogger("x").info("stopping")   # line 6
+            _STOP[0] = True
+        """,
+        "mxnet_tpu/serving/server.py": """\
+        import threading
+
+        class ServingServer:
+            def install_signal_handlers(self):
+                def _on_signal(signum, frame):
+                    t = threading.Thread(target=self.drain)   # line 6
+                    t.start()                                 # line 7
+                return _on_signal
+        """})
+    got = _lines(_findings(SignalSafetyChecker(), dirty))
+    assert ("mxnet_tpu/serving/supervisor.py", 6) in got, got
+    assert ("mxnet_tpu/serving/server.py", 6) in got, got
+    assert ("mxnet_tpu/serving/server.py", 7) in got, got
+
+    clean = _tree(tmp_path / "clean", {
+        "mxnet_tpu/telemetry/core.py": _CORE_OK,
+        "mxnet_tpu/telemetry/recorder.py": _RECORDER_OK,
+        "mxnet_tpu/serving/supervisor.py": """\
+        _STOP = [False]
+
+        def _on_term(signum, frame):
+            _STOP[0] = True
+        """,
+        "mxnet_tpu/serving/server.py": """\
+        import threading
+
+        class ServingServer:
+            def install_signal_handlers(self):
+                def _on_signal(signum, frame):
+                    self._drain_shutdown = True
+                    self._drain_event.set()
+                return _on_signal
+        """})
+    assert _findings(SignalSafetyChecker(), clean) == []
+
+
 # ---------------------------------------------------------------------------
 # env-registry
 # ---------------------------------------------------------------------------

@@ -197,13 +197,24 @@ def test_checkpoint_rank_gating(tmp_path, monkeypatch):
 def test_fault_spec_parsing(monkeypatch):
     assert fault_spec("kill@step=7,rank=1") == [
         {"action": "kill", "step": 7, "rank": 1, "gen": 0, "code": 42,
-         "dir": None}]
+         "dir": None, "batch": None, "replica": None, "ms": 1000}]
     assert fault_spec("exc@step=3 corrupt_ckpt@step=5,dir=/tmp/x")[1]["dir"] \
         == "/tmp/x"
+    # serving actions key on batch=/replica= instead of step=/rank=
+    kr, wr, sl = fault_spec("kill_replica@batch=3,replica=0 "
+                            "wedge_replica@batch=5,replica=1,gen=0 "
+                            "slow_reply@batch=2,ms=500")
+    assert (kr["action"], kr["batch"], kr["replica"]) == ("kill_replica", 3, 0)
+    assert (wr["action"], wr["batch"], wr["replica"]) == ("wedge_replica",
+                                                         5, 1)
+    assert (sl["action"], sl["batch"], sl["ms"], sl["replica"]) == \
+        ("slow_reply", 2, 500, None)
     with pytest.raises(MXNetError, match="unknown action"):
         fault_spec("explode@step=1")
     with pytest.raises(MXNetError, match="needs a step"):
         fault_spec("kill@rank=1")
+    with pytest.raises(MXNetError, match="needs a batch"):
+        fault_spec("kill_replica@step=3")
     # hook is inert without the env var
     monkeypatch.delenv("MXTPU_FAULT_INJECT", raising=False)
     monkeypatch.setattr(resilience, "_fault_cache", resilience._UNPARSED)

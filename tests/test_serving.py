@@ -24,8 +24,8 @@ from mxnet_tpu import gluon, telemetry
 from mxnet_tpu.base import MXNetError, unpad_outputs
 from mxnet_tpu.serving import (
     DeadlineExceededError, DynamicBatcher, ModelRepository,
-    ModelUnavailableError, QueueFullError, ServedModel, ServingServer,
-    bucket_for, power_of_two_buckets,
+    ModelUnavailableError, OverloadedError, QueueFullError, ServedModel,
+    ServingServer, bucket_for, power_of_two_buckets,
 )
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -175,6 +175,44 @@ def test_batcher_queue_overflow_and_deadline():
         b.close()
 
 
+def test_requeue_second_failover_resolves_503_not_stranded():
+    """Review regression: a request whose ONE failover retry was already
+    spent (``retried=True`` from an earlier requeue) used to be skipped by
+    BOTH requeue loops when its second replica died — removed from
+    in-flight accounting but never resolved, so the waiter blocked until
+    the request's own deadline (or forever without one)."""
+    gate = threading.Event()
+
+    def runner(arrays, bucket, n):
+        gate.wait(10)
+        return [arrays["x"]]
+
+    b = DynamicBatcher(runner, [1], max_delay_ms=1, queue_depth=4,
+                       name="unit_requeue")
+    try:
+        first = b.submit({"x": np.zeros((1, 1), np.float32)})
+        time.sleep(0.05)  # worker pops `first` and parks in the runner
+        req = b.submit({"x": np.zeros((1, 1), np.float32)})
+        with b._cv:
+            b._queue.remove(req)  # simulate dispatch to replica A
+        # replica A dies: the request rides its one failover retry
+        assert b.requeue([req]) == 1
+        assert req.retried and not req.done()
+        with b._cv:
+            b._queue.remove(req)  # simulate dispatch to replica B
+        # replica B dies too: the retry is spent — requeue must resolve a
+        # retryable 503 NOW, not strand the request unresolved
+        assert b.requeue([req]) == 0
+        assert req.done()
+        with pytest.raises(OverloadedError):
+            req.wait(1)
+        gate.set()
+        assert first.wait(5)[0].shape == (1, 1)
+    finally:
+        gate.set()
+        b.close()
+
+
 def test_batcher_expired_head_never_overfills_batch():
     """Review regression: the fit check must apply to the request actually
     popped — an expired queue head followed by a large live request used to
@@ -209,6 +247,39 @@ def test_batcher_expired_head_never_overfills_batch():
         assert np.all(again.wait(5)[0] == 2.0)
     finally:
         gate.set()
+        b.close()
+
+
+def test_batcher_expired_at_assembly_never_reaches_runner():
+    """Satellite regression: a request whose deadline expires DURING the
+    coalescing window must be 504ed at batch-assembly time — the runner
+    (executor) never spends time computing an answer nobody is waiting
+    for."""
+    calls = []
+
+    def runner(arrays, bucket, n):
+        calls.append(n)
+        return [arrays["x"]]
+
+    rej = telemetry.get_registry().counter(
+        "mxtpu_serve_rejected_total", {"model": "asm", "reason": "deadline"})
+    before = rej.value
+    b = DynamicBatcher(runner, [4], max_delay_ms=150, queue_depth=8,
+                       name="asm")
+    try:
+        # popped live immediately, but the 40ms deadline expires inside the
+        # 150ms coalescing window -> pruned at assembly, runner skipped
+        r = b.submit({"x": np.zeros((1, 1), np.float32)},
+                     deadline=time.monotonic() + 0.04)
+        with pytest.raises(DeadlineExceededError):
+            r.wait(2)
+        assert calls == [], calls
+        assert rej.value == before + 1
+        # the worker thread survived and still serves live traffic
+        ok = b.submit({"x": np.ones((1, 1), np.float32)})
+        assert np.all(ok.wait(5)[0] == 1.0)
+        assert calls == [1]
+    finally:
         b.close()
 
 
@@ -477,6 +548,246 @@ def test_serving_telemetry_metrics():
     assert snap["mxtpu_serve_queue_seconds" + lbl]["count"] == 5
     assert snap["mxtpu_serve_compute_seconds" + lbl]["count"] == 5
     assert "mxtpu_serve_models_loaded" in snap
+
+
+def test_hot_reload_under_sustained_load():
+    """Hot reload is invisible to clients: a closed-loop workload runs
+    while version 2 publishes and version 1 drains — zero 500s, every
+    response comes from a fully-published version (the flip is atomic:
+    per-client versions never go backwards), and the outputs prove no
+    cross-version bleed."""
+    def v1_runner(arrays, bucket, n):
+        return [arrays["x"] + 1.0]
+
+    def v2_runner(arrays, bucket, n):
+        return [arrays["x"] + 2.0]
+
+    repo = ModelRepository()
+    repo.add(ServedModel("hot", 1, v1_runner, [1, 2], {"x": (1,)},
+                         max_delay_ms=1, queue_depth=64))
+    srv = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    url = "http://127.0.0.1:%d/v1/models/hot:predict" % srv.port
+    stop = threading.Event()
+    lock = threading.Lock()
+    records = []  # (thread, version, ok) in per-thread completion order
+    errors = []   # HTTP status != 200
+
+    def client(tid):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            x = float(tid * 100 + i)
+            try:
+                code, resp = _post_json(
+                    url, {"inputs": {"x": [[x]]}, "timeout_ms": 4000},
+                    timeout=10)
+                want = x + resp["version"]  # v1 adds 1, v2 adds 2
+                with lock:
+                    records.append((tid, resp["version"],
+                                    resp["outputs"][0][0][0] == want))
+            except urllib.error.HTTPError as e:
+                e.read()
+                with lock:
+                    errors.append(e.code)
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # sustained v1 traffic
+        repo.add(ServedModel("hot", 2, v2_runner, [1, 2], {"x": (1,)},
+                             max_delay_ms=1, queue_depth=64))
+        assert repo.unload("hot", version=1, timeout=10) is True
+        time.sleep(0.3)  # sustained v2 traffic
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        srv.shutdown()
+    # zero 500s; the only tolerated rejection is the benign 503 race
+    # (model resolved to v1 right as its drain flipped on)
+    assert all(c == 503 for c in errors), errors
+    versions = {v for _, v, _ in records}
+    assert versions == {1, 2}, versions  # load really spanned the flip
+    assert all(ok for _, _, ok in records)  # no cross-version bleed
+    # atomicity: a client that saw v2 never gets v1 again
+    for tid in range(3):
+        mine = [v for t, v, _ in records if t == tid]
+        assert mine == sorted(mine), (tid, mine)
+    assert repo.get("hot").version == 2
+    with pytest.raises(ModelUnavailableError):
+        repo.get("hot", version=1)
+
+
+# ---------------------------------------------------------------------------
+# the resilience layer: supervised replica pool chaos e2e
+# ---------------------------------------------------------------------------
+
+def test_replica_pool_chaos_failover_e2e():
+    """THE acceptance test (ISSUE 6): a 2-replica pool under
+    ``kill_replica@`` and ``wedge_replica@`` injection serves a
+    closed-loop workload with zero 500s and at most one failover retry
+    per request, heartbeat ejection + respawn show up in telemetry and
+    the flight-recorder ring, and the pool recovers to full health."""
+    reg = telemetry.get_registry()
+    labels = {"model": "chaos/1"}
+    failovers = reg.counter("mxtpu_serve_failover_total", labels)
+    requeued = reg.counter("mxtpu_serve_failover_requeued_total", labels)
+    restarts = reg.counter("mxtpu_serve_replica_restart_total", labels)
+    base = (failovers.value, requeued.value, restarts.value)
+
+    model = ServedModel.pooled(
+        "chaos", 1, None, 2,
+        worker_args=["--stub", "echo", "--input", "x=2", "--max-batch", "4"],
+        heartbeat_ms=250, backoff_ms=50, teardown_grace=1.0,
+        spawn_timeout_s=90, max_delay_ms=2, queue_depth=64,
+        wedge_timeout_ms=2500,  # keep wedge detection on the request scale
+        extra_env={"MXTPU_FAULT_INJECT":
+                   "kill_replica@batch=3,replica=0 "
+                   "wedge_replica@batch=5,replica=1"})
+    repo = ModelRepository()
+    repo.add(model)
+    srv = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    url = "http://127.0.0.1:%d/v1/models/chaos:predict" % srv.port
+    lock = threading.Lock()
+    codes, bad = {}, []
+
+    def client(tid, n_requests=10):
+        for i in range(n_requests):
+            x = float(tid * 100 + i)
+            try:
+                code, resp = _post_json(
+                    url, {"inputs": {"x": [[x, x]]}, "timeout_ms": 2500},
+                    timeout=15)
+                ok = resp["outputs"][0][0] == [2 * x, 2 * x]
+            except urllib.error.HTTPError as e:
+                e.read()
+                code, ok = e.code, True  # deterministic rejection
+            with lock:
+                codes[code] = codes.get(code, 0) + 1
+                if not ok:
+                    bad.append((tid, i))
+
+    try:
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+        # every request resolved deterministically: echo 200s are correct,
+        # rejections are only the shed/deadline statuses — NO 500s
+        assert not bad, bad
+        assert set(codes) <= {200, 429, 503, 504}, codes
+        assert codes.get(200, 0) >= 20, codes
+        # wedge detection (silence past the batch deadline + heartbeat
+        # grace) can finish a beat after the workload does — wait for both
+        # ejections and the respawns before asserting on them
+        deadline = time.monotonic() + 60
+        while (restarts.value - base[2] < 2
+               or model.pool.healthy_count < 2) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        # both chaos vectors landed and failed over with the one-retry
+        # bound (requeue marks each request exactly once; a second death
+        # answers 503, so requeues can never exceed admitted requests)
+        assert failovers.value - base[0] >= 1
+        assert 1 <= requeued.value - base[1] <= sum(codes.values())
+        assert restarts.value - base[2] >= 2  # kill + wedge ejections
+        # heartbeat ejection + respawn in the flight-recorder ring
+        ring = [dict(e["fields"], event=e["event"])
+                for e in telemetry.events()
+                if e["fields"].get("model") == "chaos/1"]
+        ejects = [e for e in ring if e["event"] == "serve_replica_eject"]
+        assert {e["replica"] for e in ejects} == {0, 1}, ejects
+        assert any(e["reason"] in ("died_mid_batch", "died")
+                   for e in ejects), ejects
+        assert any(e["reason"] in ("wedged", "heartbeat_missed")
+                   for e in ejects), ejects
+        respawns = [e for e in ring if e["event"] == "serve_replica_ready"
+                    and e["generation"] >= 1]
+        assert len(respawns) >= 2, ring
+        # recovery to full health: a respawned generation serves traffic
+        deadline = time.monotonic() + 60
+        while model.pool.healthy_count < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        desc = model.pool.describe()
+        assert desc["healthy"] == 2, desc
+        assert all(g >= 1 for g in desc["generations"].values()), desc
+        assert telemetry.snapshot()[
+            'mxtpu_serve_pool_healthy{model="chaos/1"}']["value"] == 2
+        code, resp = _post_json(
+            url, {"inputs": {"x": [[7.0, 7.0]]}, "timeout_ms": 5000},
+            timeout=15)
+        assert code == 200 and resp["outputs"][0][0] == [14.0, 14.0]
+    finally:
+        srv.shutdown()
+        model.close(drain=False, timeout=0)
+
+
+def test_replica_pool_rejects_unauthenticated_connection():
+    """The pool's localhost listener speaks pickle, so it must refuse to
+    read a single frame from a connection that has not presented the
+    per-pool handshake secret — any local user can reach the port, and a
+    crafted pickle is arbitrary code execution in the router."""
+    import socket
+
+    model = ServedModel.pooled(
+        "auth", 1, None, 1,
+        worker_args=["--stub", "echo", "--input", "x=1", "--max-batch", "2"],
+        heartbeat_ms=400, backoff_ms=50, teardown_grace=1.0,
+        spawn_timeout_s=90, max_delay_ms=1, queue_depth=8)
+    try:
+        addr = model.pool._listener.getsockname()
+        # wrong token of the right length: the router must close without
+        # ever reading the (would-be malicious) frame that follows it
+        s = socket.create_connection(addr, timeout=5)
+        s.sendall(b"X" * 32 + b"\x00\x00\x00\x04evil")
+        s.settimeout(5)
+        try:
+            assert s.recv(1) == b""  # clean close, nothing unpickled
+        except ConnectionResetError:
+            pass  # RST: the router closed with our frame still unread
+        s.close()
+        # and the pool is unharmed: its authenticated replica still serves
+        out = model.predict({"x": np.ones((1, 1), np.float32)},
+                            timeout_ms=5000)
+        assert np.all(out[0] == 2.0)
+    finally:
+        model.close(drain=False, timeout=0)
+
+
+def test_replica_pool_slow_reply_cancels_not_ejects():
+    """Deadline propagation (`slow_reply@` vector): a replica that wakes
+    up past the batch's deadline budget answers `expired` instead of
+    running the forward — the request 504s, but the replica is NOT
+    ejected (its reply stayed inside the silence bound) and keeps serving
+    the next batch."""
+    reg = telemetry.get_registry()
+    restarts = reg.counter("mxtpu_serve_replica_restart_total",
+                           {"model": "slow/1"})
+    base = restarts.value
+    model = ServedModel.pooled(
+        "slow", 1, None, 1,
+        worker_args=["--stub", "echo", "--input", "x=1", "--max-batch", "2",
+                     "--stub-delay-ms", "0"],
+        heartbeat_ms=400, backoff_ms=50, teardown_grace=1.0,
+        spawn_timeout_s=90, max_delay_ms=1, queue_depth=8,
+        extra_env={"MXTPU_FAULT_INJECT": "slow_reply@batch=1,ms=300"})
+    try:
+        # batch 1: the 300ms injected sleep overruns the 150ms deadline ->
+        # the replica cancels; the waiter sees a deterministic 504
+        with pytest.raises(DeadlineExceededError):
+            model.predict({"x": np.ones((1, 1), np.float32)},
+                          timeout_ms=150)
+        # batch 2 (no fault): same replica, same generation, still alive
+        out = model.predict({"x": np.full((1, 1), 3.0, np.float32)},
+                            timeout_ms=5000)
+        assert np.all(out[0] == 6.0)
+        assert restarts.value == base  # no ejection for a slow reply
+        assert model.pool.describe()["generations"] == {0: 0}
+    finally:
+        model.close(drain=False, timeout=0)
 
 
 # ---------------------------------------------------------------------------

@@ -18,10 +18,15 @@ SLEEP="${MXTPU_PROBE_INTERVAL:-60}"
 PROBE_DEADLINE="${MXTPU_PROBE_DEADLINE:-1800}"
 SLEEP_MAX="${MXTPU_PROBE_INTERVAL_MAX:-300}"
 
+# device-topology cache (runtime.dial_devices writes it on every
+# successful non-CPU dial): failed/stale rows can still name the hardware
+# they missed, and the flight recorder brackets every dial attempt
+export MXTPU_TOPOLOGY_CACHE="${MXTPU_TOPOLOGY_CACHE:-BENCH_${TAG}_topology.json}"
+
 probe() {
   timeout "$PROBE_TIMEOUT" python -c "
-import jax
-d = jax.devices()[0]
+from mxnet_tpu.runtime import dial_devices
+d = dial_devices(timeout_s=max(1, $PROBE_TIMEOUT - 5))[0]
 print(d.platform, d.device_kind)
 " 2>/dev/null
 }
@@ -56,6 +61,11 @@ while true; do
 done
 echo "[bench_capture] device up: $KIND" >&2
 
+# per-row dial budget: starts at 300s; the FIRST unreachable-tunnel row
+# drops it to 60s so a mid-capture tunnel collapse fails the remaining
+# rows fast (stale-labeled) instead of burning 300-900s each
+DIAL_RETRY=300
+
 run_one() {  # run_one <suffix> [extra ENV=VAL ...]
   local SUFFIX="$1"; shift
   local OUT="BENCH_${TAG}_${SUFFIX}.json"
@@ -66,7 +76,7 @@ run_one() {  # run_one <suffix> [extra ENV=VAL ...]
   local TDIR
   TDIR=$(mktemp -d "telemetry_${TAG}_${SUFFIX}.XXXX")
   echo "[bench_capture] running $SUFFIX -> $OUT" >&2
-  env "$@" MXTPU_BENCH_DIAL_RETRY_S=300 MXTPU_TELEMETRY_DIR="$TDIR" \
+  env "$@" MXTPU_BENCH_DIAL_RETRY_S="$DIAL_RETRY" MXTPU_TELEMETRY_DIR="$TDIR" \
     timeout 1800 python bench.py > "$OUT" 2> "BENCH_${TAG}_${SUFFIX}.log"
   local RC=$?
   if [ "$RC" = "124" ]; then
@@ -74,9 +84,20 @@ run_one() {  # run_one <suffix> [extra ENV=VAL ...]
     # (bench.py arms it post-dial), so one retry resumes past the
     # already-compiled executables instead of starting from zero
     echo "[bench_capture] $SUFFIX timed out; retrying once on warm cache" >&2
-    env "$@" MXTPU_BENCH_DIAL_RETRY_S=300 MXTPU_TELEMETRY_DIR="$TDIR" \
+    env "$@" MXTPU_BENCH_DIAL_RETRY_S="$DIAL_RETRY" MXTPU_TELEMETRY_DIR="$TDIR" \
       timeout 1800 python bench.py > "$OUT" 2>> "BENCH_${TAG}_${SUFFIX}.log"
     RC=$?
+  fi
+  if grep -q '"error": "accelerator tunnel unreachable' "$OUT" 2>/dev/null; then
+    # the dial died mid-capture: label this row's artifact stale (its JSON
+    # already carries the stale fallback numbers) and fail the remaining
+    # rows fast instead of burning $DIAL_RETRY seconds per row
+    mv "$OUT" "BENCH_${TAG}_${SUFFIX}_stale.json"
+    OUT="BENCH_${TAG}_${SUFFIX}_stale.json"
+    if [ "$DIAL_RETRY" != "60" ]; then
+      echo "[bench_capture] tunnel collapsed mid-capture; remaining rows fail fast (60s dial budget)" >&2
+      DIAL_RETRY=60
+    fi
   fi
   # archive whatever telemetry the run flushed (concatenated across
   # pids/ranks; empty runs leave no artifact)
@@ -144,6 +165,23 @@ if ls "$SERVE_TDIR"/*.jsonl >/dev/null 2>&1; then
   cat "$SERVE_TDIR"/*.jsonl > "BENCH_${TAG}_serve_resnet18_telemetry.jsonl"
 fi
 rm -rf "$SERVE_TDIR"
+
+# serving resilience: the failover row (docs/serving.md chaos playbook) —
+# SIGKILL one replica of a 2-replica pool mid-run; the evidence is
+# error-rate 0 with every request resolving 200/429/503/504, loss-window
+# throughput > 0, and the recovery-time-to-healthy, with the pool's
+# telemetry (healthy gauge, failover/restart counters, eject events)
+# archived next to the artifact
+echo "[bench_capture] serve bench (failover)" >&2
+FAIL_TDIR=$(mktemp -d "telemetry_${TAG}_failover.XXXX")
+env MXTPU_TELEMETRY_DIR="$FAIL_TDIR" PYTHONPATH=".:${PYTHONPATH:-}" \
+  timeout 900 python tools/serve_bench.py --failover --replicas 2 \
+  > "BENCH_${TAG}_failover.json" 2> "BENCH_${TAG}_failover.log"
+echo "[bench_capture] serve failover rc=$?" >&2
+if ls "$FAIL_TDIR"/*.jsonl >/dev/null 2>&1; then
+  cat "$FAIL_TDIR"/*.jsonl > "BENCH_${TAG}_failover_telemetry.jsonl"
+fi
+rm -rf "$FAIL_TDIR"
 
 echo "[bench_capture] running tpu smoke suite" >&2
 MXTPU_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_smoke.py -v \

@@ -72,6 +72,12 @@ def main(argv=None):
                    help="override MXTPU_SERVE_QUEUE_DEPTH")
     p.add_argument("--no-warm", action="store_true",
                    help="skip bucket warmup at load (first requests compile)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="replica worker processes per model (default "
+                        "MXTPU_SERVE_REPLICAS; 0 = in-process, no pool). "
+                        "N >= 1 serves through a supervised pool with "
+                        "health-checked failover (docs/serving.md "
+                        "resilience)")
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -79,17 +85,22 @@ def main(argv=None):
         format="[serve] %(asctime)s %(levelname)s %(message)s")
     log = logging.getLogger("mxnet_tpu.serving")
 
+    from mxnet_tpu import env as _env
     from mxnet_tpu.serving import ModelRepository, ServingServer
 
+    replicas = args.replicas
+    if replicas is None:
+        replicas = _env.get("MXTPU_SERVE_REPLICAS")
     repo = ModelRepository()
     for spec in args.model:
         name, path, shapes, dtypes = parse_model_spec(spec)
-        log.info("loading %s from %s ...", name, path)
+        log.info("loading %s from %s%s ...", name, path,
+                 " (%d replicas)" % replicas if replicas else "")
         model = repo.load(name, path, input_shapes=shapes,
                           input_dtypes=dtypes, max_batch=args.max_batch,
                           max_delay_ms=args.delay_ms,
                           queue_depth=args.queue_depth,
-                          warm=not args.no_warm)
+                          warm=not args.no_warm, replicas=replicas)
         log.info("loaded %s/%d buckets=%s warm=%.2fs", model.name,
                  model.version, model.buckets, model.warm_seconds or 0.0)
 
@@ -98,6 +109,11 @@ def main(argv=None):
     log.info("serving %s on %s:%d (SIGTERM drains and exits 0)",
              repo.names(), args.addr, server.port)
     server.serve_forever()  # returns after the SIGTERM drain
+    if server.drain_failed:
+        # the drain timed out (MXTPU_SERVE_DRAIN_TIMEOUT_MS) and stranded
+        # requests were force-completed 503 — tell the supervisor
+        log.error("drain timed out; stranded requests were 503ed")
+        return 1
     log.info("drained; bye")
     return 0
 

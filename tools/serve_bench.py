@@ -16,15 +16,29 @@ stack (`ServingServer` on 127.0.0.1), and measures four phases:
   4. ``open`` (optional, ``--open-rate``) — Poisson arrivals at a fixed
      rate: latency under a load the server does not control.
 
+``--failover`` runs the resilience row instead (docs/serving.md
+chaos-testing playbook): the model is served through a supervised
+``--replicas N`` pool, a closed-loop workload runs for
+``--failover-duration`` seconds, and ``--kill-after`` seconds in one
+replica is SIGKILLed mid-run. The row reports the error-rate and
+status-code breakdown (every request must resolve to 200/429/503/504 —
+nothing silently dropped), throughput overall and DURING the
+single-replica loss window (must stay > 0), and the
+recovery-time-to-healthy measured from the kill to the respawned
+replica's ready heartbeat.
+
 Emits one JSON document on stdout: p50/p99 latency, throughput,
 speedup over sequential, batch occupancy, error counts by status, and
 the jit-compile-after-warmup count. Run under a fresh
 ``MXTPU_TELEMETRY_DIR`` to archive the full metrics JSONL next to the
-result (tools/bench_capture.sh `serve_resnet18` row does).
+result (tools/bench_capture.sh `serve_resnet18` / `serve_failover`
+rows do).
 
 Offline evidence (CPU):
 
   JAX_PLATFORMS=cpu python tools/serve_bench.py > BENCH_serve.json
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --failover \
+      > BENCH_failover.json
 """
 from __future__ import annotations
 
@@ -219,9 +233,190 @@ def _open_loop(endpoint, payloads, rate, duration, timeout_s):
     }
 
 
+def _closed_loop_timed(endpoint, payloads, clients, duration_s, timeout_s):
+    """`clients` threads firing back-to-back posts until `duration_s`
+    elapses. Returns per-request (t_done, ms, code) records (t_done on the
+    shared perf_counter clock) so callers can window the timeline around
+    an injected failure."""
+    recs, lock = [], threading.Lock()
+    t0 = time.perf_counter()
+
+    def worker(wid):
+        cli = _Client(*endpoint, timeout_s=timeout_s)
+        mine = []
+        i = 0
+        while time.perf_counter() - t0 < duration_s:
+            ms, code = cli.post(payloads[(wid + i) % len(payloads)])
+            mine.append((time.perf_counter() - t0, ms, code))
+            i += 1
+        cli.close()
+        with lock:
+            recs.extend(mine)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return t0, recs
+
+
+def _watch_pool(pool, timeline, stop, interval_s=0.005):
+    """Sample the pool's healthy-replica count into `timeline` as
+    (t_perf_counter, healthy) transition records."""
+    last = None
+    while not stop.is_set():
+        h = pool.healthy_count
+        if h != last:
+            timeline.append((time.perf_counter(), h))
+            last = h
+        time.sleep(interval_s)
+    # one closing sample: the caller stops the watch the instant the pool
+    # reports full health, which can land between two samples
+    h = pool.healthy_count
+    if h != last:
+        timeline.append((time.perf_counter(), h))
+
+
 def _payload(arr, timeout_ms):
     return json.dumps({"inputs": {"data": arr.tolist()},
                        "timeout_ms": timeout_ms}).encode()
+
+
+# ---------------------------------------------------------------------------
+# the failover row (docs/serving.md chaos-testing playbook)
+# ---------------------------------------------------------------------------
+
+def _run_failover(args, prefix, input_shapes, log):
+    """Closed-loop load over a supervised replica pool with one replica
+    SIGKILLed mid-run. The evidence this row commits: throughput during
+    the single-replica loss stays > 0, every request resolves to a
+    deterministic status (200/429/503/504 — nothing silently dropped, no
+    500s), and the pool recovers to full health."""
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import ModelRepository, ServingServer
+
+    repo = ModelRepository()
+    t0 = time.perf_counter()
+    model = repo.load("bench", prefix, input_shapes=input_shapes,
+                      max_batch=args.max_batch, max_delay_ms=args.delay_ms,
+                      queue_depth=max(1024, args.clients * 4),
+                      replicas=args.replicas)
+    load_s = time.perf_counter() - t0
+    pool = model.pool
+    log("pooled load: %d replicas, buckets=%s, %.1fs (per-replica load + "
+        "warm)" % (args.replicas, model.buckets, load_s))
+
+    server = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    endpoint = ("127.0.0.1", server.port, "/v1/models/bench:predict")
+    timeout_s = args.timeout_ms / 1e3 + 10.0
+    shape = next(iter(input_shapes.values()))
+    rng = np.random.RandomState(0)
+    payloads = [_payload(rng.uniform(-1, 1, (1,) + shape).astype(np.float32),
+                         args.timeout_ms) for _ in range(8)]
+
+    timeline, stop = [], threading.Event()
+    watcher = threading.Thread(target=_watch_pool,
+                               args=(pool, timeline, stop), daemon=True)
+    watcher.start()
+    kill_rec = {}
+
+    def killer():
+        time.sleep(args.kill_after)
+        pid = pool.replica_pid(0)
+        kill_rec["t"] = time.perf_counter()
+        kill_rec["pid"] = pid
+        log("SIGKILL replica 0 (pid %s) at t=%.1fs" % (pid, args.kill_after))
+        try:
+            os.kill(pid, 9)
+        except OSError as e:
+            kill_rec["error"] = str(e)
+
+    threading.Thread(target=killer, daemon=True).start()
+    log("closed loop: %d clients for %.0fs, kill at %.0fs ..."
+        % (args.clients, args.failover_duration, args.kill_after))
+    t_run, recs = _closed_loop_timed(endpoint, payloads, args.clients,
+                                     args.failover_duration, timeout_s)
+    # let the respawn land even when the kill came late in the window
+    recovery_deadline = time.perf_counter() + 60.0
+    while pool.healthy_count < args.replicas and \
+            time.perf_counter() < recovery_deadline:
+        time.sleep(0.02)
+    stop.set()
+    watcher.join(timeout=2.0)
+
+    t_kill = kill_rec.get("t")
+    recovery_s = None
+    if t_kill is not None:
+        recovered = [t for (t, h) in timeline
+                     if t > t_kill and h >= args.replicas]
+        if recovered:
+            recovery_s = recovered[0] - t_kill
+    loss_end = t_kill + recovery_s if (t_kill is not None
+                                       and recovery_s is not None) \
+        else t_run + args.failover_duration
+    loss = [r for r in recs if t_kill is not None
+            and t_kill <= t_run + r[0] <= loss_end]
+    codes = {}
+    for _, _, code in recs:
+        codes[code] = codes.get(code, 0) + 1
+    lats = sorted(ms for _, ms, _ in recs)
+    ok = codes.get(200, 0)
+    resolved = all(c in (200, 429, 503, 504) for c in codes)
+    snap = telemetry.snapshot()
+    label = '{model="%s/%d"}' % (model.name, model.version)
+
+    def counter(name):
+        return snap.get(name + label, {}).get("value", 0)
+
+    wall = max(r[0] for r in recs) if recs else args.failover_duration
+    result = {
+        "mode": "serve_failover",
+        "net": os.path.basename(args.model) if args.model else args.net,
+        "device": "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu"
+                  else "default",
+        "replicas": args.replicas,
+        "buckets": model.buckets,
+        "duration_s": args.failover_duration,
+        "kill_after_s": args.kill_after,
+        "load_s": round(load_s, 2),
+        "requests": len(recs),
+        "codes": {str(k): v for k, v in sorted(codes.items())},
+        "error_rate": round(1.0 - ok / len(recs), 4) if recs else None,
+        "unresolved": codes.get(-1, 0),
+        "all_resolved_deterministically": resolved,
+        "rps_overall": round(len(recs) / wall, 2) if recs else 0.0,
+        "p50_ms": round(_percentile(lats, 0.50), 3) if lats else None,
+        "p99_ms": round(_percentile(lats, 0.99), 3) if lats else None,
+        "recovery_s": round(recovery_s, 3) if recovery_s is not None
+                      else None,
+        "loss_window": {
+            "requests": len(loss),
+            "rps": round(len(loss) / recovery_s, 2)
+                   if recovery_s else None,
+            "codes": {str(c): sum(1 for r in loss if r[2] == c)
+                      for c in sorted({r[2] for r in loss})},
+        },
+        "healthy_timeline": [
+            [round(t - (t_kill or t_run), 3), h] for t, h in timeline],
+        "pool": {
+            "failovers": counter("mxtpu_serve_failover_total"),
+            "requeued": counter("mxtpu_serve_failover_requeued_total"),
+            "restarts": counter("mxtpu_serve_replica_restart_total"),
+            "final_healthy": pool.healthy_count,
+        },
+    }
+    log("failover: %d reqs, codes=%s, recovery=%.2fs, loss-window rps=%s"
+        % (len(recs), result["codes"], recovery_s or -1.0,
+           result["loss_window"]["rps"]))
+    server.drain(shutdown=True)
+    telemetry.flush(reason="serve_bench_failover")
+    json.dump(result, sys.stdout, indent=1)
+    sys.stdout.write("\n")
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -253,6 +448,18 @@ def main(argv=None):
     p.add_argument("--open-rate", type=float, default=0.0,
                    help="open-loop phase arrival rate per second (0 = skip)")
     p.add_argument("--open-duration", type=float, default=5.0)
+    p.add_argument("--failover", action="store_true",
+                   help="run the resilience row instead of the throughput "
+                        "phases: closed-loop load over a --replicas pool "
+                        "with a SIGKILLed replica at --kill-after")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="pool size for --failover (>= 2 so the endpoint "
+                        "survives a single-replica loss)")
+    p.add_argument("--failover-duration", type=float, default=12.0,
+                   help="closed-loop seconds for the --failover row")
+    p.add_argument("--kill-after", type=float, default=3.0,
+                   help="seconds into the --failover run to SIGKILL "
+                        "replica 0")
     args = p.parse_args(argv)
 
     import numpy as np
@@ -277,6 +484,9 @@ def main(argv=None):
     else:
         log("building mlp ...")
         prefix, input_shapes = _build_mlp(tmpdir)
+
+    if args.failover:
+        return _run_failover(args, prefix, input_shapes, log)
 
     repo = ModelRepository()
     t0 = time.perf_counter()
