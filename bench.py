@@ -61,10 +61,16 @@ AMP_DTYPE = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16")
 if AMP_DTYPE in ("float32", "fp32", "none"):
     AMP_DTYPE = None
 
-# Analytic ResNet-50 FLOPs at 224x224: 4.09 GMACs -> 8.18 GF forward
-# (2 FLOPs per MAC). Training = fwd + bwd-wrt-input + bwd-wrt-weight
-# ~= 3x forward (the standard accounting used by MFU papers).
-RESNET50_FWD_FLOPS_PER_IMG = 2 * 4.089e9
+# Analytic ResNet-50 FLOPs at 224x224: 3.86 GMACs -> 7.72 GF forward
+# (2 FLOPs per MAC; conv+fc, exact per-layer count for the v1
+# architecture this bench builds — stride-2 on the bottleneck 1x1, NOT
+# the 4.09-GMAC v1b/torchvision variant with stride on the 3x3, which
+# this constant wrongly used before and inflated reported MFU ~6%).
+# Training = fwd + bwd-wrt-input + bwd-wrt-weight ~= 3x forward (the
+# standard accounting used by MFU papers). Cross-checked against the
+# automatic cost-analysis accounting (telemetry/flops.py): auto/hand =
+# 0.96 train, 0.96 fwd on CPU XLA.
+RESNET50_FWD_FLOPS_PER_IMG = 2 * 3.858e9
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * RESNET50_FWD_FLOPS_PER_IMG
 
 from mxnet_tpu.runtime import chip_peak_tflops as _chip_peak_tflops  # noqa: E402
@@ -138,9 +144,10 @@ def bench_train():
         net, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
         loss=gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh,
         amp_dtype=AMP_DTYPE)
-    # declare per-step FLOPs so always-on telemetry publishes achieved MFU
-    # alongside the bench's own number (docs/observability.md)
-    mx.telemetry.set_step_flops(flops_per_img * BATCH)
+    # per-step FLOPs are no longer declared by hand: the jit-cache-fill
+    # cost analysis (telemetry/flops.py, MXTPU_TRACE_FLOPS) accounts them
+    # and telemetry publishes achieved MFU on its own; the bench keeps its
+    # analytic flops_per_img for the headline number and reports both
 
     def timed_train(xb, yb, batch):
         """warmup -> drain -> free-running timed loop (async dispatch
@@ -182,6 +189,12 @@ def bench_train():
     peak = _chip_peak_tflops(dev)
     mfu = (imgs_per_sec * flops_per_img / (peak * 1e12)) if peak else None
 
+    # cost-analysis cross-check: the automatically accounted per-step
+    # FLOPs (what telemetry MFU is computed from, zero set_step_flops)
+    # against the analytic hand count — the two should agree within a few
+    # percent or the analytic model is wrong
+    auto_step_flops = mx.telemetry.flops.last_step_flops()
+    hand_step_flops = flops_per_img * BATCH
     out = {
         "metric": "%s_train_bs%d_imgs_per_sec" % (net_key, BATCH),
         "value": round(imgs_per_sec, 2),
@@ -193,8 +206,16 @@ def bench_train():
         "batch": BATCH,
         "device": getattr(dev, "device_kind", str(dev)),
         "flops_per_img": flops_per_img,
+        "auto_step_flops": auto_step_flops,
+        "auto_vs_hand_flops": round(auto_step_flops / hand_step_flops, 4)
+                              if auto_step_flops else None,
         "peak_bf16_tflops": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # auto MFU = auto_step_flops / step_seconds / peak, with
+        # step_seconds = BATCH / imgs_per_sec
+        "auto_mfu": round(auto_step_flops * imgs_per_sec
+                          / (BATCH * peak * 1e12), 4)
+                    if peak and auto_step_flops and imgs_per_sec else None,
     }
     out.update(_percentiles(step_ms))
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 from .bare_print import BarePrintChecker
 from .env_registry import EnvRegistryChecker
 from .host_sync import HostSyncChecker
+from .metric_registry import MetricRegistryChecker
 from .registry_parity import RegistryParityChecker
 from .signal_safety import SignalSafetyChecker
 
@@ -17,5 +18,6 @@ CHECKERS = (
     SignalSafetyChecker(),
     EnvRegistryChecker(),
     RegistryParityChecker(),
+    MetricRegistryChecker(),
     BarePrintChecker(),
 )

@@ -1,0 +1,128 @@
+"""metric-registry: every metric/span name the library emits is documented.
+
+The observability contract is the docs/observability.md tables: operators
+alert on metric names and build dashboards from them, and the tracing
+playbook is written against span names. A metric added without a docs row
+is invisible to operators; a documented name the code no longer emits is
+an alert that can never fire. Same both-direction parity discipline as
+the env-registry checker (docs/env_vars.md ↔ mxnet_tpu/env.py):
+
+  1. every ``mxtpu_*`` string-literal name passed to a telemetry
+     ``counter(`` / ``gauge(`` / ``histogram(`` call in ``mxnet_tpu/``
+     must appear in a docs/observability.md "## Metrics"-section table
+     (first cell);
+  2. every span name literal passed to tracing ``span(`` / ``root(`` /
+     ``emit_span(`` in ``mxnet_tpu/`` must appear in the "## Tracing"
+     section's span table (first cell);
+  3. both directions: documented names that no library call emits fail
+     too (stale docs row).
+
+Dynamic names (built at runtime) can't be checked — sites that build one
+carry a ``# mxlint: disable=metric-registry`` pragma with justification.
+All checks are AST/text-level; the lint never imports mxnet_tpu.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .. import Finding
+from ..astutil import dotted, str_const
+
+_DOCS_FILE = "docs/observability.md"
+_METRIC_RE = re.compile(r"mxtpu_[a-z0-9_]+")
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+_SPAN_FACTORIES = ("span", "root", "emit_span")
+# span names are dotted lowercase words ("serve.request", "train.step") —
+# the regex keeps prose out of the documented set
+_SPAN_RE = re.compile(r"[a-z_]+\.[a-z_.]+")
+
+
+def emitted_names(repo):
+    """(metric name -> first (rel, line)), (span name -> first (rel, line))
+    for every literal-name telemetry emission in mxnet_tpu/."""
+    metrics, spans = {}, {}
+    for rel in repo.py_files("mxnet_tpu"):
+        tree = repo.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fname = dotted(node.func) or ""
+            # aliased imports keep the factory name as a suffix by
+            # convention (`from ..telemetry.core import counter as
+            # _tm_counter`), so match on it
+            tail = fname.rsplit(".", 1)[-1].lstrip("_")
+            name = str_const(node.args[0])
+            if name is None:
+                continue
+            if (any(tail == f or tail.endswith("_" + f)
+                    for f in _METRIC_FACTORIES)
+                    and name.startswith("mxtpu_")):
+                metrics.setdefault(name, (rel, node.lineno))
+            elif tail in _SPAN_FACTORIES and _SPAN_RE.fullmatch(name):
+                spans.setdefault(name, (rel, node.lineno))
+    return metrics, spans
+
+
+def documented_names(repo):
+    """(metric names, span names) from the docs/observability.md tables:
+    ``mxtpu_*`` tokens in first cells of tables under "## Metrics", and
+    dotted span tokens in first cells of tables under "## Tracing"."""
+    text = repo.read(_DOCS_FILE) or ""
+    metrics, spans = set(), set()
+    section = None
+    for line in text.splitlines():
+        if line.startswith("## "):
+            section = line[3:].strip()
+            continue
+        if not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1] if line.count("|") >= 2 else ""
+        if section == "Metrics":
+            metrics.update(_METRIC_RE.findall(first_cell))
+        elif section == "Tracing":
+            for tok in re.findall(r"`([^`]+)`", first_cell):
+                if _SPAN_RE.fullmatch(tok):
+                    spans.add(tok)
+    return metrics, spans
+
+
+class MetricRegistryChecker:
+    rule = "metric-registry"
+    description = ("telemetry metric/span names emitted by the library and "
+                   "the docs/observability.md tables agree, both directions")
+
+    def run(self, repo):
+        metrics, spans = emitted_names(repo)
+        doc_metrics, doc_spans = documented_names(repo)
+        if not doc_metrics:
+            yield Finding(self.rule, _DOCS_FILE, 1,
+                          "no mxtpu_* names found in the docs/"
+                          "observability.md Metrics tables — moved/renamed "
+                          "section? the metric registry is unverifiable")
+            return
+        for name in sorted(set(metrics) - doc_metrics):
+            rel, line = metrics[name]
+            yield Finding(
+                self.rule, rel, line,
+                "metric `%s` is emitted here but missing from the "
+                "docs/observability.md Metrics table (operators can't "
+                "know it exists)" % name)
+        for name in sorted(doc_metrics - set(metrics)):
+            yield Finding(
+                self.rule, _DOCS_FILE, 1,
+                "metric `%s` is documented in docs/observability.md but "
+                "no library call emits it (stale docs row?)" % name)
+        for name in sorted(set(spans) - doc_spans):
+            rel, line = spans[name]
+            yield Finding(
+                self.rule, rel, line,
+                "span `%s` is emitted here but missing from the "
+                "docs/observability.md Tracing span table" % name)
+        for name in sorted(doc_spans - set(spans)):
+            yield Finding(
+                self.rule, _DOCS_FILE, 1,
+                "span `%s` is documented in docs/observability.md but no "
+                "library call emits it (stale docs row?)" % name)

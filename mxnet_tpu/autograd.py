@@ -210,7 +210,11 @@ def _bwd_jitted(name, attr_key, has_rng, x64=False):
                 seeds.append(_np.zeros(p.shape, jax.dtypes.float0))
         return pull(tuple(seeds))
 
-    return jax.jit(bwd)
+    # automatic FLOP accounting for the fused recompute+vjp executable
+    # (per-shape cost analysis at cache fill — telemetry/flops.py)
+    from .telemetry import flops as _tm_flops
+
+    return _tm_flops.instrument(jax.jit(bwd))
 
 
 def _run_backward(heads, head_grads, retain_graph=False):

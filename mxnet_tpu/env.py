@@ -451,7 +451,36 @@ _var("MXTPU_DUMP_GRACE", "float", 1.0,
 _var("MXTPU_STEP_FLOPS", "float", None,
      "model FLOPs per training step; when set, `observe_step` publishes "
      "achieved MFU (`mxtpu_step_mfu`) against `runtime.chip_peak_tflops` "
-     "× local device count (API spelling: `telemetry.set_step_flops`).")
+     "× local device count (API spelling: `telemetry.set_step_flops`). "
+     "Overrides the automatic cost-analysis accounting "
+     "(`MXTPU_TRACE_FLOPS`).")
+
+# -- distributed tracing ----------------------------------------------------
+_var("MXTPU_TRACE_SAMPLE", "float", 0.0,
+     "distributed tracing (docs/observability.md §Tracing): fraction of "
+     "new root traces (serving requests, training steps) that record "
+     "spans, 0.0..1.0. Default 0 — spans cost nothing unless sampled in; "
+     "an incoming `x-mxtpu-trace` header / wire context with the sampled "
+     "flag is always honored regardless of the local rate.")
+_var("MXTPU_TRACE_SLOW_MS", "float", None,
+     "always-sample-on-slow escape hatch: when set, unsampled root spans "
+     "are buffered locally and RETROACTIVELY emitted if the root runs "
+     "longer than this many milliseconds — every slow request/step leaves "
+     "a trace even at sample rate 0. (Local-process spans only: a child "
+     "process cannot know the root overran.)")
+_var("MXTPU_TRACE_CONTEXT", "str", None,
+     "inherited trace context, `<trace_id>-<span_id>-<flags>` (the "
+     "`x-mxtpu-trace` header format). Set by `tools/launch.py` for each "
+     "worker so training-step root spans join the launch's generation "
+     "span; honored as the ambient parent for root spans minted in this "
+     "process.")
+_var("MXTPU_TRACE_FLOPS", "bool", True,
+     "automatic FLOP accounting: derive per-executable FLOPs from JAX's "
+     "lowered-HLO cost analysis at jit-cache-fill time (`ops._jitted`, "
+     "autograd `_bwd_jitted`, Executor builds, serving bucket warm) and "
+     "accumulate executed FLOPs so `observe_step` publishes MFU with no "
+     "manual `set_step_flops`. `0` disables the accounting (and the "
+     "per-shape lowering it pays on each cache fill).")
 
 
 # ---------------------------------------------------------------------------

@@ -192,11 +192,14 @@ class Executor:
             new_aux = tuple(aux_up.get(n, values[n]) for n in aux_names)
             return tuple(outs), new_aux
 
+        from .telemetry import flops as _tm_flops
+
         if self._mesh is None:
-            return jax.jit(run)
+            return _tm_flops.instrument(jax.jit(run))
         repl, arg_sh = self._shardings()
-        return jax.jit(run, in_shardings=(repl, tuple(arg_sh),
-                                          tuple(repl for _ in aux_names)))
+        return _tm_flops.instrument(
+            jax.jit(run, in_shardings=(repl, tuple(arg_sh),
+                                       tuple(repl for _ in aux_names))))
 
     def backward(self, out_grads=None, is_train=True):
         """Gradients via jax.vjp of the graph (reference:
@@ -268,7 +271,9 @@ class Executor:
             _, pull = jax.vjp(pure, tuple(arg_arrays[i] for i in wrt))
             return pull(tuple(cots))[0]
 
-        return jax.jit(bwd)
+        from .telemetry import flops as _tm_flops
+
+        return _tm_flops.instrument(jax.jit(bwd))
 
     # -- misc API parity ---------------------------------------------------
     def set_monitor_callback(self, callback, monitor_all=False):

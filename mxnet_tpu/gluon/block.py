@@ -450,7 +450,11 @@ class HybridBlock(Block):
                 _TRACING.flag = False
                 _random.pop_trace_key(prev_key)
 
-        entry.jitted = jax.jit(traced)
+        from ..telemetry import flops as _tm_flops
+
+        # automatic FLOP accounting: the hybridized forward/backward are
+        # the gluon hot path's executables (telemetry/flops.py)
+        entry.jitted = _tm_flops.instrument(jax.jit(traced))
 
         def bwd(key, arg_arrays, param_arrays, out_cots):
             def pure(a, p):
@@ -460,7 +464,7 @@ class HybridBlock(Block):
             _, pull = jax.vjp(pure, arg_arrays, param_arrays)
             return pull(tuple(out_cots))
 
-        entry.bwd = jax.jit(bwd)
+        entry.bwd = _tm_flops.instrument(jax.jit(bwd))
         return entry
 
     def _record_cached(self, entry, key, arg_nds, param_nds, arg_arrays,

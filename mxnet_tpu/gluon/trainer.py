@@ -120,16 +120,24 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """Allreduce grads + update (reference: trainer.py:298)."""
         t0 = time.perf_counter()
-        if not self._kv_initialized:
-            self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
-        self._step_count += 1
-        # always-on telemetry: step wall time, examples/sec, MFU (when step
-        # FLOPs are declared) + the flight-recorder/watchdog heartbeat
-        telemetry.observe_step(time.perf_counter() - t0,
-                               examples=batch_size, step=self._step_count)
+        # distributed tracing: a sampled step records allreduce/optimizer
+        # phase spans (no-op span when tracing is unarmed)
+        with telemetry.tracing.root("train.step", component="train",
+                                    attrs={"step": self._step_count + 1}):
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._optimizer.rescale_grad = self._scale / batch_size
+            with telemetry.tracing.span("train.allreduce"):
+                self._allreduce_grads()
+            with telemetry.tracing.span("train.optimizer"):
+                self._update(ignore_stale_grad)
+            self._step_count += 1
+            # always-on telemetry: step wall time, examples/sec, MFU (auto
+            # cost-analysis FLOPs, or set_step_flops when declared) + the
+            # flight-recorder/watchdog heartbeat
+            telemetry.observe_step(time.perf_counter() - t0,
+                                   examples=batch_size,
+                                   step=self._step_count)
         # step-boundary fault hook; the env guard keeps the hot path free
         # of even the import lookup when injection is unarmed
         if _env.is_set("MXTPU_FAULT_INJECT"):

@@ -225,17 +225,30 @@ class BaseModule(object):
                 tm_wait.inc(t_step - t_wait)
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                fit_updates += 1
-                examples = None
-                try:
-                    examples = int(data_batch.data[0].shape[0])
-                except (AttributeError, IndexError, TypeError):
-                    pass
-                telemetry.observe_step(time.perf_counter() - t_step,
-                                       examples=examples, step=fit_updates,
-                                       kind="fit")
+                # distributed tracing: one root span per fit step; the
+                # data wait predates the root, so it is emitted
+                # retroactively as a child with measured times
+                with telemetry.tracing.root(
+                        "train.step", component="train",
+                        attrs={"step": fit_updates + 1,
+                               "kind": "fit"}) as t_span:
+                    telemetry.tracing.emit_span(
+                        "train.data_wait",
+                        time.time() - (t_step - t_wait), t_step - t_wait,
+                        t_span, component="train")
+                    with telemetry.tracing.span("train.fwd_bwd"):
+                        self.forward_backward(data_batch)
+                    with telemetry.tracing.span("train.optimizer"):
+                        self.update()
+                    fit_updates += 1
+                    examples = None
+                    try:
+                        examples = int(data_batch.data[0].shape[0])
+                    except (AttributeError, IndexError, TypeError):
+                        pass
+                    telemetry.observe_step(time.perf_counter() - t_step,
+                                           examples=examples,
+                                           step=fit_updates, kind="fit")
                 # step-boundary fault hook: counts updates since THIS
                 # process started (no-op unless MXTPU_FAULT_INJECT is set)
                 maybe_inject_fault(fit_updates)

@@ -21,6 +21,7 @@ import typing as _t
 
 from ..base import MXNetError
 from ..telemetry import core as _telemetry
+from ..telemetry import flops as _flops
 from ..telemetry import recorder as _recorder
 
 __all__ = ["OpDef", "register", "get", "list_ops", "invoke_jax"]
@@ -122,7 +123,10 @@ def _jitted(name, attr_key):
     def call(*arrays):
         return op.fn(*arrays, **kwargs)
 
-    return jax.jit(call)
+    # automatic FLOP accounting: each execution of this executable feeds
+    # the per-step accumulator (per-shape cost analysis at cache fill —
+    # telemetry/flops.py); returns jax.jit(call) unchanged when disabled
+    return _flops.instrument(jax.jit(call))
 
 
 def invoke_jax(name, arrays, attrs):

@@ -112,11 +112,19 @@ def _observe_collective(op, arrays, seconds):
     belongs to the profiler, not the always-on layer)."""
     if not _telemetry._STATE.enabled:
         return  # the kill switch must also skip the payload-byte scan
+    from ..telemetry import tracing as _tracing
+
     nbytes = _payload_bytes(arrays)
     labels = {"op": op}
     _telemetry.counter("mxtpu_collective_calls_total", labels).inc()
     _telemetry.counter("mxtpu_collective_bytes_total", labels).inc(nbytes)
-    _telemetry.histogram("mxtpu_collective_seconds", labels).observe(seconds)
+    _telemetry.histogram("mxtpu_collective_seconds", labels).observe(
+        seconds, exemplar=_tracing.current_trace_id())
+    # inside a traced step, the collective becomes a child span (emitted
+    # retroactively from the measured window; no-op otherwise)
+    _tracing.emit_span("train.collective", _time_mod.time() - seconds,
+                       seconds, _tracing.current(), component="train",
+                       attrs={"op": op, "bytes": nbytes})
 
 
 def all_reduce_arrays(arrays):

@@ -205,6 +205,11 @@ class CheckpointManager:
             nbytes = 0
         self._retain()
         seconds = time.perf_counter() - t0
+        # inside a traced step/run, the checkpoint becomes a child span
+        telemetry.tracing.emit_span(
+            "train.checkpoint", time.time() - seconds, seconds,
+            telemetry.tracing.current(), component="train",
+            attrs={"step": int(step), "bytes": nbytes})
         telemetry.histogram("mxtpu_checkpoint_seconds",
                             {"what": "save"}).observe(seconds)
         telemetry.counter("mxtpu_checkpoint_bytes_total",

@@ -302,7 +302,12 @@ class DistributedTrainer:
             out_shardings=out_shardings,
             donate_argnums=(3, 4),
         )
-        return jitted
+        from ..telemetry import flops as _tm_flops
+
+        # NOTE: donated buffers make a post-hoc lower() on live args
+        # unsafe-looking but fine — lower() only traces avals, it never
+        # executes or donates; cost analysis happens on abstract values
+        return _tm_flops.instrument(jitted)
 
     # ------------------------------------------------------------------
     def step(self, data, label=None, batch_size=None):
@@ -350,18 +355,24 @@ class DistributedTrainer:
         lr = self._host_lr()
         key = _random.next_key()
         t = jnp.asarray(self._step_count, dtype=jnp.float32)
-        loss_val, self._arrays, self._states = fn(
-            key, t, jnp.asarray(lr, dtype=jnp.float32),
-            self._arrays, self._states, *batch)
-        ctx = self._params[0].list_ctx()[0]
         from .. import telemetry
 
-        # global-batch examples/sec: the leading dim of the (global) batch
-        examples = None
-        if batch and getattr(batch[0], "ndim", 0) > 0:
-            examples = int(batch[0].shape[0])
-        telemetry.observe_step(_time.perf_counter() - t0, examples=examples,
-                               step=self._step_count, kind="dist")
+        with telemetry.tracing.root("train.step", component="train",
+                                    attrs={"step": self._step_count,
+                                           "kind": "dist"}):
+            with telemetry.tracing.span("train.fused_step"):
+                loss_val, self._arrays, self._states = fn(
+                    key, t, jnp.asarray(lr, dtype=jnp.float32),
+                    self._arrays, self._states, *batch)
+            ctx = self._params[0].list_ctx()[0]
+            # global-batch examples/sec: the leading dim of the (global)
+            # batch
+            examples = None
+            if batch and getattr(batch[0], "ndim", 0) > 0:
+                examples = int(batch[0].shape[0])
+            telemetry.observe_step(_time.perf_counter() - t0,
+                                   examples=examples,
+                                   step=self._step_count, kind="dist")
         from . import resilience
 
         # step-boundary fault hook (no-op unless MXTPU_FAULT_INJECT is set)

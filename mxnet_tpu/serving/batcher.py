@@ -37,6 +37,7 @@ import numpy as _np
 
 from .. import env as _env
 from .. import telemetry
+from ..telemetry import tracing as _tracing
 from ..base import MXNetError, unpad_outputs
 
 __all__ = [
@@ -173,7 +174,7 @@ class ServeRequest:
 
     __slots__ = ("arrays", "n", "deadline", "outputs", "error", "bucket",
                  "_event", "_rlock", "_t_submit", "queue_seconds",
-                 "compute_seconds", "retried")
+                 "compute_seconds", "retried", "trace")
 
     def __init__(self, arrays, n, deadline):
         self.arrays = arrays
@@ -185,6 +186,10 @@ class ServeRequest:
         self.queue_seconds = None
         self.compute_seconds = None
         self.retried = False  # failover re-enqueue happened (exactly once)
+        # span context captured at admission (the HTTP handler's request
+        # span); every later phase — whichever thread or process runs it —
+        # parents its spans here, so one trace follows the request
+        self.trace = _tracing.capture()
         self._event = threading.Event()
         self._rlock = threading.Lock()
         self._t_submit = time.monotonic()
@@ -469,7 +474,8 @@ class DynamicBatcher:
                 continue
             batch.append(first)
             total = first.n
-            close_at = time.monotonic() + self.max_delay_s
+            t_assembly = time.monotonic()
+            close_at = t_assembly + self.max_delay_s
             # coalesce until the bucket ceiling or the delay window closes;
             # when draining, take whatever is queued without waiting
             while total < self.max_batch:
@@ -495,6 +501,14 @@ class DynamicBatcher:
             total = sum(r.n for r in batch)
             if not batch:
                 continue
+            # the coalescing window, per traced request (retroactive span:
+            # only the window's end knows the batch composition)
+            assembly_s = time.monotonic() - t_assembly
+            assembly_wall = time.time() - assembly_s
+            for req in batch:
+                _tracing.emit_span("serve.assembly", assembly_wall,
+                                   assembly_s, req.trace, component="router",
+                                   attrs={"batch": len(batch), "n": total})
             try:
                 if self._dispatcher is not None:
                     self._dispatcher(batch, total)
@@ -513,15 +527,31 @@ class DynamicBatcher:
         `batch` must be the exact request list the outputs were computed
         for (order preserved)."""
         now = time.monotonic()
+        t_unpad = time.perf_counter()
+        unpad_wall = time.time()
         outs = unpad_outputs(outputs, bucket - total)
         offset = 0
+        splits = []
         for req in batch:
             req.bucket = bucket
             req.queue_seconds = max(0.0, now - compute_s - req._t_submit)
             req.compute_seconds = compute_s
-            self._m_queue_s.observe(req.queue_seconds)
+            trace_id = req.trace.trace_id if req.trace is not None else None
+            self._m_queue_s.observe(req.queue_seconds, exemplar=trace_id)
+            # queue-phase span, start rebased to the request's submit time
+            # (wall clock = now minus the monotonic elapsed)
+            _tracing.emit_span(
+                "serve.queue", unpad_wall - (now - req._t_submit),
+                req.queue_seconds, req.trace, component="router")
             per_req = [o[offset:offset + req.n].copy() for o in outs]
             offset += req.n
+            splits.append((req, per_req))
+        unpad_s = time.perf_counter() - t_unpad
+        for req, per_req in splits:
+            _tracing.emit_span("serve.unpad", unpad_wall, unpad_s,
+                               req.trace, component="router",
+                               attrs={"bucket": bucket,
+                                      "pad": bucket - total})
             req._resolve(outputs=per_req)
         with self._cv:
             self._inflight.difference_update(batch)
@@ -530,7 +560,10 @@ class DynamicBatcher:
         self._m_batch_size.observe(total)
         if bucket:
             self._m_occupancy.observe(total / float(bucket))
-        self._m_compute_s.observe(compute_s)
+        self._m_compute_s.observe(
+            compute_s, exemplar=next(
+                (r.trace.trace_id for r in batch
+                 if r.trace is not None and r.trace.recorded), None))
 
     def fail_batch(self, batch, error, compute_s=None):
         """Resolve every request in `batch` with `error` and close
@@ -606,7 +639,14 @@ class DynamicBatcher:
         t0 = time.monotonic()
         try:
             padded, bucket = pad_batch(batch, total, self.buckets)
+            t_run = time.monotonic()
+            run_wall = time.time()
             outs = self._runner(padded, bucket, total)
+            compute_s = time.monotonic() - t_run
+            for req in batch:
+                _tracing.emit_span("serve.compute", run_wall, compute_s,
+                                   req.trace, component="worker",
+                                   attrs={"bucket": bucket, "n": total})
             self.resolve_batch(batch, outs, bucket, total,
                                time.monotonic() - t0)
         except ServingError as e:

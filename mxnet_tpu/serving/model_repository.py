@@ -62,6 +62,8 @@ class ServedModel:
         self.loaded_at = time.time()
         self.warmed = False
         self.warm_seconds = None
+        self.bucket_flops = {}  # bucket -> FLOPs per batch (warm-time
+        #                         cost analysis; {} when unavailable)
         self._runner = runner
         self._pool = pool
         if pool is not None:
@@ -127,6 +129,8 @@ class ServedModel:
         # every replica warmed its buckets before reporting ready
         model.warmed = True
         model.warm_seconds = info.get("warm_seconds")
+        if info.get("bucket_flops"):
+            model.set_bucket_flops(info["bucket_flops"])
         return model
 
     @staticmethod
@@ -218,24 +222,42 @@ class ServedModel:
             else max(0.0, deadline - time.monotonic())
         return req.wait(timeout)
 
+    def set_bucket_flops(self, bucket_flops):
+        """Publish per-bucket FLOP cost (from warm-time cost analysis) as
+        ``mxtpu_serve_bucket_flops`` gauges — the serving arm of the
+        automatic FLOP accounting (docs/observability.md)."""
+        self.bucket_flops = {int(b): f for b, f in bucket_flops.items() if f}
+        for b, f in self.bucket_flops.items():
+            telemetry.gauge("mxtpu_serve_bucket_flops",
+                            {"model": "%s/%d" % (self.name, self.version),
+                             "bucket": str(b)}).set(f)
+
     def warm(self):
         """One zeros-forward per bucket: populates the executable cache so
-        steady-state traffic never compiles. Emits one
-        ``serve_bucket_warm`` event per bucket."""
+        steady-state traffic never compiles, and — with automatic FLOP
+        accounting on — prices each bucket's executable from the compile's
+        cost analysis. Emits one ``serve_bucket_warm`` event per bucket."""
+        from ..telemetry import flops as _flops
+
         if self._pool is not None:
             # pooled models warm replica-side before each replica reports
             # ready (supervisor.worker_main) — nothing to do here
             self.warmed = True
             return self.warm_seconds
         t_all = time.monotonic()
+        bucket_flops = {}
         for b in self._batcher.buckets:
             zeros = {k: _np.zeros((b,) + s, dtype=self.input_dtypes[k])
                      for k, s in self.example_shapes.items()}
             t0 = time.monotonic()
+            f0 = _flops.total()
             self._runner(zeros, b, b)
+            bucket_flops[b] = _flops.total() - f0
             telemetry.record_event(
                 "serve_bucket_warm", model=self.name, version=self.version,
-                bucket=b, seconds=round(time.monotonic() - t0, 4))
+                bucket=b, seconds=round(time.monotonic() - t0, 4),
+                flops=bucket_flops[b] or None)
+        self.set_bucket_flops(bucket_flops)
         self.warm_seconds = time.monotonic() - t_all
         self.warmed = True
         return self.warm_seconds

@@ -48,6 +48,7 @@ import time
 
 from .. import env as _env
 from .. import telemetry
+from ..telemetry import tracing as _tracing
 from ..base import MXNetError
 from .batcher import OverloadedError, ServingError, pad_batch
 from .supervisor import (TOKEN_LEN, ReplicaProcess, backoff_s, recv_msg,
@@ -496,10 +497,18 @@ class ReplicaPool:
             remaining = max(0.0, max(deadlines) - now)
         slot.msg_id += 1
         msg_id = slot.msg_id
+        # per-request dispatch spans: ids are minted BEFORE the send so
+        # the replica's compute span can parent under them on the far side
+        # of the wire ((trace_id, span_id, sampled) tuples on the frame)
+        dispatch_refs = [(req, _tracing.child_ref(req.trace))
+                         for req in batch]
+        wire_traces = [_tracing.to_wire(ref) for _, ref in dispatch_refs
+                       if ref is not None and ref.sampled]
         with self._lock:
             slot.state = _BUSY
         self._m_inflight[slot.id].set(total)
         t0 = time.monotonic()
+        t0_wall = time.time()
         # silence bound: max(batch deadline budget, the wedge floor) plus
         # the heartbeat grace. The floor (`MXTPU_SERVE_WEDGE_TIMEOUT_MS`)
         # decouples wedge detection from client deadlines — a forward that
@@ -511,7 +520,8 @@ class ReplicaPool:
         try:
             send_msg(slot.conn, {
                 "kind": "predict", "id": msg_id, "arrays": padded,
-                "bucket": bucket, "n": total, "remaining": remaining})
+                "bucket": bucket, "n": total, "remaining": remaining,
+                "traces": wire_traces})
             while True:
                 try:
                     msg = recv_msg(slot.conn, first_timeout=0.1,
@@ -534,9 +544,21 @@ class ReplicaPool:
                     slot.state = _READY
         kind = msg.get("kind")
         if kind == "result" and msg.get("id") == msg_id:
+            # dispatch span per traced request: the router-side window
+            # around the wire round trip; `wire_s` (window minus the
+            # replica's own compute) is the serialization + hop cost
+            dispatch_s = time.monotonic() - t0
+            compute_s = msg.get("seconds") or dispatch_s
+            for req, ref in dispatch_refs:
+                if ref is not None:
+                    _tracing.emit_span(
+                        "serve.dispatch", t0_wall, dispatch_s, req.trace,
+                        component="router", span_id=ref.span_id,
+                        attrs={"replica": slot.id,
+                               "wire_s": max(0.0, dispatch_s - compute_s),
+                               "compute_s": compute_s})
             self._batcher.resolve_batch(batch, msg["outputs"], bucket,
-                                        total, msg.get("seconds") or
-                                        (time.monotonic() - t0))
+                                        total, compute_s)
             # the generation proved itself on real input: the exponential
             # respawn backoff resets only now, so a warm-but-crash-on-input
             # artifact still escalates toward the 60s cap

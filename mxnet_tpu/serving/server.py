@@ -38,6 +38,7 @@ import numpy as _np
 
 from .. import env as _env
 from .. import telemetry
+from ..telemetry import tracing as _tracing
 from ..base import MXNetError
 from .batcher import DrainingError, ServingError, drain_timeout_s
 
@@ -272,6 +273,19 @@ class ServingServer:
 
     # -- predict -----------------------------------------------------------
     def _predict(self, handler, name, version):
+        # trace context is minted AT ADMISSION (or honored from an
+        # incoming `x-mxtpu-trace` header — a proxy/client that already
+        # traces keeps its ids); the reply always carries the header so
+        # callers can link any outcome to its trace
+        ref = _tracing.parse_header(
+            handler.headers.get(_tracing.HEADER) or "")
+        ref = _tracing.mint(ref)
+        handler._mxtpu_trace = _tracing.header_value(ref)
+        with _tracing.root("serve.request", component="server", ref=ref,
+                           attrs={"model": name}):
+            self._predict_traced(handler, name, version)
+
+    def _predict_traced(self, handler, name, version):
         # consume the body FIRST: replying before the read would desync a
         # keep-alive connection (next request line = leftover body bytes)
         length = int(handler.headers.get("Content-Length") or 0)
@@ -348,6 +362,11 @@ class ServingServer:
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(body)))
+        trace = getattr(handler, "_mxtpu_trace", None)
+        if trace is not None:
+            # header contract: every predict reply (success or error)
+            # names its trace so a slow/failed request is renderable
+            handler.send_header(_tracing.HEADER, trace)
         if retry_after is None and code == 429:
             retry_after = 1
         if retry_after is not None:

@@ -321,6 +321,7 @@ def worker_main(argv=None):
     signal.signal(signal.SIGTERM, _on_term)
 
     from ..parallel.resilience import maybe_inject_serving_fault
+    from ..telemetry import tracing
     from .batcher import power_of_two_buckets
 
     max_batch = args.max_batch
@@ -354,18 +355,26 @@ def worker_main(argv=None):
     # cold executable cache (the same publish-after-warm rule as in-process
     # models, docs/serving.md)
     warm_s = 0.0
+    bucket_flops = {}
     if not args.no_warm:
         import numpy as np
+
+        from ..telemetry import flops as _tm_flops
 
         t0 = time.monotonic()
         for b in buckets:
             zeros = {k: np.zeros((b,) + tuple(s),
                                  dtype=(input_dtypes or {}).get(k, "float32"))
                      for k, s in example_shapes.items()}
+            f0 = _tm_flops.total()
             runner(zeros, b, b)
+            f = _tm_flops.total() - f0
+            if f:
+                bucket_flops[int(b)] = f
         warm_s = time.monotonic() - t0
     send_msg(sock, {"kind": "ready", "replica": args.replica,
                     "generation": args.generation, "warm_seconds": warm_s,
+                    "bucket_flops": bucket_flops or None,
                     "buckets": list(buckets),
                     "example_shapes": {k: tuple(v)
                                        for k, v in example_shapes.items()},
@@ -407,6 +416,7 @@ def worker_main(argv=None):
         if deadline is not None and time.monotonic() >= deadline:
             send_msg(sock, {"kind": "expired", "id": msg["id"]})
             continue
+        t_run_wall = time.time()
         try:
             outs = runner(msg["arrays"], msg["bucket"], msg["n"])
         except Exception as e:  # model failure (incl. OSError from the
@@ -416,10 +426,23 @@ def worker_main(argv=None):
             except OSError:
                 break  # router went away mid-reply
             continue
+        compute_s = time.monotonic() - t_batch
+        # cross-process trace: one compute span per traced request in the
+        # batch, parented under the router's dispatch span shipped on the
+        # frame — this process's JSONL carries the worker lane of the
+        # merged timeline (tools/trace_merge.py)
+        for wire_ctx in msg.get("traces") or ():
+            ref = tracing.from_wire(wire_ctx)
+            if ref is not None:
+                tracing.emit_span(
+                    "serve.compute", t_run_wall, compute_s, ref,
+                    component="worker",
+                    attrs={"replica": args.replica,
+                           "generation": args.generation,
+                           "bucket": msg["bucket"], "n": msg["n"]})
         try:
             send_msg(sock, {"kind": "result", "id": msg["id"],
-                            "outputs": outs,
-                            "seconds": time.monotonic() - t_batch})
+                            "outputs": outs, "seconds": compute_s})
         except OSError:
             break  # router went away: nothing to serve into
     try:

@@ -30,13 +30,15 @@ from .recorder import (  # noqa: F401
     record_step,
 )
 from . import core as _core
+from . import flops  # noqa: F401  (automatic FLOP accounting)
+from . import tracing  # noqa: F401  (distributed request/step spans)
 
 __all__ = [
     "counter", "gauge", "histogram", "enabled", "set_enabled", "snapshot",
     "prometheus_text", "flush", "start_http_server", "get_registry",
     "record_event", "record_step", "events", "dump", "dump_path",
     "last_step", "install_signal_handler", "observe_step", "set_step_flops",
-    "rank", "restart_generation", "telemetry_dir",
+    "rank", "restart_generation", "telemetry_dir", "tracing", "flops",
     "LATENCY_BOUNDS", "BYTE_BOUNDS",
 ]
 
@@ -96,29 +98,36 @@ def _step_metrics(kind):
              _core._REGISTRY.counter("mxtpu_steps_total", labels),
              _core._REGISTRY.counter("mxtpu_examples_total", labels),
              _core._REGISTRY.gauge("mxtpu_examples_per_sec", labels),
-             _core._REGISTRY.gauge("mxtpu_step_mfu", labels))
+             _core._REGISTRY.gauge("mxtpu_step_mfu", labels),
+             _core._REGISTRY.gauge("mxtpu_step_flops_auto", labels))
         _STEP_METRICS[kind] = m
     return m
 
 
 def observe_step(duration_s, examples=None, step=None, kind="train"):
-    """Record one completed training step: latency histogram, step/example
-    counters, examples/sec gauge, achieved-MFU gauge (when step FLOPs are
-    declared), plus the flight-recorder heartbeat that feeds the hang
-    watchdog."""
+    """Record one completed training step: latency histogram (with a
+    trace-id exemplar when the step is traced), step/example counters,
+    examples/sec gauge, achieved-MFU gauge, plus the flight-recorder
+    heartbeat that feeds the hang watchdog. Step FLOPs for the MFU come
+    from `set_step_flops`/``MXTPU_STEP_FLOPS`` when declared, else from
+    the automatic cost-analysis accounting (`telemetry.flops`) — the
+    FLOPs instrumented executables actually ran since the last step."""
     if not _core._STATE.enabled:
         return
-    hist, c_steps, c_examples, g_eps, g_mfu = _step_metrics(kind)
-    hist.observe(duration_s)
+    hist, c_steps, c_examples, g_eps, g_mfu, g_auto = _step_metrics(kind)
+    hist.observe(duration_s, exemplar=tracing.current_trace_id())
     c_steps.inc()
     if examples is not None and duration_s > 0:
         c_examples.inc(int(examples))
         g_eps.set(examples / duration_s)
-    flops = _STEP_FLOPS[0]
-    if flops and duration_s > 0:
+    auto = flops.take_step_delta() if flops.enabled() else 0.0
+    step_flops = _STEP_FLOPS[0] or auto
+    if step_flops and duration_s > 0:
+        if auto and not _STEP_FLOPS[0]:
+            g_auto.set(auto)
         peak = _peak_flops()
         if peak:
-            g_mfu.set((flops / duration_s) / peak)
+            g_mfu.set((step_flops / duration_s) / peak)
     record_step(step)
 
 

@@ -190,7 +190,7 @@ class Histogram:
 
     kind = "histogram"
     __slots__ = ("name", "labels", "bounds", "_counts", "_count", "_sum",
-                 "_min", "_max")
+                 "_min", "_max", "_exemplars")
 
     def __init__(self, name, labels=None, bounds=None):
         self.name = name
@@ -201,8 +201,13 @@ class Histogram:
         self._sum = 0.0
         self._min = None
         self._max = None
+        self._exemplars = None  # bucket index -> (value, trace_id, ts)
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
+        """Record one observation. ``exemplar`` (a trace id) attaches the
+        observation's trace to its latency bucket — the last exemplar per
+        bucket is kept (Prometheus OpenMetrics semantics), so a p99
+        outlier in the tail bucket links to a renderable trace."""
         if not _STATE.enabled:
             return
         i = 0
@@ -219,6 +224,11 @@ class Histogram:
             self._min = value
         if self._max is None or value > self._max:
             self._max = value
+        if exemplar is not None:
+            ex = self._exemplars
+            if ex is None:
+                ex = self._exemplars = {}
+            ex[i] = (value, exemplar, time.time())
 
     @property
     def count(self):
@@ -228,6 +238,18 @@ class Histogram:
     def sum(self):
         return self._sum
 
+    def _bucket_le(self, i):
+        return "%g" % self.bounds[i] if i < len(self.bounds) else "+Inf"
+
+    def exemplars(self):
+        """Bucket upper-bound -> {value, trace, ts} for buckets that saw a
+        traced observation ({} when none did)."""
+        ex = self._exemplars
+        if not ex:
+            return {}
+        return {self._bucket_le(i): {"value": v, "trace": t, "ts": ts}
+                for i, (v, t, ts) in sorted(ex.items())}
+
     def snapshot(self):
         buckets = {}
         cum = 0
@@ -235,8 +257,12 @@ class Histogram:
             cum += c
             buckets["%g" % b] = cum
         buckets["+Inf"] = self._count
-        return {"type": "histogram", "count": self._count, "sum": self._sum,
-                "min": self._min, "max": self._max, "buckets": buckets}
+        out = {"type": "histogram", "count": self._count, "sum": self._sum,
+               "min": self._min, "max": self._max, "buckets": buckets}
+        ex = self.exemplars()
+        if ex:
+            out["exemplars"] = ex
+        return out
 
     def expose(self, lines):
         base = dict(self.labels)
@@ -283,8 +309,11 @@ class _NullMetric:
     def set(self, value):
         pass
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
         pass
+
+    def exemplars(self):
+        return {}
 
     def snapshot(self):
         return {"type": "null"}
@@ -400,6 +429,7 @@ def flush(directory=None, reason="manual"):
     if not directory or not _STATE.enabled:
         return None
     from . import recorder
+    from . import tracing
 
     path = _jsonl_path(directory)
     try:
@@ -409,6 +439,8 @@ def flush(directory=None, reason="manual"):
             lines.append(json.dumps(
                 {"kind": "event", "ts": ev[0], "event": ev[1],
                  "fields": ev[2]}, default=str))
+        for sp in tracing.drain_pending():
+            lines.append(json.dumps(sp, default=str))
         lines.append(json.dumps({
             "kind": "metrics",
             "ts": time.time(),

@@ -41,6 +41,8 @@ import traceback
 
 from .. import env as _env
 from . import core
+from . import tracing  # imported HERE, not inside dump(): an import in a
+#                        signal handler could deadlock on the import lock
 
 __all__ = ["record_event", "record_step", "events", "dump", "dump_path",
            "last_step", "install_signal_handler", "drain_pending_events"]
@@ -173,6 +175,9 @@ def dump(reason, path=None):
             "argv": list(sys.argv),
             "last_step": None if ls is None else
                 {"step": ls[0], "seconds_since": round(ls[1], 3)},
+            # which phase each thread is stuck in, straight from the
+            # span table (lock-free dict snapshot — signal-safe)
+            "active_spans": tracing.active_spans(),
             "threads": _thread_stacks(),
             "events": events(),
             "metrics": core.snapshot(),

@@ -20,6 +20,7 @@ from ci.mxlint import Repo, load_baseline, run_checkers  # noqa: E402
 from ci.mxlint.checkers import CHECKERS  # noqa: E402
 from ci.mxlint.checkers.env_registry import EnvRegistryChecker  # noqa: E402
 from ci.mxlint.checkers.host_sync import HostSyncChecker  # noqa: E402
+from ci.mxlint.checkers.metric_registry import MetricRegistryChecker  # noqa: E402
 from ci.mxlint.checkers.registry_parity import RegistryParityChecker  # noqa: E402
 from ci.mxlint.checkers.signal_safety import SignalSafetyChecker  # noqa: E402
 from ci.mxlint.checkers.bare_print import BarePrintChecker  # noqa: E402
@@ -446,6 +447,106 @@ _PRINTY = """\
 """
 
 
+# ---------------------------------------------------------------------------
+# metric-registry
+# ---------------------------------------------------------------------------
+
+_METRIC_DOCS = """\
+# Observability
+
+## Metrics
+
+| Metric | Labels | Source |
+|---|---|---|
+| `mxtpu_good_total` | — | documented and emitted |
+| `mxtpu_stale_total` | — | documented, nothing emits it |
+
+## Tracing
+
+| Span | Component | What |
+|---|---|---|
+| `serve.good` | server | documented and emitted |
+| `train.stale` | train | documented, nothing emits it |
+"""
+
+_METRIC_EMITTERS = """\
+from . import telemetry
+from .telemetry import tracing
+from .telemetry.core import counter as _tm_counter
+
+def hot():
+    telemetry.counter("mxtpu_good_total").inc()
+    _tm_counter("mxtpu_aliased_total").inc()   # line 7: aliased + undocumented
+    telemetry.gauge("mxtpu_undocumented").set(1)  # line 8: undocumented
+    with tracing.root("serve.good", component="server"):
+        with tracing.span("serve.undocumented"):  # line 10: undocumented span
+            pass
+"""
+
+
+def test_metric_registry_both_directions(tmp_path):
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/emit.py": _METRIC_EMITTERS,
+        "docs/observability.md": _METRIC_DOCS,
+    })
+    got = _findings(MetricRegistryChecker(), repo)
+    lines = _lines(got)
+    # undocumented emissions point at the emitting line (aliased factory
+    # names like _tm_counter are matched on their suffix)
+    assert ("mxnet_tpu/emit.py", 7) in lines
+    assert ("mxnet_tpu/emit.py", 8) in lines
+    assert ("mxnet_tpu/emit.py", 10) in lines
+    # stale docs rows point at the docs file
+    stale = [f.message for f in got if f.path == "docs/observability.md"]
+    assert any("mxtpu_stale_total" in m for m in stale), stale
+    assert any("train.stale" in m for m in stale), stale
+    # documented-and-emitted names produce no finding
+    assert not any("mxtpu_good_total" in f.message or
+                   "serve.good" in f.message for f in got)
+
+
+def test_metric_registry_clean_and_unverifiable(tmp_path):
+    clean = _tree(tmp_path / "clean", {
+        "mxnet_tpu/emit.py": """\
+            from . import telemetry
+
+            def hot():
+                telemetry.counter("mxtpu_good_total").inc()
+            """,
+        "docs/observability.md": """\
+            ## Metrics
+
+            | Metric | Labels |
+            |---|---|
+            | `mxtpu_good_total` | — |
+            """,
+    })
+    assert _findings(MetricRegistryChecker(), clean) == []
+    # a moved/emptied Metrics section is one loud finding, not silence
+    blank = _tree(tmp_path / "blank", {
+        "mxnet_tpu/emit.py": "x = 1\n",
+        "docs/observability.md": "# nothing here\n",
+    })
+    got = _findings(MetricRegistryChecker(), blank)
+    assert len(got) == 1 and "unverifiable" in got[0].message
+
+
+def test_metric_registry_dynamic_names_skipped(tmp_path):
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/emit.py": """\
+            from . import telemetry
+
+            def hot(name):
+                telemetry.counter("mxtpu_dyn_%s_total" % name).inc()
+            """,
+        "docs/observability.md": _METRIC_DOCS,
+    })
+    # dynamic names are invisible (no literal first arg) — nothing to flag
+    got = [f for f in _findings(MetricRegistryChecker(), repo)
+           if f.path.startswith("mxnet_tpu/")]
+    assert got == []
+
+
 def test_bare_print_checker_semantics(tmp_path):
     repo = _tree(tmp_path, {
         "mxnet_tpu/bad.py": _PRINTY,
@@ -611,7 +712,7 @@ def test_env_module_typed_accessors(monkeypatch):
 
 
 def test_env_registry_covers_every_checker_rule():
-    """Meta: the shipped checker set is exactly the documented five."""
+    """Meta: the shipped checker set is exactly the documented six."""
     assert sorted(c.rule for c in CHECKERS) == [
-        "bare-print", "env-registry", "host-sync", "registry-parity",
-        "signal-safety"]
+        "bare-print", "env-registry", "host-sync", "metric-registry",
+        "registry-parity", "signal-safety"]

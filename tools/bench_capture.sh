@@ -183,6 +183,22 @@ if ls "$FAIL_TDIR"/*.jsonl >/dev/null 2>&1; then
 fi
 rm -rf "$FAIL_TDIR"
 
+# trace row: render the archived telemetry JSONL (serve_bench samples
+# every request at --trace-sample 1.0, so the serve rows' JSONL carries
+# the full span stream) into perfetto-loadable merged traces next to the
+# raw JSONL — `--trace <id>` on the slowest_request id from the serve
+# JSON zooms to the worst request (docs/observability.md §Tracing)
+echo "[bench_capture] trace merge" >&2
+for ROW in serve_resnet18 failover; do
+  JSONL="BENCH_${TAG}_${ROW}_telemetry.jsonl"
+  if [ -s "$JSONL" ]; then
+    PYTHONPATH=".:${PYTHONPATH:-}" timeout 300 python tools/trace_merge.py \
+      "$JSONL" -o "BENCH_${TAG}_${ROW}_trace.json" \
+      2>> "BENCH_${TAG}_${ROW}.log" \
+      && echo "[bench_capture] trace row: BENCH_${TAG}_${ROW}_trace.json" >&2
+  fi
+done
+
 echo "[bench_capture] running tpu smoke suite" >&2
 MXTPU_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_smoke.py -v \
   > "TPU_SMOKE_${TAG}.log" 2>&1

@@ -89,6 +89,10 @@ def _protocol_env(n, coord, extra, rank=None, generation=0):
         "MXTPU_COORDINATOR": coord,
         "MXTPU_NUM_WORKERS": str(n),
         "MXTPU_RESTART_GENERATION": str(generation),
+        # distributed-tracing context: worker step spans join the launch
+        # trace under this generation's span (telemetry/tracing.py; the
+        # flags bit carries whether the launcher env samples the run)
+        "MXTPU_TRACE_CONTEXT": _generation_trace_context(generation),
         # reference-compatible aliases (DMLC_* protocol, launch.py:29)
         "DMLC_NUM_WORKER": str(n),
         "DMLC_ROLE": "worker",
@@ -140,6 +144,46 @@ def _emit_event(kind, **fields):
                 "pid": os.getpid(), "fields": fields}) + "\n")
     except OSError:
         pass  # telemetry must never break supervision
+
+
+# -- launch trace (distributed tracing, docs/observability.md §Tracing) ----
+# one trace id per launcher invocation; each supervised generation is a
+# span under it, exported to workers via MXTPU_TRACE_CONTEXT so their
+# training-step spans share the trace. Import-free like _emit_event: the
+# launcher hand-rolls the same `{"kind": "event", "event": "span"}` record
+# shape tools/trace_merge.py normalizes.
+_LAUNCH_TRACE = "%032x" % random.getrandbits(128)
+_GEN_SPANS = {}  # generation -> (span_id, start_wall)
+
+
+def _launch_sampled():
+    """Whether the launcher environment samples the run (workers inherit
+    the flag and force-record their step spans when it is set)."""
+    try:
+        return float(os.environ.get("MXTPU_TRACE_SAMPLE") or 0) >= 1.0
+    except ValueError:
+        return False
+
+
+def _generation_trace_context(generation):
+    span_id, _ = _GEN_SPANS.get(generation) or (None, None)
+    if span_id is None:
+        span_id = "%016x" % random.getrandbits(64)
+        _GEN_SPANS[generation] = (span_id, time.time())
+    return "%s-%s-%02d" % (_LAUNCH_TRACE, span_id,
+                           1 if _launch_sampled() else 0)
+
+
+def _emit_generation_span(generation, rc):
+    """Close generation `generation`'s span (emitted at exit, when its
+    duration is known) into launcher-events.jsonl."""
+    span_id, start = _GEN_SPANS.get(generation) or (None, None)
+    if span_id is None:
+        return
+    _emit_event("span", name="launch.generation", trace=_LAUNCH_TRACE,
+                span=span_id, parent=None, component="launcher",
+                ts=start, dur_us=(time.time() - start) * 1e6,
+                attrs={"generation": generation, "rc": rc})
 
 
 _PUMP_LOCK = threading.Lock()
@@ -286,6 +330,7 @@ def _spawn_and_wait(make_cmds, max_restarts=0, backoff=1.0):
                     max_restarts=max_restarts)
         rc = _run_generation(make_cmds(generation))
         _emit_event("launcher_generation_exit", generation=generation, rc=rc)
+        _emit_generation_span(generation, rc)
         if rc == 0:
             return 0
         if generation >= max_restarts:

@@ -101,6 +101,41 @@ def _build_resnet18(tmpdir, image_size):
 # load phases
 # ---------------------------------------------------------------------------
 
+def _phase_breakdown(spans):
+    """Aggregate collected span records into the per-phase latency table
+    (queue / assembly / wire / compute / unpad — plus the request total)
+    and find the slowest request's trace id, the one to feed
+    `tools/trace_merge.py --trace <id>`."""
+    by_phase = {}
+    slowest = None
+    for s in spans:
+        name = s.get("name", "")
+        if not name.startswith("serve."):
+            continue
+        dur_ms = (s.get("dur_us") or 0) / 1e3
+        phase = name.split(".", 1)[1]
+        by_phase.setdefault(phase, []).append(dur_ms)
+        attrs = s.get("attrs") or {}
+        if phase == "dispatch" and "wire_s" in attrs:
+            # router-side split of the dispatch window: serialization +
+            # hop cost vs the replica's own compute
+            by_phase.setdefault("wire", []).append(attrs["wire_s"] * 1e3)
+        if phase == "request" and (slowest is None
+                                   or dur_ms > slowest["total_ms"]):
+            slowest = {"trace_id": s.get("trace"),
+                       "total_ms": round(dur_ms, 3)}
+    phases = {}
+    for phase, vals in sorted(by_phase.items()):
+        vals.sort()
+        phases[phase] = {
+            "count": len(vals),
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "p50_ms": round(_percentile(vals, 50), 3),
+            "p99_ms": round(_percentile(vals, 99), 3),
+        }
+    return phases, slowest
+
+
 def _percentile(sorted_ms, q):
     if not sorted_ms:
         return None
@@ -448,6 +483,10 @@ def main(argv=None):
     p.add_argument("--open-rate", type=float, default=0.0,
                    help="open-loop phase arrival rate per second (0 = skip)")
     p.add_argument("--open-duration", type=float, default=5.0)
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="distributed-tracing sample rate for the bench "
+                        "(1.0 = every request contributes to the "
+                        "per-phase breakdown; 0 disables spans)")
     p.add_argument("--failover", action="store_true",
                    help="run the resilience row instead of the throughput "
                         "phases: closed-loop load over a --replicas pool "
@@ -502,6 +541,14 @@ def main(argv=None):
     builds = telemetry.get_registry().counter(
         "mxtpu_executor_build_total", {"what": "forward"})
     builds_after_warm = builds.value
+
+    # distributed tracing: sample bench traffic and collect spans in-
+    # process (tracing.set_collector) for the per-phase breakdown
+    tracing = telemetry.tracing
+    spans = []
+    if args.trace_sample > 0:
+        tracing.configure(sample=min(1.0, args.trace_sample))
+        tracing.set_collector(spans.append)
 
     server = ServingServer(repo, port=0, addr="127.0.0.1").start()
     endpoint = ("127.0.0.1", server.port, "/v1/models/bench:predict")
@@ -560,6 +607,18 @@ def main(argv=None):
     examples = snap.get("mxtpu_serve_examples_total" + label,
                         {}).get("value", 0)
 
+    phases, slowest = _phase_breakdown(spans)
+    if phases:
+        log("  phase breakdown (p50 ms): %s" % {
+            k: v["p50_ms"] for k, v in phases.items()})
+    if slowest:
+        log("  slowest request: %.1fms trace %s (render: python tools/"
+            "trace_merge.py --trace %s -o slow.json <telemetry jsonl>)"
+            % (slowest["total_ms"], slowest["trace_id"],
+               slowest["trace_id"]))
+    tracing.set_collector(None)
+    tracing.configure()
+
     speedup = round(batched["rps"] / seq["rps"], 2) if seq["rps"] else None
     result = {
         "mode": "serve_bench",
@@ -579,6 +638,12 @@ def main(argv=None):
         "speedup_batched_vs_sequential": speedup,
         "jit_compiles_after_warmup": jit_after_warm,
         "jit_compiles_in_mixed_phase": jit_in_mixed,
+        # span-derived per-phase latency split + the trace id to render
+        # for the worst request (tools/trace_merge.py --trace <id>)
+        "phases": phases or None,
+        "slowest_request": slowest,
+        "trace_sample": args.trace_sample,
+        "bucket_flops": model.bucket_flops or None,
         "occupancy": {
             "batches": batches,
             "examples": examples,

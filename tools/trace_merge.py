@@ -1,23 +1,32 @@
-"""Merge per-rank chrome-trace profiler dumps into ONE perfetto timeline.
+"""Merge per-process traces into ONE perfetto timeline.
 
-Each rank of a distributed run writes its own `profiler.dump()` file
-(pid = rank, named thread lanes — mxnet_tpu/profiler.py). This tool merges
-them into a single chrome://tracing / perfetto.dev -loadable JSON whose
-process lanes are the ranks:
+Two input kinds, freely mixable:
 
+  * chrome-trace profiler dumps (`profiler.dump()` — pid = rank, named
+    thread lanes), the original PR-3 path;
+  * telemetry JSONL files carrying distributed-tracing span lines
+    (``{"kind": "span", ...}`` — telemetry/tracing.py), including
+    `launcher-events.jsonl` span records. One serving request or training
+    step becomes a span tree across every process it touched.
+
+    python tools/trace_merge.py -o merged.json telemetry-rank0-*.jsonl ...
     python tools/trace_merge.py -o merged.json rank0.json rank1.json ...
 
 Guarantees on the output:
-  * every input file occupies a DISTINCT pid (inputs that collide — e.g.
-    single-process dumps that all stamped pid 0, or pre-telemetry traces —
-    are remapped to the first free pid, preserving each file's internal
-    pid->tid structure);
-  * each process lane carries `process_name` ("rank N") and
-    `process_sort_index` metadata, so perfetto orders and labels them;
-  * timestamps are passed through untouched by default (profiler clocks
-    are already relative to process start, which lines ranks up at step
-    granularity); `--align-start` rebases every file so its earliest event
-    sits at t=0 for clock-skewed hosts.
+  * every chrome-trace input occupies a DISTINCT pid (colliding inputs —
+    e.g. single-process dumps that all stamped pid 0 — are remapped to the
+    first free pid, preserving each file's internal pid->tid structure);
+  * span inputs are grouped into one process lane per (component, os-pid)
+    — a pooled serving request renders as server / router / worker lanes
+    — labeled via `process_name`/`process_sort_index` metadata; span
+    trace/span/parent ids ride in each event's `args` so perfetto's flow
+    UI and `--trace <id>` filtering work;
+  * OLD-format telemetry JSONL (span-less, pre-tracing) is tolerated: the
+    file contributes zero events and is reported, not fatal;
+  * timestamps pass through untouched by default (profiler clocks are
+    relative to process start; span clocks are epoch wall time — same-host
+    processes line up at µs granularity); `--align-start` rebases every
+    input so its earliest event sits at t=0 for clock-skewed hosts.
 
 Stdlib-only (safe on a login host with no jax).
 """
@@ -29,17 +38,61 @@ import sys
 
 
 def load_trace(path):
-    """Read one chrome-trace JSON (object form {traceEvents: [...]} or the
-    bare array form) and return its event list."""
+    """Read one input file. Returns ``("chrome", events)`` for a
+    chrome-trace JSON (object form {traceEvents: [...]} or the bare array
+    form) or ``("spans", records)`` for a telemetry/launcher JSONL with
+    span lines (possibly empty — old-format files are span-less)."""
     with open(path) as f:
-        data = json.load(f)
+        text = f.read()
+    if path.endswith(".jsonl"):
+        return "spans", _spans_of_jsonl(text, path)
+    try:
+        data = json.loads(text)
+    except ValueError:
+        return "spans", _spans_of_jsonl(text, path)
     if isinstance(data, list):
-        return data
-    events = data.get("traceEvents")
-    if not isinstance(events, list):
-        raise ValueError("%s: not a chrome trace (no traceEvents array)"
-                         % path)
-    return events
+        return "chrome", data
+    if isinstance(data, dict) and isinstance(data.get("traceEvents"), list):
+        return "chrome", data["traceEvents"]
+    if isinstance(data, dict) and "kind" in data:
+        # a one-line JSONL (single flush) parses as a bare JSON object
+        return "spans", _spans_of_jsonl(text, path)
+    raise ValueError("%s: neither a chrome trace (no traceEvents array) "
+                     "nor a telemetry JSONL" % path)
+
+
+def _span_of_record(rec):
+    """Normalize the two span-record shapes: telemetry's top-level
+    ``{"kind": "span", ...}`` and the launcher's
+    ``{"kind": "event", "event": "span", "fields": {...}}``."""
+    if rec.get("kind") == "span":
+        return rec
+    if rec.get("kind") == "event" and rec.get("event") == "span":
+        fields = dict(rec.get("fields") or {})
+        fields.setdefault("ts", rec.get("ts"))
+        fields.setdefault("pid", rec.get("pid", 0))
+        return fields
+    return None
+
+
+def _spans_of_jsonl(text, path):
+    spans, bad = [], 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            bad += 1  # torn tail line from a live writer: skip, don't die
+            continue
+        span = _span_of_record(rec)
+        if span is not None and isinstance(span.get("ts"), (int, float)):
+            spans.append(span)
+    if bad:
+        sys.stderr.write("[trace_merge] %s: skipped %d unparseable "
+                         "line(s)\n" % (path, bad))
+    return spans
 
 
 def _pids_of(events):
@@ -52,60 +105,124 @@ def _min_ts(events):
     return min(ts) if ts else 0
 
 
-def merge_traces(event_lists, align_start=False):
-    """Merge several per-process event lists into one trace dict.
+def _alloc_pid(used, want=0):
+    new = want
+    while new in used:
+        new += 1
+    used.add(new)
+    return new
 
-    Each input keeps its own pid (the profiler stamps pid=rank); when two
-    inputs claim the same pid, later ones are remapped to the first unused
-    pid so no two files ever share a process lane. process_name /
-    process_sort_index metadata is (re)written per lane as "rank <pid>"."""
+
+def merge_chrome(events, used_pids, merged, align_start):
+    """One chrome-trace input: remap colliding pids, label lanes."""
+    pids = sorted(_pids_of(events))
+    remap = {pid: _alloc_pid(used_pids, pid) for pid in pids}
+    base_ts = _min_ts(events) if align_start else 0
+    for pid in pids:
+        merged.append({"ph": "M", "name": "process_name",
+                       "pid": remap[pid], "tid": 0,
+                       "args": {"name": "rank %d" % remap[pid]}})
+        merged.append({"ph": "M", "name": "process_sort_index",
+                       "pid": remap[pid], "tid": 0,
+                       "args": {"sort_index": remap[pid]}})
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") in (
+                "process_name", "process_sort_index"):
+            continue  # superseded by the labels above
+        out = dict(ev)
+        out["pid"] = remap.get(ev.get("pid", 0), ev.get("pid", 0))
+        if base_ts and isinstance(out.get("ts"), (int, float)):
+            out["ts"] = out["ts"] - base_ts
+        merged.append(out)
+
+
+def merge_spans(spans, used_pids, merged, align_start, lanes,
+                trace_filter=None):
+    """Span records (already normalized) from ONE input file: each
+    (component, os-pid) pair becomes a process lane shared across input
+    files (server/router/worker lanes), threads become tids."""
+    if trace_filter:
+        spans = [s for s in spans if s.get("trace") == trace_filter]
+    base_ts = min((s["ts"] for s in spans), default=0) if align_start else 0
+    for span in spans:
+        component = span.get("component") or "rank %s" % span.get("rank", 0)
+        lane_key = (component, span.get("pid", 0))
+        lane = lanes.get(lane_key)
+        if lane is None:
+            pid = _alloc_pid(used_pids, 100 + len(lanes))
+            lane = lanes[lane_key] = {"pid": pid, "tids": {}}
+            merged.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": 0, "args": {
+                               "name": "%s (pid %s)" % lane_key}})
+            merged.append({"ph": "M", "name": "process_sort_index",
+                           "pid": pid, "tid": 0,
+                           "args": {"sort_index": pid}})
+        thread = str(span.get("thread") or "main")
+        tid = lane["tids"].get(thread)
+        if tid is None:
+            tid = lane["tids"][thread] = len(lane["tids"]) + 1
+            merged.append({"ph": "M", "name": "thread_name",
+                           "pid": lane["pid"], "tid": tid,
+                           "args": {"name": thread}})
+        args = {"trace": span.get("trace"), "span": span.get("span"),
+                "parent": span.get("parent")}
+        args.update(span.get("attrs") or {})
+        merged.append({
+            "ph": "X",
+            "name": span.get("name", "span"),
+            "cat": component,
+            "ts": (span["ts"] - base_ts) * 1e6,
+            "dur": span.get("dur_us", 0),
+            "pid": lane["pid"],
+            "tid": tid,
+            "args": args,
+        })
+
+
+def merge_traces(inputs, align_start=False, trace_filter=None):
+    """Merge parsed inputs — a list of ``(kind, payload)`` from
+    `load_trace` — into one trace dict."""
     used_pids = set()
     merged = []
-    for events in event_lists:
-        pids = sorted(_pids_of(events))
-        remap = {}
-        for pid in pids:
-            new = pid
-            while new in used_pids:
-                new += 1
-            remap[pid] = new
-            used_pids.add(new)
-        base_ts = _min_ts(events) if align_start else 0
-        for pid in pids:
-            merged.append({"ph": "M", "name": "process_name",
-                           "pid": remap[pid], "tid": 0,
-                           "args": {"name": "rank %d" % remap[pid]}})
-            merged.append({"ph": "M", "name": "process_sort_index",
-                           "pid": remap[pid], "tid": 0,
-                           "args": {"sort_index": remap[pid]}})
-        for ev in events:
-            if ev.get("ph") == "M" and ev.get("name") in (
-                    "process_name", "process_sort_index"):
-                continue  # superseded by the labels above
-            out = dict(ev)
-            out["pid"] = remap.get(ev.get("pid", 0), ev.get("pid", 0))
-            if base_ts and isinstance(out.get("ts"), (int, float)):
-                out["ts"] = out["ts"] - base_ts
-            merged.append(out)
+    lanes = {}  # (component, os pid) -> {pid, tids} — shared across files
+    for kind, payload in inputs:
+        if kind == "chrome":
+            merge_chrome(payload, used_pids, merged, align_start)
+        else:
+            merge_spans(payload, used_pids, merged, align_start, lanes,
+                        trace_filter=trace_filter)
     return {"traceEvents": merged, "displayTimeUnit": "ms"}
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser(
-        description="Merge per-rank mxnet_tpu profiler dumps into one "
-                    "perfetto-loadable chrome trace")
+        description="Merge per-process mxnet_tpu traces (profiler dumps "
+                    "and/or telemetry span JSONL) into one perfetto-"
+                    "loadable chrome trace")
     parser.add_argument("inputs", nargs="+",
-                        help="per-rank profile.json files (rank order = "
-                             "argument order)")
+                        help="profiler dump .json and/or telemetry .jsonl "
+                             "files (rank order = argument order)")
     parser.add_argument("-o", "--output", required=True,
                         help="merged trace path")
     parser.add_argument("--align-start", action="store_true",
                         help="rebase each file's earliest event to t=0 "
                              "(clock-skewed hosts)")
+    parser.add_argument("--trace", default=None, metavar="TRACE_ID",
+                        help="keep only spans of this trace id (renders "
+                             "one request/step; profiler inputs are "
+                             "unaffected)")
     args = parser.parse_args(argv)
 
-    event_lists = [load_trace(p) for p in args.inputs]
-    merged = merge_traces(event_lists, align_start=args.align_start)
+    inputs = []
+    for p in args.inputs:
+        kind, payload = load_trace(p)
+        if kind == "spans" and not payload:
+            sys.stderr.write("[trace_merge] %s: no span records (old-"
+                             "format/span-less file) — skipped\n" % p)
+            continue
+        inputs.append((kind, payload))
+    merged = merge_traces(inputs, align_start=args.align_start,
+                          trace_filter=args.trace)
     with open(args.output, "w") as f:
         json.dump(merged, f)
     pids = sorted(_pids_of(merged["traceEvents"]))
