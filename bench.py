@@ -904,21 +904,26 @@ def main():
                      % (NET, MODE, sorted(tables[MODE]))}))
         raise SystemExit(1)
     _device_watchdog()
-    # arm the persistent XLA compile cache now the dial answered and the
+    # arm the persistent compile caches now the dial answered and the
     # device is known NOT to be CPU: each capture mode is a fresh process
-    # recompiling the same step over a slow remote dial. CPU runs (the CI
-    # contract tests, accelerator-less fallback) stay uncached — XLA:CPU
-    # AOT reloads across machines risk SIGILL (see
-    # base.enable_persistent_compile_cache). The cache config only has to
-    # land before the first *compile*, so post-dial arming is in time.
+    # recompiling the same step over a slow remote dial. Both tiers arm —
+    # the framework's executable-artifact tier (MXTPU_COMPILE_CACHE ->
+    # mxnet_tpu.compile, read lazily at first fill so post-import arming
+    # is in time) and jax's HLO-keyed cache as backstop for executables
+    # the artifact tier can't serialize. CPU runs (the CI contract tests,
+    # accelerator-less fallback) stay uncached — XLA:CPU AOT reloads
+    # across machines risk SIGILL (see
+    # base.enable_persistent_compile_cache).
     import jax
 
-    if (jax.devices()[0].platform != "cpu"
-            and not os.environ.get("MXTPU_COMPILE_CACHE")):
-        os.environ["MXTPU_COMPILE_CACHE"] = "1"
-        from mxnet_tpu.base import enable_persistent_compile_cache
+    if jax.devices()[0].platform != "cpu":
+        if not os.environ.get("MXTPU_COMPILE_CACHE"):
+            os.environ["MXTPU_COMPILE_CACHE"] = "1"
+        if not os.environ.get("MXTPU_JAX_COMPILE_CACHE"):
+            os.environ["MXTPU_JAX_COMPILE_CACHE"] = "1"
+            from mxnet_tpu.base import enable_persistent_compile_cache
 
-        enable_persistent_compile_cache()
+            enable_persistent_compile_cache()
     if MODE == "score":
         bench_score()
     elif MODE == "score_int8":

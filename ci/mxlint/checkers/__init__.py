@@ -7,6 +7,7 @@ below (docs/static_analysis.md walks through adding one).
 from __future__ import annotations
 
 from .bare_print import BarePrintChecker
+from .compile_registry import CompileRegistryChecker
 from .env_registry import EnvRegistryChecker
 from .host_sync import HostSyncChecker
 from .metric_registry import MetricRegistryChecker
@@ -19,5 +20,6 @@ CHECKERS = (
     EnvRegistryChecker(),
     RegistryParityChecker(),
     MetricRegistryChecker(),
+    CompileRegistryChecker(),
     BarePrintChecker(),
 )

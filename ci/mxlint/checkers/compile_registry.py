@@ -1,0 +1,135 @@
+"""compile-registry: no ad-hoc executable caching outside mxnet_tpu/compile.
+
+The unified executable cache (`mxnet_tpu/compile/`, docs/compile_cache.md)
+is the ONE place compiled executables are keyed, counted, evicted and
+persisted. Before it existed, five independent signature-keyed caches had
+grown across the library (per-op lru_cache, autograd backward, Executor
+dicts, gluon CachedOp, serving predictors) — and every cross-cutting
+feature (FLOP accounting, jit telemetry, cold-start persistence) had to
+chase all of them. This checker stops the drift from restarting:
+
+  1. a ``functools.lru_cache`` / ``lru_cache``-decorated function whose
+     body calls ``jax.jit`` / ``jit`` / ``pjit`` is a hidden executable
+     cache (the old ``ops._jitted`` pattern);
+  2. storing a ``jax.jit(...)``  result under a subscript —
+     ``d[key] = jax.jit(fn)``, ``d[key] = fn`` where ``fn = jax.jit(...)``
+     in the same function, or ``d.setdefault(key, jax.jit(fn))`` — is a
+     dict-keyed executable holder (the old Executor/trainer pattern).
+
+Scope: library code under ``mxnet_tpu/`` EXCEPT ``mxnet_tpu/compile/``
+(the registry itself). Plain module-global singletons
+(``_JIT = jax.jit(fn)``) are not flagged: they hold one executable keyed
+by nothing, which the registry has nothing to add to. Route new keyed
+caches through `mxnet_tpu.compile.get_or_build` instead, or — for a
+deliberate exception — pragma the line with
+``# mxlint: disable=compile-registry`` and a justification.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from ..astutil import FUNC_DEFS, dotted
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_LRU_NAMES = {"functools.lru_cache", "lru_cache"}
+
+
+def _is_jit_call(node):
+    return isinstance(node, ast.Call) and (dotted(node.func) in _JIT_NAMES)
+
+
+def _has_lru_decorator(func):
+    for deco in func.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        if dotted(target) in _LRU_NAMES:
+            return True
+    return False
+
+
+def _calls_jit(func):
+    """Does the function body (nested defs INCLUDED — builders return
+    closures) call jax.jit/pjit anywhere?"""
+    for node in ast.walk(func):
+        if _is_jit_call(node):
+            return True
+    return False
+
+
+class _FuncScanner(ast.NodeVisitor):
+    """Within one function scope: track names assigned from jit calls and
+    flag subscript stores of jitted values."""
+
+    def __init__(self, checker, rel, findings):
+        self.checker = checker
+        self.rel = rel
+        self.findings = findings
+        self.jit_names = set()
+
+    def visit_Assign(self, node):
+        if _is_jit_call(node.value):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self.jit_names.add(target.id)
+                elif isinstance(target, ast.Subscript):
+                    self._flag(node, "a `jax.jit(...)` result is stored "
+                                     "under a subscript")
+        elif any(isinstance(t, ast.Subscript) for t in node.targets) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in self.jit_names:
+            self._flag(node, "`%s` (assigned from jax.jit) is stored "
+                             "under a subscript" % node.value.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        # d.setdefault(k, jax.jit(f)) — the third holder spelling
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "setdefault" and \
+                any(_is_jit_call(a) for a in node.args):
+            self._flag(node, "a `jax.jit(...)` result is stored via "
+                             ".setdefault")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass   # nested defs get their own scope pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _flag(self, node, what):
+        self.findings.append(Finding(
+            self.checker.rule, self.rel, node.lineno,
+            "%s — a dict-keyed executable holder outside mxnet_tpu/compile; "
+            "route it through mxnet_tpu.compile.get_or_build "
+            "(docs/compile_cache.md)" % what))
+
+
+class CompileRegistryChecker:
+    rule = "compile-registry"
+    description = ("executable caching (lru_cache-wrapped jit builders, "
+                   "dict-keyed jax.jit holders) happens only in "
+                   "mxnet_tpu/compile")
+
+    def run(self, repo):
+        for rel in repo.py_files("mxnet_tpu"):
+            if rel.startswith("mxnet_tpu/compile/"):
+                continue
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            findings = []
+            for node in ast.walk(tree):
+                if not isinstance(node, FUNC_DEFS):
+                    continue
+                if _has_lru_decorator(node) and _calls_jit(node):
+                    findings.append(Finding(
+                        self.rule, rel, node.lineno,
+                        "lru_cache-decorated `%s` builds jitted executables "
+                        "— a hidden executable cache outside "
+                        "mxnet_tpu/compile; route it through "
+                        "mxnet_tpu.compile.get_or_build "
+                        "(docs/compile_cache.md)" % node.name))
+                scanner = _FuncScanner(self, rel, findings)
+                for child in node.body:
+                    scanner.visit(child)
+            for finding in findings:
+                yield finding

@@ -20,7 +20,6 @@ path, like the reference's per-op Imperative::Backward.
 from __future__ import annotations
 
 import contextlib
-import functools
 import threading
 
 import numpy as _np
@@ -176,45 +175,47 @@ def _x64_for_arrays(arrays, dtypes=()):
     return _x64_arming(arrays=arrays, dtypes=dtypes)
 
 
-@functools.lru_cache(maxsize=8192)
 def _bwd_jitted(name, attr_key, has_rng, x64=False):
     # x64 joins the cache key only: the same (op, attrs) replayed in and
     # out of large-tensor mode must not share a trace
     """Jitted per-(op, attrs) backward: recompute forward + vjp in one fused
     executable (the tape-recompute formulation; XLA DCEs what the pullback
-    doesn't need)."""
-    import jax
+    doesn't need). Resolves through the unified registry
+    (`mxnet_tpu.compile`, kind ``op_bwd``): counters, ``jit_compile``
+    events, FLOP accounting and the persistent tier ride the fill hook,
+    and Custom-op backwards carry the same ``custom-op:<op_type>``
+    invalidation tag as their forwards."""
+    from . import compile as _compile
 
-    from .telemetry import core as _tm_core
-    from .telemetry import recorder as _tm_rec
+    key = _ops.op_key(name, attr_key, kind="op_bwd").with_static_extra(
+        (bool(has_rng), bool(x64)))
 
-    _tm_core.counter("mxtpu_jit_cache_miss_total").inc()
-    _tm_rec.record_event("jit_compile", op="_backward_" + name)
-    opdef = _ops.get(name)
-    kwargs = dict(attr_key)
+    def build():
+        import jax
 
-    def bwd(rng, in_arrays, float_cots):
-        def f(*args):
-            call = (rng,) + args if has_rng else args
-            out = opdef.fn(*call, **kwargs)
-            return out if isinstance(out, (tuple, list)) else (out,)
+        opdef = _ops.get(name)
+        kwargs = dict(attr_key)
 
-        primals, pull = jax.vjp(f, *in_arrays)
-        seeds = []
-        fi = 0
-        for p in primals:
-            if _is_float(p.dtype):
-                seeds.append(float_cots[fi])
-                fi += 1
-            else:
-                seeds.append(_np.zeros(p.shape, jax.dtypes.float0))
-        return pull(tuple(seeds))
+        def bwd(rng, in_arrays, float_cots):
+            def f(*args):
+                call = (rng,) + args if has_rng else args
+                out = opdef.fn(*call, **kwargs)
+                return out if isinstance(out, (tuple, list)) else (out,)
 
-    # automatic FLOP accounting for the fused recompute+vjp executable
-    # (per-shape cost analysis at cache fill — telemetry/flops.py)
-    from .telemetry import flops as _tm_flops
+            primals, pull = jax.vjp(f, *in_arrays)
+            seeds = []
+            fi = 0
+            for p in primals:
+                if _is_float(p.dtype):
+                    seeds.append(float_cots[fi])
+                    fi += 1
+                else:
+                    seeds.append(_np.zeros(p.shape, jax.dtypes.float0))
+            return pull(tuple(seeds))
 
-    return _tm_flops.instrument(jax.jit(bwd))
+        return jax.jit(bwd)
+
+    return _compile.get_or_build(key, build, label="_backward_" + name)
 
 
 def _run_backward(heads, head_grads, retain_graph=False):
@@ -265,9 +266,6 @@ def _run_backward(heads, head_grads, retain_graph=False):
                 import jax
 
                 rng = jax.random.PRNGKey(0)
-            from .telemetry import core as _tm_core
-
-            _tm_core.counter("mxtpu_jit_cache_lookup_total").inc()
             fn = _bwd_jitted(node.opdef.name, node.attr_key,
                              node.opdef.needs_rng, x64)
             with x64_ctx:

@@ -47,20 +47,23 @@ def honor_explicit_cpu_platform():
 
 
 def enable_persistent_compile_cache():
-    """Opt-in persistent XLA compilation cache: set ``MXTPU_COMPILE_CACHE``
-    to a directory (or ``1`` for the repo-local default) and executables are
-    cached keyed by HLO+backend, so repeated bench/capture runs — each a
-    fresh process compiling the same ResNet/BERT step over a slow remote
-    dial — skip straight to execution. Deliberately NOT default-on: XLA:CPU
+    """Opt-in *jax-level* persistent compilation cache: set
+    ``MXTPU_JAX_COMPILE_CACHE`` to a directory (or ``1`` for the repo-local
+    default) and jax caches executables keyed by HLO+backend, so repeated
+    runs skip XLA backend compilation (each process still pays
+    trace+lower). This is the optional extra knob UNDER the framework's own
+    persistent executable-artifact tier (``MXTPU_COMPILE_CACHE`` →
+    `mxnet_tpu.compile`, docs/compile_cache.md), which skips trace, lower
+    AND compile; the two compose. Deliberately NOT default-on: XLA:CPU
     AOT reloads warn about machine-feature mismatches (potential SIGILL) and
-    save little, so the CPU test suite stays uncached; ``bench.py`` arms it
-    for accelerator runs. Best-effort: backends that cannot serialize
+    save little, so the CPU test suite stays uncached; ``bench.py`` arms
+    both for accelerator runs. Best-effort: backends that cannot serialize
     executables simply miss the cache."""
     import os
 
     from . import env as _env
 
-    choice = _env.raw("MXTPU_COMPILE_CACHE") or ""
+    choice = _env.raw("MXTPU_JAX_COMPILE_CACHE") or ""
     if not choice or choice.lower() in ("0", "off", "none", "disable",
                                         "false", "no"):
         return

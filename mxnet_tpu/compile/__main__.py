@@ -1,0 +1,149 @@
+"""CLI for the persistent compile cache: list / inspect / prune / verify.
+
+    python -m mxnet_tpu.compile list   [--dir D]
+    python -m mxnet_tpu.compile inspect <digest-prefix> [--dir D]
+    python -m mxnet_tpu.compile prune  [--all | --bad | --jax-mismatch |
+                                        --older-than SECONDS] [--dir D]
+    python -m mxnet_tpu.compile verify [--dir D]
+
+``--dir`` overrides ``MXTPU_COMPILE_CACHE``. ``list``/``inspect``/
+``prune --all/--bad/--older-than`` read only headers and never import
+jax; ``verify`` crc-checks payloads; ``prune --jax-mismatch`` needs jax
+to know the live version/backend.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import manifest as _manifest
+from . import persist as _persist
+
+
+def _resolve_dir(args):
+    d = args.dir or _persist.cache_dir()
+    if not d:
+        sys.stderr.write("compile-cache: no directory (set "
+                         "MXTPU_COMPILE_CACHE or pass --dir)\n")
+        sys.exit(2)
+    return d
+
+
+def _fmt_age(created):
+    if not created:
+        return "?"
+    s = max(0, time.time() - created)
+    for unit, div in (("d", 86400), ("h", 3600), ("m", 60)):
+        if s >= div:
+            return "%.1f%s" % (s / div, unit)
+    return "%ds" % s
+
+
+def cmd_list(args):
+    d = _resolve_dir(args)
+    rows, bad, total = [], 0, 0
+    for path, header in _persist.scan(d):
+        size = os.path.getsize(path)
+        total += size
+        if header is None:
+            bad += 1
+            rows.append(("<corrupt>", "-", "-", size, "-", "-",
+                         os.path.basename(path)))
+            continue
+        key = header.get("key") or {}
+        rows.append((header.get("digest", "?")[:12], key.get("kind", "?"),
+                     header.get("label") or key.get("fingerprint", "?")[:24],
+                     size, _fmt_age(header.get("created")),
+                     "%s/%s" % (header.get("backend", "?"),
+                                header.get("jax", "?")),
+                     ""))
+    print("%-14s %-14s %-26s %10s %6s %-16s" %  # allow-print: CLI display surface
+          ("DIGEST", "KIND", "LABEL", "BYTES", "AGE", "BACKEND/JAX"))
+    for r in rows:
+        print("%-14s %-14s %-26s %10d %6s %-16s %s" % r)  # allow-print: CLI display surface
+    manifests = list(_manifest.list_manifests(d))
+    print("-- %d artifact(s), %d bad, %.1f KiB total, %d manifest(s) in %s"  # allow-print: CLI display surface
+          % (len(rows), bad, total / 1024.0, len(manifests), d))
+    for doc in manifests:
+        print("   manifest %s  model=%s/%s  %d entries" %  # allow-print: CLI display surface
+              (doc.get("manifest"), doc.get("model"), doc.get("version"),
+               len(doc.get("entries", []))))
+    return 0
+
+
+def cmd_inspect(args):
+    d = _resolve_dir(args)
+    for path, header in _persist.scan(d):
+        if header is not None and \
+                header.get("digest", "").startswith(args.digest):
+            doc = dict(header)
+            doc["path"] = path
+            doc["bytes"] = os.path.getsize(path)
+            json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+            sys.stdout.write("\n")
+            return 0
+    sys.stderr.write("compile-cache: no artifact matching %r\n" % args.digest)
+    return 1
+
+
+def cmd_prune(args):
+    d = _resolve_dir(args)
+    removed = _persist.prune(
+        d,
+        older_than_s=args.older_than,
+        bad_only=args.bad,
+        jax_mismatch=args.jax_mismatch,
+    )
+    for path in removed:
+        print("pruned %s" % path)  # allow-print: CLI display surface
+    print("-- pruned %d artifact(s)" % len(removed))  # allow-print: CLI display surface
+    return 0
+
+
+def cmd_verify(args):
+    d = _resolve_dir(args)
+    ok = bad = 0
+    for path, header in _persist.scan(d):
+        # full-payload read: crc + length verified by the loader contract
+        full, payload = _persist._read(path, want_payload=True)
+        if header is None or full is None or payload is None:
+            bad += 1
+            print("BAD  %s" % path)  # allow-print: CLI display surface
+        else:
+            ok += 1
+    print("-- %d ok, %d bad" % (ok, bad))  # allow-print: CLI display surface
+    return 1 if bad else 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.compile",
+        description="persistent compile-cache maintenance "
+                    "(docs/compile_cache.md)")
+    parser.add_argument("--dir", default=None,
+                        help="cache directory (default: MXTPU_COMPILE_CACHE)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list", help="table of artifacts + manifests")
+    p_inspect = sub.add_parser("inspect", help="full header of one artifact")
+    p_inspect.add_argument("digest", help="digest prefix")
+    p_prune = sub.add_parser("prune", help="delete artifacts")
+    group = p_prune.add_mutually_exclusive_group()
+    group.add_argument("--all", action="store_true",
+                       help="everything (the default)")
+    group.add_argument("--bad", action="store_true",
+                       help="only unreadable/corrupt artifacts")
+    group.add_argument("--jax-mismatch", action="store_true",
+                       help="only artifacts from another jax/backend")
+    group.add_argument("--older-than", type=float, default=None,
+                       metavar="SECONDS")
+    sub.add_parser("verify", help="crc-check every artifact payload")
+    args = parser.parse_args(argv)
+    return {"list": cmd_list, "inspect": cmd_inspect, "prune": cmd_prune,
+            "verify": cmd_verify}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Executable cache keys: one schema for every compiled-artifact factory.
+
+The reference framework's dependency engine amortized kernel setup behind
+one shared execution layer (PAPER.md layer 1); this rebuild had grown five
+independent signature-keyed executable caches (per-op jit, autograd
+backward, Executor builds, gluon CachedOp, serving's per-bucket
+predictors). `ExecutableKey` is the one key those factories now share:
+
+    (kind, graph/op fingerprint) x input shapes x dtypes x static attrs
+    x sharding x donation
+
+Keys are immutable, hashable (the in-memory table key) and canonically
+JSON-able; the persistent tier names its artifact files by
+``digest(backend=..., jax_version=...)`` — a sha256 over the canonical
+JSON plus the jax version and XLA backend, so an upgraded jax or a
+different platform can never resurrect a stale executable.
+
+``tags`` carry invalidation labels (e.g. ``custom-op:<op_type>``): the
+registry drops every entry carrying a tag when that tag is invalidated
+(the custom-op re-registration path, operator.py).
+
+``no_persist`` marks executables that embed process-local state — today,
+anything staging a `jax.pure_callback` into the program (custom ops, host
+ops): the serialized executable would carry a dangling host-callback
+reference into the next process. Those keys live in the memory tier only.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+__all__ = ["ExecutableKey"]
+
+
+def _freeze(v):
+    """Canonicalize a key component: lists/tuples -> tuples, dicts ->
+    sorted (k, v) tuples, JSON primitives kept, anything else -> repr."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((str(k), _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)
+
+
+def _jsonable(v):
+    """The canonical-JSON rendering of a frozen component (tuples become
+    lists; bools stay bools)."""
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+class ExecutableKey:
+    """One executable's identity across the memory and persistent tiers."""
+
+    __slots__ = ("kind", "fingerprint", "shapes", "static", "sharded",
+                 "donation", "tags", "no_persist", "_hash")
+
+    def __init__(self, kind, fingerprint, shapes=None, static=(),
+                 sharded=False, donation=(), tags=(), no_persist=False):
+        self.kind = str(kind)
+        self.fingerprint = str(fingerprint)
+        self.shapes = _freeze(shapes) if shapes is not None else None
+        self.static = _freeze(static)
+        self.sharded = bool(sharded)
+        self.donation = _freeze(tuple(donation))
+        self.tags = tuple(str(t) for t in tags)
+        self.no_persist = bool(no_persist)
+        self._hash = hash((self.kind, self.fingerprint, self.shapes,
+                           self.static, self.sharded, self.donation))
+
+    # -- identity ----------------------------------------------------------
+    def _ident(self):
+        return (self.kind, self.fingerprint, self.shapes, self.static,
+                self.sharded, self.donation)
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return isinstance(other, ExecutableKey) and \
+            self._ident() == other._ident()
+
+    def __repr__(self):
+        return "ExecutableKey(kind=%r, fingerprint=%r, shapes=%r)" % (
+            self.kind, self.fingerprint, self.shapes)
+
+    @property
+    def concrete(self):
+        """Shapes are pinned: the key names ONE executable (eligible for
+        AOT compile + the persistent tier). Lazy keys (shapes None) hold a
+        per-shape wrapper instead."""
+        return self.shapes is not None
+
+    def with_static_extra(self, extra):
+        """A derived key with ``extra`` joined onto the static component
+        (autograd's has_rng/x64 axes on top of the shared op key)."""
+        return ExecutableKey(self.kind, self.fingerprint, shapes=self.shapes,
+                            static=(self.static, _freeze(extra)),
+                            sharded=self.sharded, donation=self.donation,
+                            tags=self.tags, no_persist=self.no_persist)
+
+    def with_shapes(self, shapes):
+        """The concrete per-shape key derived from a lazy base key (the
+        eager-op / autograd per-shape persistence path)."""
+        return ExecutableKey(self.kind, self.fingerprint, shapes=shapes,
+                            static=self.static, sharded=self.sharded,
+                            donation=self.donation, tags=self.tags,
+                            no_persist=self.no_persist)
+
+    # -- persistence -------------------------------------------------------
+    def to_json(self):
+        """Canonical JSON-able rendering (stable across processes — the
+        digest input and the artifact-header record)."""
+        return {
+            "kind": self.kind,
+            "fingerprint": self.fingerprint,
+            "shapes": _jsonable(self.shapes),
+            "static": _jsonable(self.static),
+            "sharded": self.sharded,
+            "donation": _jsonable(self.donation),
+        }
+
+    def digest(self, backend, jax_version):
+        """Artifact name in the persistent tier: sha256 over the canonical
+        key JSON + backend + jax version (version/platform mismatches
+        resolve to different files, never to a wrong load)."""
+        blob = json.dumps({"key": self.to_json(), "backend": str(backend),
+                           "jax": str(jax_version)},
+                          sort_keys=True, separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:40]

@@ -1,0 +1,125 @@
+"""Warmup manifests: a served model's executable key-set, on disk.
+
+When a model is published (`ModelRepository.add` after warm) with the
+persistent tier armed, the repository records which compile-cache
+artifacts the warm filled or loaded — one JSON manifest per model under
+``<cache>/manifests/``. A freshly spawned replica worker reads its
+artifact's manifest BEFORE accepting traffic and prefetches every listed
+executable into the registry's staging table, so the warm pass (and the
+first real request on any bucket) deserializes instead of compiling:
+cold start with a warm cache reaches ready with zero ``jit_compile``
+events.
+
+Manifests are keyed by a stable *artifact id* (sha256 of the resolved
+artifact path + the serving geometry), so the worker — which knows only
+its ``--artifact`` argv — finds the same manifest the repository wrote.
+Writes are atomic-rename (`base.atomic_writer`); a missing/corrupt
+manifest is a no-op, never fatal.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+from ..base import atomic_writer
+from . import persist as _persist
+
+__all__ = ["model_manifest_id", "manifest_path", "write_manifest",
+           "read_manifest", "prefetch", "list_manifests"]
+
+
+def model_manifest_id(artifact_path, max_batch=None, input_shapes=None):
+    """Stable id tying a serving artifact + geometry to its manifest.
+    Path is resolved absolute so repository and replica worker agree."""
+    blob = json.dumps({
+        "path": os.path.abspath(os.fspath(artifact_path)),
+        "max_batch": max_batch,
+        "input_shapes": {str(k): list(v)
+                         for k, v in sorted((input_shapes or {}).items())},
+    }, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+
+def manifest_path(directory, manifest_id):
+    return os.path.join(directory, "manifests", manifest_id + ".json")
+
+
+def write_manifest(directory, manifest_id, entries, model=None,
+                   version=None):
+    """Record a model's key-set: ``entries`` is the registry's
+    ``keys_since`` result — (ExecutableKey, digest) pairs. Returns the
+    manifest path, or None when there is nothing to record."""
+    digests = []
+    seen = set()
+    for key, digest in entries:
+        if digest in seen:
+            continue
+        seen.add(digest)
+        digests.append({"digest": digest, "kind": key.kind,
+                        "fingerprint": key.fingerprint[:40]})
+    if not digests:
+        return None
+    path = manifest_path(directory, manifest_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = {
+        "format": 1,
+        "manifest": manifest_id,
+        "model": model,
+        "version": version,
+        "created": time.time(),
+        "entries": digests,
+    }
+    try:
+        with atomic_writer(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    except OSError:
+        return None
+    return path
+
+
+def read_manifest(directory, manifest_id):
+    """The manifest document, or None (missing/corrupt are misses)."""
+    try:
+        with open(manifest_path(directory, manifest_id)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if doc.get("format") != 1 or not isinstance(doc.get("entries"), list):
+        return None
+    return doc
+
+
+def prefetch(manifest_id, directory=None, registry=None):
+    """Load every artifact a manifest names into the registry staging
+    table (replica pre-traffic warm). Returns how many executables
+    loaded (0 when the tier is off or the manifest is absent)."""
+    directory = directory or _persist.cache_dir()
+    if directory is None:
+        return 0
+    doc = read_manifest(directory, manifest_id)
+    if doc is None:
+        return 0
+    if registry is None:
+        from .registry import registry as _singleton
+
+        registry = _singleton()
+    paths = [_persist.artifact_path(directory, e.get("digest", ""))
+             for e in doc["entries"] if e.get("digest")]
+    return registry.prefetch_paths(paths)
+
+
+def list_manifests(directory):
+    """Yield every readable manifest document under the cache dir."""
+    mdir = os.path.join(directory, "manifests")
+    try:
+        names = sorted(os.listdir(mdir))
+    except OSError:
+        return
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        doc = read_manifest(directory, name[:-len(".json")])
+        if doc is not None:
+            yield doc

@@ -142,13 +142,31 @@ _var("MXTPU_NO_NATIVE", "bool", False,
      "Disable the native C++ runtime (recordio/prefetch/buffer pool); "
      "pure-Python fallbacks are used.")
 _var("MXTPU_COMPILE_CACHE", "str", None,
-     "Opt-in persistent XLA compilation cache (`base.enable_persistent_"
-     "compile_cache`): a directory path, or `1` for the repo-local "
-     "`.jax_cache` default; `0`/`off`/`none` (or unset) disables. "
-     "Executables are cached keyed by HLO+backend so repeated bench/capture "
-     "runs skip recompiles; deliberately NOT default-on (XLA:CPU AOT "
-     "reloads can SIGILL across machine-feature mismatches), `bench.py` "
-     "arms it for accelerator runs.")
+     "Persistent tier of the unified executable cache "
+     "(`mxnet_tpu.compile`, docs/compile_cache.md): a directory path, or "
+     "`1` for the repo-local `.mxtpu_compile_cache` default; "
+     "`0`/`off`/`none` (or unset) disables. Compiled executables are "
+     "serialized per (key x shapes x dtypes x jax version x backend) with "
+     "crc-verified atomic-rename artifacts, so a restarted serving "
+     "replica / elastic-restart generation / repeat bench run reaches "
+     "steady state with zero recompiles. Not default-on: artifacts are "
+     "machine-scoped (XLA:CPU AOT reloads across machine-feature "
+     "mismatches risk SIGILL) and the directory must be trusted "
+     "(artifacts unpickle on load). `bench.py` arms it for accelerator "
+     "runs; manage with `python -m mxnet_tpu.compile`.")
+_var("MXTPU_COMPILE_CACHE_ENTRIES", "int", 4096,
+     "Capacity of the unified executable cache's in-memory LRU table "
+     "(`mxnet_tpu.compile.registry`): oldest-touched executables are "
+     "evicted past this many entries "
+     "(`mxtpu_compile_cache_evict_total`).")
+_var("MXTPU_JAX_COMPILE_CACHE", "str", None,
+     "Optional extra knob: arm jax's OWN persistent compilation cache "
+     "(`jax_compilation_cache_dir`, keyed by HLO+backend) at the given "
+     "directory, `1` for the repo-local `.jax_cache` default "
+     "(`base.enable_persistent_compile_cache`). Independent of — and "
+     "composable with — the `MXTPU_COMPILE_CACHE` executable-artifact "
+     "tier: jax's cache skips XLA backend compilation but still pays "
+     "trace+lower per process; the artifact tier skips everything.")
 _var("MXTPU_PY_RECORDIO", "bool", False,
      "Force the Python recordio reader/writer even when the native library "
      "is built (used by rec2idx for `tell()` positions).")
@@ -476,8 +494,9 @@ _var("MXTPU_TRACE_CONTEXT", "str", None,
      "process.")
 _var("MXTPU_TRACE_FLOPS", "bool", True,
      "automatic FLOP accounting: derive per-executable FLOPs from JAX's "
-     "lowered-HLO cost analysis at jit-cache-fill time (`ops._jitted`, "
-     "autograd `_bwd_jitted`, Executor builds, serving bucket warm) and "
+     "lowered-HLO cost analysis at the unified executable registry's "
+     "fill hook (`mxnet_tpu.compile` — eager ops, autograd backward, "
+     "Executor builds, CachedOp, serving bucket warm) and "
      "accumulate executed FLOPs so `observe_step` publishes MFU with no "
      "manual `set_step_flops`. `0` disables the accounting (and the "
      "per-shape lowering it pays on each cache fill).")

@@ -49,8 +49,7 @@ class Executor:
 
         self.outputs = []
         self._monitor_callback = None
-        self._fwd_cache = {}
-        self._bwd_cache = {}
+        self._graph_meta_cache = None  # (content fingerprint, no_persist)
         self._last = None
 
     # -- construction helpers ---------------------------------------------
@@ -132,6 +131,57 @@ class Executor:
             if a is not None:
                 self.arg_arrays[i]._data = jax.device_put(a._data, sh)
 
+    # -- the unified executable cache --------------------------------------
+    def _graph_meta(self):
+        """(content fingerprint, no_persist) of the bound graph — the
+        stable half of the `mxnet_tpu.compile` key, so two Executors over
+        the same exported graph (serving's per-bucket predictor clones, a
+        restarted replica) share ONE executable per signature, in memory
+        and across processes via the persistent tier. ``no_persist`` marks
+        graphs staging host callbacks (Custom/host ops): their serialized
+        executables would carry dangling process-local references."""
+        if self._graph_meta_cache is None:
+            import hashlib
+            import json as _json
+
+            from . import ops as _ops_mod
+
+            js = self._symbol.tojson()
+            fingerprint = hashlib.sha256(js.encode()).hexdigest()[:40]
+            no_persist = False
+            try:
+                for node in _json.loads(js).get("nodes", []):
+                    opname = node.get("op")
+                    if opname in (None, "null"):
+                        continue
+                    opdef = _ops_mod._REGISTRY.get(opname)
+                    if opname == "Custom" or (opdef is not None
+                                              and opdef.host):
+                        no_persist = True
+                        break
+            except Exception:  # unparseable graph json: cache in memory only
+                no_persist = True
+            self._graph_meta_cache = (fingerprint, no_persist)
+        return self._graph_meta_cache
+
+    def _mesh_desc(self):
+        if self._mesh is None:
+            return None
+        return (tuple(str(a) for a in self._mesh.axis_names),
+                tuple(int(d) for d in self._mesh.devices.shape))
+
+    def _cache_key(self, kind, sig, static):
+        from . import compile as _compile
+
+        fingerprint, no_persist = self._graph_meta()
+        aux_sig = tuple(tuple(a.shape) + (str(a.dtype),)
+                        for a in self.aux_arrays)
+        return _compile.ExecutableKey(
+            kind, fingerprint, shapes=(sig[0], aux_sig),
+            static=static + (self._mesh_desc(),
+                             tuple(sorted(self._data_arg_names))),
+            sharded=self._mesh is not None, no_persist=no_persist)
+
     # -- execution ---------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
         """reference: executor.py forward / GraphExecutor::Forward."""
@@ -151,20 +201,20 @@ class Executor:
 
         sig = (tuple(tuple(a.shape) + (str(a.dtype),) for a in self.arg_arrays),
                bool(is_train))
-        fn = self._fwd_cache.get(sig)
-        if fn is None:
-            from .telemetry import core as _tm_core
-            from .telemetry import recorder as _tm_rec
-
-            _tm_core.counter("mxtpu_executor_build_total",
-                             {"what": "forward"}).inc()
-            _tm_rec.record_event("jit_compile", op="executor_forward",
-                                 is_train=bool(is_train))
-            fn = self._build_forward(bool(is_train))
-            self._fwd_cache[sig] = fn
         key = _random.next_key()
         arg_arrays = tuple(a._data for a in self.arg_arrays)
         aux_arrays = tuple(a._data for a in self.aux_arrays)
+        from . import compile as _compile
+        from .telemetry import core as _tm_core
+
+        fn = _compile.get_or_build(
+            self._cache_key("executor_fwd", sig, (bool(is_train),)),
+            lambda: self._build_forward(bool(is_train)),
+            label="executor_forward",
+            example_args=(key, arg_arrays, aux_arrays),
+            on_fill=lambda: _tm_core.counter(
+                "mxtpu_executor_build_total", {"what": "forward"}).inc(),
+            event_fields={"is_train": bool(is_train)})
         from . import profiler as _profiler
 
         outs, new_aux = _profiler.timed_call(
@@ -192,14 +242,13 @@ class Executor:
             new_aux = tuple(aux_up.get(n, values[n]) for n in aux_names)
             return tuple(outs), new_aux
 
-        from .telemetry import flops as _tm_flops
-
+        # FLOP accounting + persistence happen at the registry fill hook
+        # (mxnet_tpu.compile.registry), not here
         if self._mesh is None:
-            return _tm_flops.instrument(jax.jit(run))
+            return jax.jit(run)
         repl, arg_sh = self._shardings()
-        return _tm_flops.instrument(
-            jax.jit(run, in_shardings=(repl, tuple(arg_sh),
-                                       tuple(repl for _ in aux_names))))
+        return jax.jit(run, in_shardings=(repl, tuple(arg_sh),
+                                          tuple(repl for _ in aux_names)))
 
     def backward(self, out_grads=None, is_train=True):
         """Gradients via jax.vjp of the graph (reference:
@@ -216,17 +265,6 @@ class Executor:
                if self.grad_req.get(n, "null") != "null"]
         if not wrt:
             return
-        fn = self._bwd_cache.get(sig)
-        if fn is None:
-            from .telemetry import core as _tm_core
-            from .telemetry import recorder as _tm_rec
-
-            _tm_core.counter("mxtpu_executor_build_total",
-                             {"what": "backward"}).inc()
-            _tm_rec.record_event("jit_compile", op="executor_backward")
-            fn = self._build_backward(sig[1], wrt)
-            self._bwd_cache[sig] = fn
-
         if out_grads is None:
             cots = tuple(jnp.ones(tuple(o.shape), o.dtype) for o in self.outputs)
         else:
@@ -234,6 +272,17 @@ class Executor:
                 out_grads = [out_grads]
             cots = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
                          for g in out_grads)
+        from . import compile as _compile
+        from .telemetry import core as _tm_core
+
+        fn = _compile.get_or_build(
+            self._cache_key("executor_bwd", sig,
+                            (bool(sig[1]), tuple(wrt))),
+            lambda: self._build_backward(sig[1], wrt),
+            label="executor_backward",
+            example_args=(key, arg_arrays, aux_arrays, cots),
+            on_fill=lambda: _tm_core.counter(
+                "mxtpu_executor_build_total", {"what": "backward"}).inc())
         from . import profiler as _profiler
 
         grads = _profiler.timed_call(
@@ -271,9 +320,7 @@ class Executor:
             _, pull = jax.vjp(pure, tuple(arg_arrays[i] for i in wrt))
             return pull(tuple(cots))[0]
 
-        from .telemetry import flops as _tm_flops
-
-        return _tm_flops.instrument(jax.jit(bwd))
+        return jax.jit(bwd)
 
     # -- misc API parity ---------------------------------------------------
     def set_monitor_callback(self, callback, monitor_all=False):

@@ -374,7 +374,7 @@ class HybridBlock(Block):
                      for a in args), is_train)
         entry = self._cached.get(sig)
         if entry is None:
-            entry = self._build_cache(args, param_nds, is_train)
+            entry = self._build_cache(args, param_nds, is_train, sig)
             self._cached[sig] = entry
 
         key = _random.next_key()
@@ -395,7 +395,23 @@ class HybridBlock(Block):
             return out_nds[0]
         return out_nds
 
-    def _build_cache(self, args, param_nds, is_train):
+    def _cached_key(self, kind, sig):
+        """`mxnet_tpu.compile` key for this block instance's CachedOp
+        executables. The fingerprint is a process-local instance token
+        (a live block's graph has no stable content identity — params and
+        sub-block structure are python state), so entries are memory-tier
+        only (``no_persist``); the hybridized hot path still gets the
+        registry's counters, fill spans, FLOP hook and LRU accounting."""
+        from .. import compile as _compile
+
+        if not hasattr(self, "_compile_token"):
+            self._compile_token = _compile.instance_token(
+                type(self).__name__)
+        return _compile.ExecutableKey(kind, self._compile_token,
+                                      shapes=sig[0], static=(sig[1],),
+                                      no_persist=True)
+
+    def _build_cache(self, args, param_nds, is_train, sig):
         """Trace the whole block into one jitted executable
         (reference: block.py:748 _build_cache -> CachedOp)."""
         import jax
@@ -450,11 +466,15 @@ class HybridBlock(Block):
                 _TRACING.flag = False
                 _random.pop_trace_key(prev_key)
 
-        from ..telemetry import flops as _tm_flops
+        from .. import compile as _compile
 
-        # automatic FLOP accounting: the hybridized forward/backward are
-        # the gluon hot path's executables (telemetry/flops.py)
-        entry.jitted = _tm_flops.instrument(jax.jit(traced))
+        # the hybridized forward/backward resolve through the unified
+        # executable registry: FLOP accounting, jit_compile events and
+        # LRU accounting ride the fill hook (mxnet_tpu.compile.registry)
+        label = "cachedop:%s" % type(self).__name__
+        entry.jitted = _compile.get_or_build(
+            self._cached_key("cachedop_fwd", sig),
+            lambda: jax.jit(traced), label=label)
 
         def bwd(key, arg_arrays, param_arrays, out_cots):
             def pure(a, p):
@@ -464,7 +484,9 @@ class HybridBlock(Block):
             _, pull = jax.vjp(pure, arg_arrays, param_arrays)
             return pull(tuple(out_cots))
 
-        entry.bwd = _tm_flops.instrument(jax.jit(bwd))
+        entry.bwd = _compile.get_or_build(
+            self._cached_key("cachedop_bwd", sig),
+            lambda: jax.jit(bwd), label="%s:bwd" % label)
         return entry
 
     def _record_cached(self, entry, key, arg_nds, param_nds, arg_arrays,

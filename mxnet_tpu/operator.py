@@ -18,8 +18,6 @@ program).
 """
 from __future__ import annotations
 
-import functools
-
 import numpy as _np
 
 from .base import MXNetError
@@ -94,15 +92,17 @@ def register(reg_name):
         if not issubclass(prop_cls, CustomOpProp):
             raise MXNetError("register expects a CustomOpProp subclass")
         _CUSTOM_PROPS[reg_name] = prop_cls
-        # drop every compiled trace that may close over a previous
-        # registration of this op_type (re-registering is the notebook
-        # cell-rerun workflow the reference supports)
-        _make_custom_fn.cache_clear()
-        from . import autograd as _autograd
-        from . import ops as _ops_mod
+        # drop every compiled trace that closes over a previous
+        # registration of THIS op_type (re-registering is the notebook
+        # cell-rerun workflow the reference supports): the custom_vjp
+        # bridge functions per name, and — through the unified registry's
+        # tag invalidation — the forward/backward executables keyed with
+        # the `custom-op:<op_type>` tag. Other ops' warm executables stay
+        # cached (the old blanket cache_clear threw them ALL away).
+        _CUSTOM_FNS.pop(reg_name, None)
+        from . import compile as _compile
 
-        _ops_mod._jitted.cache_clear()
-        _autograd._bwd_jitted.cache_clear()
+        _compile.invalidate_tag("custom-op:%s" % reg_name)
         return prop_cls
 
     return deco
@@ -174,9 +174,25 @@ def _run_forward(prop, np_ins, is_train):
     return in_nd, out_nd, out_types, op
 
 
-@functools.lru_cache(maxsize=512)
+# op_type -> {(attr_key, is_train): (custom_vjp fn, n_out)} — keyed by
+# name FIRST so re-registration invalidates exactly one op_type's
+# bridges (the lru_cache this replaced could only be cleared wholesale)
+_CUSTOM_FNS = {}
+
+
 def _make_custom_fn(op_type, attr_key, is_train):
-    """Build the custom_vjp jax function for (op_type, attrs, is_train)."""
+    """Build (or fetch) the custom_vjp jax function for
+    (op_type, attrs, is_train)."""
+    by_sig = _CUSTOM_FNS.setdefault(op_type, {})
+    hit = by_sig.get((attr_key, is_train))
+    if hit is not None:
+        return hit
+    fn_out = _build_custom_fn(op_type, attr_key, is_train)
+    by_sig[(attr_key, is_train)] = fn_out
+    return fn_out
+
+
+def _build_custom_fn(op_type, attr_key, is_train):
     import jax
 
     prop = _make_prop(op_type, attr_key)

@@ -16,13 +16,9 @@ equivalent of dmlc::Parameter op schemas (SURVEY §5.6 tier 3).
 from __future__ import annotations
 
 import dataclasses
-import functools
 import typing as _t
 
 from ..base import MXNetError
-from ..telemetry import core as _telemetry
-from ..telemetry import flops as _flops
-from ..telemetry import recorder as _recorder
 
 __all__ = ["OpDef", "register", "get", "list_ops", "invoke_jax"]
 
@@ -93,46 +89,53 @@ def _hashable(v):
     return v
 
 
-# jit executable-cache telemetry: a lookup lands here per eager dispatch;
-# the lru_cache body below runs only on a miss, so
-# hits = mxtpu_jit_cache_lookup_total - mxtpu_jit_cache_miss_total.
-# Resolved lazily so a process that starts MXTPU_TELEMETRY=0 and calls
-# set_enabled(True) later records real counts (never cache the null)
-_TM_JIT = {}
+def op_key(name, attr_key, kind="op"):
+    """The unified-cache key for a per-(op, attrs) executable
+    (`mxnet_tpu.compile`, shared with autograd's ``op_bwd`` kind). Custom
+    ops carry a ``custom-op:<op_type>`` invalidation tag — re-registering
+    the op_type drops every executable that closed over the old callbacks
+    (operator.py) — and never persist (the serialized executable would
+    embed a process-local `pure_callback` reference); host ops likewise
+    stay in-process."""
+    from .. import compile as _compile
+
+    op = _REGISTRY.get(name)
+    tags = ()
+    no_persist = bool(op is not None and op.host)
+    if name == "Custom":
+        op_type = dict(attr_key).get("op_type")
+        tags = ("custom-op:%s" % (op_type,),)
+        no_persist = True
+    return _compile.ExecutableKey(kind, name, static=attr_key, tags=tags,
+                                  no_persist=no_persist)
 
 
-def _jit_counter(name):
-    c = _TM_JIT.get(name)
-    if c is None:
-        if not _telemetry._STATE.enabled:
-            return _telemetry._NULL
-        c = _telemetry.counter(name)
-        _TM_JIT[name] = c
-    return c
-
-
-@functools.lru_cache(maxsize=8192)
 def _jitted(name, attr_key):
-    op = _REGISTRY[name]
-    kwargs = dict(attr_key)
-    import jax
+    """Resolve the per-(op, attrs) executable through the unified
+    registry (`mxnet_tpu.compile`): telemetry lookup/miss counters,
+    ``jit_compile`` events, FLOP accounting and the optional persistent
+    tier all ride the registry's fill hook — hits =
+    mxtpu_jit_cache_lookup_total - mxtpu_jit_cache_miss_total."""
+    from .. import compile as _compile
 
-    _jit_counter("mxtpu_jit_cache_miss_total").inc()
-    _recorder.record_event("jit_compile", op=name)
+    def build():
+        op = _REGISTRY[name]
+        kwargs = dict(attr_key)
+        import jax
 
-    def call(*arrays):
-        return op.fn(*arrays, **kwargs)
+        def call(*arrays):
+            return op.fn(*arrays, **kwargs)
 
-    # automatic FLOP accounting: each execution of this executable feeds
-    # the per-step accumulator (per-shape cost analysis at cache fill —
-    # telemetry/flops.py); returns jax.jit(call) unchanged when disabled
-    return _flops.instrument(jax.jit(call))
+        return jax.jit(call)
+
+    return _compile.get_or_build(op_key(name, attr_key), build, label=name)
 
 
 def invoke_jax(name, arrays, attrs):
-    """Run op `name` on raw jax arrays. Uses a per-(op, attrs) compiled-
-    executable cache — the analogue of the reference's per-op kernel dispatch,
-    with XLA doing codegen + autotuning instead of mshadow/cuDNN.
+    """Run op `name` on raw jax arrays. Uses the unified per-(op, attrs)
+    compiled-executable cache — the analogue of the reference's per-op
+    kernel dispatch, with XLA doing codegen + autotuning instead of
+    mshadow/cuDNN.
 
     When any input is a tracer (we are inside an outer jit trace — CachedOp,
     Symbol executor, vjp), the op function is inlined instead of nested-jitted:
@@ -148,7 +151,6 @@ def invoke_jax(name, arrays, attrs):
     if any(isinstance(a, jax.core.Tracer) for a in arrays):
         return op.fn(*arrays, **dict(attrs))
     attr_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
-    _jit_counter("mxtpu_jit_cache_lookup_total").inc()
     return _jitted(name, attr_key)(*arrays)
 
 

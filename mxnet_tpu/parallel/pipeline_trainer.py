@@ -210,8 +210,11 @@ class PipelineTrainer:
             self._state_shardings.append(_tree_map(lambda s: sh, st))
 
         self._step_count = 0
-        self._compiled = {}
-        self._fwd_compiled = {}
+        # executables resolve through mxnet_tpu.compile, keyed by this
+        # process-local token x batch signature (memory tier only)
+        from .. import compile as _compile
+
+        self._compile_token = _compile.instance_token("PipelineTrainer")
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -437,10 +440,14 @@ class PipelineTrainer:
         arrs = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
                 for a in batch]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
-        fn = self._compiled.get(sig)
-        if fn is None:
-            fn = self._build_step([a.shape for a in arrs])
-            self._compiled[sig] = fn
+        from .. import compile as _compile
+
+        fn = _compile.get_or_build(
+            _compile.ExecutableKey("pipeline_step", self._compile_token,
+                                   shapes=sig, sharded=True,
+                                   donation=(3, 4, 5), no_persist=True),
+            lambda: self._build_step([a.shape for a in arrs]),
+            label="pipeline_trainer_step")
 
         import jax
 
@@ -476,8 +483,8 @@ class PipelineTrainer:
         arrs = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
                 for a in batch]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrs) + (is_train,)
-        fn = self._fwd_compiled.get(sig)
-        if fn is None:
+
+        def build():
             def fwd(key, outer_arrays, cell_leaves, *data):
                 pred, _ = self._run_model(data, list(outer_arrays),
                                           list(cell_leaves), key, is_train)
@@ -486,10 +493,17 @@ class PipelineTrainer:
             data_sh = [named_sharding(self._mesh,
                                       batch_spec(self._mesh, a.ndim))
                        for a in arrs]
-            fn = jax.jit(fwd, in_shardings=(
+            return jax.jit(fwd, in_shardings=(
                 self._repl, [self._repl] * len(self._outer_arrays),
                 [self._pp_sharding] * len(self._cell_leaves), *data_sh))
-            self._fwd_compiled[sig] = fn
+
+        from .. import compile as _compile
+
+        fn = _compile.get_or_build(
+            _compile.ExecutableKey("pipeline_forward", self._compile_token,
+                                   shapes=sig, sharded=True,
+                                   no_persist=True),
+            build, label="pipeline_trainer_forward")
         key = _random.next_key()
         arrs = [jax.device_put(a, named_sharding(
             self._mesh, batch_spec(self._mesh, a.ndim))) for a in arrs]

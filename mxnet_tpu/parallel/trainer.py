@@ -162,7 +162,12 @@ class DistributedTrainer:
             self._state_shardings.append(_tree_map(lambda s: sh, st))
 
         self._step_count = 0
-        self._compiled = {}
+        # executables resolve through mxnet_tpu.compile (keyed by this
+        # process-local token x batch signature); the local dict only
+        # carries forward's trace-time aux ordering metadata
+        from .. import compile as _compile
+
+        self._compile_token = _compile.instance_token("DistributedTrainer")
         self._fwd_compiled = {}
 
     # ------------------------------------------------------------------
@@ -295,19 +300,17 @@ class DistributedTrainer:
                    for s in batch_shapes]
         repl = named_sharding(self._mesh, PartitionSpec())
         out_shardings = (repl, list(self._shardings), list(self._state_shardings))
-        jitted = jax.jit(
+        # NOTE: donated buffers make a post-hoc lower() on live args
+        # unsafe-looking but fine — lower() only traces avals, it never
+        # executes or donates; cost analysis (now at the registry fill
+        # hook, mxnet_tpu.compile.registry) happens on abstract values
+        return jax.jit(
             step,
             in_shardings=(repl, repl, repl, list(self._shardings),
                           list(self._state_shardings), *data_sh),
             out_shardings=out_shardings,
             donate_argnums=(3, 4),
         )
-        from ..telemetry import flops as _tm_flops
-
-        # NOTE: donated buffers make a post-hoc lower() on live args
-        # unsafe-looking but fine — lower() only traces avals, it never
-        # executes or donates; cost analysis happens on abstract values
-        return _tm_flops.instrument(jitted)
 
     # ------------------------------------------------------------------
     def step(self, data, label=None, batch_size=None):
@@ -333,16 +336,18 @@ class DistributedTrainer:
         # optimizer's own value.
 
         sig = tuple((tuple(b.shape), str(b.dtype)) for b in batch)
-        fn = self._compiled.get(sig)
-        if fn is None:
-            from .. import telemetry
+        from .. import compile as _compile
+        from .. import telemetry
 
-            telemetry.counter("mxtpu_executor_build_total",
-                              {"what": "dist_step"}).inc()
-            telemetry.record_event("jit_compile", op="dist_trainer_step",
-                                   batch_sig=str(sig))
-            fn = self._build_step([b.shape for b in batch])
-            self._compiled[sig] = fn
+        fn = _compile.get_or_build(
+            _compile.ExecutableKey("dist_step", self._compile_token,
+                                   shapes=sig, sharded=True,
+                                   donation=(3, 4), no_persist=True),
+            lambda: self._build_step([b.shape for b in batch]),
+            label="dist_trainer_step",
+            on_fill=lambda: telemetry.counter(
+                "mxtpu_executor_build_total", {"what": "dist_step"}).inc(),
+            event_fields={"batch_sig": str(sig)})
 
         batch = [self._shard_batch(b) for b in batch]
         # host-side schedule: the real step count advances here (only after
@@ -400,20 +405,29 @@ class DistributedTrainer:
             aux_order = []   # aux indices whose updates the trace emits
                              # (filled at trace time; stable thereafter)
 
-            def fwd(key, arrays, batch):
-                out, aux_up = self._trace_forward((batch,), arrays, key,
-                                                  is_train)
-                pred = out[0] if isinstance(out, (list, tuple)) else out
-                aux_order.clear()
-                aux_order.extend(sorted(aux_up))
-                return pred._data, [aux_up[i] for i in aux_order]
+            def build():
+                def fwd(key, arrays, batch):
+                    out, aux_up = self._trace_forward((batch,), arrays, key,
+                                                      is_train)
+                    pred = out[0] if isinstance(out, (list, tuple)) else out
+                    aux_order.clear()
+                    aux_order.extend(sorted(aux_up))
+                    return pred._data, [aux_up[i] for i in aux_order]
 
-            from jax.sharding import PartitionSpec
+                from jax.sharding import PartitionSpec
 
-            fn = jax.jit(fwd, in_shardings=(
-                named_sharding(self._mesh, PartitionSpec()),
-                list(self._shardings),
-                named_sharding(self._mesh, batch_spec(self._mesh, x.ndim))))
+                return jax.jit(fwd, in_shardings=(
+                    named_sharding(self._mesh, PartitionSpec()),
+                    list(self._shardings),
+                    named_sharding(self._mesh, batch_spec(self._mesh, x.ndim))))
+
+            from .. import compile as _compile
+
+            fn = _compile.get_or_build(
+                _compile.ExecutableKey("dist_forward", self._compile_token,
+                                       shapes=sig, sharded=True,
+                                       no_persist=True),
+                build, label="dist_trainer_forward")
             entry = (fn, aux_order)
             self._fwd_compiled[sig] = entry
         fn, aux_order = entry

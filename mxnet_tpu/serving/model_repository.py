@@ -31,6 +31,7 @@ import time
 
 import numpy as _np
 
+from .. import compile as _compile
 from .. import env as _env
 from .. import telemetry
 from ..base import MXNetError
@@ -38,6 +39,16 @@ from .batcher import (DynamicBatcher, ModelUnavailableError,
                       drain_timeout_s, power_of_two_buckets)
 
 __all__ = ["ServedModel", "ModelRepository", "build_runner"]
+
+
+def _resolved_max_batch(max_batch):
+    """The max_batch that actually shapes the bucket set (env default
+    applied) — warmup-manifest ids key on THIS value on both the
+    repository and replica-worker sides, so a geometry change cleanly
+    partitions manifests (docs/compile_cache.md)."""
+    if max_batch is not None:
+        return int(max_batch)
+    return _env.get("MXTPU_SERVE_MAX_BATCH")
 
 
 class ServedModel:
@@ -62,6 +73,9 @@ class ServedModel:
         self.loaded_at = time.time()
         self.warmed = False
         self.warm_seconds = None
+        self.manifest_id = None     # warmup-manifest id (artifact models)
+        self.compile_digests = []   # executable-cache digests the warm
+        #                             filled/loaded (docs/compile_cache.md)
         self.bucket_flops = {}  # bucket -> FLOPs per batch (warm-time
         #                         cost analysis; {} when unavailable)
         self._runner = runner
@@ -131,6 +145,12 @@ class ServedModel:
         model.warm_seconds = info.get("warm_seconds")
         if info.get("bucket_flops"):
             model.set_bucket_flops(info["bucket_flops"])
+        # the replica's executable key-set (it wrote the warmup manifest
+        # worker-side, next to the artifacts it filled/loaded)
+        if path is not None:
+            model.manifest_id = _compile.model_manifest_id(
+                path, _resolved_max_batch(max_batch), input_shapes)
+        model.compile_digests = sorted(info.get("compile_digests") or [])
         return model
 
     @staticmethod
@@ -142,15 +162,21 @@ class ServedModel:
         shapes, batch dim EXCLUDED)."""
         kind, parts = _resolve_artifact(path)
         if kind == "compiled":
-            return ServedModel._from_compiled(
+            model = ServedModel._from_compiled(
                 name, version, parts, max_delay_ms=max_delay_ms,
                 queue_depth=queue_depth)
-        symbol_file, param_file = parts
-        return ServedModel._from_symbol(
-            name, version, symbol_file, param_file,
-            input_shapes=input_shapes, input_dtypes=input_dtypes, ctx=ctx,
-            max_batch=max_batch, max_delay_ms=max_delay_ms,
-            queue_depth=queue_depth)
+        else:
+            symbol_file, param_file = parts
+            model = ServedModel._from_symbol(
+                name, version, symbol_file, param_file,
+                input_shapes=input_shapes, input_dtypes=input_dtypes,
+                ctx=ctx, max_batch=max_batch, max_delay_ms=max_delay_ms,
+                queue_depth=queue_depth)
+        # ties this artifact + geometry to its warmup manifest (the SAME
+        # id a replica worker derives from its argv — manifest.py)
+        model.manifest_id = _compile.model_manifest_id(
+            path, _resolved_max_batch(max_batch), input_shapes)
+        return model
 
     @staticmethod
     def _from_symbol(name, version, symbol_file, param_file, input_shapes,
@@ -221,6 +247,18 @@ class ServedModel:
         timeout = None if deadline is None \
             else max(0.0, deadline - time.monotonic())
         return req.wait(timeout)
+
+    def record_compile_entries(self, entries):
+        """Record the executable key-set the load+warm filled or loaded
+        from the persistent tier (``(ExecutableKey, digest)`` pairs from
+        `compile.keys_since`), and publish it as this model's warmup
+        manifest so a future cold start prefetches instead of compiling
+        (docs/compile_cache.md)."""
+        self.compile_digests = sorted({d for _, d in entries})
+        directory = _compile.cache_dir()
+        if directory and self.manifest_id and entries:
+            _compile.write_manifest(directory, self.manifest_id, entries,
+                                    model=self.name, version=self.version)
 
     def set_bucket_flops(self, bucket_flops):
         """Publish per-bucket FLOP cost (from warm-time cost analysis) as
@@ -293,6 +331,8 @@ class ServedModel:
             "pending": self.pending(),
             "loaded_at": self.loaded_at,
             "meta": self.meta,
+            "compile": {"manifest": self.manifest_id,
+                        "digests": list(self.compile_digests)},
         }
         if self._pool is not None:
             out["pool"] = self._pool.describe()
@@ -473,6 +513,13 @@ class ModelRepository:
                     max_batch=max_batch, max_delay_ms=max_delay_ms,
                     queue_depth=queue_depth, **pool_kwargs)
             else:
+                # warmup-manifest prefetch BEFORE binding: with the
+                # persistent tier armed and a previous publish of this
+                # artifact, every executable deserializes instead of
+                # compiling (cold start, warm cache — docs/compile_cache.md)
+                _compile.prefetch(_compile.model_manifest_id(
+                    path, _resolved_max_batch(max_batch), input_shapes))
+                cursor = _compile.mark()
                 model = ServedModel.from_path(
                     name, version, path, input_shapes=input_shapes,
                     input_dtypes=input_dtypes, ctx=ctx, max_batch=max_batch,
@@ -480,6 +527,11 @@ class ModelRepository:
             try:
                 if warm:
                     model.warm()
+                if model.pool is None:
+                    model.record_compile_entries(_compile.keys_since(cursor))
+                    # drop staged prefetch entries the warm never claimed
+                    # (stale manifest rows must not stay pinned)
+                    _compile.clear_staged()
                 return self.add(model)
             except Exception:
                 model.close(drain=False, timeout=0)  # no thread/weight leak

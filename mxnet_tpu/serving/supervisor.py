@@ -320,6 +320,7 @@ def worker_main(argv=None):
         format="%(asctime)s %(levelname)s %(message)s", stream=sys.stderr)
     signal.signal(signal.SIGTERM, _on_term)
 
+    from .. import compile as _compile
     from ..parallel.resilience import maybe_inject_serving_fault
     from ..telemetry import tracing
     from .batcher import power_of_two_buckets
@@ -327,6 +328,9 @@ def worker_main(argv=None):
     max_batch = args.max_batch
     if max_batch is None:
         max_batch = _env.get("MXTPU_SERVE_MAX_BATCH")
+    manifest_id = None
+    prefetched = 0
+    compile_cursor = _compile.mark()
     if args.stub:
         runner = _build_stub_runner(args)
         example_shapes, input_dtypes = _parse_inputs(args.input)
@@ -335,6 +339,21 @@ def worker_main(argv=None):
         from .model_repository import build_runner
 
         example_shapes, input_dtypes = _parse_inputs(args.input)
+        # warmup-manifest prefetch BEFORE the artifact binds: with the
+        # persistent tier armed and a manifest from a previous publish of
+        # this artifact+geometry, every executable the warm needs
+        # deserializes up front — ready with zero jit_compile events
+        # (docs/compile_cache.md cold-start playbook). The id keys on the
+        # RESOLVED max_batch (the same resolution the bucket set uses and
+        # the repository applies), so an MXTPU_SERVE_MAX_BATCH change
+        # cleanly partitions manifests instead of reusing a stale one.
+        manifest_id = _compile.model_manifest_id(
+            args.artifact, max_batch, example_shapes or None)
+        prefetched = _compile.prefetch(manifest_id)
+        if prefetched:
+            _LOG.info("replica %d: prefetched %d cached executable(s) "
+                      "from warmup manifest %s", args.replica, prefetched,
+                      manifest_id)
         runner, buckets, example_shapes, input_dtypes, _meta = build_runner(
             args.artifact, input_shapes=example_shapes or None,
             input_dtypes=input_dtypes, max_batch=max_batch)
@@ -372,6 +391,21 @@ def worker_main(argv=None):
             if f:
                 bucket_flops[int(b)] = f
         warm_s = time.monotonic() - t0
+    # record this replica's executable key-set and (re)write the warmup
+    # manifest so the NEXT cold start — a respawned generation or a fresh
+    # deployment — prefetches these executables instead of compiling
+    compile_entries = _compile.keys_since(compile_cursor)
+    cache_dir = _compile.cache_dir()
+    if cache_dir and manifest_id and compile_entries:
+        _compile.write_manifest(cache_dir, manifest_id, compile_entries,
+                                model="replica", version=args.generation)
+    # staged prefetch entries the warm never claimed (stale manifest rows)
+    # must not stay pinned for the worker's lifetime
+    unclaimed = _compile.clear_staged()
+    if unclaimed:
+        _LOG.info("replica %d: dropped %d unclaimed prefetched "
+                  "executable(s) (stale manifest rows)", args.replica,
+                  unclaimed)
     send_msg(sock, {"kind": "ready", "replica": args.replica,
                     "generation": args.generation, "warm_seconds": warm_s,
                     "bucket_flops": bucket_flops or None,
@@ -379,7 +413,10 @@ def worker_main(argv=None):
                     "example_shapes": {k: tuple(v)
                                        for k, v in example_shapes.items()},
                     "input_dtypes": {k: str(v) for k, v in
-                                     (input_dtypes or {}).items()} or None})
+                                     (input_dtypes or {}).items()} or None,
+                    "compile_digests":
+                        sorted({d for _, d in compile_entries}) or None,
+                    "compile_prefetched": prefetched})
     _LOG.info("replica %d gen %d ready (warm %.2fs, buckets %s)",
               args.replica, args.generation, warm_s, list(buckets))
 

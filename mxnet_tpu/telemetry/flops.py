@@ -12,10 +12,15 @@ wide counter. `observe_step` reads the per-step delta, so
 layer prices each padding bucket (`mxtpu_serve_bucket_flops`) the same
 way.
 
-Accounting covers the four executable factories (`ops._jitted`, autograd
-`_bwd_jitted`, Executor forward/backward builds, and — via the Executor
-path — serving bucket warm). The cost: one extra trace+lower per NEW
-shape signature (amortized to zero in steady state) and one float add per
+Accounting is wired at ONE place: the unified executable registry's fill
+hook (`mxnet_tpu.compile.registry`), which every factory resolves
+through — eager ops, autograd backward, Executor forward/backward,
+gluon CachedOp, the sharded trainers, and via the Executor serving
+bucket warm. Concrete fills price the executable once from the compile's
+own `Lowered` (stored in persistent-tier artifact headers, so pricing
+survives a zero-compile cold start); lazy fills use `instrument`'s
+per-shape memo below. The cost: one extra trace+lower per NEW shape
+signature (amortized to zero in steady state) and one float add per
 execution. `MXTPU_TRACE_FLOPS=0` turns all of it off. Cost analysis can
 fail (exotic primitives, missing backend support); every entry point
 degrades to "unknown" (None) rather than ever breaking dispatch.

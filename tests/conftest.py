@@ -41,7 +41,7 @@ def pytest_configure(config):
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     """Record suite wall time in every run's output (and optionally a file
-    via MXTPU_WALLTIME_FILE) so the tier-1 CI budget — the 1200s timeout in
+    via MXTPU_WALLTIME_FILE) so the tier-1 CI budget — the 1500s timeout in
     ROADMAP.md's verify command — is visibly respected as the suite grows
     (VERDICT round-5 item 9)."""
     import json
@@ -52,7 +52,7 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     if t0 is None:
         return
     wall = time.time() - t0
-    budget = 1200  # keep in sync with the ROADMAP.md tier-1 timeout
+    budget = 1500  # keep in sync with the ROADMAP.md tier-1 timeout
     terminalreporter.write_line(
         "[tier-1] suite wall time: %.0fs (budget %ds, %.0f%% used)"
         % (wall, budget, 100.0 * wall / budget))

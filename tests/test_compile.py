@@ -1,0 +1,481 @@
+"""Tests for `mxnet_tpu.compile` — the unified executable cache.
+
+Covers the registry contract (hit/miss/evict counters, LRU at capacity,
+tag invalidation), the persistent tier (same-process + cross-process
+roundtrip, corrupt/truncated/version-skewed artifact tolerance), warmup
+manifests + prefetch, the maintenance CLI, the custom-op re-registration
+regression (per-name invalidation instead of blanket cache clears), and
+the flagship acceptance: a freshly spawned serving replica reaching
+ready against a warm persistent cache with ZERO ``jit_compile`` events.
+All models are tiny — the whole file must stay well inside the tier-1
+budget.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile as cc
+from mxnet_tpu import gluon, telemetry
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name):
+    return telemetry.counter(name).value
+
+
+def _jit(fn):
+    import jax
+
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# key schema
+# ---------------------------------------------------------------------------
+
+def test_key_schema_equality_and_digest():
+    k1 = cc.ExecutableKey("op", "dot", static=(("axis", 0),))
+    k2 = cc.ExecutableKey("op", "dot", static=[["axis", 0]])  # freeze lists
+    assert k1 == k2 and hash(k1) == hash(k2)
+    assert k1 != cc.ExecutableKey("op_bwd", "dot", static=(("axis", 0),))
+    assert not k1.concrete
+
+    c1 = k1.with_shapes((((4, 8), "float32"),))
+    c2 = k1.with_shapes((((4, 8), "float32"),))
+    assert c1 == c2 and c1.concrete
+    assert c1 != k1.with_shapes((((8, 8), "float32"),))
+
+    # digest: stable for equal keys, distinct across backend/jax version
+    d = c1.digest("cpu", "0.4.37")
+    assert d == c2.digest("cpu", "0.4.37") and len(d) == 40
+    assert d != c1.digest("tpu", "0.4.37")
+    assert d != c1.digest("cpu", "0.5.0")
+
+    # static extras (autograd's has_rng/x64 axes) change identity
+    assert k1.with_static_extra((True, False)) != \
+        k1.with_static_extra((True, True))
+    # tags/no_persist are metadata, not identity
+    assert cc.ExecutableKey("op", "Custom", tags=("custom-op:a",),
+                            no_persist=True) == \
+        cc.ExecutableKey("op", "Custom")
+
+    # canonical JSON round-trips through json without loss
+    doc = json.loads(json.dumps(c1.to_json()))
+    assert doc["kind"] == "op" and doc["fingerprint"] == "dot"
+
+
+# ---------------------------------------------------------------------------
+# registry contract: hit/miss/evict counters, LRU, invalidation
+# ---------------------------------------------------------------------------
+
+def test_registry_hit_miss_counter_contract():
+    reg = cc.Registry(capacity=8, persist_dir="")
+    key = cc.ExecutableKey("op", "unit_add", static=())
+    builds = []
+
+    def build():
+        builds.append(1)
+        return _jit(lambda a: a + 1)
+
+    lk0, miss0, hit0 = (_counter("mxtpu_jit_cache_lookup_total"),
+                        _counter("mxtpu_jit_cache_miss_total"),
+                        _counter("mxtpu_compile_cache_hit_total"))
+    fn = reg.get_or_build(key, build, label="unit_add")
+    assert float(fn(np.float32(1.0))) == 2.0
+    assert len(builds) == 1
+    assert _counter("mxtpu_jit_cache_lookup_total") == lk0 + 1
+    assert _counter("mxtpu_jit_cache_miss_total") == miss0 + 1
+    assert _counter("mxtpu_compile_cache_hit_total") == hit0
+
+    fn2 = reg.get_or_build(key, build, label="unit_add")
+    assert fn2 is fn and len(builds) == 1  # hit: build never called
+    assert _counter("mxtpu_jit_cache_lookup_total") == lk0 + 2
+    assert _counter("mxtpu_jit_cache_miss_total") == miss0 + 1
+    assert _counter("mxtpu_compile_cache_hit_total") == hit0 + 1
+
+    # on_fill runs on true fills only
+    fills = []
+    k2 = cc.ExecutableKey("op", "unit_mul", static=())
+    reg.get_or_build(k2, lambda: _jit(lambda a: a * 2), label="unit_mul",
+                     on_fill=lambda: fills.append(1))
+    reg.get_or_build(k2, lambda: _jit(lambda a: a * 2), label="unit_mul",
+                     on_fill=lambda: fills.append(1))
+    assert fills == [1]
+
+
+def test_registry_lru_eviction_at_capacity():
+    reg = cc.Registry(capacity=2, persist_dir="")
+    keys = [cc.ExecutableKey("op", "lru_%d" % i) for i in range(3)]
+    ev0 = _counter("mxtpu_compile_cache_evict_total")
+    for i, k in enumerate(keys[:2]):
+        reg.get_or_build(k, lambda i=i: _jit(lambda a, i=i: a + i))
+    # touch keys[0] so keys[1] is the LRU victim
+    assert reg.lookup(keys[0]) is not None
+    reg.get_or_build(keys[2], lambda: _jit(lambda a: a + 2))
+    assert _counter("mxtpu_compile_cache_evict_total") == ev0 + 1
+    assert reg.lookup(keys[1]) is None       # evicted
+    assert reg.lookup(keys[0]) is not None   # survived (recently used)
+    assert reg.lookup(keys[2]) is not None
+    assert reg.stats()["entries"] == 2
+
+
+def test_registry_invalidate_tag_and_reset():
+    reg = cc.Registry(capacity=8, persist_dir="")
+    tagged = cc.ExecutableKey("op", "Custom", static=(("op_type", "t"),),
+                              tags=("custom-op:t",), no_persist=True)
+    plain = cc.ExecutableKey("op", "stable_op")
+    reg.get_or_build(tagged, lambda: _jit(lambda a: a))
+    reg.get_or_build(plain, lambda: _jit(lambda a: a))
+    assert reg.invalidate_tag("custom-op:t") == 1
+    assert reg.lookup(tagged) is None
+    assert reg.lookup(plain) is not None
+    reg.reset()
+    assert reg.stats()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# persistent tier
+# ---------------------------------------------------------------------------
+
+def _concrete_fill(reg, tag="p"):
+    """Fill one concrete matmul executable; returns (key, args, result)."""
+    a = np.ones((4, 8), np.float32)
+    b = np.ones((8, 2), np.float32)
+    key = cc.ExecutableKey("unit_exec", "matmul_" + tag,
+                           shapes=(((4, 8), "float32"), ((8, 2), "float32")))
+    fn = reg.get_or_build(key, lambda: _jit(lambda x, y: x @ y),
+                          label="matmul_" + tag, example_args=(a, b))
+    return key, (a, b), np.asarray(fn(a, b))
+
+
+def test_persist_store_and_reload_same_machine(tmp_path):
+    d = str(tmp_path / "cache")
+    st0 = _counter("mxtpu_compile_cache_persist_store_total")
+    reg1 = cc.Registry(capacity=8, persist_dir=d)
+    key, args, out = _concrete_fill(reg1)
+    assert out[0, 0] == 8.0
+    assert _counter("mxtpu_compile_cache_persist_store_total") == st0 + 1
+    assert len(reg1.keys_since(0)) == 1
+
+    # a FRESH registry over the same dir: loads, never compiles
+    reg2 = cc.Registry(capacity=8, persist_dir=d)
+    ph0 = _counter("mxtpu_compile_cache_persist_hit_total")
+    miss0 = _counter("mxtpu_jit_cache_miss_total")
+    built = []
+    fn = reg2.get_or_build(key, lambda: built.append(1) or _jit(
+        lambda x, y: x @ y), label="matmul_p", example_args=args)
+    assert np.asarray(fn(*args))[0, 0] == 8.0
+    assert built == []  # the build closure never ran
+    assert _counter("mxtpu_compile_cache_persist_hit_total") == ph0 + 1
+    assert _counter("mxtpu_jit_cache_miss_total") == miss0
+
+
+def test_persist_corrupt_truncated_and_version_skew(tmp_path):
+    d = str(tmp_path / "cache")
+    reg1 = cc.Registry(capacity=8, persist_dir=d)
+    key, args, _ = _concrete_fill(reg1, tag="c")
+    (_, digest), = reg1.keys_since(0)
+    path = os.path.join(d, "objects", digest + ".mxe")
+    blob = open(path, "rb").read()
+
+    def rebuild_after(mutate, label):
+        mutate()
+        bad0 = _counter("mxtpu_compile_cache_persist_bad_total")
+        reg = cc.Registry(capacity=8, persist_dir=d)
+        built = []
+        fn = reg.get_or_build(
+            key, lambda: built.append(1) or _jit(lambda x, y: x @ y),
+            label=label, example_args=args)
+        assert np.asarray(fn(*args))[0, 0] == 8.0, label
+        assert built == [1], "%s: corrupt artifact must rebuild" % label
+        assert _counter("mxtpu_compile_cache_persist_bad_total") == bad0 + 1
+
+    # truncated mid-payload
+    rebuild_after(lambda: open(path, "wb").write(blob[:len(blob) // 2]),
+                  "truncated")
+    # flipped payload byte (crc catches it)
+    corrupt = bytearray(blob)
+    corrupt[-10] ^= 0xFF
+    rebuild_after(lambda: open(path, "wb").write(bytes(corrupt)), "bitflip")
+    # version skew: same digest filename, header claims another jax
+    from mxnet_tpu.compile import persist
+    hlen = int.from_bytes(blob[len(persist.MAGIC):len(persist.MAGIC) + 8],
+                          "little")
+    header = json.loads(
+        blob[len(persist.MAGIC) + 8:len(persist.MAGIC) + 8 + hlen].decode())
+    header["jax"] = "0.0.0"
+    h2 = json.dumps(header, sort_keys=True).encode()
+    skewed = (persist.MAGIC + len(h2).to_bytes(8, "little") + h2
+              + blob[len(persist.MAGIC) + 8 + hlen:])
+    rebuild_after(lambda: open(path, "wb").write(skewed), "version-skew")
+    # garbage that is not even an artifact
+    rebuild_after(lambda: open(path, "wb").write(b"not an artifact"),
+                  "garbage")
+
+
+def test_persist_cross_process_roundtrip(tmp_path):
+    """The elastic-restart contract: process 2 resolves process 1's
+    executor executable from disk with zero ``jit_compile`` events."""
+    d = str(tmp_path / "cache")
+    script = """\
+import sys, numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+s = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4, name="fc")
+ex = s.bind(mx.cpu(), args={"data": mx.nd.ones((2, 8)),
+                            "fc_weight": mx.nd.ones((4, 8)),
+                            "fc_bias": mx.nd.zeros((4,))})
+out = ex.forward(is_train=False)[0].asnumpy()
+assert out[0, 0] == 8.0, out
+print("misses=%d persist_hits=%d" % (
+    telemetry.counter("mxtpu_jit_cache_miss_total").value,
+    telemetry.counter("mxtpu_compile_cache_persist_hit_total").value))
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_COMPILE_CACHE=d,
+               PYTHONPATH=_ROOT)
+    env.pop("MXTPU_TELEMETRY_DIR", None)
+    r1 = subprocess.run([sys.executable, "-c", script], env=env,
+                        capture_output=True, text=True, timeout=180)
+    assert r1.returncode == 0, r1.stdout + r1.stderr
+    assert "persist_hits=0" in r1.stdout and "misses=0" not in r1.stdout
+    r2 = subprocess.run([sys.executable, "-c", script], env=env,
+                        capture_output=True, text=True, timeout=180)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "misses=0" in r2.stdout, r2.stdout
+    assert "persist_hits=1" in r2.stdout, r2.stdout
+
+
+# ---------------------------------------------------------------------------
+# warmup manifests
+# ---------------------------------------------------------------------------
+
+def test_manifest_write_read_and_prefetch(tmp_path):
+    d = str(tmp_path / "cache")
+    reg1 = cc.Registry(capacity=8, persist_dir=d)
+    cursor = reg1.mark()
+    key, args, _ = _concrete_fill(reg1, tag="m")
+    entries = reg1.keys_since(cursor)
+    assert len(entries) == 1
+
+    mid = cc.model_manifest_id(str(tmp_path / "model"), 4, {"data": (6,)})
+    path = cc.write_manifest(d, mid, entries, model="m", version=1)
+    assert path and os.path.exists(path)
+    doc = cc.read_manifest(d, mid)
+    assert doc["model"] == "m" and len(doc["entries"]) == 1
+    assert [m["manifest"] for m in cc.list_manifests(d)] == [mid]
+    # id is geometry-sensitive
+    assert mid != cc.model_manifest_id(str(tmp_path / "model"), 8,
+                                       {"data": (6,)})
+
+    # prefetch stages the executable; the next resolve drains staging
+    reg2 = cc.Registry(capacity=8, persist_dir=d)
+    assert cc.prefetch(mid, directory=d, registry=reg2) == 1
+    assert reg2.stats()["staged"] == 1
+    ph0 = _counter("mxtpu_compile_cache_persist_hit_total")
+    fn = reg2.get_or_build(key, lambda: pytest.fail("must not build"),
+                           label="m", example_args=args)
+    assert np.asarray(fn(*args))[0, 0] == 8.0
+    assert reg2.stats()["staged"] == 0
+    assert _counter("mxtpu_compile_cache_persist_hit_total") == ph0 + 1
+    # absent manifest / disabled tier are quiet no-ops
+    assert cc.prefetch("0" * 24, directory=d, registry=reg2) == 0
+    assert cc.prefetch(mid, directory=None, registry=reg2) == 0
+
+
+# ---------------------------------------------------------------------------
+# custom-op re-registration (the operator.py:104 satellite)
+# ---------------------------------------------------------------------------
+
+def _register_addk(op_type, k):
+    class _Op(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        mx.nd.array(in_data[0].asnumpy() + k))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        mx.nd.array(out_grad[0].asnumpy() * (k + 1.0)))
+
+    @mx.operator.register(op_type)
+    class _Prop(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _Op()
+
+    return _Prop
+
+
+def test_custom_op_reregistration_not_served_stale():
+    """Re-registering an op_type must invalidate ITS cached executables
+    (forward and backward) — and ONLY its: other ops' warm entries
+    survive (the old blanket cache_clear threw the whole process's
+    executable cache away)."""
+    from mxnet_tpu import autograd
+
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    # warm an unrelated executable we expect to SURVIVE re-registration
+    probe = mx.nd.dot(mx.nd.ones((2, 4)), mx.nd.ones((4, 2))).asnumpy()
+    assert probe[0, 0] == 4.0
+
+    _register_addk("cc_regress", 1.0)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="cc_regress")
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), 2.0)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2.0)
+
+    # same op_type, same shapes/attrs, NEW semantics
+    _register_addk("cc_regress", 10.0)
+    x2 = mx.nd.array(np.ones((2, 3), np.float32))
+    x2.attach_grad()
+    with autograd.record():
+        y2 = mx.nd.Custom(x2, op_type="cc_regress")
+    y2.backward()
+    np.testing.assert_allclose(y2.asnumpy(), 11.0)   # not the stale 2.0
+    np.testing.assert_allclose(x2.grad.asnumpy(), 11.0)
+
+    # the unrelated executable was untouched: this dispatch is a pure hit
+    miss0 = _counter("mxtpu_jit_cache_miss_total")
+    assert mx.nd.dot(mx.nd.ones((2, 4)),
+                     mx.nd.ones((4, 2))).asnumpy()[0, 0] == 4.0
+    assert _counter("mxtpu_jit_cache_miss_total") == miss0
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_list_inspect_verify_prune(tmp_path, capsys):
+    d = str(tmp_path / "cache")
+    reg = cc.Registry(capacity=8, persist_dir=d)
+    _concrete_fill(reg, tag="cli")
+    (_, digest), = reg.keys_since(0)
+    cc.write_manifest(d, "deadbeef" * 3, reg.keys_since(0), model="m",
+                      version=1)
+    # plant a corrupt artifact for prune --bad
+    bad = os.path.join(d, "objects", "f" * 40 + ".mxe")
+    open(bad, "wb").write(b"garbage")
+
+    from mxnet_tpu.compile.__main__ import main as cli
+
+    def run(*args):
+        rc = cli(["--dir", d] + list(args))
+        return rc, capsys.readouterr().out
+
+    rc, out = run("list")
+    assert rc == 0
+    assert digest[:12] in out and "1 bad" in out
+    assert "deadbeef" in out  # manifest listed
+
+    rc, out = run("inspect", digest[:8])
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["digest"] == digest and doc["key"]["kind"] == "unit_exec"
+
+    rc, out = run("verify")
+    assert rc == 1 and "1 bad" in out
+
+    rc, out = run("prune", "--bad")
+    assert rc == 0 and "pruned 1 artifact" in out
+    assert not os.path.exists(bad)
+    assert run("verify")[0] == 0
+
+    rc, _ = run("prune")  # everything
+    assert rc == 0
+    assert run("list")[1].count(".mxe") == 0
+
+    # the module entry point itself (one subprocess smoke)
+    r = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.compile", "--dir", d, "list"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# flagship: serving replica cold start against a warm cache
+# ---------------------------------------------------------------------------
+
+def _export_mlp(tmp_path):
+    net = gluon.nn.HybridSequential(prefix="ccold_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    net(mx.nd.zeros((2, 6)))
+    prefix = str(tmp_path / "coldmodel")
+    net.export(prefix, epoch=0)
+    return prefix
+
+
+def _jsonl_events(tdir):
+    events = []
+    for name in os.listdir(tdir):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "event":
+                    events.append(rec.get("event"))
+    return events
+
+
+def test_replica_cold_start_with_warm_cache_zero_jit_compile(tmp_path):
+    """A freshly spawned replica worker process, pointed at a persistent
+    cache a previous generation populated, reaches ready with ZERO
+    ``jit_compile`` telemetry events (every executable deserializes via
+    the warmup manifest / persistent tier) — the acceptance criterion of
+    docs/compile_cache.md's cold-start playbook."""
+    from mxnet_tpu.serving.model_repository import ServedModel
+
+    prefix = _export_mlp(tmp_path)
+    cache = str(tmp_path / "cache")
+
+    def spawn(tag):
+        tdir = str(tmp_path / ("telemetry_" + tag))
+        os.makedirs(tdir, exist_ok=True)
+        t0 = time.monotonic()
+        model = ServedModel.pooled(
+            "cold", 1, prefix, replicas=1,
+            input_shapes={"data": (6,)}, max_batch=4,
+            extra_env={"MXTPU_COMPILE_CACHE": cache,
+                       "MXTPU_TELEMETRY_DIR": tdir},
+            spawn_timeout_s=120.0)
+        ready_s = time.monotonic() - t0
+        try:
+            out = model.predict({"data": np.zeros((2, 6), np.float32)},
+                                timeout_ms=10000)
+            assert out[0].shape == (2, 3)
+            digests = list(model.compile_digests)
+        finally:
+            model.close(drain=True, timeout=5)
+        time.sleep(0.5)  # let the worker's exit flush land
+        return _jsonl_events(tdir), digests, ready_s
+
+    cold_events, cold_digests, cold_s = spawn("cold")
+    assert cold_events.count("jit_compile") > 0   # generation 0 compiles
+    assert cold_digests, "cold warm recorded no executable key-set"
+    assert cc.read_manifest(cache, cc.model_manifest_id(
+        prefix, 4, {"data": (6,)})) is not None
+
+    warm_events, warm_digests, warm_s = spawn("warm")
+    assert warm_events.count("jit_compile") == 0, warm_events
+    assert warm_events.count("compile_persist_hit") >= 3  # every bucket
+    assert sorted(warm_digests) == sorted(cold_digests)

@@ -404,9 +404,9 @@ def test_no_bare_print_in_library(tmp_path):
 
 def test_mxlint_clean():
     """CI static analysis (ci/mxlint, docs/static_analysis.md): the tree has
-    ZERO findings across all six checkers (host-sync, signal-safety,
-    env-registry, registry-parity, metric-registry, bare-print) modulo the
-    committed
+    ZERO findings across all seven checkers (host-sync, signal-safety,
+    env-registry, registry-parity, metric-registry, compile-registry,
+    bare-print) modulo the committed
     baseline — enforced in-suite so a new violation fails tier-1, not just
     a side CI job. Checker efficacy (each rule still catches a planted
     violation) is proven separately in test_mxlint.py's fixture tests."""
@@ -418,4 +418,4 @@ def test_mxlint_clean():
     r = subprocess.run([sys.executable, "-m", "ci.mxlint"], cwd=root,
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "0 finding(s) across 6 rule(s)" in r.stdout, r.stdout
+    assert "0 finding(s) across 7 rule(s)" in r.stdout, r.stdout

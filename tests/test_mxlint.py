@@ -24,6 +24,7 @@ from ci.mxlint.checkers.metric_registry import MetricRegistryChecker  # noqa: E4
 from ci.mxlint.checkers.registry_parity import RegistryParityChecker  # noqa: E402
 from ci.mxlint.checkers.signal_safety import SignalSafetyChecker  # noqa: E402
 from ci.mxlint.checkers.bare_print import BarePrintChecker  # noqa: E402
+from ci.mxlint.checkers.compile_registry import CompileRegistryChecker  # noqa: E402
 
 
 def _tree(tmp_path, files):
@@ -547,6 +548,101 @@ def test_metric_registry_dynamic_names_skipped(tmp_path):
     assert got == []
 
 
+# ---------------------------------------------------------------------------
+# compile-registry
+# ---------------------------------------------------------------------------
+
+def test_compile_registry_positive_patterns(tmp_path):
+    """The three ad-hoc executable-cache spellings all flag: an
+    lru_cache-wrapped jit builder, a direct subscript store of a jit
+    result, a name-laundered subscript store, and a setdefault store."""
+    repo = _tree(tmp_path, {"mxnet_tpu/holders.py": """\
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=128)
+        def jitted(name):                      # line 4: hidden cache
+            def call(x):
+                return x
+            return jax.jit(call)
+
+        class Holder:
+            def __init__(self):
+                self._cache = {}
+
+            def direct(self, sig, fn):
+                self._cache[sig] = jax.jit(fn)        # line 14
+
+            def laundered(self, sig, fn):
+                exe = jax.jit(fn)
+                self._cache[sig] = exe                # line 18
+
+            def via_setdefault(self, sig, fn):
+                return self._cache.setdefault(sig, jax.jit(fn))  # line 21
+        """})
+    got = _lines(_findings(CompileRegistryChecker(), repo))
+    assert got == [("mxnet_tpu/holders.py", 5),    # def jitted
+                   ("mxnet_tpu/holders.py", 15),   # direct subscript store
+                   ("mxnet_tpu/holders.py", 19),   # laundered via name
+                   ("mxnet_tpu/holders.py", 22)]   # setdefault
+
+
+def test_compile_registry_negative_and_scope(tmp_path):
+    """Not flagged: the registry package itself, non-jit lru_caches,
+    single module-global jits (keyed by nothing), registry-routed fills,
+    and pragma'd exceptions."""
+    repo = _tree(tmp_path, {
+        "mxnet_tpu/compile/registry.py": """\
+            import jax
+
+            class Registry:
+                def fill(self, table, key, fn):
+                    table[key] = jax.jit(fn)   # the ONE allowed home
+            """,
+        "mxnet_tpu/clean.py": """\
+            import functools
+            import jax
+            from . import compile as _compile
+
+            @functools.lru_cache(maxsize=8)
+            def parse(spec):                   # lru_cache without jit: fine
+                return tuple(spec.split(","))
+
+            _BARRIER = jax.jit(lambda v: v.sum())   # unkeyed singleton: fine
+
+            def routed(key, fn):
+                return _compile.get_or_build(key, lambda: jax.jit(fn))
+
+            class Ok:
+                def __init__(self):
+                    self._cache = {}
+
+                def store_routed(self, sig, key, fn):
+                    # registry result in a local dict: not a jit holder
+                    self._cache[sig] = routed(key, fn)
+            """,
+        "mxnet_tpu/excused.py": """\
+            import jax
+            _T = {}
+
+            def special(sig, fn):
+                _T[sig] = jax.jit(fn)  # mxlint: disable=compile-registry
+            """,
+    })
+    from ci.mxlint import run_checkers
+
+    kept, by_pragma, _ = run_checkers(repo, [CompileRegistryChecker()])
+    assert _lines(kept) == []
+    assert _lines(by_pragma) == [("mxnet_tpu/excused.py", 5)]
+
+
+def test_compile_registry_real_tree_is_clean():
+    """The live tree: every executable factory resolves through
+    mxnet_tpu/compile (the acceptance criterion for the migration)."""
+    repo = Repo(ROOT)
+    assert _lines(_findings(CompileRegistryChecker(), repo)) == []
+
+
 def test_bare_print_checker_semantics(tmp_path):
     repo = _tree(tmp_path, {
         "mxnet_tpu/bad.py": _PRINTY,
@@ -654,7 +750,7 @@ def test_cli_modes(args, expect_rc):
     assert r.returncode == expect_rc, r.stdout + r.stderr
     if expect_rc == 0:
         for rule in ("host-sync", "signal-safety", "env-registry",
-                     "registry-parity", "bare-print"):
+                     "registry-parity", "compile-registry", "bare-print"):
             assert rule in r.stdout
 
 
@@ -712,7 +808,7 @@ def test_env_module_typed_accessors(monkeypatch):
 
 
 def test_env_registry_covers_every_checker_rule():
-    """Meta: the shipped checker set is exactly the documented six."""
+    """Meta: the shipped checker set is exactly the documented seven."""
     assert sorted(c.rule for c in CHECKERS) == [
-        "bare-print", "env-registry", "host-sync", "metric-registry",
-        "registry-parity", "signal-safety"]
+        "bare-print", "compile-registry", "env-registry", "host-sync",
+        "metric-registry", "registry-parity", "signal-safety"]

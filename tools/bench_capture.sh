@@ -183,6 +183,24 @@ if ls "$FAIL_TDIR"/*.jsonl >/dev/null 2>&1; then
 fi
 rm -rf "$FAIL_TDIR"
 
+# cold start: serving replica time-to-ready, cold vs persistent-warm
+# compile cache (docs/compile_cache.md) — run 1 populates an empty
+# MXTPU_COMPILE_CACHE dir, run 2's fresh replica must reach ready with
+# ZERO jit_compile events (rc=4 if it compiled anything) and measurably
+# lower time-to-ready; the workers' telemetry JSONL is archived beside
+# the row
+echo "[bench_capture] cold start (resnet18, compile cache)" >&2
+COLD_TDIR=$(mktemp -d "telemetry_${TAG}_coldstart.XXXX")
+env PYTHONPATH=".:${PYTHONPATH:-}" TMPDIR="$COLD_TDIR" \
+  timeout 1500 python tools/coldstart_bench.py --net resnet18 \
+  > "BENCH_${TAG}_coldstart.json" 2> "BENCH_${TAG}_coldstart.log"
+echo "[bench_capture] cold start rc=$?" >&2
+if ls "$COLD_TDIR"/coldstart_bench_*/telemetry_*/*.jsonl >/dev/null 2>&1; then
+  cat "$COLD_TDIR"/coldstart_bench_*/telemetry_*/*.jsonl \
+    > "BENCH_${TAG}_coldstart_telemetry.jsonl"
+fi
+rm -rf "$COLD_TDIR"
+
 # trace row: render the archived telemetry JSONL (serve_bench samples
 # every request at --trace-sample 1.0, so the serve rows' JSONL carries
 # the full span stream) into perfetto-loadable merged traces next to the
