@@ -46,6 +46,7 @@ from ..astutil import FUNC_DEFS, body_walk, dotted
 _SCOPE_FILES = (
     "mxnet_tpu/telemetry/recorder.py",
     "mxnet_tpu/telemetry/core.py",
+    "mxnet_tpu/telemetry/memory.py",
     "mxnet_tpu/telemetry/__init__.py",
     "mxnet_tpu/env.py",
     "mxnet_tpu/serving/supervisor.py",
@@ -60,7 +61,11 @@ _ENTRY = (("mxnet_tpu/telemetry/recorder.py", "_on_sigusr1"),
           ("mxnet_tpu/serving/server.py", "_on_signal"))
 
 _SAFE_ROOTS = {"os", "sys", "time", "json", "traceback", "tempfile",
-               "collections", "math", "io"}
+               "collections", "math", "io",
+               # getrusage is one read-only syscall (memory.py's VmHWM
+               # fallback); the module is imported at load, never from
+               # the signal path
+               "resource", "_resource"}
 _SAFE_THREADING = {"enumerate", "current_thread", "main_thread",
                    "get_ident"}
 _SAFE_BUILTINS = {

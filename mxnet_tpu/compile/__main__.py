@@ -42,6 +42,24 @@ def _fmt_age(created):
     return "%ds" % s
 
 
+def _fmt_mem(memory):
+    """Compact argument/output/temp rendering of a header's compile-time
+    memory_analysis figures (docs/observability.md §Memory)."""
+    if not memory:
+        return "-"
+    return "a%s+o%s+t%s" % tuple(
+        _fmt_bytes(memory.get(k)) for k in ("arguments", "outputs", "temp"))
+
+
+def _fmt_bytes(v):
+    if v is None:
+        return "?"
+    for unit, div in (("G", 1 << 30), ("M", 1 << 20), ("K", 1 << 10)):
+        if v >= div:
+            return "%.1f%s" % (v / div, unit)
+    return "%d" % v
+
+
 def cmd_list(args):
     d = _resolve_dir(args)
     rows, bad, total = [], 0, 0
@@ -50,20 +68,22 @@ def cmd_list(args):
         total += size
         if header is None:
             bad += 1
-            rows.append(("<corrupt>", "-", "-", size, "-", "-",
+            rows.append(("<corrupt>", "-", "-", size, "-", "-", "-",
                          os.path.basename(path)))
             continue
         key = header.get("key") or {}
         rows.append((header.get("digest", "?")[:12], key.get("kind", "?"),
                      header.get("label") or key.get("fingerprint", "?")[:24],
-                     size, _fmt_age(header.get("created")),
+                     size, _fmt_mem(header.get("memory")),
+                     _fmt_age(header.get("created")),
                      "%s/%s" % (header.get("backend", "?"),
                                 header.get("jax", "?")),
                      ""))
-    print("%-14s %-14s %-26s %10s %6s %-16s" %  # allow-print: CLI display surface
-          ("DIGEST", "KIND", "LABEL", "BYTES", "AGE", "BACKEND/JAX"))
+    print("%-14s %-14s %-26s %10s %-20s %6s %-16s" %  # allow-print: CLI display surface
+          ("DIGEST", "KIND", "LABEL", "BYTES", "MEM(arg+out+tmp)", "AGE",
+           "BACKEND/JAX"))
     for r in rows:
-        print("%-14s %-14s %-26s %10d %6s %-16s %s" % r)  # allow-print: CLI display surface
+        print("%-14s %-14s %-26s %10d %-20s %6s %-16s %s" % r)  # allow-print: CLI display surface
     manifests = list(_manifest.list_manifests(d))
     print("-- %d artifact(s), %d bad, %.1f KiB total, %d manifest(s) in %s"  # allow-print: CLI display surface
           % (len(rows), bad, total / 1024.0, len(manifests), d))

@@ -80,10 +80,14 @@ def _jax_version():
     return jax.__version__
 
 
-def store(directory, key, compiled, label=None, flops=None):
+def store(directory, key, compiled, label=None, flops=None, memory=None):
     """Serialize ``compiled`` (a jax Compiled) under ``key``; returns the
     digest, or None when this executable/backend cannot serialize (a
-    cache store is always best-effort)."""
+    cache store is always best-effort). ``memory`` is the compile-time
+    `memory_analysis()` figures dict (argument/output/temp/generated-
+    code/alias bytes) — persisted in the header so a zero-compile cold
+    start still knows the executable's footprint
+    (docs/compile_cache.md)."""
     from jax.experimental import serialize_executable as _se
 
     backend, jaxver = _backend(), _jax_version()
@@ -101,6 +105,7 @@ def store(directory, key, compiled, label=None, flops=None):
         "jax": jaxver,
         "backend": backend,
         "flops": flops,
+        "memory": memory,
         "created": time.time(),
         "payload_len": len(payload),
         "payload_crc32": zlib.crc32(payload) & 0xFFFFFFFF,
@@ -150,8 +155,8 @@ def read_header(path):
 
 def load(directory, key):
     """Deserialize the executable stored under ``key``. Returns
-    ``(callable, flops)`` or ``(None, None)`` on miss/corruption/version
-    skew — loading NEVER raises."""
+    ``(callable, flops, memory)`` or ``(None, None, None)`` on miss/
+    corruption/version skew — loading NEVER raises."""
     path = artifact_path(directory, key.digest(_backend(), _jax_version()))
     return load_path(path)
 
@@ -160,20 +165,20 @@ def load_path(path):
     """`load` by explicit artifact path (manifest prefetch)."""
     header, payload = _read(path, want_payload=True)
     if header is None:
-        return None, None
+        return None, None, None
     # version/backend double-check: the digest already encodes both, but a
     # renamed/copied file must not smuggle a foreign executable in
     if header.get("jax") != _jax_version() or \
             header.get("backend") != _backend():
-        return None, None
+        return None, None, None
     try:
         from jax.experimental import serialize_executable as _se
 
         payload_bytes, in_tree, out_tree = pickle.loads(payload)
         fn = _se.deserialize_and_load(payload_bytes, in_tree, out_tree)
     except Exception:
-        return None, None
-    return fn, header.get("flops")
+        return None, None, None
+    return fn, header.get("flops"), header.get("memory")
 
 
 def scan(directory):

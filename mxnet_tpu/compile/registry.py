@@ -40,13 +40,15 @@ import threading
 from .. import env as _env
 from ..telemetry import core as _tm_core
 from ..telemetry import flops as _tm_flops
+from ..telemetry import memory as _tm_memory
 from ..telemetry import recorder as _tm_rec
 from ..telemetry import tracing as _tracing
 from . import persist as _persist
 
 __all__ = ["Registry", "registry", "get_or_build", "lookup", "invalidate_tag",
            "reset", "stats", "mark", "keys_since", "prefetch_paths",
-           "clear_staged", "instance_token"]
+           "clear_staged", "instance_token", "begin_touch_log",
+           "end_touch_log"]
 
 
 # lazily-resolved counters: a process that starts MXTPU_TELEMETRY=0 and
@@ -180,12 +182,18 @@ class Registry:
         self._clock = itertools.count(1)
         self._capacity = capacity
         self._persist_dir = persist_dir  # None = resolve from env per miss
-        self._staged = {}    # digest -> (callable, flops) manifest prefetch
+        self._staged = {}    # digest -> (callable, flops, memory_figures)
+        #                      manifest prefetch staging
         # per-THREAD fill log: loads/warms bracket their own thread's
         # fills with mark()/keys_since(), so concurrent model loads (and
         # live traffic on batcher threads) never pollute each other's
         # warmup manifests
         self._fill_local = threading.local()
+        # per-THREAD touch log (armed only between begin_touch_log/
+        # end_touch_log): which keys a warm LOOKED UP, hit or miss — the
+        # memory-attribution bracket needs this because a reload of an
+        # already-resident model fills nothing (telemetry.memory)
+        self._touch_local = threading.local()
 
     # -- config ------------------------------------------------------------
     def capacity(self):
@@ -220,6 +228,9 @@ class Registry:
         mutex (the eviction path under the lock tolerates the benign
         stamp races this allows)."""
         _counter("mxtpu_jit_cache_lookup_total").inc()
+        touches = getattr(self._touch_local, "log", None)
+        if touches is not None:  # armed only inside a warm bracket
+            touches.append(key)
         value = self._table.get(key)
         if value is not None:
             self._stamps[key] = next(self._clock)
@@ -232,13 +243,18 @@ class Registry:
         (never called on a hit). With ``example_args`` the key is filled
         as ONE concrete executable (AOT + persistent tier when armed);
         without, the entry is a per-shape callable (plain jitted wrapper,
-        or the per-shape persist wrapper when armed). ``on_fill`` runs
-        only on a true fill (site-specific build counters);
-        ``event_fields`` joins the ``jit_compile`` event."""
+        or the per-shape persist wrapper when armed). ``example_args``
+        may be a zero-arg THUNK returning the tuple — evaluated only on
+        a true fill, so hot call sites (the trainers' per-step
+        resolution) pay nothing on a hit. ``on_fill`` runs only on a
+        true fill (site-specific build counters); ``event_fields`` joins
+        the ``jit_compile`` event."""
         value = self.lookup(key)
         if value is not None:
             return value
         label = label or key.fingerprint
+        if callable(example_args):
+            example_args = example_args()
         if key.concrete and example_args is not None:
             value = self._fill_concrete(key, build, example_args, label,
                                         on_fill, event_fields)
@@ -286,7 +302,11 @@ class Registry:
 
     def _fill_concrete(self, key, build, args, label, on_fill, event_fields):
         """Fill ONE executable for pinned shapes: disk hit (no compile) or
-        AOT trace+compile (+ store when armed)."""
+        AOT trace+compile (+ store when armed). Sharded/donating keys the
+        persistent tier refuses (the fused trainer steps) still take the
+        AOT path when memory accounting is on, so their memory figures —
+        and the donation verifier — come from the compile the fill pays
+        anyway."""
         directory = self._dir(key)
         if directory is not None:
             loaded = self._load_persisted(directory, key, label, build)
@@ -298,6 +318,8 @@ class Registry:
             value = None
             if directory is not None:
                 value = self._aot_store(directory, key, jitted, args, label)
+            elif (key.sharded or key.donation) and _tm_memory.enabled():
+                value = self._aot_capture(key, jitted, args, label)
             if value is None:
                 value = _tm_flops.instrument(jitted)
         self._count_fill(label, on_fill, event_fields)
@@ -318,10 +340,12 @@ class Registry:
 
         return rebuild
 
-    def _aot_store(self, directory, key, jitted, args, label):
-        """Lower+compile ahead of time, capture cost-analysis FLOPs, and
-        serialize into the persistent tier. None when this executable
-        can't take the AOT path (caller falls back to plain jit)."""
+    def _compile_aot(self, key, jitted, args, label):
+        """Shared AOT front half: lower + compile, price FLOPs from the
+        lowering and memory figures from the compile (recorded into the
+        attribution table; donating keys run the donation verifier).
+        Returns (compiled, flops, mem) or None when this executable
+        can't take the AOT path."""
         try:
             lowered = jitted.lower(*args)
             flops = None
@@ -334,11 +358,41 @@ class Registry:
             compiled = lowered.compile()
         except Exception:
             return None
+        mem = _tm_memory.from_compiled(compiled)
+        if key.donation:
+            _tm_memory.verify_donation(key, args, mem)
+        return compiled, flops, mem
+
+    def _aot_capture(self, key, jitted, args, label):
+        """Memory-tier-only AOT fill (sharded/donating keys): same
+        compile the jit would pay on first call, but through `lower()`+
+        `compile()` so `memory_analysis()` is attributable. The compiled
+        executable is used directly (no second compile), with the
+        standard rebuild escape hatch."""
+        res = self._compile_aot(key, jitted, args, label)
+        if res is None:
+            return None
+        compiled, flops, mem = res
+        _tm_memory.record_executable(key.kind, label, None, mem, key=key)
+        return _FixedFlops(compiled, flops,
+                           rebuild=self._rebuilder(lambda: jitted, label))
+
+    def _aot_store(self, directory, key, jitted, args, label):
+        """Lower+compile ahead of time, capture cost-analysis FLOPs +
+        memory figures, and serialize into the persistent tier (figures
+        ride the artifact header, so a zero-compile cold start still
+        knows its footprint). None when this executable can't take the
+        AOT path (caller falls back to plain jit)."""
+        res = self._compile_aot(key, jitted, args, label)
+        if res is None:
+            return None
+        compiled, flops, mem = res
         digest = _persist.store(directory, key, compiled, label=label,
-                                flops=flops)
+                                flops=flops, memory=mem)
         if digest is not None:
             _counter("mxtpu_compile_cache_persist_store_total").inc()
             self._log_fill(key, digest)
+        _tm_memory.record_executable(key.kind, label, digest, mem, key=key)
         return _FixedFlops(compiled, flops,
                            rebuild=self._rebuilder(lambda: jitted, label))
 
@@ -351,12 +405,12 @@ class Registry:
         with self._lock:
             staged = self._staged.pop(digest, None)
         if staged is not None:
-            fn, flops = staged
+            fn, flops, mem = staged
         else:
             path = _persist.artifact_path(directory, digest)
             if not os.path.exists(path):
                 return None
-            fn, flops = _persist.load_path(path)
+            fn, flops, mem = _persist.load_path(path)
             if fn is None:
                 _counter("mxtpu_compile_cache_persist_bad_total").inc()
                 _tm_rec.record_event("compile_persist_bad", op=label)
@@ -364,6 +418,9 @@ class Registry:
         _counter("mxtpu_compile_cache_persist_hit_total").inc()
         _tm_rec.record_event("compile_persist_hit", op=label)
         self._log_fill(key, digest)
+        # the header figures keep attribution alive across a zero-compile
+        # cold start (the memory_analysis ran in the process that stored)
+        _tm_memory.record_executable(key.kind, label, digest, mem, key=key)
         return _FixedFlops(fn, flops, rebuild=self._rebuilder(build, label))
 
     # -- invalidation ------------------------------------------------------
@@ -387,7 +444,22 @@ class Registry:
             self._stamps.clear()
             self._staged.clear()
             self._fill_local.entries = []
+            self._touch_local.log = None
             _entries_gauge().set(0)
+
+    # -- touch bracketing (memory attribution) -----------------------------
+    def begin_touch_log(self):
+        """Arm this thread's touch log: every registry lookup (hit or
+        miss) records its key until `end_touch_log`. The serving warm
+        brackets each bucket with it so memory attribution survives the
+        all-hits reload path (docs/observability.md §Memory)."""
+        self._touch_local.log = []
+
+    def end_touch_log(self):
+        """Disarm and return this thread's touched keys (in order)."""
+        log = getattr(self._touch_local, "log", None)
+        self._touch_local.log = None
+        return log or []
 
     # -- warmup manifests --------------------------------------------------
     def mark(self):
@@ -422,12 +494,12 @@ class Registry:
             if header is None or not header.get("digest"):
                 _counter("mxtpu_compile_cache_persist_bad_total").inc()
                 continue
-            fn, flops = _persist.load_path(path)
+            fn, flops, mem = _persist.load_path(path)
             if fn is None:
                 _counter("mxtpu_compile_cache_persist_bad_total").inc()
                 continue
             with self._lock:
-                self._staged[header["digest"]] = (fn, flops)
+                self._staged[header["digest"]] = (fn, flops, mem)
             n += 1
         return n
 
@@ -509,6 +581,14 @@ def prefetch_paths(paths):
 
 def clear_staged():
     return registry().clear_staged()
+
+
+def begin_touch_log():
+    registry().begin_touch_log()
+
+
+def end_touch_log():
+    return registry().end_touch_log()
 
 
 _TOKENS = itertools.count()

@@ -466,6 +466,21 @@ _var("MXTPU_DUMP_GRACE", "float", 1.0,
      "`MXTPU_TELEMETRY_DIR` is set (the same condition that installs the "
      "worker-side dump handler at import); otherwise teardown starts "
      "directly at SIGTERM.")
+_var("MXTPU_MEMORY_POLL_MS", "float", None,
+     "period of the background memory-gauge poller "
+     "(`telemetry.memory.sample`: device `memory_stats()`, process "
+     "RSS/VmHWM, NDArray live bytes). Default off — gauges still refresh "
+     "at every JSONL flush, Prometheus scrape and training step; the "
+     "poller is for catching spikes inside long forwards between steps.")
+_var("MXTPU_SERVE_MEMORY_BUDGET", "str", None,
+     "serving memory budget in bytes (suffixes K/M/G/T accepted, e.g. "
+     "`24G`): `ModelRepository.load` computes each model's device "
+     "footprint from per-executable `memory_analysis()` figures "
+     "(docs/observability.md §Memory) and REJECTS a load whose footprint "
+     "would exceed the budget (typed `MemoryBudgetError`). A `warn:` "
+     "prefix (e.g. `warn:24G`) logs + emits an event instead of "
+     "rejecting. Unset (default) disables the check; loads whose "
+     "footprint is unknown (no figures recorded) are never rejected.")
 _var("MXTPU_STEP_FLOPS", "float", None,
      "model FLOPs per training step; when set, `observe_step` publishes "
      "achieved MFU (`mxtpu_step_mfu`) against `runtime.chip_peak_tflops` "

@@ -31,6 +31,7 @@ import numpy as _np
 from .. import ops as _ops
 from ..base import MXNetError, np_dtype, numeric_types
 from ..context import Context, current_context
+from ..telemetry import memory as _tm_memory
 
 _uid_counter = itertools.count(1)
 
@@ -94,7 +95,7 @@ class NDArray:
     """Multi-dimensional array on a device (reference: ndarray.h:82)."""
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_grad_stype",
-                 "_version", "_fresh_grad", "_uid")
+                 "_version", "_fresh_grad", "_uid", "_live_bytes")
 
     def __new__(cls, *args, **kwargs):
         # process-unique id for autograd tape keys: unlike id(), a uid is
@@ -111,6 +112,26 @@ class NDArray:
         self._grad_req = "null"
         self._version = 0
         self._fresh_grad = False
+        # live-memory accounting (telemetry.memory): handles created minus
+        # handles collected, in counts and bytes. nbytes comes off the
+        # aval (no device sync); tracer-wrapped handles count too but die
+        # with the trace. Plain list adds — this is the hot path. A handle
+        # created while telemetry is off carries the None sentinel so a
+        # later toggle can never skew the gauge negative.
+        if _tm_memory.enabled():
+            nb = int(getattr(data, "nbytes", 0) or 0)
+            self._live_bytes = nb
+            _tm_memory.ndarray_created(nb)
+        else:
+            self._live_bytes = None
+
+    def __del__(self):
+        # interpreter shutdown may have torn the module down — never raise
+        try:
+            if self._live_bytes is not None:
+                _tm_memory.ndarray_freed(self._live_bytes)
+        except Exception:
+            pass
 
     # -- core properties --------------------------------------------------
     @property
@@ -151,6 +172,11 @@ class NDArray:
         """Swap the underlying buffer (functional mutation)."""
         self._data = new_data
         self._version += 1
+        if self._live_bytes is not None:
+            nb = int(getattr(new_data, "nbytes", 0) or 0)
+            if nb != self._live_bytes:
+                _tm_memory.ndarray_resized(nb - self._live_bytes)
+                self._live_bytes = nb
 
     # -- sync / transfer (engine boundary) --------------------------------
     def wait_to_read(self):

@@ -442,12 +442,30 @@ class PipelineTrainer:
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
         from .. import compile as _compile
 
+        # minted BEFORE the fill: the AOT lower below traces the model and
+        # the RNG chain must never initialize inside a trace (trainer.py)
+        key = _random.next_key()
+        # aval-only example args as a thunk (see trainer.py): on a true
+        # fill they let the registry capture memory_analysis figures and
+        # run the donation verifier on the fused pipeline step
+        def example_avals():
+            import jax as _jax
+
+            aval = lambda a: _jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+            return (aval(key), _jax.ShapeDtypeStruct((), "float32"),
+                    _jax.ShapeDtypeStruct((), "float32"),
+                    [aval(a) for a in self._outer_arrays],
+                    [aval(a) for a in self._cell_leaves],
+                    _jax.tree_util.tree_map(aval, list(self._states)),
+                    *map(aval, arrs))
+
         fn = _compile.get_or_build(
             _compile.ExecutableKey("pipeline_step", self._compile_token,
                                    shapes=sig, sharded=True,
                                    donation=(3, 4, 5), no_persist=True),
             lambda: self._build_step([a.shape for a in arrs]),
-            label="pipeline_trainer_step")
+            label="pipeline_trainer_step",
+            example_args=example_avals)
 
         import jax
 
@@ -458,7 +476,6 @@ class PipelineTrainer:
         o.num_update = max(self._step_count + o.begin_num_update,
                            o.num_update)
         lr = self._host_lr()
-        key = _random.next_key()
         t = jnp.asarray(self._step_count, dtype=jnp.float32)
         loss_val, self._outer_arrays, self._cell_leaves, self._states = fn(
             key, t, jnp.asarray(lr, dtype=jnp.float32),

@@ -339,12 +339,34 @@ class DistributedTrainer:
         from .. import compile as _compile
         from .. import telemetry
 
+        # the step's RNG key is minted BEFORE the executable fill: the AOT
+        # lower below traces _trace_forward, and the global RNG chain must
+        # be initialized eagerly — a lazy first _get() inside a trace would
+        # store a tracer into process state (UnexpectedTracerError later)
+        key = _random.next_key()
+        # aval-only example args (ShapeDtypeStruct — committed host arrays
+        # would fail the lower's sharding validation), passed as a THUNK
+        # so a steady-state step pays nothing: on a true fill they let the
+        # registry capture memory_analysis figures and run the donation
+        # verifier on the fused step (telemetry.memory,
+        # docs/observability.md §Memory)
+        def example_avals():
+            import jax
+
+            aval = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+            return (aval(key), jax.ShapeDtypeStruct((), "float32"),
+                    jax.ShapeDtypeStruct((), "float32"),
+                    [aval(a) for a in self._arrays],
+                    jax.tree_util.tree_map(aval, list(self._states)),
+                    *[aval(b) for b in batch])
+
         fn = _compile.get_or_build(
             _compile.ExecutableKey("dist_step", self._compile_token,
                                    shapes=sig, sharded=True,
                                    donation=(3, 4), no_persist=True),
             lambda: self._build_step([b.shape for b in batch]),
             label="dist_trainer_step",
+            example_args=example_avals,
             on_fill=lambda: telemetry.counter(
                 "mxtpu_executor_build_total", {"what": "dist_step"}).inc(),
             event_fields={"batch_sig": str(sig)})
@@ -358,7 +380,6 @@ class DistributedTrainer:
         o = self._optimizer
         o.num_update = max(self._step_count + o.begin_num_update, o.num_update)
         lr = self._host_lr()
-        key = _random.next_key()
         t = jnp.asarray(self._step_count, dtype=jnp.float32)
         from .. import telemetry
 
@@ -399,6 +420,9 @@ class DistributedTrainer:
         from ..ndarray import NDArray
 
         x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        # minted before the fill: the AOT lower must never initialize the
+        # RNG chain inside its trace (see step())
+        key = _random.next_key()
         sig = (tuple(x.shape), str(x.dtype), is_train)
         entry = self._fwd_compiled.get(sig)
         if entry is None:
@@ -427,11 +451,15 @@ class DistributedTrainer:
                 _compile.ExecutableKey("dist_forward", self._compile_token,
                                        shapes=sig, sharded=True,
                                        no_persist=True),
-                build, label="dist_trainer_forward")
+                build, label="dist_trainer_forward",
+                example_args=lambda: (
+                    jax.ShapeDtypeStruct(key.shape, key.dtype),
+                    [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for a in self._arrays],
+                    jax.ShapeDtypeStruct(x.shape, x.dtype)))
             entry = (fn, aux_order)
             self._fwd_compiled[sig] = entry
         fn, aux_order = entry
-        key = _random.next_key()
         out, aux_new = fn(key, self._arrays, self._shard_batch(x))
         # train-mode forward advances BatchNorm running stats (gluon
         # semantics); write the updates back into the mesh param set

@@ -30,7 +30,8 @@ from __future__ import annotations
 
 from .batcher import (  # noqa: F401
     DeadlineExceededError, DrainingError, DynamicBatcher,
-    ModelUnavailableError, OverloadedError, QueueFullError, ServeRequest,
+    MemoryBudgetError, ModelUnavailableError, OverloadedError,
+    QueueFullError, ServeRequest,
     ServingError, bucket_for, pad_batch, power_of_two_buckets,
 )
 from .model_repository import (  # noqa: F401
@@ -43,6 +44,7 @@ __all__ = [
     "DynamicBatcher", "ServeRequest", "ModelRepository", "ServedModel",
     "ServingServer", "ReplicaPool", "ServingError", "QueueFullError",
     "DeadlineExceededError", "ModelUnavailableError", "DrainingError",
-    "OverloadedError", "power_of_two_buckets", "bucket_for", "pad_batch",
+    "OverloadedError", "MemoryBudgetError", "power_of_two_buckets",
+    "bucket_for", "pad_batch",
     "build_runner",
 ]

@@ -43,6 +43,7 @@ from ..base import MXNetError, unpad_outputs
 __all__ = [
     "ServingError", "QueueFullError", "DeadlineExceededError",
     "ModelUnavailableError", "DrainingError", "OverloadedError",
+    "MemoryBudgetError",
     "power_of_two_buckets", "bucket_for", "pad_batch", "DynamicBatcher",
     "drain_timeout_s",
 ]
@@ -116,6 +117,16 @@ class OverloadedError(ServingError):
     def __init__(self, msg, retry_after=1):
         super().__init__(msg)
         self.retry_after = max(1, int(retry_after))
+
+
+class MemoryBudgetError(ServingError):
+    """A model load's computed device footprint (per-executable
+    `memory_analysis()` figures, docs/observability.md §Memory) exceeds
+    ``MXTPU_SERVE_MEMORY_BUDGET``: the load is rejected BEFORE publish —
+    at admission time, deterministically — instead of letting the
+    process OOM under traffic. 507 Insufficient Storage."""
+
+    status = 507
 
 
 # ---------------------------------------------------------------------------

@@ -35,8 +35,10 @@ from .. import compile as _compile
 from .. import env as _env
 from .. import telemetry
 from ..base import MXNetError
-from .batcher import (DynamicBatcher, ModelUnavailableError,
-                      drain_timeout_s, power_of_two_buckets)
+from ..telemetry import memory as _tm_memory
+from .batcher import (DynamicBatcher, MemoryBudgetError,
+                      ModelUnavailableError, drain_timeout_s,
+                      power_of_two_buckets)
 
 __all__ = ["ServedModel", "ModelRepository", "build_runner"]
 
@@ -78,6 +80,11 @@ class ServedModel:
         #                             filled/loaded (docs/compile_cache.md)
         self.bucket_flops = {}  # bucket -> FLOPs per batch (warm-time
         #                         cost analysis; {} when unavailable)
+        self.bucket_memory = {}  # bucket -> memory_analysis figures of
+        #                          the executables the bucket warm
+        #                          filled/loaded ({} when unavailable)
+        self.memory_bytes = None  # model device footprint from the
+        #                           figures (docs/observability.md §Memory)
         self._runner = runner
         self._pool = pool
         if pool is not None:
@@ -145,6 +152,9 @@ class ServedModel:
         model.warm_seconds = info.get("warm_seconds")
         if info.get("bucket_flops"):
             model.set_bucket_flops(info["bucket_flops"])
+        if info.get("bucket_memory"):
+            # figures computed replica-side during its warm (ready frame)
+            model.set_bucket_memory(info["bucket_memory"])
         # the replica's executable key-set (it wrote the warmup manifest
         # worker-side, next to the artifacts it filled/loaded)
         if path is not None:
@@ -270,6 +280,40 @@ class ServedModel:
                             {"model": "%s/%d" % (self.name, self.version),
                              "bucket": str(b)}).set(f)
 
+    @property
+    def resident_copies(self):
+        """How many full copies of the model are resident: each replica
+        worker process warms its own weights + executables, so a pooled
+        model costs N× its single-copy footprint."""
+        try:
+            return max(1, int(self.meta.get("replicas") or 1))
+        except (TypeError, ValueError):
+            return 1
+
+    @property
+    def effective_memory_bytes(self):
+        """Single-copy footprint × resident copies — what the
+        ``MXTPU_SERVE_MEMORY_BUDGET`` admission check charges."""
+        if not self.memory_bytes:
+            return None
+        return self.memory_bytes * self.resident_copies
+
+    def set_bucket_memory(self, bucket_memory):
+        """Record per-bucket memory figures (summed `memory_analysis()`
+        of the executables each bucket warm filled or loaded from the
+        persistent tier), derive the model's single-copy device
+        footprint, and publish the EFFECTIVE (× replicas) figure as
+        ``mxtpu_serve_model_memory_bytes`` — the number the
+        ``MXTPU_SERVE_MEMORY_BUDGET`` admission check enforces and the
+        answer to "how many replicas of this model fit on a chip"."""
+        self.bucket_memory = {int(b): dict(f)
+                              for b, f in bucket_memory.items() if f}
+        self.memory_bytes = _tm_memory.model_footprint(self.bucket_memory)
+        if self.effective_memory_bytes:
+            telemetry.gauge("mxtpu_serve_model_memory_bytes",
+                            {"model": "%s/%d" % (self.name, self.version)}
+                            ).set(self.effective_memory_bytes)
+
     def warm(self):
         """One zeros-forward per bucket: populates the executable cache so
         steady-state traffic never compiles, and — with automatic FLOP
@@ -284,18 +328,33 @@ class ServedModel:
             return self.warm_seconds
         t_all = time.monotonic()
         bucket_flops = {}
+        bucket_memory = {}
         for b in self._batcher.buckets:
             zeros = {k: _np.zeros((b,) + s, dtype=self.input_dtypes[k])
                      for k, s in self.example_shapes.items()}
             t0 = time.monotonic()
             f0 = _flops.total()
-            self._runner(zeros, b, b)
+            m0 = _tm_memory.recorded_mark()
+            _compile.begin_touch_log()
+            try:
+                self._runner(zeros, b, b)
+            finally:
+                touched = _compile.end_touch_log()
             bucket_flops[b] = _flops.total() - f0
+            # memory figures of the executables THIS bucket's warm filled,
+            # deserialized (zero-compile cold starts read them from the
+            # artifact headers) or merely TOUCHED as memory-tier hits (the
+            # reload path) — docs/observability.md §Memory
+            bucket_memory[b] = _tm_memory.bucket_figures(
+                touched, _tm_memory.recorded_since(m0))
             telemetry.record_event(
                 "serve_bucket_warm", model=self.name, version=self.version,
                 bucket=b, seconds=round(time.monotonic() - t0, 4),
-                flops=bucket_flops[b] or None)
+                flops=bucket_flops[b] or None,
+                memory_bytes=_tm_memory.footprint_bytes(bucket_memory[b])
+                or None)
         self.set_bucket_flops(bucket_flops)
+        self.set_bucket_memory(bucket_memory)
         self.warm_seconds = time.monotonic() - t_all
         self.warmed = True
         return self.warm_seconds
@@ -333,6 +392,11 @@ class ServedModel:
             "meta": self.meta,
             "compile": {"manifest": self.manifest_id,
                         "digests": list(self.compile_digests)},
+            "memory": {"total_bytes": self.memory_bytes,
+                       "copies": self.resident_copies,
+                       "effective_bytes": self.effective_memory_bytes,
+                       "per_bucket": {str(b): f for b, f in
+                                      sorted(self.bucket_memory.items())}},
         }
         if self._pool is not None:
             out["pool"] = self._pool.describe()
@@ -532,6 +596,9 @@ class ModelRepository:
                     # drop staged prefetch entries the warm never claimed
                     # (stale manifest rows must not stay pinned)
                     _compile.clear_staged()
+                # memory-budget admission happens inside add(), under the
+                # repository lock — a rejected load raises the typed
+                # MemoryBudgetError here and tears the model down below
                 return self.add(model)
             except Exception:
                 model.close(drain=False, timeout=0)  # no thread/weight leak
@@ -540,15 +607,57 @@ class ModelRepository:
             with self._lock:
                 self._loading.discard((name, version))
 
+    def _check_memory_budget_locked(self, model):
+        """The ``MXTPU_SERVE_MEMORY_BUDGET`` admission check, evaluated
+        UNDER the repository lock so two concurrent loads cannot both
+        pass against the same headroom: already-published models'
+        footprints plus this one must fit the budget. Returns the
+        over-budget message for warn-only mode, raises `MemoryBudgetError`
+        (HTTP 507) otherwise; unknown footprints (no figures recorded —
+        accounting off, or a backend without memory_analysis) never
+        block a load."""
+        limit, warn_only = _tm_memory.serve_memory_budget()
+        needed = model.effective_memory_bytes  # N replicas = N copies
+        if not limit or not needed:
+            return None
+        resident = sum(m.effective_memory_bytes or 0
+                       for vs in self._models.values() for m in vs.values())
+        total = resident + needed
+        if total <= limit:
+            return None
+        telemetry.record_event(
+            "serve_memory_budget", model=model.name, version=model.version,
+            footprint_bytes=needed, copies=model.resident_copies,
+            resident_bytes=resident, budget_bytes=limit,
+            action="warn" if warn_only else "reject")
+        msg = ("loading %s/%d needs %d bytes (%d bytes/copy x %d "
+               "replica(s); %d already resident); budget "
+               "MXTPU_SERVE_MEMORY_BUDGET=%d cannot fit it"
+               % (model.name, model.version, needed, model.memory_bytes,
+                  model.resident_copies, resident, limit))
+        if not warn_only:
+            raise MemoryBudgetError(msg)
+        return msg
+
     def add(self, model):
-        """Publish an already-built ServedModel (tests inject stubs here)."""
+        """Publish an already-built ServedModel (tests inject stubs here).
+        The memory-budget admission check runs here, under the lock —
+        a rejected model raises `MemoryBudgetError` and is never
+        published (`load` tears it down)."""
         with self._lock:
-            versions = self._models.setdefault(model.name, {})
-            if model.version in versions:
+            if model.version in self._models.get(model.name, {}):
                 raise MXNetError("model %s/%d is already loaded"
                                  % (model.name, model.version))
-            versions[model.version] = model
+            # raises BEFORE any mutation: a rejected name never appears
+            # half-registered in names()/describe()
+            over_budget = self._check_memory_budget_locked(model)
+            self._models.setdefault(model.name, {})[model.version] = model
             self._m_loaded.set(sum(len(v) for v in self._models.values()))
+        if over_budget:
+            import logging
+
+            logging.getLogger("mxnet_tpu.serving").warning(
+                "%s (warn-only budget: publishing anyway)", over_budget)
         telemetry.record_event("serve_model_load", model=model.name,
                                version=model.version)
         return model

@@ -24,7 +24,8 @@ pool's localhost listener, and serves length-prefixed pickled messages:
     router -> replica   {kind: predict, id, arrays, bucket, n, remaining}
                         {kind: ping, id} | {kind: shutdown}
     replica -> router   {kind: hello, replica, generation, pid}
-                        {kind: ready, warm_seconds}
+                        {kind: ready, warm_seconds, bucket_flops,
+                         bucket_memory, compile_digests, ...}
                         {kind: result, id, outputs, seconds}
                         {kind: expired, id} | {kind: error, id, error}
                         {kind: pong, id}
@@ -375,10 +376,12 @@ def worker_main(argv=None):
     # models, docs/serving.md)
     warm_s = 0.0
     bucket_flops = {}
+    bucket_memory = {}
     if not args.no_warm:
         import numpy as np
 
         from ..telemetry import flops as _tm_flops
+        from ..telemetry import memory as _tm_memory
 
         t0 = time.monotonic()
         for b in buckets:
@@ -386,10 +389,21 @@ def worker_main(argv=None):
                                  dtype=(input_dtypes or {}).get(k, "float32"))
                      for k, s in example_shapes.items()}
             f0 = _tm_flops.total()
-            runner(zeros, b, b)
+            m0 = _tm_memory.recorded_mark()
+            _compile.begin_touch_log()
+            try:
+                runner(zeros, b, b)
+            finally:
+                touched = _compile.end_touch_log()
             f = _tm_flops.total() - f0
             if f:
                 bucket_flops[int(b)] = f
+            # memory figures the bucket's warm filled/deserialized/touched
+            # — the router prices the pool's footprint from the ready frame
+            mem = _tm_memory.bucket_figures(touched,
+                                            _tm_memory.recorded_since(m0))
+            if mem:
+                bucket_memory[int(b)] = mem
         warm_s = time.monotonic() - t0
     # record this replica's executable key-set and (re)write the warmup
     # manifest so the NEXT cold start — a respawned generation or a fresh
@@ -409,6 +423,7 @@ def worker_main(argv=None):
     send_msg(sock, {"kind": "ready", "replica": args.replica,
                     "generation": args.generation, "warm_seconds": warm_s,
                     "bucket_flops": bucket_flops or None,
+                    "bucket_memory": bucket_memory or None,
                     "buckets": list(buckets),
                     "example_shapes": {k: tuple(v)
                                        for k, v in example_shapes.items()},

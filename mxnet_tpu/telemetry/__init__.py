@@ -31,6 +31,7 @@ from .recorder import (  # noqa: F401
 )
 from . import core as _core
 from . import flops  # noqa: F401  (automatic FLOP accounting)
+from . import memory  # noqa: F401  (HBM/RSS attribution + live gauges)
 from . import tracing  # noqa: F401  (distributed request/step spans)
 
 __all__ = [
@@ -39,7 +40,7 @@ __all__ = [
     "record_event", "record_step", "events", "dump", "dump_path",
     "last_step", "install_signal_handler", "observe_step", "set_step_flops",
     "rank", "restart_generation", "telemetry_dir", "tracing", "flops",
-    "LATENCY_BOUNDS", "BYTE_BOUNDS",
+    "memory", "LATENCY_BOUNDS", "BYTE_BOUNDS",
 ]
 
 
@@ -115,7 +116,12 @@ def observe_step(duration_s, examples=None, step=None, kind="train"):
     if not _core._STATE.enabled:
         return
     hist, c_steps, c_examples, g_eps, g_mfu, g_auto = _step_metrics(kind)
-    hist.observe(duration_s, exemplar=tracing.current_trace_id())
+    trace_id = tracing.current_trace_id()
+    hist.observe(duration_s, exemplar=trace_id)
+    # per-step peak-memory growth (device peak or VmHWM), exemplared with
+    # the step's trace so a memory spike names a renderable trace
+    memory.observe_step_delta(exemplar=trace_id)
+    memory.ensure_poller()
     c_steps.inc()
     if examples is not None and duration_s > 0:
         c_examples.inc(int(examples))

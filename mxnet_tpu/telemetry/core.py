@@ -428,9 +428,13 @@ def flush(directory=None, reason="manual"):
     directory = directory or telemetry_dir()
     if not directory or not _STATE.enabled:
         return None
+    from . import memory
     from . import recorder
     from . import tracing
 
+    # refresh the memory gauges (RSS/VmHWM, NDArray live, device stats)
+    # so every snapshot line carries current residency figures
+    memory.sample()
     path = _jsonl_path(directory)
     try:
         os.makedirs(directory, exist_ok=True)
@@ -542,6 +546,9 @@ def start_http_server(port=None, addr="0.0.0.0"):
             if self.path.rstrip("/") not in ("", "/metrics"):
                 self.send_error(404)
                 return
+            from . import memory
+
+            memory.sample()  # scrape-time residency refresh
             body = prometheus_text().encode()
             self.send_response(200)
             self.send_header("Content-Type",

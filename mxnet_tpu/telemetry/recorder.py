@@ -41,8 +41,8 @@ import traceback
 
 from .. import env as _env
 from . import core
-from . import tracing  # imported HERE, not inside dump(): an import in a
-#                        signal handler could deadlock on the import lock
+from . import memory  # imported HERE, not inside dump(): an import in a
+from . import tracing  # signal handler could deadlock on the import lock
 
 __all__ = ["record_event", "record_step", "events", "dump", "dump_path",
            "last_step", "install_signal_handler", "drain_pending_events"]
@@ -178,6 +178,10 @@ def dump(reason, path=None):
             # which phase each thread is stuck in, straight from the
             # span table (lock-free dict snapshot — signal-safe)
             "active_spans": tracing.active_spans(),
+            # what was resident: RSS/VmHWM (fresh /proc read), last-polled
+            # device stats, NDArray live counts, top executables by temp
+            # bytes — every hang/OOM dump says where the memory went
+            "memory": memory.snapshot(),
             "threads": _thread_stacks(),
             "events": events(),
             "metrics": core.snapshot(),

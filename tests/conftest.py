@@ -53,13 +53,25 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
         return
     wall = time.time() - t0
     budget = 1500  # keep in sync with the ROADMAP.md tier-1 timeout
+    # suite peak RSS (ru_maxrss high-water mark) rides the report so the
+    # next tier-1 budget renegotiation has memory data, not just wall time
+    peak_rss = None
+    try:
+        import resource
+
+        peak_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        pass
     terminalreporter.write_line(
-        "[tier-1] suite wall time: %.0fs (budget %ds, %.0f%% used)"
-        % (wall, budget, 100.0 * wall / budget))
+        "[tier-1] suite wall time: %.0fs (budget %ds, %.0f%% used)%s"
+        % (wall, budget, 100.0 * wall / budget,
+           "" if peak_rss is None
+           else ", peak RSS %.0f MiB" % (peak_rss / (1 << 20))))
     out = os.environ.get("MXTPU_WALLTIME_FILE")
     if out:
         with open(out, "a") as f:
             f.write(json.dumps({"utc": time.strftime("%FT%TZ", time.gmtime()),
                                 "wall_s": round(wall, 1),
                                 "budget_s": budget,
+                                "peak_rss_bytes": peak_rss,
                                 "exit": int(exitstatus)}) + "\n")

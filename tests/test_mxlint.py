@@ -532,6 +532,27 @@ def test_metric_registry_clean_and_unverifiable(tmp_path):
     assert len(got) == 1 and "unverifiable" in got[0].message
 
 
+def test_metric_registry_covers_memory_metrics():
+    """The §Memory metrics (telemetry/memory.py) are visible to the
+    checker — labeled emissions (`core.gauge(name, labels)`) parse to
+    literal names — and every one is documented, both directions."""
+    from ci.mxlint.checkers.metric_registry import (documented_names,
+                                                    emitted_names)
+
+    repo = Repo(ROOT)
+    emitted, _ = emitted_names(repo)
+    documented, _ = documented_names(repo)
+    for name in ("mxtpu_device_bytes_in_use", "mxtpu_device_bytes_peak",
+                 "mxtpu_device_bytes_limit", "mxtpu_process_rss_bytes",
+                 "mxtpu_process_vmhwm_bytes", "mxtpu_ndarray_live",
+                 "mxtpu_ndarray_live_bytes", "mxtpu_step_peak_bytes_delta",
+                 "mxtpu_donation_declared_bytes",
+                 "mxtpu_donation_alias_bytes",
+                 "mxtpu_serve_model_memory_bytes"):
+        assert name in emitted, "library no longer emits %s" % name
+        assert name in documented, "%s missing from observability.md" % name
+
+
 def test_metric_registry_dynamic_names_skipped(tmp_path):
     repo = _tree(tmp_path, {
         "mxnet_tpu/emit.py": """\

@@ -201,6 +201,16 @@ if ls "$COLD_TDIR"/coldstart_bench_*/telemetry_*/*.jsonl >/dev/null 2>&1; then
 fi
 rm -rf "$COLD_TDIR"
 
+# memory row: the serving memory budget's evidence (docs/observability.md
+# §Memory) — per-bucket memory_analysis footprint, over-budget load
+# rejected / within-budget accepted / warn-mode canary, and the donation
+# verifier confirming the fused trainer step aliases its donated buffers
+echo "[bench_capture] serve memory budget" >&2
+env PYTHONPATH=".:${PYTHONPATH:-}" \
+  timeout 900 python tools/memory_bench.py \
+  > "BENCH_${TAG}_memory.json" 2> "BENCH_${TAG}_memory.log"
+echo "[bench_capture] serve memory rc=$?" >&2
+
 # trace row: render the archived telemetry JSONL (serve_bench samples
 # every request at --trace-sample 1.0, so the serve rows' JSONL carries
 # the full span stream) into perfetto-loadable merged traces next to the
@@ -221,4 +231,10 @@ echo "[bench_capture] running tpu smoke suite" >&2
 MXTPU_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_smoke.py -v \
   > "TPU_SMOKE_${TAG}.log" 2>&1
 echo "[bench_capture] smoke rc=$?" >&2
+
+# refresh the committed bench trajectory (docs/bench_trajectory.md +
+# BENCH_TRAJECTORY.json) so this capture's rows land in the reviewer table
+echo "[bench_capture] bench history" >&2
+PYTHONPATH=".:${PYTHONPATH:-}" timeout 120 python tools/bench_history.py \
+  2>> /dev/stderr || echo "[bench_capture] bench history failed" >&2
 echo "[bench_capture] done" >&2

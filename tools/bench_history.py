@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""bench_history: aggregate committed ``BENCH_*.json`` evidence into one
+trajectory table.
+
+20+ bench artifacts are committed at the repo root (bench.py rows,
+serve_bench, failover, coldstart, memory rows — every PR adds more), but
+a reviewer asking "how has throughput moved across PRs?" has to open
+them one by one. This tool reads every ``BENCH_*.json``, extracts each
+row's headline figure with schema-aware extractors (the artifacts were
+never one schema and never will be — stale/error rows are kept and
+labeled, not hidden), and writes:
+
+  * ``docs/bench_trajectory.md`` — the human table, sorted by capture
+    round then row name;
+  * ``BENCH_TRAJECTORY.json`` — the machine-readable rows (plots, CI
+    trend checks).
+
+Run it directly or let ``tools/bench_capture.sh`` append the current
+capture's rows at the end of every run:
+
+    python tools/bench_history.py [--root DIR] [--quiet]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+# the row group is LAZY so a trailing `_stale` relabel (bench_capture.sh
+# dial-failure path) lands in the stale group instead of being swallowed
+# into the row name — stale captures must render as stale
+_NAME_RE = re.compile(r"BENCH_(?:(?P<scope>local)_)?r(?P<round>\d+)"
+                      r"(?:_(?P<row>[A-Za-z0-9_]+?))?(?P<stale>_stale)?"
+                      r"\.json$")
+
+
+def _fmt(v, nd=2):
+    if v is None:
+        return ""
+    if isinstance(v, float):
+        return ("%%.%df" % nd) % v
+    return str(v)
+
+
+def _extract(doc):
+    """(metric, value, unit, detail) headline for one artifact, by schema
+    family. Unknown schemas degrade to a labeled raw row, never a skip."""
+    if not isinstance(doc, dict):
+        return ("unparsed", None, "", "non-object JSON")
+    # bench_capture probe-failure rows ({"n":..,"rc":..,"tail":..} or
+    # explicit error/stale labels)
+    if doc.get("error") or ("rc" in doc and doc.get("rc") not in (0, None)):
+        return ("capture_failed", None, "",
+                str(doc.get("error") or "rc=%s" % doc.get("rc"))[:60])
+    mode = doc.get("mode")
+    if mode == "serve_bench":
+        b = doc.get("batched") or {}
+        s = doc.get("sequential") or {}
+        detail = "seq %s rps, x%s, p99 %sms" % (
+            _fmt(s.get("rps"), 1),
+            _fmt(doc.get("speedup_batched_vs_sequential")),
+            _fmt(b.get("p99_ms"), 1))
+        return ("serve_batched_rps", b.get("rps"), "req/s", detail)
+    if mode == "serve_failover":
+        lw = doc.get("loss_window") or {}
+        return ("failover_rps", doc.get("rps_overall"), "req/s",
+                "loss-window %s rps, %s errors, recovery %ss" % (
+                    _fmt(lw.get("rps"), 1), _fmt(doc.get("unresolved"), 0),
+                    _fmt(doc.get("recovery_s"), 1)))
+    if mode == "serve_memory":
+        return ("serve_memory", doc.get("footprint_bytes"), "bytes",
+                "budget reject=%s accept=%s, donation aliased=%s" % (
+                    doc.get("over_budget_rejected"),
+                    doc.get("within_budget_accepted"),
+                    _fmt((doc.get("donation") or {}).get(
+                        "aliased_fraction"))))
+    metric = doc.get("metric") or ""
+    if metric.startswith("coldstart"):
+        warm, cold = doc.get("warm") or {}, doc.get("cold") or {}
+        return (metric, warm.get("ready_s"), "s ready (warm)",
+                "cold %ss, x%s, %s jit on warm" % (
+                    _fmt(cold.get("ready_s"), 1),
+                    _fmt(doc.get("ready_speedup")),
+                    _fmt(warm.get("jit_compiles"), 0)))
+    if metric and "value" in doc:
+        detail = []
+        if doc.get("mfu") is not None:
+            detail.append("MFU %s" % _fmt(doc["mfu"], 3))
+        if doc.get("vs_baseline") is not None:
+            detail.append("x%s vs %s" % (_fmt(doc["vs_baseline"]),
+                                         (doc.get("baseline") or {}).get(
+                                             "hw", "baseline")))
+        if doc.get("stale"):
+            detail.append("STALE")
+        return (metric, doc.get("value"), doc.get("unit") or "",
+                ", ".join(detail))
+    return ("unknown_schema", None, "",
+            ", ".join(sorted(doc)[:6]))
+
+
+def collect(root):
+    """One trajectory row per BENCH_*.json under ``root``."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
+        base = os.path.basename(path)
+        if base == "BENCH_TRAJECTORY.json":
+            continue
+        m = _NAME_RE.match(base)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            doc = {"error": "unreadable: %s" % e}
+        metric, value, unit, detail = _extract(doc)
+        device = doc.get("device") or doc.get("backend") \
+            if isinstance(doc, dict) else None
+        utc = doc.get("utc") if isinstance(doc, dict) else None
+        if not utc:
+            utc = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                time.gmtime(os.path.getmtime(path)))
+        rows.append({
+            "file": base,
+            "round": int(m.group("round")) if m else None,
+            "row": (m.group("row") if m else None) or "",
+            "stale": bool(m and m.group("stale")) or bool(
+                isinstance(doc, dict) and doc.get("stale")),
+            "metric": metric,
+            "value": value,
+            "unit": unit,
+            "device": device,
+            "detail": detail,
+            "utc": utc,
+        })
+    rows.sort(key=lambda r: (r["round"] if r["round"] is not None else 999,
+                             r["row"], r["file"]))
+    return rows
+
+
+def render_markdown(rows):
+    lines = [
+        "# Bench trajectory",
+        "",
+        "Generated by `python tools/bench_history.py` from the committed",
+        "`BENCH_*.json` evidence files (one row each; `bench_capture.sh`",
+        "refreshes this table at the end of every capture). `capture_failed`",
+        "rows are kept — a stale/failed capture is evidence too",
+        "(ROADMAP item 5).",
+        "",
+        "| Round | Row | Metric | Value | Unit | Device | Detail | File |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        detail = (r["detail"] or "-").replace("|", "/")
+        if r["stale"]:
+            detail = ("**STALE** " + detail).rstrip(" -")
+        lines.append("| %s | %s | %s | %s | %s | %s | %s | `%s` |" % (
+            "r%02d" % r["round"] if r["round"] is not None else "?",
+            r["row"] or "-", r["metric"],
+            _fmt(r["value"]), r["unit"] or "-", r["device"] or "-",
+            detail, r["file"]))
+    lines += ["",
+              "%d artifact(s); machine-readable mirror: "
+              "`BENCH_TRAJECTORY.json`." % len(rows), ""]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--root", default=None,
+                   help="repo root holding BENCH_*.json (default: the "
+                        "checkout this tool lives in)")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    rows = collect(root)
+    md_path = os.path.join(root, "docs", "bench_trajectory.md")
+    os.makedirs(os.path.dirname(md_path), exist_ok=True)
+    with open(md_path, "w") as f:
+        f.write(render_markdown(rows))
+    json_path = os.path.join(root, "BENCH_TRAJECTORY.json")
+    with open(json_path, "w") as f:
+        json.dump({"generated_by": "tools/bench_history.py",
+                   "rows": rows}, f, indent=1)
+        f.write("\n")
+    if not args.quiet:
+        sys.stderr.write("[bench_history] %d rows -> %s + %s\n"
+                         % (len(rows), md_path, json_path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,7 @@ def _jsonl_events(tdir):
 def _spawn_run(tag, prefix, input_shapes, max_batch, cache_dir, workdir,
                timeout_s):
     from mxnet_tpu.serving.model_repository import ServedModel
+    from mxnet_tpu.telemetry import memory as _tm_memory
 
     import numpy as np
 
@@ -78,6 +79,10 @@ def _spawn_run(tag, prefix, input_shapes, max_batch, cache_dir, workdir,
             "buckets": buckets,
             "first_predict_ok": bool(out and out[0].shape[0] == 2),
             "compile_digests": len(model.compile_digests),
+            # ready-frame memory attribution + this phase's peak RSS
+            # (docs/observability.md §Memory)
+            "model_memory_bytes": model.memory_bytes,
+            "memory": _tm_memory.read_process_memory(),
         }
     finally:
         model.close(drain=True, timeout=10)

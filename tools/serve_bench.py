@@ -527,12 +527,21 @@ def main(argv=None):
     if args.failover:
         return _run_failover(args, prefix, input_shapes, log)
 
+    # per-phase peak-RSS bookkeeping (telemetry.memory): the serving
+    # memory budget's committed CPU evidence needs real residency numbers
+    # next to each phase's throughput
+    def phase_mem():
+        return telemetry.memory.read_process_memory() or {}
+
+    mem_phases = {"start": phase_mem()}
+
     repo = ModelRepository()
     t0 = time.perf_counter()
     model = repo.load("bench", prefix, input_shapes=input_shapes,
                       max_batch=args.max_batch, max_delay_ms=args.delay_ms,
                       queue_depth=max(1024, args.clients * 4))
     load_s = time.perf_counter() - t0
+    mem_phases["loaded"] = phase_mem()
     log("loaded buckets=%s warm=%.2fs" % (model.buckets,
                                           model.warm_seconds or 0.0))
 
@@ -565,6 +574,7 @@ def main(argv=None):
                        timeout_s=timeout_s)
     log("  sequential: %.1f req/s p50=%.1fms p99=%.1fms"
         % (seq["rps"], seq["p50_ms"], seq["p99_ms"]))
+    mem_phases["sequential"] = phase_mem()
 
     log("phase 2/3: batched closed-loop %d clients x%d ..."
         % (args.clients, args.requests))
@@ -572,6 +582,7 @@ def main(argv=None):
                            requests_each=args.requests, timeout_s=timeout_s)
     log("  batched: %.1f req/s p50=%.1fms p99=%.1fms"
         % (batched["rps"], batched["p50_ms"], batched["p99_ms"]))
+    mem_phases["batched"] = phase_mem()
 
     # mixed per-request example counts: every bucket gets traffic, and the
     # executable cache must already hold them all
@@ -590,6 +601,7 @@ def main(argv=None):
     jit_in_mixed = builds.value - builds_before_mixed
     log("  mixed: %.1f req/s; jit compiles during traffic: %d"
         % (mixed["rps"], jit_after_warm))
+    mem_phases["mixed"] = phase_mem()
 
     open_phase = None
     if args.open_rate > 0:
@@ -644,6 +656,13 @@ def main(argv=None):
         "slowest_request": slowest,
         "trace_sample": args.trace_sample,
         "bucket_flops": model.bucket_flops or None,
+        # per-executable memory attribution of the served model (what the
+        # MXTPU_SERVE_MEMORY_BUDGET admission check prices) + peak RSS at
+        # each phase boundary (docs/observability.md §Memory)
+        "model_memory": {"total_bytes": model.memory_bytes,
+                         "per_bucket": {str(b): f for b, f in
+                                        sorted(model.bucket_memory.items())}},
+        "memory_phases": mem_phases,
         "occupancy": {
             "batches": batches,
             "examples": examples,
