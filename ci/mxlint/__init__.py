@@ -16,6 +16,15 @@ invariants no general-purpose linter knows about:
     registry; every ``jax.custom_vjp`` has its ``defvjp`` backward wired.
   * ``bare-print``      — no bare ``print(`` in library code (the ported
     ``ci/lint_print.py`` rule, same allowlist semantics).
+  * ``lock-discipline`` — thread-root inventory + call-graph race
+    detector: instance state written from multiple thread roots is
+    lock-guarded, or carries a ``# mxlint: gil-atomic — <why>``
+    annotation where lock-freedom is the design.
+  * ``lock-order``      — the serving/telemetry/compile
+    acquired-while-holding lock graph stays acyclic (and non-reentrant
+    locks are never re-acquired down a call chain).
+  * ``thread-hygiene``  — every library ``threading.Thread`` passes
+    ``name=`` and is daemon or provably joined.
 
 Checker API (see ``checkers/``): a checker is an object with ``rule``,
 ``description`` and ``run(repo) -> iterable[Finding]``; per-file AST
@@ -23,6 +32,9 @@ visitors and whole-repo cross-file passes both fit. Suppression:
 
   * pragma — append ``# mxlint: disable=<rule>[,<rule>...]`` to the flagged
     line (grep-able, justification comment expected next to it);
+  * semantic annotation — ``# mxlint: gil-atomic — <why>`` marks
+    deliberately lock-free state for the lock-discipline rule
+    (docs/static_analysis.md §Annotating intentional lock-free state);
   * baseline — ``ci/mxlint/baseline.txt`` grandfathers pre-existing
     findings (``--update-baseline`` regenerates; the committed file is kept
     EMPTY — fix, don't baseline, is the default posture).

@@ -88,3 +88,262 @@ def arrayish_params(func):
 def names_in(node):
     """All bare Name ids appearing in an expression subtree."""
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# ---------------------------------------------------------------------------
+# module/class symbol graph (shared by the concurrency checkers)
+# ---------------------------------------------------------------------------
+
+def build_parents(tree):
+    """node -> parent map for the whole tree (the AST has no uplinks)."""
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def decorator_names(func):
+    """Dotted names of a def's decorators (non-chain decorators skipped)."""
+    out = set()
+    for dec in func.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted(target)
+        if name:
+            out.add(name)
+    return out
+
+
+class ClassInfo:
+    """One class: its methods, properties, and attr-initializer calls."""
+
+    def __init__(self, node):
+        self.node = node
+        self.name = node.name
+        self.bases = {dotted(b) for b in node.bases if dotted(b)}
+        self.methods = {}     # name -> FunctionDef (class body only)
+        self.properties = set()
+        for item in node.body:
+            if isinstance(item, FUNC_DEFS):
+                self.methods[item.name] = item
+                if "property" in decorator_names(item):
+                    self.properties.add(item.name)
+
+
+class ModuleIndex:
+    """Per-file symbol tables for call-graph walks: module functions,
+    classes (including ones nested in functions — stdlib-server handler
+    classes are defined that way), module-level instances of same-file
+    classes, and import aliases."""
+
+    def __init__(self, rel, tree):
+        self.rel = rel
+        self.tree = tree
+        self.parents = build_parents(tree)
+        self.functions = {}    # module-level name -> FunctionDef
+        self.classes = {}      # class name -> ClassInfo (ANY nesting depth)
+        self.instances = {}    # module-level name -> class name
+        self.mod_aliases = {}  # local alias -> imported module/name
+        self.global_assigns = {}  # module-level name -> value node
+        for node in tree.body:
+            if isinstance(node, FUNC_DEFS):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self.global_assigns[node.targets[0].id] = node.value
+        self._defs_by_name = {}  # def name -> [defs, walk order]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self.classes.setdefault(node.name, ClassInfo(node))
+            elif isinstance(node, FUNC_DEFS):
+                self._defs_by_name.setdefault(node.name, []).append(node)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    self.mod_aliases[alias.asname or alias.name] = alias.name
+        for name, value in self.global_assigns.items():
+            if isinstance(value, ast.Call):
+                cname = dotted(value.func)
+                if cname in self.classes:
+                    self.instances[name] = cname
+
+    def enclosing(self, node, kinds):
+        """Nearest ancestor of ``node`` matching ``kinds`` (or None)."""
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, kinds):
+            cur = self.parents.get(cur)
+        return cur
+
+    def enclosing_class(self, node):
+        """The ClassInfo whose body (transitively) contains ``node`` —
+        nested defs inside a method still belong to the method's class."""
+        cls = self.enclosing(node, ast.ClassDef)
+        return self.classes.get(cls.name) if cls is not None else None
+
+    def in_loop(self, node, stop=None):
+        """Is ``node`` lexically inside a For/While (searching up to the
+        enclosing function / ``stop`` node)? Threads created in a loop run
+        as multiple concurrent instances of the same root."""
+        cur = self.parents.get(node)
+        while cur is not None and cur is not stop:
+            if isinstance(cur, (ast.For, ast.While)):
+                return True
+            if isinstance(cur, FUNC_DEFS):
+                return False
+            cur = self.parents.get(cur)
+        return False
+
+    def _contains(self, ancestor, node):
+        cur = node
+        while cur is not None:
+            if cur is ancestor:
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    def find_def(self, name, near=None):
+        """A def named ``name``: module-level first, then (for nested
+        handlers/closures) one under ``near``, then anywhere in the file
+        (all via the prebuilt name index — no per-call tree walks)."""
+        target = self.functions.get(name)
+        if target is not None:
+            return target
+        candidates = self._defs_by_name.get(name)
+        if not candidates:
+            return None
+        if near is not None:
+            for cand in candidates:
+                if self._contains(near, cand):
+                    return cand
+        return candidates[0]
+
+
+class ThreadRoot:
+    """One concurrent entry point: the function that starts executing on
+    a new thread of control (thread target, signal/atexit handler, HTTP
+    handler method). ``parallel`` marks roots that run as multiple
+    concurrent instances (threads created in a loop, per-connection
+    HTTP handler threads)."""
+
+    __slots__ = ("root_id", "kind", "func", "cls", "parallel", "line")
+
+    def __init__(self, root_id, kind, func, cls, parallel, line):
+        self.root_id = root_id
+        self.kind = kind        # thread | signal | atexit | handler
+        self.func = func        # FunctionDef/Lambda to expand from
+        self.cls = cls          # ClassInfo whose `self` binds in func
+        self.parallel = parallel
+        self.line = line
+
+
+def _resolve_target(idx, expr, call_node):
+    """Resolve a thread-target/handler expression to (func, ClassInfo).
+    Handles bare names (module or nested defs), ``self._method``, lambdas
+    and ``functools.partial(f, ...)``. Returns (None, None) when the
+    target is dynamic."""
+    if isinstance(expr, ast.Lambda):
+        return expr, idx.enclosing_class(call_node)
+    if isinstance(expr, ast.Call):
+        # functools.partial(f, ...) and friends: resolve the first arg
+        if (dotted(expr.func) or "").rsplit(".", 1)[-1] == "partial" \
+                and expr.args:
+            return _resolve_target(idx, expr.args[0], call_node)
+        return None, None
+    if isinstance(expr, ast.Name):
+        func = idx.find_def(expr.id, near=idx.enclosing(call_node, FUNC_DEFS))
+        if func is not None:
+            return func, idx.enclosing_class(func)
+        return None, None
+    if isinstance(expr, ast.Attribute):
+        cls = idx.enclosing_class(call_node)
+        if isinstance(expr.value, ast.Name) and cls is not None \
+                and expr.value.id in ("self", "cls"):
+            method = cls.methods.get(expr.attr)
+            if method is not None:
+                return method, cls
+        # instance.method on a module-level instance of a same-file class
+        if isinstance(expr.value, ast.Name):
+            inst_cls = idx.instances.get(expr.value.id)
+            if inst_cls is not None:
+                info = idx.classes[inst_cls]
+                method = info.methods.get(expr.attr)
+                if method is not None:
+                    return method, info
+    return None, None
+
+
+def _is_http_server(idx, cname, tail):
+    """Does this constructor call build a threaded stdlib HTTP server —
+    directly (``ThreadingHTTPServer(...)``) or via a same-file subclass
+    (``class _Server(ThreadingHTTPServer)``)? Its handler-class argument's
+    ``do_*`` methods run one thread per connection."""
+    if tail.endswith("HTTPServer"):
+        return True
+    info = idx.classes.get(cname)
+    return info is not None and any(
+        (b or "").endswith(("HTTPServer", "ThreadingMixIn"))
+        for b in info.bases)
+
+
+def thread_roots(idx):
+    """The thread-root inventory for one file: every ``threading.Thread``
+    target (incl. lambdas, bound methods, nested defs), every
+    ``*HTTPServer`` handler class's ``do_*`` methods, ``signal.signal``
+    handlers and ``atexit.register`` hooks. Dynamic targets the resolver
+    cannot see into are omitted (their body is analyzed when some
+    resolvable root calls them)."""
+    roots = []
+    seen = {}
+
+    def add(root_id, kind, func, cls, parallel, line):
+        if func is None:
+            return
+        key = (id(func), kind)
+        prior = seen.get(key)
+        if prior is not None:
+            # same target spawned again: a later loop-spawned site makes
+            # the root parallel even if the first site was not
+            prior.parallel = prior.parallel or parallel
+            return
+        root = ThreadRoot(root_id, kind, func, cls, parallel, line)
+        seen[key] = root
+        roots.append(root)
+
+    for node in ast.walk(idx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = dotted(node.func) or ""
+        tail = cname.rsplit(".", 1)[-1]
+        if tail in ("Thread", "Timer") and (
+                "." not in cname or cname.startswith("threading.")):
+            target = keyword_value(node, "target") or keyword_value(
+                node, "function")
+            if target is None and tail == "Timer" and len(node.args) >= 2:
+                target = node.args[1]
+            func, cls = _resolve_target(idx, target, node) \
+                if target is not None else (None, None)
+            name = getattr(func, "name", "<lambda>")
+            add("thread:%s" % name, "thread", func, cls,
+                idx.in_loop(node), node.lineno)
+        elif _is_http_server(idx, cname, tail) and len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Name):
+            info = idx.classes.get(node.args[1].id)
+            if info is not None:
+                for mname, method in info.methods.items():
+                    if mname.startswith("do_") or mname == "handle":
+                        add("handler:%s" % mname, "handler", method, info,
+                            True, node.lineno)
+        elif cname == "signal.signal" and len(node.args) >= 2:
+            func, cls = _resolve_target(idx, node.args[1], node)
+            add("signal:%s" % getattr(func, "name", "?"), "signal", func,
+                cls, False, node.lineno)
+        elif cname == "atexit.register" and node.args:
+            func, cls = _resolve_target(idx, node.args[0], node)
+            add("atexit:%s" % getattr(func, "name", "?"), "atexit", func,
+                cls, False, node.lineno)
+    # @atexit.register as a decorator
+    for node in ast.walk(idx.tree):
+        if isinstance(node, FUNC_DEFS) and \
+                "atexit.register" in decorator_names(node):
+            add("atexit:%s" % node.name, "atexit", node,
+                idx.enclosing_class(node), False, node.lineno)
+    return roots

@@ -8,6 +8,8 @@ from __future__ import annotations
 
 from .bare_print import BarePrintChecker
 from .compile_registry import CompileRegistryChecker
+from .concurrency import (LockDisciplineChecker, LockOrderChecker,
+                          ThreadHygieneChecker)
 from .env_registry import EnvRegistryChecker
 from .host_sync import HostSyncChecker
 from .metric_registry import MetricRegistryChecker
@@ -22,4 +24,7 @@ CHECKERS = (
     MetricRegistryChecker(),
     CompileRegistryChecker(),
     BarePrintChecker(),
+    LockDisciplineChecker(),
+    LockOrderChecker(),
+    ThreadHygieneChecker(),
 )

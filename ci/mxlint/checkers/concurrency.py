@@ -1,0 +1,771 @@
+"""Concurrency checkers: lock-discipline, lock-order, thread-hygiene.
+
+The serving/telemetry stack is deeply multi-threaded (batcher workers,
+replica dispatch threads, heartbeat loops, the HTTP pools, the telemetry
+flusher/poller, the watchdog, the lock-free compile registry) and every
+recent review-hardening pass found at least one hand-caught data race.
+These rules automate that review the way host-sync and signal-safety
+already are:
+
+  * ``lock-discipline`` — builds the per-file **thread-root inventory**
+    (``astutil.thread_roots``: every ``threading.Thread`` target incl.
+    lambdas/bound methods/nested defs, ``*HTTPServer`` handler methods,
+    ``signal.signal`` handlers, ``atexit`` hooks), expands each root
+    through the same-file/same-class call graph with held-lock
+    propagation (a write in a helper the worker calls under ``with
+    self._cv`` counts as guarded), and flags instance-attribute writes
+    that are exposed — written with no lock held — when either
+    (a) the attribute is lock-guarded at other write sites
+    (inconsistent discipline, the classic race smell), or
+    (b) it is written from >= 2 distinct thread roots (parallel roots —
+    threads created in a loop, per-connection HTTP handlers — count
+    twice; the public API surface counts as one root).
+    Synchronized objects (``queue.Queue``/``Event``/locks/
+    ``threading.local``) are exempt from mutation tracking, but
+    REPLACING one outside ``__init__`` while another thread root still
+    uses it is flagged (the stale-queue/stale-event race).
+    Deliberate GIL-atomic state is annotated in place:
+    ``# mxlint: gil-atomic — <why>`` on the write line suppresses the
+    finding and turns intent into machine-checked documentation
+    (docs/static_analysis.md §Annotating intentional lock-free state).
+
+  * ``lock-order`` — builds the acquired-while-holding graph across the
+    serving/telemetry/compile locks (cross-file: bare calls, method
+    calls, properties, and unique duck-typed private-method calls such
+    as the batcher's ``self._admission_gate`` -> the pool's
+    ``admission_gate``) and fails on cycles, plus on re-acquiring a
+    non-reentrant lock already held. ``build_lock_graph`` is exposed so
+    the test suite can prove the HEAD graph is non-vacuously acyclic.
+
+  * ``thread-hygiene`` — every library ``threading.Thread(...)`` must
+    pass ``name=`` (flight-recorder/SIGUSR1 stack dumps must attribute
+    stacks to components, not ``Thread-7``) and be ``daemon=True`` or
+    provably ``.join()``-ed in the same file.
+
+Known limits (documented in docs/static_analysis.md): writes through
+local aliases of shared objects (``slot.state = ...``) and module-global
+names are invisible — only ``self.<attr>`` and writes through
+module-level instances (``_STATE.devices = ...``) are tracked; call
+edges are same-file for lock-discipline (cross-file reachability would
+need whole-program alias analysis). The thread-root inventory makes the
+common library shapes visible, not every shape expressible.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .. import Finding
+from ..astutil import (FUNC_DEFS, ModuleIndex, dotted, keyword_value,
+                       thread_roots)
+
+GIL_ATOMIC = "mxlint: gil-atomic"
+
+# method names that mutate their receiver in place (set()/get() excluded:
+# they collide with Event.set / dict.get / Queue.get and the telemetry
+# metric setters, which are lock-free by design)
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear", "update",
+    "difference_update", "intersection_update",
+    "symmetric_difference_update", "setdefault", "sort", "reverse",
+}
+
+# constructor tails that yield internally-synchronized objects: their
+# method mutations are safe by construction; only REPLACING them is racy
+_SYNC_TAILS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore", "Event", "Barrier", "local", "Queue",
+               "LifoQueue", "PriorityQueue", "SimpleQueue"}
+# lock-ish constructors (things a `with` block can guard state with)
+_LOCK_TAILS = {"Lock", "RLock", "Condition"}
+# telemetry metric factories: lock-free by design (docs/observability.md)
+_METRIC_TAILS = {"counter", "gauge", "histogram"}
+
+# dunder methods that are external entry points (part of the "api" root)
+_DUNDER_API = {"__call__", "__iter__", "__next__", "__enter__", "__exit__",
+               "__del__"}
+
+# receiver-method names too generic to duck-type across classes (a
+# socket's .close() must not resolve to ReplicaPool.close)
+_DUCK_SKIP = MUTATORS | {
+    "get", "put", "set", "close", "start", "join", "wait", "notify",
+    "acquire", "release", "read", "write", "send", "recv", "flush",
+    "copy", "items", "keys", "values", "encode", "decode", "strip",
+    "split", "format", "next", "drain", "describe", "pending",
+}
+
+
+def _tail(name):
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def _attr_chain(node):
+    """Peel an Attribute/Subscript chain down to its base. Returns
+    (base_name, first_attr) — e.g. ``self._table[k]`` -> ("self",
+    "_table"); ``_STATE.nd_live[0]`` -> ("_STATE", "nd_live") — or
+    (None, None) for anything not rooted in a bare name."""
+    first = None
+    while True:
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Attribute):
+            first = node.attr
+            node = node.value
+        elif isinstance(node, ast.Name):
+            return node.id, first
+        else:
+            return None, None
+
+
+class _ClassFacts:
+    """Attr classification for one class: which attrs hold synchronized /
+    metric / lock objects (from ``self.X = <Call>`` initializers)."""
+
+    def __init__(self, info):
+        self.info = info
+        self.attr_kind = {}   # attr -> "sync" | "metric" | "lock"
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                for t in node.targets:
+                    base, attr = _attr_chain(t)
+                    if base in ("self", "cls") and attr is not None and \
+                            isinstance(t, ast.Attribute):
+                        tail = _tail(dotted(node.value.func))
+                        if tail in _LOCK_TAILS:
+                            self.attr_kind[attr] = "lock"
+                        elif tail in _SYNC_TAILS:
+                            self.attr_kind.setdefault(attr, "sync")
+                        elif tail in _METRIC_TAILS:
+                            self.attr_kind.setdefault(attr, "metric")
+
+    def is_lock(self, attr):
+        if self.attr_kind.get(attr) == "lock":
+            return True
+        low = attr.lower()
+        return "lock" in low or "mutex" in low or low.endswith("_cv") \
+            or low == "_cv"
+
+
+class _FileConcurrency:
+    """The lock-discipline analysis for one file: thread-root inventory +
+    per-root reachability with held-lock propagation, producing per
+    (class, attr) write/read site tables."""
+
+    def __init__(self, rel, tree):
+        self.idx = ModuleIndex(rel, tree)
+        self.facts = {name: _ClassFacts(info)
+                      for name, info in self.idx.classes.items()}
+        self.roots = thread_roots(self.idx)
+        # (cls_name, attr) -> line -> list of (root_id, frozenset(held),
+        #                                      kind, is_init)
+        self.writes = {}
+        # (cls_name, attr) -> set of root_ids with any read access
+        self.reads = {}
+        self.parallel_roots = {r.root_id for r in self.roots if r.parallel}
+        self._visited = set()
+        self._run()
+
+    # -- driving -----------------------------------------------------------
+    def _run(self):
+        for root in self.roots:
+            self._visit(root.root_id, root.cls, root.func, frozenset())
+        # the synthetic "api" root: public module functions and public /
+        # entry-dunder methods, each expanded with no lock held
+        for func in self.idx.functions.values():
+            if not func.name.startswith("_"):
+                self._visit("api", self.idx.enclosing_class(func), func,
+                            frozenset())
+        for info in self.idx.classes.values():
+            for name, method in info.methods.items():
+                if not name.startswith("_") or name in _DUNDER_API:
+                    self._visit("api", info, method, frozenset())
+
+    def _lock_id(self, cls, expr):
+        """Canonical id of the lock an expression denotes, or None."""
+        base, attr = _attr_chain(expr)
+        if base in ("self", "cls") and attr is not None and cls is not None:
+            facts = self.facts.get(cls.name)
+            if facts is not None and facts.is_lock(attr):
+                return "%s.%s" % (cls.name, attr)
+            return None
+        if attr is not None and base in self.idx.instances:
+            icls = self.idx.instances[base]
+            if self.facts[icls].is_lock(attr):
+                return "%s.%s" % (icls, attr)
+            return None
+        if isinstance(expr, ast.Name):
+            value = self.idx.global_assigns.get(expr.id)
+            tail = _tail(dotted(value.func)) if isinstance(value, ast.Call) \
+                else None
+            if tail in _LOCK_TAILS:
+                return expr.id
+            if "lock" in expr.id.lower():
+                # a lock-ish local/closure name (`with lock:`) still
+                # counts as "some lock held"
+                return expr.id if value is not None \
+                    else "<local>.%s" % expr.id
+        return None
+
+    # -- one (root, function, held) state ----------------------------------
+    def _visit(self, root_id, cls, func, held):
+        key = (root_id, cls.name if cls else None, id(func), held)
+        if key in self._visited:
+            return
+        self._visited.add(key)
+        body = func.body if isinstance(func.body, list) else [func.body]
+        self_name = "self"
+        args = getattr(func, "args", None)
+        if cls is not None and args is not None and args.args and \
+                getattr(func, "name", None) in cls.methods:
+            self_name = args.args[0].arg
+        is_init = cls is not None and \
+            getattr(func, "name", None) == "__init__"
+        state = (root_id, cls, func, self_name, is_init)
+        for node in body:
+            self._scan(state, node, held)
+
+    def _scan(self, state, node, held):
+        if isinstance(node, FUNC_DEFS) or isinstance(node, ast.Lambda):
+            return  # separate call-graph node; analyzed when called
+        if isinstance(node, ast.With):
+            new = set(held)
+            for item in node.items:
+                lid = self._lock_id(state[1], item.context_expr)
+                if lid is not None:
+                    new.add(lid)
+                else:
+                    self._scan_children(state, item.context_expr, held)
+            for stmt in node.body:
+                self._scan(state, stmt, frozenset(new))
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                self._record_write(state, t, node, held)
+        elif isinstance(node, ast.Call):
+            self._record_call(state, node, held)
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load):
+            self._record_read(state, node)
+        self._scan_children(state, node, held)
+
+    def _scan_children(self, state, node, held):
+        for child in ast.iter_child_nodes(node):
+            self._scan(state, child, held)
+
+    # -- recording ---------------------------------------------------------
+    def _owner(self, state, base):
+        """Map a chain base name to the owning class name (None if the
+        write is to something this analysis cannot see)."""
+        root_id, cls, _func, self_name, _ = state
+        if base == self_name and cls is not None:
+            return cls.name
+        return self.idx.instances.get(base)
+
+    def _record_write(self, state, target, stmt, held, kind=None):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_write(state, elt, stmt, held, kind)
+            return
+        if not isinstance(target, (ast.Attribute, ast.Subscript)):
+            return
+        base, attr = _attr_chain(target)
+        owner = self._owner(state, base) if attr is not None else None
+        if owner is None:
+            return
+        if kind is None:
+            if isinstance(target, ast.Subscript):
+                kind = "item"
+            elif isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name):
+                kind = "assign"
+            else:
+                kind = "deep"   # self.X.Y = ... mutates self.X
+        root_id, cls, _func, _self, is_init = state
+        init_here = is_init and cls is not None and cls.name == owner \
+            and base == state[3]
+        sites = self.writes.setdefault((owner, attr), {})
+        sites.setdefault(stmt.lineno, []).append(
+            (root_id, held, kind, init_here))
+
+    def _record_read(self, state, node):
+        base, attr = _attr_chain(node)
+        if attr is None:
+            return
+        owner = self._owner(state, base)
+        if owner is not None:
+            self.reads.setdefault((owner, attr), set()).add(state[0])
+
+    def _record_call(self, state, node, held):
+        root_id, cls, func, self_name, _ = state
+        callee = node.func
+        if isinstance(callee, ast.Name):
+            target = self.idx.find_def(
+                callee.id, near=self.idx.enclosing(node, FUNC_DEFS))
+            if target is not None:
+                self._visit(root_id, self.idx.enclosing_class(target),
+                            target, held)
+            return
+        if not isinstance(callee, ast.Attribute):
+            return
+        method = callee.attr
+        # self.m() / INSTANCE.m(): resolve into the owning class
+        if isinstance(callee.value, ast.Name):
+            owner = None
+            if callee.value.id == self_name and cls is not None:
+                owner = cls
+            else:
+                icls = self.idx.instances.get(callee.value.id)
+                owner = self.idx.classes.get(icls) if icls else None
+            if owner is not None:
+                target = owner.methods.get(method)
+                if target is not None:
+                    self._visit(root_id, owner, target, held)
+                    return
+        # mutator call on a tracked attribute (self._queue.append(...),
+        # _REC.ring.append(...)) — a write to that attribute
+        wbase, wattr = _attr_chain(callee.value)
+        if method in MUTATORS and wattr is not None and \
+                self._owner(state, wbase) is not None:
+            self._record_write(state, callee.value, node, held,
+                               kind="mutate")
+            return
+        # duck-typed private-method call (req._resolve(...)): unique
+        # match across this file's classes — how cross-class root
+        # attribution (the batcher worker resolving a ServeRequest)
+        # stays visible without alias analysis
+        if method.startswith("_") and method not in _DUCK_SKIP:
+            matches = [info for info in self.idx.classes.values()
+                       if method in info.methods]
+            if len(matches) == 1:
+                self._visit(root_id, matches[0],
+                            matches[0].methods[method], held)
+
+    # -- findings ----------------------------------------------------------
+    def _root_weight(self, roots):
+        return sum(2 if r in self.parallel_roots else 1 for r in roots)
+
+    def findings(self, rule, repo):
+        out = []
+        lines = repo.lines(self.idx.rel) or []
+
+        def annotated(lineno):
+            return 0 < lineno <= len(lines) and \
+                GIL_ATOMIC in lines[lineno - 1]
+
+        for (owner, attr), sites in sorted(self.writes.items()):
+            facts = self.facts.get(owner)
+            kind = facts.attr_kind.get(attr) if facts else None
+            if kind in ("metric", "lock"):
+                continue
+            live = {line: ctxs for line, ctxs in sites.items()
+                    if not all(c[3] for c in ctxs)}     # drop __init__ writes
+            if not live:
+                continue
+            if kind == "sync":
+                out.extend(self._sync_findings(rule, owner, attr, live,
+                                               annotated))
+                continue
+            write_roots = {c[0] for ctxs in live.values() for c in ctxs}
+            guard_locks = sorted({l for ctxs in live.values() for c in ctxs
+                                  for l in c[1]})
+            weight = self._root_weight(write_roots)
+            if weight < 2 and not guard_locks:
+                continue
+            for line in sorted(live):
+                exposed = [c for c in live[line] if not c[1]]
+                if not exposed or annotated(line):
+                    continue
+                if guard_locks:
+                    msg = ("%s.%s is written while holding %s elsewhere "
+                           "but written with no lock held here (reached "
+                           "from %s) — guard it, or annotate the line "
+                           "`# %s — <why>` if GIL-atomicity is the design"
+                           % (owner, attr, "/".join(guard_locks),
+                              ", ".join(sorted({c[0] for c in exposed})),
+                              GIL_ATOMIC))
+                else:
+                    msg = ("%s.%s is written from %d thread roots (%s) "
+                           "with no lock anywhere — guard it, or annotate "
+                           "the line `# %s — <why>` if GIL-atomicity is "
+                           "the design"
+                           % (owner, attr, weight,
+                              ", ".join(sorted(write_roots)), GIL_ATOMIC))
+                out.append(Finding(rule, self.idx.rel, line, msg))
+        return out
+
+    def _sync_findings(self, rule, owner, attr, live, annotated):
+        out = []
+        read_roots = self.reads.get((owner, attr), set())
+        all_write_roots = {c[0] for ctxs in live.values() for c in ctxs}
+        for line in sorted(live):
+            ctxs = [c for c in live[line] if c[2] == "assign" and not c[3]]
+            if not ctxs or annotated(line):
+                continue
+            site_roots = {c[0] for c in ctxs}
+            others = (read_roots | all_write_roots) - site_roots
+            if others and any(not c[1] for c in ctxs):
+                out.append(Finding(
+                    rule, self.idx.rel, line,
+                    "synchronized object %s.%s is replaced outside "
+                    "__init__ while other thread roots (%s) still use it "
+                    "— a worker started against the old object feeds the "
+                    "stale one; capture it as a local in the worker or "
+                    "stop/join the worker before replacing"
+                    % (owner, attr, ", ".join(sorted(others)))))
+        return out
+
+
+class LockDisciplineChecker:
+    rule = "lock-discipline"
+    description = ("instance state written from multiple thread roots is "
+                   "lock-guarded or annotated `# mxlint: gil-atomic`")
+
+    def run(self, repo):
+        findings = []
+        for rel in repo.py_files("mxnet_tpu"):
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            try:
+                analysis = _FileConcurrency(rel, tree)
+            except RecursionError:   # pathological tree: skip, don't crash
+                continue
+            findings.extend(analysis.findings(self.rule, repo))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+_LOCK_ORDER_SCOPE = ("mxnet_tpu/serving", "mxnet_tpu/telemetry",
+                     "mxnet_tpu/compile", "mxnet_tpu/runtime.py")
+
+
+class _LockGraph:
+    """Acquired-while-holding graph across the scope files. Nodes are
+    canonical lock ids ("serving/batcher.py:DynamicBatcher._cv"); an edge
+    A -> B means some path acquires B while holding A."""
+
+    def __init__(self, repo):
+        self.repo = repo
+        self.files = {}       # rel -> (ModuleIndex, {cls -> _ClassFacts})
+        self.method_map = {}  # method name -> [(rel, ClassInfo, func)]
+        self.edges = {}       # (A, B) -> (rel, line, chain)
+        self.reacquires = []  # (lock, rel, line, chain) non-reentrant
+        self._visited = set()
+        for rel in repo.py_files(*_LOCK_ORDER_SCOPE):
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            idx = ModuleIndex(rel, tree)
+            facts = {n: _ClassFacts(i) for n, i in idx.classes.items()}
+            self.files[rel] = (idx, facts)
+            for info in idx.classes.values():
+                for name, func in info.methods.items():
+                    self.method_map.setdefault(name, []).append(
+                        (rel, info, func))
+        for rel, (idx, _facts) in sorted(self.files.items()):
+            for func in idx.functions.values():
+                self._visit(rel, None, func, (), func.name)
+            for info in idx.classes.values():
+                for name, func in info.methods.items():
+                    self._visit(rel, info, func, (),
+                                "%s.%s" % (info.name, name))
+
+    def _lock_id(self, rel, cls, expr):
+        idx, facts = self.files[rel]
+        base, attr = _attr_chain(expr)
+        if base in ("self", "cls") and attr is not None and cls is not None:
+            if facts[cls.name].is_lock(attr):
+                return "%s:%s.%s" % (rel, cls.name, attr)
+            return None
+        if attr is not None and base in idx.instances:
+            icls = idx.instances[base]
+            if facts[icls].is_lock(attr):
+                return "%s:%s.%s" % (rel, icls, attr)
+            return None
+        if isinstance(expr, ast.Name):
+            value = idx.global_assigns.get(expr.id)
+            tail = _tail(dotted(value.func)) if isinstance(value, ast.Call) \
+                else None
+            if tail in _LOCK_TAILS or \
+                    (tail is None and "lock" in expr.id.lower()):
+                return "%s:%s" % (rel, expr.id)
+        return None
+
+    @staticmethod
+    def _reentrant_ctor(call):
+        """Does this constructor build a re-acquirable lock? RLock, and a
+        default-constructed Condition (its internal lock IS an RLock —
+        nested `with cv:` is legal; `Condition(some_lock)` stays
+        conservative since the caller chose the backing lock)."""
+        tail = _tail(dotted(call.func))
+        return tail == "RLock" or (tail == "Condition" and not call.args)
+
+    def _reentrant(self, rel, lock_id):
+        """Is re-acquiring this lock legal (RLock / default Condition)?"""
+        idx, _facts = self.files[rel]
+        name = lock_id.rsplit(":", 1)[-1]
+        if "." in name:
+            cls, attr = name.split(".", 1)
+            info = idx.classes.get(cls)
+            if info is not None:
+                for node in ast.walk(info.node):
+                    if isinstance(node, ast.Assign) and \
+                            isinstance(node.value, ast.Call):
+                        for t in node.targets:
+                            _b, a = _attr_chain(t)
+                            if a == attr and self._reentrant_ctor(
+                                    node.value):
+                                return True
+            return False
+        value = idx.global_assigns.get(name)
+        return isinstance(value, ast.Call) and self._reentrant_ctor(value)
+
+    def _visit(self, rel, cls, func, held, chain):
+        key = (rel, id(func), held)
+        if key in self._visited:
+            return
+        self._visited.add(key)
+        for node in func.body:
+            self._scan(rel, cls, node, held, chain)
+
+    def _scan(self, rel, cls, node, held, chain):
+        if isinstance(node, FUNC_DEFS) or isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.With):
+            new = list(held)
+            for item in node.items:
+                lid = self._lock_id(rel, cls, item.context_expr)
+                if lid is not None:
+                    self._acquire(rel, lid, node.lineno, held, chain)
+                    if lid not in new:
+                        new.append(lid)
+                else:
+                    for child in ast.iter_child_nodes(item.context_expr):
+                        self._scan(rel, cls, child, held, chain)
+            for stmt in node.body:
+                self._scan(rel, cls, stmt, tuple(new), chain)
+            return
+        if isinstance(node, ast.Call):
+            self._resolve_call(rel, cls, node, held, chain)
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load) and cls is not None and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in ("self", "cls") and \
+                node.attr in cls.properties:
+            # property access runs code: self.healthy_count -> _lock
+            self._visit(rel, cls, cls.methods[node.attr], held,
+                        chain + " -> %s" % node.attr)
+        for child in ast.iter_child_nodes(node):
+            self._scan(rel, cls, child, held, chain)
+
+    def _acquire(self, rel, lock_id, line, held, chain):
+        if lock_id in held and not self._reentrant(rel, lock_id):
+            self.reacquires.append((lock_id, rel, line, chain))
+        for holder in held:
+            if holder != lock_id:
+                self.edges.setdefault((holder, lock_id),
+                                      (rel, line, chain))
+
+    def _resolve_call(self, rel, cls, node, held, chain):
+        callee = node.func
+        idx, _facts = self.files[rel]
+        if isinstance(callee, ast.Name):
+            # explicit .acquire()? (not used in-tree; with-blocks only)
+            target = idx.find_def(callee.id,
+                                  near=idx.enclosing(node, FUNC_DEFS))
+            if target is not None:
+                self._visit(rel, idx.enclosing_class(target), target, held,
+                            chain + " -> %s" % callee.id)
+            return
+        if not isinstance(callee, ast.Attribute):
+            return
+        method = callee.attr
+        if method == "acquire":
+            lid = self._lock_id(rel, cls, callee.value)
+            if lid is not None:
+                self._acquire(rel, lid, node.lineno, held, chain)
+            return
+        # self.m() / INSTANCE.m() in-class resolution
+        if isinstance(callee.value, ast.Name):
+            owner = None
+            if callee.value.id in ("self", "cls") and cls is not None:
+                owner = cls
+            else:
+                icls = idx.instances.get(callee.value.id)
+                owner = idx.classes.get(icls) if icls else None
+            if owner is not None and method in owner.methods:
+                self._visit(rel, owner, owner.methods[method], held,
+                            chain + " -> %s" % method)
+                return
+            # module-alias call into another scope file (core.flush())
+            alias = idx.mod_aliases.get(callee.value.id)
+            if owner is None and alias is not None:
+                tail = alias.rsplit(".", 1)[-1]
+                for orel, (oidx, _of) in self.files.items():
+                    if orel.rsplit("/", 1)[-1] == tail + ".py" and \
+                            method in oidx.functions:
+                        self._visit(orel, None, oidx.functions[method],
+                                    held, chain + " -> %s.%s"
+                                    % (tail, method))
+                        return
+        # duck-typed unique resolution across the scope: private names
+        # always; public names only when not generic (_DUCK_SKIP) — this
+        # is how `self._admission_gate(...)` (an attribute holding
+        # `pool.admission_gate`) and `self._batcher.requeue(...)` resolve
+        for name in (method, method.lstrip("_")):
+            if name in _DUCK_SKIP or (not method.startswith("_")
+                                      and name != method):
+                continue
+            matches = self.method_map.get(name, [])
+            if len(matches) == 1:
+                mrel, info, func = matches[0]
+                self._visit(mrel, info, func, held,
+                            chain + " -> %s" % name)
+                return
+
+    def cycles(self):
+        """Every simple cycle reachable in the edge set (tiny graphs:
+        plain DFS is fine)."""
+        adj = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        found = []
+        seen_cycles = set()
+
+        def dfs(start, node, path):
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    cyc = tuple(sorted(path))
+                    if cyc not in seen_cycles:
+                        seen_cycles.add(cyc)
+                        found.append(path[:])
+                elif nxt not in path and nxt > start:
+                    dfs(start, nxt, path + [nxt])
+
+        for start in sorted(adj):
+            dfs(start, start, [start])
+        return found
+
+
+def build_lock_graph(repo):
+    """The acquired-while-holding graph (test hook: proves the HEAD
+    serving/telemetry/compile graph is non-vacuously acyclic)."""
+    return _LockGraph(repo)
+
+
+class LockOrderChecker:
+    rule = "lock-order"
+    description = ("the serving/telemetry/compile acquired-while-holding "
+                   "lock graph is acyclic (no lock-order deadlocks)")
+
+    def run(self, repo):
+        graph = _LockGraph(repo)
+        findings = []
+        for lock_id, rel, line, chain in graph.reacquires:
+            findings.append(Finding(
+                self.rule, rel, line,
+                "non-reentrant lock %s re-acquired while already held "
+                "(via %s) — self-deadlock" % (lock_id, chain)))
+        for cycle in graph.cycles():
+            closed = cycle + [cycle[0]]
+            rel, line, chain = graph.edges[(cycle[0], closed[1])]
+            findings.append(Finding(
+                self.rule, rel, line,
+                "lock-order cycle: %s — threads taking these locks in "
+                "different orders can deadlock; pick one order (first "
+                "edge via %s)" % (" -> ".join(closed), chain)))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# thread-hygiene
+# ---------------------------------------------------------------------------
+
+class ThreadHygieneChecker:
+    rule = "thread-hygiene"
+    description = ("library threads pass name= and are daemon or joined "
+                   "(readable flight-recorder stack dumps, no shutdown "
+                   "leaks)")
+
+    def run(self, repo):
+        findings = []
+        for rel in repo.py_files("mxnet_tpu"):
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            idx = ModuleIndex(rel, tree)
+            src = "\n".join(repo.lines(rel) or [])
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = dotted(node.func) or ""
+                tail = _tail(cname)
+                if tail not in ("Thread", "Timer") or (
+                        "." in cname and not cname.startswith("threading.")):
+                    continue
+                # Timer's constructor takes no name=/daemon= kwargs: both
+                # must be set as attributes on the handle before start()
+                named = keyword_value(node, "name") is not None \
+                    if tail == "Thread" else \
+                    self._scoped_match(idx, node, src,
+                                       r"\.name\s*=")
+                if not named:
+                    findings.append(Finding(
+                        self.rule, rel, node.lineno,
+                        "threading.%s(...) without a name — "
+                        "flight-recorder/SIGUSR1 stack dumps attribute "
+                        "this thread's stack to Thread-N instead of its "
+                        "component (use a `mxtpu-*` name)" % tail))
+                daemon = keyword_value(node, "daemon")
+                is_daemon = isinstance(daemon, ast.Constant) and \
+                    bool(daemon.value)
+                if daemon is not None and not isinstance(daemon,
+                                                         ast.Constant):
+                    is_daemon = True   # computed daemon flag: trust it
+                if not is_daemon and not self._scoped_match(
+                        idx, node, src,
+                        r"\.(join\(|daemon\s*=\s*True)"):
+                    findings.append(Finding(
+                        self.rule, rel, node.lineno,
+                        "non-daemon %s is never joined in this file — "
+                        "it outlives shutdown and leaks past interpreter "
+                        "exit; pass daemon=True or join it on a shutdown "
+                        "path" % tail))
+        return findings
+
+    @staticmethod
+    def _scoped_match(idx, node, src, suffix_pattern):
+        """Does the handle this Thread/Timer(...) call is assigned to
+        match ``<handle><suffix_pattern>`` somewhere in scope? A local
+        name is searched within its enclosing function only (a join on an
+        unrelated local elsewhere must not excuse it); a ``self._x`` attr
+        is searched file-wide (the start/reset split is the library's
+        normal shape). Word-boundary anchored: `out_t.join()` on a name
+        that merely ENDS with ours does not match."""
+        parent = idx.parents.get(node)
+        if not isinstance(parent, ast.Assign):
+            return False
+        lines = src.splitlines()
+        for t in parent.targets:
+            if isinstance(t, ast.Name):
+                func = idx.enclosing(node, FUNC_DEFS)
+                if func is None:
+                    scope = src
+                else:
+                    end = getattr(func, "end_lineno", len(lines))
+                    scope = "\n".join(lines[func.lineno - 1:end])
+                name = t.id
+            elif isinstance(t, ast.Attribute) and dotted(t):
+                scope, name = src, dotted(t)
+            else:
+                continue
+            pat = r"(?<![\w.])%s%s" % (re.escape(name), suffix_pattern)
+            if re.search(pat, scope):
+                return True
+        return False

@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # Single static-analysis entry point (SURVEY §5.2 — the reference's lint +
 # sanitizer CI layer): mxlint (AST checks: host-sync, signal-safety,
-# env-registry, registry-parity, bare-print — docs/static_analysis.md)
-# followed by the native-runtime sanitizers (ASan/UBSan + TSan).
+# env-registry, registry-parity, metric-registry, compile-registry,
+# bare-print, and the concurrency suite: lock-discipline, lock-order,
+# thread-hygiene — docs/static_analysis.md) followed by the
+# native-runtime sanitizers (ASan/UBSan + TSan).
 #
 # Usage: ci/run_checks.sh [--lint-only]
 # Exit nonzero on the first failing layer.

@@ -233,7 +233,9 @@ class Registry:
             touches.append(key)
         value = self._table.get(key)
         if value is not None:
-            self._stamps[key] = next(self._clock)
+            # lock-free hit path by design: a torn/raced stamp only skews
+            # LRU recency by one touch, never correctness
+            self._stamps[key] = next(self._clock)  # mxlint: gil-atomic — LRU stamp
             _counter("mxtpu_compile_cache_hit_total").inc()
         return value
 

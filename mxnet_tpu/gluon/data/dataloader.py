@@ -441,7 +441,8 @@ class DataLoader:
             finally:
                 q.put(sentinel)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="mxtpu-dataloader-prefetch")
         t.start()
         while True:
             item = q.get()

@@ -550,7 +550,8 @@ class ImageRecordIterPy(ImageIter):
             except Exception as e:  # surfaced to the consumer in next()
                 q.put(e)
 
-        self._worker = threading.Thread(target=run, daemon=True)
+        self._worker = threading.Thread(target=run, daemon=True,
+                                        name="mxtpu-image-prefetch")
         self._worker.start()
 
     def reset(self):

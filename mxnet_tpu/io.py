@@ -233,9 +233,7 @@ class PrefetchingIter(DataIter):
         self.rename_label = rename_label
         self.batch_size = getattr(iters[0], "batch_size", 0)
         self._queue = queue.Queue(maxsize=2)
-        self._stop = threading.Event()
-        self._thread = None
-        self._start()
+        self._start()   # sets self._stop + self._thread for THIS worker
 
     @property
     def provide_data(self):
@@ -260,35 +258,49 @@ class PrefetchingIter(DataIter):
         return out
 
     def _start(self):
-        self._stop.clear()
+        # the worker must capture THIS generation's queue + stop event as
+        # locals: `self._queue`/`self._stop` read live from the loop would
+        # let a worker that outlived a timed-out reset feed stale batches
+        # into the NEXT epoch's queue (and a cleared live Event would
+        # resurrect its loop) — the lock-discipline checker flags the
+        # reassign-under-use shape this guards against
+        self._stop = stop = threading.Event()
+        q = self._queue
 
         def run():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     batches = [it.next() for it in self.iters]
                 except StopIteration:
-                    self._queue.put(None)
+                    q.put(None)
                     return
                 except Exception as e:
-                    self._queue.put(e)
+                    q.put(e)
                     return
                 data = sum([b.data for b in batches], [])
                 label = sum([(b.label or []) for b in batches], [])
-                self._queue.put(DataBatch(data=data, label=label,
-                                          pad=batches[0].pad,
-                                          index=batches[0].index))
+                q.put(DataBatch(data=data, label=label,
+                                pad=batches[0].pad,
+                                index=batches[0].index))
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="mxtpu-io-prefetch")
         self._thread.start()
 
     def reset(self):
+        # stop + join the producer BEFORE rewinding: resetting the wrapped
+        # iterators under a live reader corrupts the next epoch
         self._stop.set()
         try:
             while True:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            raise MXNetError(
+                "PrefetchingIter.reset: prefetch worker did not stop "
+                "within 30s (stalled read?); cannot safely rewind")
         for it in self.iters:
             it.reset()
         self._queue = queue.Queue(maxsize=2)

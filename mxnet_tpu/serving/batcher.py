@@ -429,7 +429,7 @@ class DynamicBatcher:
             if req.deadline is not None and now >= req.deadline:
                 self._queue.popleft()
                 self._m_queue.set(len(self._queue))
-                self._expire(req, now, locked=True)
+                self._expire(req, now)
                 continue
             if max_n is not None and req.n > max_n:
                 return None  # stays queued for the next batch
@@ -439,17 +439,14 @@ class DynamicBatcher:
             return req
         return None
 
-    def _expire(self, req, now=None, locked=False):
-        """Resolve one request 504 and close its in-flight accounting.
-        ``locked=True`` when the caller already holds ``_cv`` (it is not
-        reentrant)."""
+    def _expire(self, req, now=None):
+        """Resolve one request 504. In-flight accounting is the CALLER's
+        job (close it under ``_cv`` before calling): the old ``locked=``
+        parameter made this method's locking depend on caller-supplied
+        control flow, which the lock-discipline/lock-order checkers
+        rightly cannot prove safe — and neither could a reviewer."""
         if now is None:
             now = time.monotonic()
-        if locked:
-            self._inflight.discard(req)
-        else:
-            with self._cv:
-                self._inflight.discard(req)
         self._m_rej_dead.inc()
         req._resolve(error=DeadlineExceededError(
             "deadline expired after %.0f ms in queue"
@@ -462,13 +459,18 @@ class DynamicBatcher:
         still-live remainder. Spending executor time on an answer nobody is
         waiting for is exactly the work a degraded pool cannot afford."""
         now = time.monotonic()
-        live = []
+        live, dead = [], []
         for req in batch:
             if req.deadline is not None and now >= req.deadline \
                     and not req.done():
-                self._expire(req, now)
+                dead.append(req)
             elif not req.done():
                 live.append(req)
+        if dead:
+            with self._cv:
+                self._inflight.difference_update(dead)
+            for req in dead:
+                self._expire(req, now)
         return live
 
     def _loop(self):

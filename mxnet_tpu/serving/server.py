@@ -122,7 +122,9 @@ class ServingServer:
         return self
 
     def shutdown(self):
-        self._closed = True
+        # monotonic False->True flag (drain waiter + api callers race
+        # benignly: both write the same value, readers poll)
+        self._closed = True  # mxlint: gil-atomic — monotonic shutdown flag
         self._drain_event.set()  # release an idle drain waiter
         self._http.shutdown()
         self._http.server_close()
@@ -144,7 +146,9 @@ class ServingServer:
         gets an answer, not a connection reset), `drain_failed` is set, and
         the `tools/serve.py` process exits nonzero so the supervisor knows
         the drain was not clean."""
-        self._draining = True
+        # monotonic admission flag: the /drainz waiter thread and direct
+        # api callers both only ever flip it False->True
+        self._draining = True  # mxlint: gil-atomic — monotonic drain flag
         if timeout is None:
             # drain_timeout_s honors the deprecated seconds-typed
             # MXTPU_SERVE_DRAIN_TIMEOUT_S with a one-time warning
@@ -158,7 +162,7 @@ class ServingServer:
         ok = ok and not self._inflight
         if not ok:
             aborted = self.repository.abort_pending()
-            self._drain_failed = True
+            self._drain_failed = True  # mxlint: gil-atomic — monotonic flag
             telemetry.record_event("serve_drain_forced", aborted=aborted,
                                    timeout_s=timeout)
             # the 503s are resolved; give handler threads a moment to
@@ -340,7 +344,10 @@ class ServingServer:
         if m is None:
             m = telemetry.counter("mxtpu_serve_http_requests_total",
                                   {"code": str(code)})
-            self._m_codes[code] = m
+            # racing handler threads both miss and both store the SAME
+            # object (the telemetry registry is the point of truth), so
+            # the last-wins dict store is harmless memoization
+            self._m_codes[code] = m  # mxlint: gil-atomic — idempotent memo
         m.inc()
 
     def _text(self, handler, code, text):

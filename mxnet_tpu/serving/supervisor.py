@@ -235,7 +235,8 @@ class ReplicaProcess:
             target=_pump, args=(self.proc.stdout,
                                 "%s/r%d.g%d" % (self.model, self.replica_id,
                                                 self.generation)),
-            daemon=True)
+            daemon=True,
+            name="mxtpu-replica-pump-r%d" % self.replica_id)
         self._pump_thread.start()
         return self.generation
 

@@ -60,6 +60,12 @@ class _State:
 
 _STATE = _State()
 
+# serializes the ensure_* cold paths only: record_event fires from every
+# serving/telemetry thread, and an unlocked decided-flag check-then-act
+# could start TWO flusher/exporter threads on a cold-start race. The hot
+# path (decided flag already set) never touches this lock.
+_DECIDE_LOCK = threading.Lock()
+
 
 def enabled():
     """Is the metrics layer active? (``MXTPU_TELEMETRY``, default on.)"""
@@ -460,7 +466,10 @@ def flush(directory=None, reason="manual"):
         return path
     except OSError as e:
         if not _STATE.flush_fail_logged:
-            _STATE.flush_fail_logged = True
+            # flusher/atexit/api callers race benignly: the worst case is
+            # one duplicate warning line, and a lock here would put a
+            # mutex on the telemetry failure path
+            _STATE.flush_fail_logged = True  # mxlint: gil-atomic — warn once-ish
             import logging
 
             logging.getLogger("mxnet_tpu.telemetry").warning(
@@ -485,15 +494,18 @@ def ensure_flusher():
     mid-run."""
     if _STATE.flusher_decided:
         return
-    if not _STATE.enabled or not telemetry_dir():
+    with _DECIDE_LOCK:  # double-checked: only the cold path locks
+        if _STATE.flusher_decided:
+            return
         _STATE.flusher_decided = True
-        return
-    _STATE.flusher_decided = True
-    period = _env.get("MXTPU_TELEMETRY_FLUSH_S")
-    t = threading.Thread(target=_flusher_loop, args=(max(0.25, period),),
-                         name="mxtpu-telemetry-flush", daemon=True)
-    _STATE.flusher = t
-    t.start()
+        if not _STATE.enabled or not telemetry_dir():
+            return
+        period = _env.get("MXTPU_TELEMETRY_FLUSH_S")
+        t = threading.Thread(target=_flusher_loop,
+                             args=(max(0.25, period),),
+                             name="mxtpu-telemetry-flush", daemon=True)
+        _STATE.flusher = t
+        t.start()
 
 
 @atexit.register
@@ -577,7 +589,11 @@ def ensure_http():
         return
     if not _STATE.enabled:
         return
-    _STATE.http_decided = True
+    with _DECIDE_LOCK:  # double-checked: a cold-start race here would
+        #                 bind two exporters (see ensure_flusher)
+        if _STATE.http_decided:
+            return
+        _STATE.http_decided = True
     if _env.raw("MXTPU_TELEMETRY_PORT") is None:
         return
     try:

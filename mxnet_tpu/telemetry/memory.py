@@ -116,6 +116,9 @@ class _MemState:
 _STATE = _MemState()
 _MAX_EXECUTABLES = 4096  # runaway-shape backstop, same order as the LRU
 _MAX_LOG = 4096          # per-thread attribution-log bound
+# serializes ensure_poller's cold path only (same double-checked shape as
+# core._DECIDE_LOCK): an unlocked decided-flag race could start 2 pollers
+_DECIDE_LOCK = threading.Lock()
 
 
 def _reset_after_fork():
@@ -418,12 +421,16 @@ def sample_devices():
             if isinstance(v, (int, float)) and k in (
                 "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
                 "largest_free_block_bytes", "bytes_reserved")}
+    # last-sample cache, lock-free BY DESIGN: the flight recorder's
+    # signal-context snapshot() reads these fields, so no lock may ever
+    # guard them (poller/flusher/scrape racers each publish a complete
+    # sample; a reader sees one sample or the other, never a crash)
     if not out:
-        _STATE.caps = False
+        _STATE.caps = False  # mxlint: gil-atomic — signal-safe cache
         return None
-    _STATE.caps = True
-    _STATE.devices = out
-    _STATE.devices_ts = time.time()
+    _STATE.caps = True  # mxlint: gil-atomic — signal-safe cache
+    _STATE.devices = out  # mxlint: gil-atomic — signal-safe cache
+    _STATE.devices_ts = time.time()  # mxlint: gil-atomic — signal-safe cache
     for dev_id, stats in out.items():
         labels = {"device": dev_id}
         if "bytes_in_use" in stats:
@@ -561,17 +568,20 @@ def ensure_poller():
     for). Env decision cached, same discipline as the flusher."""
     if _STATE.poller_decided:
         return
-    _STATE.poller_decided = True
-    if not enabled():
-        return
-    period_ms = _env.get("MXTPU_MEMORY_POLL_MS")
-    if not period_ms or period_ms <= 0:
-        return
-    t = threading.Thread(target=_poller_loop,
-                         args=(max(0.01, period_ms / 1e3),),
-                         name="mxtpu-memory-poll", daemon=True)
-    _STATE.poller = t
-    t.start()
+    with _DECIDE_LOCK:  # double-checked: only the cold path locks
+        if _STATE.poller_decided:
+            return
+        _STATE.poller_decided = True
+        if not enabled():
+            return
+        period_ms = _env.get("MXTPU_MEMORY_POLL_MS")
+        if not period_ms or period_ms <= 0:
+            return
+        t = threading.Thread(target=_poller_loop,
+                             args=(max(0.01, period_ms / 1e3),),
+                             name="mxtpu-memory-poll", daemon=True)
+        _STATE.poller = t
+        t.start()
 
 
 # ---------------------------------------------------------------------------

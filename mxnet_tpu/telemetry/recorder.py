@@ -88,8 +88,12 @@ def record_event(kind, **fields):
     if not core._STATE.enabled:
         return
     ev = (time.time(), kind, fields)
-    _REC.ring.append(ev)
-    _REC.pending.append(ev)
+    # bare deque appends, lock-free BY DESIGN: every thread (and the
+    # watchdog) records events, and the SIGUSR1 dump path reads the ring
+    # from signal context — a lock here is exactly the deadlock the
+    # flight recorder exists to diagnose (module docstring)
+    _REC.ring.append(ev)  # mxlint: gil-atomic — signal-safe ring
+    _REC.pending.append(ev)  # mxlint: gil-atomic — signal-safe queue
     core.ensure_flusher()
     core.ensure_http()
 
@@ -99,7 +103,9 @@ def drain_pending_events():
     out = []
     while True:
         try:
-            out.append(_REC.pending.popleft())
+            # deque.popleft is GIL-atomic; racing flushers each drain a
+            # disjoint subset (an event lands in exactly one JSONL line)
+            out.append(_REC.pending.popleft())  # mxlint: gil-atomic — drain
         except IndexError:
             return out
 
@@ -123,8 +129,12 @@ def record_step(step=None):
     ring, and installs the SIGUSR1 handler / watchdog thread on first use."""
     if not core._STATE.enabled:
         return
-    _REC.last_step = (step, time.monotonic(), time.time())
-    _REC.ring.append((time.time(), "step", {"step": step}))
+    # one immutable tuple store: the watchdog reads (and on `dump` action
+    # re-arms) last_step concurrently — a reader sees the old tuple or
+    # the new one, never a half-written pair; locking the per-step hot
+    # path is the cost this design refuses
+    _REC.last_step = (step, time.monotonic(), time.time())  # mxlint: gil-atomic — tuple swap
+    _REC.ring.append((time.time(), "step", {"step": step}))  # mxlint: gil-atomic — signal-safe ring
     install_signal_handler()
     _ensure_watchdog()
     core.ensure_flusher()
@@ -189,7 +199,10 @@ def dump(reason, path=None):
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        _REC.dump_seq += 1
+        # raced increments (watchdog + signal + api dumps) at worst reuse
+        # a tmp suffix; os.replace keeps the final dump file consistent —
+        # and this path must stay lock-free (it runs in signal context)
+        _REC.dump_seq += 1  # mxlint: gil-atomic — tmp-name nonce
         tmp = "%s.tmp-%d" % (path, _REC.dump_seq)
         with open(tmp, "w") as f:
             json.dump(payload, f, indent=1, default=str)
@@ -268,7 +281,9 @@ def _watchdog_loop(timeout):
         action = _env.get("MXTPU_WATCHDOG_ACTION").lower()
         if action == "dump":
             # keep running, re-arm from now
-            _REC.last_step = (ls[0], time.monotonic(), time.time())
+            # re-arm: same atomic-tuple-swap contract as record_step (a
+            # step completing concurrently just re-arms again, harmless)
+            _REC.last_step = (ls[0], time.monotonic(), time.time())  # mxlint: gil-atomic — tuple swap
             continue
         # a typo'd exit code must not disarm the abort (get falls back)
         code = _env.get("MXTPU_WATCHDOG_EXIT_CODE")

@@ -402,11 +402,60 @@ def test_no_bare_print_in_library(tmp_path):
     assert r.stdout.count("bad.py:") == 1, r.stdout  # only the real one
 
 
+def test_conftest_leaked_thread_report(tmp_path, monkeypatch):
+    """The end-of-suite report records non-daemon threads still alive next
+    to the walltime/peak-RSS row (MXTPU_WALLTIME_FILE), and FAIL-ANNOTATEs
+    when the count grew vs the previous run — the runtime shadow of
+    mxlint's thread-hygiene rule."""
+    import json
+    import threading
+    import time
+
+    import conftest
+
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="mxtpu-test-leak",
+                         daemon=False)
+    t.start()
+    try:
+        assert "mxtpu-test-leak" in conftest._leaked_threads()
+
+        out = tmp_path / "walltime.jsonl"
+        out.write_text(json.dumps({"wall_s": 1.0,
+                                   "leaked_threads": []}) + "\n")
+        monkeypatch.setenv("MXTPU_WALLTIME_FILE", str(out))
+
+        lines = []
+
+        class _Reporter:
+            def write_line(self, line, **kw):
+                lines.append(line)
+
+        class _Config:
+            _mxtpu_suite_t0 = time.time()
+
+        conftest.pytest_terminal_summary(_Reporter(), 0, _Config())
+        report = "\n".join(lines)
+        assert "leaked non-daemon threads: " in report
+        assert "FAIL-ANNOTATE" in report and "GREW from 0" in report
+        rows = [json.loads(ln) for ln in out.read_text().splitlines()]
+        assert "mxtpu-test-leak" in rows[-1]["leaked_threads"]
+
+        # same count on the next run: reported, but no growth annotation
+        lines.clear()
+        conftest.pytest_terminal_summary(_Reporter(), 0, _Config())
+        assert "FAIL-ANNOTATE" not in "\n".join(lines)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
 def test_mxlint_clean():
     """CI static analysis (ci/mxlint, docs/static_analysis.md): the tree has
-    ZERO findings across all seven checkers (host-sync, signal-safety,
+    ZERO findings across all ten checkers (host-sync, signal-safety,
     env-registry, registry-parity, metric-registry, compile-registry,
-    bare-print) modulo the committed
+    bare-print, lock-discipline, lock-order, thread-hygiene) modulo the
+    committed
     baseline — enforced in-suite so a new violation fails tier-1, not just
     a side CI job. Checker efficacy (each rule still catches a planted
     violation) is proven separately in test_mxlint.py's fixture tests."""
@@ -418,4 +467,4 @@ def test_mxlint_clean():
     r = subprocess.run([sys.executable, "-m", "ci.mxlint"], cwd=root,
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "0 finding(s) across 7 rule(s)" in r.stdout, r.stdout
+    assert "0 finding(s) across 10 rule(s)" in r.stdout, r.stdout

@@ -18,6 +18,10 @@ sys.path.insert(0, ROOT) if ROOT not in sys.path else None
 
 from ci.mxlint import Repo, load_baseline, run_checkers  # noqa: E402
 from ci.mxlint.checkers import CHECKERS  # noqa: E402
+from ci.mxlint.checkers.concurrency import (LockDisciplineChecker,  # noqa: E402
+                                            LockOrderChecker,
+                                            ThreadHygieneChecker,
+                                            build_lock_graph)
 from ci.mxlint.checkers.env_registry import EnvRegistryChecker  # noqa: E402
 from ci.mxlint.checkers.host_sync import HostSyncChecker  # noqa: E402
 from ci.mxlint.checkers.metric_registry import MetricRegistryChecker  # noqa: E402
@@ -692,6 +696,363 @@ def test_lint_print_old_cli_still_catches(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# concurrency suite: lock-discipline / lock-order / thread-hygiene
+# ---------------------------------------------------------------------------
+
+def test_lock_discipline_unguarded_cross_root_write(tmp_path):
+    """A worker thread and the public API both write an attribute with no
+    lock anywhere: every exposed write site flags; the lock-guarded
+    attribute next to it stays quiet."""
+    repo = _tree(tmp_path, {"mxnet_tpu/svc.py": """\
+        import threading
+
+        class Service:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.counter = 0
+                self.guarded = 0
+                self._t = threading.Thread(target=self._loop,
+                                           name="w", daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                self.counter += 1           # line 13: worker write
+                with self._lock:
+                    self.guarded += 1       # guarded everywhere: quiet
+
+            def bump(self):
+                self.counter += 1           # line 18: api write
+                with self._lock:
+                    self.guarded += 1
+        """})
+    got = _lines(_findings(LockDisciplineChecker(), repo))
+    assert got == [("mxnet_tpu/svc.py", 13), ("mxnet_tpu/svc.py", 18)], got
+
+
+def test_lock_discipline_inconsistent_guarding(tmp_path):
+    """An attribute written under the lock in one method and bare in
+    another (single api root — the registry's lock-free-hit-path shape)
+    flags only the exposed site, and held-lock context PROPAGATES through
+    same-class calls: a write inside a helper invoked under `with
+    self._lock` is guarded."""
+    repo = _tree(tmp_path, {"mxnet_tpu/reg.py": """\
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stamps = {}
+
+            def touch(self, key):
+                self._stamps[key] = 1       # line 9: exposed
+
+            def _store(self, key):
+                self._stamps[key] = 2       # guarded via caller: quiet
+
+            def insert(self, key):
+                with self._lock:
+                    self._store(key)
+        """})
+    got = _lines(_findings(LockDisciplineChecker(), repo))
+    assert got == [("mxnet_tpu/reg.py", 9)], got
+
+
+def test_lock_discipline_gil_atomic_annotation_honored(tmp_path):
+    """`# mxlint: gil-atomic — <why>` on the write line suppresses the
+    finding — intent becomes machine-checked documentation."""
+    repo = _tree(tmp_path, {"mxnet_tpu/svc.py": """\
+        import threading
+
+        class Service:
+            def __init__(self):
+                self.flag = False
+                t = threading.Thread(target=self._loop, name="w",
+                                     daemon=True)
+                t.start()
+
+            def _loop(self):
+                self.flag = True  # mxlint: gil-atomic — monotonic flag
+
+            def stop(self):
+                self.flag = True  # mxlint: gil-atomic — monotonic flag
+        """})
+    assert _findings(LockDisciplineChecker(), repo) == []
+
+
+def test_lock_discipline_thread_in_lambda_root_discovery(tmp_path):
+    """A `Thread(target=lambda: ...)` root expands through the lambda into
+    the method it calls — the write inside is still attributed to the
+    worker root."""
+    repo = _tree(tmp_path, {"mxnet_tpu/svc.py": """\
+        import threading
+
+        class Service:
+            def __init__(self):
+                self.state = 0
+                t = threading.Thread(target=lambda: self._work(),
+                                     name="w", daemon=True)
+                t.start()
+
+            def _work(self):
+                self.state = 1              # line 11: via lambda root
+
+            def poke(self):
+                self.state = 2              # line 14: api root
+        """})
+    got = _lines(_findings(LockDisciplineChecker(), repo))
+    assert got == [("mxnet_tpu/svc.py", 11), ("mxnet_tpu/svc.py", 14)], got
+
+
+def test_lock_discipline_sync_object_reassigned_under_use(tmp_path):
+    """The io.py race shape: a worker reads `self._queue` live while
+    reset() swaps in a fresh Queue — the reassignment flags. The
+    capture-as-local worker (image.py's shape) is clean."""
+    racy = _tree(tmp_path / "racy", {"mxnet_tpu/it.py": """\
+        import queue
+        import threading
+
+        class Prefetch:
+            def __init__(self):
+                self._queue = queue.Queue(maxsize=2)
+                self._start()
+
+            def _start(self):
+                def run():
+                    self._queue.put(1)
+                t = threading.Thread(target=run, name="w", daemon=True)
+                t.start()
+
+            def reset(self):
+                self._queue = queue.Queue(maxsize=2)   # line 16
+                self._start()
+        """})
+    got = _findings(LockDisciplineChecker(), racy)
+    assert _lines(got) == [("mxnet_tpu/it.py", 16)], _lines(got)
+    assert "replaced outside __init__" in got[0].message
+
+    clean = _tree(tmp_path / "clean", {"mxnet_tpu/it.py": """\
+        import queue
+        import threading
+
+        class Prefetch:
+            def __init__(self):
+                self._queue = queue.Queue(maxsize=2)
+                self._start()
+
+            def _start(self):
+                q = self._queue
+
+                def run():
+                    q.put(1)
+                t = threading.Thread(target=run, name="w", daemon=True)
+                t.start()
+
+            def reset(self):
+                self._queue = queue.Queue(maxsize=2)
+                self._start()
+        """})
+    assert _findings(LockDisciplineChecker(), clean) == []
+
+
+def test_lock_order_cycle_and_clean(tmp_path):
+    """Two locks taken in opposite orders across serving classes is a
+    deadlock finding; a consistent order is clean."""
+    cyclic = _tree(tmp_path / "cyc", {"mxnet_tpu/serving/ab.py": """\
+        import threading
+
+        class A:
+            def __init__(self, b):
+                self._lock = threading.Lock()
+                self._b = b
+
+            def forward(self):
+                with self._lock:
+                    self._b.enter()
+
+            def reenter(self):
+                with self._lock:
+                    pass
+
+        class B:
+            def __init__(self, a):
+                self._lock = threading.Lock()
+                self._a = a
+
+            def enter(self):
+                with self._lock:
+                    pass
+
+            def backward(self):
+                with self._lock:
+                    self._a.reenter()
+        """})
+    got = _findings(LockOrderChecker(), cyclic)
+    assert len(got) == 1 and "lock-order cycle" in got[0].message, \
+        [f.render() for f in got]
+    assert "A._lock" in got[0].message and "B._lock" in got[0].message
+
+    acyclic = _tree(tmp_path / "ok", {"mxnet_tpu/serving/ab.py": """\
+        import threading
+
+        class A:
+            def __init__(self, b):
+                self._lock = threading.Lock()
+                self._b = b
+
+            def forward(self):
+                with self._lock:
+                    self._b.enter()
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def enter(self):
+                with self._lock:
+                    pass
+        """})
+    assert _findings(LockOrderChecker(), acyclic) == []
+
+
+def test_lock_order_self_deadlock_reacquire(tmp_path):
+    """Re-acquiring a non-reentrant Lock down a call chain is flagged;
+    the same shape on an RLock — or a default Condition, whose internal
+    lock IS an RLock — is legal."""
+    repo = _tree(tmp_path, {"mxnet_tpu/serving/re.py": """\
+        import threading
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rlock = threading.RLock()
+                self._cv = threading.Condition()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:        # line 14: self-deadlock
+                    pass
+
+            def outer_r(self):
+                with self._rlock:
+                    self._inner_r()
+
+            def _inner_r(self):
+                with self._rlock:       # RLock: fine
+                    pass
+
+            def outer_cv(self):
+                with self._cv:
+                    self._inner_cv()
+
+            def _inner_cv(self):
+                with self._cv:          # default Condition: fine
+                    pass
+        """})
+    got = _findings(LockOrderChecker(), repo)
+    assert _lines(got) == [("mxnet_tpu/serving/re.py", 14)], \
+        [f.render() for f in got]
+    assert "re-acquired" in got[0].message
+
+
+def test_lock_order_real_graph_nonvacuous_and_acyclic():
+    """Acceptance: the live serving/telemetry/compile lock graph is
+    ACYCLIC — and non-vacuously so: the checker must still see the known
+    batcher-submit -> admission-gate -> pool-lock edge (if this edge
+    disappears, the walker regressed and the acyclicity proof is hollow)."""
+    graph = build_lock_graph(Repo(ROOT))
+    edges = set(graph.edges)
+    assert ("mxnet_tpu/serving/batcher.py:DynamicBatcher._cv",
+            "mxnet_tpu/serving/replica_pool.py:ReplicaPool._lock") in edges, \
+        sorted(edges)
+    assert graph.cycles() == []
+    assert graph.reacquires == []
+
+
+def test_thread_hygiene_unnamed_and_unjoined(tmp_path):
+    """Library threads must pass name= and be daemon or joined; the
+    pragma works like every other rule's."""
+    repo = _tree(tmp_path, {"mxnet_tpu/w.py": """\
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=spawn)          # line 4: both
+            t.start()
+
+        def ok():
+            t = threading.Thread(target=ok, name="mxtpu-x", daemon=True)
+            t.start()
+
+        def joined_ok():
+            t = threading.Thread(target=ok, name="mxtpu-y")
+            t.start()
+            t.join()
+
+        def excused():
+            t = threading.Thread(target=ok)  # mxlint: disable=thread-hygiene
+            t.start()
+            t.join()
+
+        def decoy(out_t, parts):
+            t = threading.Thread(target=ok, name="mxtpu-z")  # line 22
+            t.start()
+            out_t.join()        # OTHER object's join must not excuse t
+            return ",".join(parts)
+
+        def timer_bad():
+            t = threading.Timer(5.0, ok)                     # line 28
+            t.start()
+
+        def timer_ok():
+            t = threading.Timer(5.0, ok)
+            t.name = "mxtpu-timer"
+            t.daemon = True
+            t.start()
+        """})
+    kept, by_pragma, _ = run_checkers(repo, [ThreadHygieneChecker()])
+    msgs = [(f.line, f.message) for f in kept]
+    assert [line for line, _ in msgs] == [4, 4, 22, 28, 28], msgs
+    assert sum("without a name" in m for _, m in msgs) == 2
+    assert sum("never joined" in m for _, m in msgs) == 3
+    assert len(by_pragma) == 1
+
+
+def test_concurrency_rules_real_tree_clean():
+    """The live tree is clean under all three concurrency rules (real
+    races fixed, deliberate lock-free state gil-atomic-annotated — the
+    acceptance criterion for this suite)."""
+    repo = Repo(ROOT)
+    assert _lines(_findings(ThreadHygieneChecker(), repo)) == []
+    assert _lines(_findings(LockOrderChecker(), repo)) == []
+    kept, _, _ = run_checkers(repo, [LockDisciplineChecker()])
+    assert _lines(kept) == []
+
+
+def test_lock_discipline_real_tree_annotations_load_bearing():
+    """The committed gil-atomic annotations are LOAD-BEARING: stripping
+    them re-surfaces findings (i.e. the checker still sees those sites —
+    an annotation on dead code would rot silently)."""
+    import re
+
+    repo = Repo(ROOT)
+    checker = LockDisciplineChecker()
+    rel = "mxnet_tpu/telemetry/recorder.py"
+    src = repo.read(rel)
+    assert "mxlint: gil-atomic" in src
+    stripped = re.sub(r"# mxlint: gil-atomic[^\n]*", "", src)
+    repo._cache = {}
+    lines = stripped.splitlines()
+    import ast as _ast
+
+    repo._cache[rel] = (_ast.parse(stripped, filename=rel), lines)
+    got = [f for f in checker.run(repo) if f.path == rel]
+    assert got, "stripping recorder.py annotations surfaces nothing — " \
+        "the checker no longer sees the ring/last_step writes"
+
+
+# ---------------------------------------------------------------------------
 # runner: pragmas, baseline, CLI
 # ---------------------------------------------------------------------------
 
@@ -771,7 +1132,8 @@ def test_cli_modes(args, expect_rc):
     assert r.returncode == expect_rc, r.stdout + r.stderr
     if expect_rc == 0:
         for rule in ("host-sync", "signal-safety", "env-registry",
-                     "registry-parity", "compile-registry", "bare-print"):
+                     "registry-parity", "compile-registry", "bare-print",
+                     "lock-discipline", "lock-order", "thread-hygiene"):
             assert rule in r.stdout
 
 
@@ -829,7 +1191,8 @@ def test_env_module_typed_accessors(monkeypatch):
 
 
 def test_env_registry_covers_every_checker_rule():
-    """Meta: the shipped checker set is exactly the documented seven."""
+    """Meta: the shipped checker set is exactly the documented ten."""
     assert sorted(c.rule for c in CHECKERS) == [
         "bare-print", "compile-registry", "env-registry", "host-sync",
-        "metric-registry", "registry-parity", "signal-safety"]
+        "lock-discipline", "lock-order", "metric-registry",
+        "registry-parity", "signal-safety", "thread-hygiene"]

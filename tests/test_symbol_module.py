@@ -292,3 +292,46 @@ def test_python_loss_module():
     m.backward()
     np.testing.assert_allclose(m.get_input_grads()[0].asnumpy(),
                                (s - l).asnumpy(), rtol=1e-6)
+
+
+def test_prefetching_iter_reset_is_race_free():
+    """PR-12 regression (the lock-discipline checker's first real catch):
+    PrefetchingIter's worker used to read `self._queue`/`self._stop` live
+    from its loop, so a reset() whose join timed out left the OLD worker
+    feeding stale batches into the NEW epoch's queue. The fixed worker
+    captures its generation's queue/stop as locals and reset joins before
+    rewinding — epochs reproduce exactly, exactly one named prefetch
+    thread survives a reset, and none survives the epoch's natural end."""
+    import threading
+
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+
+    rng = np.random.RandomState(7)
+    X = rng.rand(24, 3).astype(np.float32)
+    base = NDArrayIter(X, batch_size=8, shuffle=False)
+    it = PrefetchingIter(base)
+
+    def epoch():
+        out = []
+        while True:
+            try:
+                out.append(it.next().data[0].asnumpy().copy())
+            except StopIteration:
+                return out
+
+    first = epoch()
+    assert len(first) == 3
+    it.reset()
+    workers = [t for t in threading.enumerate()
+               if t.name == "mxtpu-io-prefetch" and t.is_alive()]
+    assert len(workers) == 1, [t.name for t in workers]
+    second = epoch()
+    assert len(second) == len(first)
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a, b)
+    # the worker that finished the epoch exits on its own (daemon, but it
+    # must not linger feeding a queue nobody reads)
+    for t in workers:
+        t.join(timeout=5)
+    assert not any(t.name == "mxtpu-io-prefetch" and t.is_alive()
+                   for t in threading.enumerate())
