@@ -189,6 +189,14 @@ _var("MXTPU_PALLAS_CONV_EPILOGUE", "str", "auto",
      "BatchNormAddRelu ops; parameter names unchanged). Read at first "
      "compile of each op/attrs combination — flip it between processes (as "
      "`tools/bench_capture.sh` A-B rows do), not mid-process.")
+_var("MXTPU_PALLAS_DECODE", "str", "auto",
+     "Paged decode-attention kernel (`ops/pallas_kernels.paged_attention` "
+     "— flash-decode, q_len=1 against the block-allocated KV cache, page "
+     "tables via scalar prefetch): `auto` = kernel on TPU, dense-gather "
+     "jnp fallback elsewhere; `1` forces the kernel everywhere (interpret "
+     "mode on CPU — parity tests); `0` forces the jnp path. Read at trace "
+     "time of each decode executable — flip it between processes, not "
+     "mid-process.")
 _var("MXTPU_S2D_STEM", "bool", False,
      "`1` builds model-zoo ResNets with the space-to-depth stem (7×7/s2 "
      "over 3ch → 4×4/s1 over 12ch; weight-space transform `resnet."
@@ -413,6 +421,30 @@ _var("MXTPU_SERVE_RESTART_BACKOFF_MS", "float", 200.0,
      "serving replica pool: initial delay before respawning an ejected "
      "replica (doubles per consecutive restart of the same replica, "
      "capped at 60s; resets once a generation serves a batch cleanly).")
+_var("MXTPU_SERVE_KV_PAGES", "int", 256,
+     "generation serving (`mxnet_tpu.serving.generate`): total fixed-size "
+     "KV-cache pages allocated per served LM. The whole pool is allocated "
+     "at load (its bytes are part of the model footprint the "
+     "`MXTPU_SERVE_MEMORY_BUDGET` admission check prices — a 507 at load "
+     "time instead of an OOM mid-decode); the free-list allocator hands "
+     "pages to sequences at admission and reclaims them at completion "
+     "(`mxtpu_serve_kv_pages_{total,used}`).")
+_var("MXTPU_SERVE_KV_PAGE_SIZE", "int", 16,
+     "generation serving: tokens per KV-cache page. Smaller pages waste "
+     "less on short tails but grow the per-sequence page table (and the "
+     "decode executable's gather width); 16 matches the classic "
+     "PagedAttention block size.")
+_var("MXTPU_SERVE_MAX_NEW_TOKENS", "int", 128,
+     "generation serving: cap on a request's `max_new_tokens` (also the "
+     "per-request default when the body omits it). Together with "
+     "`MXTPU_SERVE_MAX_PROMPT` it bounds the pages a sequence can ever "
+     "need, so admission reserves worst-case pages up front and a "
+     "running batch can never deadlock on the page pool.")
+_var("MXTPU_SERVE_MAX_PROMPT", "int", 64,
+     "generation serving: longest admissible prompt in tokens. Prompts "
+     "pad to power-of-two prefill buckets up to this length — one cached "
+     "prefill executable per bucket, so steady-state admission never "
+     "compiles.")
 
 # -- accelerator dial -------------------------------------------------------
 _var("MXTPU_DIAL_TIMEOUT_S", "float", 60.0,

@@ -18,7 +18,8 @@ from ..block import HybridBlock
 from .. import nn
 
 __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
-           "TransformerEncoder", "BERTModel", "bert_12_768_12", "bert_mini"]
+           "TransformerEncoder", "BERTModel", "bert_12_768_12", "bert_mini",
+           "TransformerLM", "lm_mini"]
 
 
 class MultiHeadAttention(HybridBlock):
@@ -210,6 +211,116 @@ class BERTModel(HybridBlock):
             return self._embed_prelude(F, inputs, token_types, valid_length)
 
         return prelude, list(self.encoder.cells), self._pool_postlude
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only language model: the BERT encoder cells under a causal
+    mask, with a TIED embedding head (the logits projection reuses the
+    word-embedding weight — one parameter, GPT/PaLM convention). The
+    servable text-generation workload `mxnet_tpu.serving.generate` wraps
+    with a paged KV cache; this block is the full-sequence form used for
+    training, prefill parity and the greedy oracle."""
+
+    def __init__(self, vocab_size=1000, units=64, hidden_size=128,
+                 num_layers=2, num_heads=2, max_length=256, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._config = dict(vocab_size=int(vocab_size), units=int(units),
+                            hidden_size=int(hidden_size),
+                            num_layers=int(num_layers),
+                            num_heads=int(num_heads),
+                            max_length=int(max_length),
+                            dropout=float(dropout))
+        self._vocab = int(vocab_size)
+        self._units = int(units)
+        with self.name_scope():
+            # the tied weight is declared on THIS block (not an Embedding
+            # child) so hybrid_forward receives it and can use it for both
+            # the lookup and the head projection
+            self.word_weight = self.params.get(
+                "word_weight", shape=(vocab_size, units))
+            self.position_embed = nn.Embedding(max_length, units,
+                                               prefix="pos_")
+            self.embed_norm = nn.LayerNorm()
+            self.embed_dropout = nn.Dropout(dropout) if dropout else None
+            self.cells = []
+            for i in range(num_layers):
+                cell = TransformerEncoderCell(units, hidden_size, num_heads,
+                                              dropout=dropout,
+                                              prefix="layer%d_" % i)
+                self.register_child(cell)
+                self.cells.append(cell)
+
+    @property
+    def config(self):
+        """Architecture dict (`serving.generate` artifact header)."""
+        return dict(self._config)
+
+    def hybrid_forward(self, F, inputs, word_weight):
+        """inputs: (B, L) int token ids -> logits (B, L, V); position t
+        sees tokens [0, t] (causal)."""
+        b, l = inputs.shape[0], inputs.shape[1]
+        x = F.Embedding(inputs, word_weight, input_dim=self._vocab,
+                        output_dim=self._units)
+        pos = F.arange(0, l, dtype="int32")
+        x = x + self.position_embed(pos).expand_dims(0)
+        x = self.embed_norm(x)
+        if self.embed_dropout is not None:
+            x = self.embed_dropout(x)
+        row = F.arange(0, l).expand_dims(1)
+        col = F.arange(0, l).expand_dims(0)
+        mask = (col <= row).expand_dims(0).broadcast_to((b, l, l))
+        for cell in self.cells:
+            x = cell(x, mask)
+        # tied head: logits = x @ word_weight.T
+        return F.FullyConnected(x, word_weight, None, num_hidden=self._vocab,
+                                flatten=False, no_bias=True)
+
+    def decode_params(self):
+        """The parameters as a structured numpy dict, in the layout the
+        `serving.generate.TransformerLMEngine` pure-jax prefill/decode
+        functions consume (the engine and this block must compute the
+        same function — tests/test_generate.py proves it)."""
+        if any(p._data is None for p in self.collect_params().values()):
+            # deferred Dense shapes materialize on first forward
+            from ... import nd
+
+            self(nd.array([[0]], dtype="int32"))
+
+        def arr(p):
+            return p.data().asnumpy()
+
+        def dense(d):
+            return {"w": arr(d.weight), "b": arr(d.bias)}
+
+        layers = []
+        for cell in self.cells:
+            att = cell.attention
+            layers.append({
+                "q": dense(att.proj_query), "k": dense(att.proj_key),
+                "v": dense(att.proj_value), "o": dense(att.proj_out),
+                "attn_norm": {"g": arr(cell.attention_norm.gamma),
+                              "b": arr(cell.attention_norm.beta)},
+                "ffn1": dense(cell.ffn.ffn_1),
+                "ffn2": dense(cell.ffn.ffn_2),
+                "ffn_norm": {"g": arr(cell.ffn_norm.gamma),
+                             "b": arr(cell.ffn_norm.beta)},
+            })
+        return {"word": arr(self.word_weight),
+                "pos": arr(self.position_embed.weight),
+                "embed_norm": {"g": arr(self.embed_norm.gamma),
+                               "b": arr(self.embed_norm.beta)},
+                "layers": layers}
+
+
+def lm_mini(vocab_size=128, **kwargs):
+    """Tiny decoder-only LM for tests/examples (2 layers, d=32)."""
+    kwargs.setdefault("units", 32)
+    kwargs.setdefault("hidden_size", 64)
+    kwargs.setdefault("num_layers", 2)
+    kwargs.setdefault("num_heads", 2)
+    kwargs.setdefault("max_length", 128)
+    return TransformerLM(vocab_size=vocab_size, **kwargs)
 
 
 def bert_12_768_12(vocab_size=30522, **kwargs):

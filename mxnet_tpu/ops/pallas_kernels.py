@@ -25,7 +25,8 @@ import functools
 import numpy as _np
 
 __all__ = ["flash_attention", "lstm_layer", "conv_epilogue",
-           "conv_epilogue_fits"]
+           "conv_epilogue_fits", "paged_attention",
+           "paged_attention_reference"]
 
 _NEG_INF = -1e30
 
@@ -1109,3 +1110,190 @@ def conv_epilogue(x, gamma, beta, residual=None, eps=1e-3, fix_gamma=False,
         out, mean, var = epi4(x2d, gamma, beta, residual.reshape((-1, c)),
                               eps, fix_gamma, relu, interpret)
     return out.reshape(shape), mean, var
+
+
+# ---------------------------------------------------------------------------
+# Paged decode attention (flash-decode): one query token per sequence
+# against a block-allocated paged KV cache (serving/generate.py).
+#
+# Autoregressive decode is the q_len=1 degenerate case of attention, and
+# its memory layout is dictated by the KV-cache allocator: each sequence's
+# keys/values live scattered across fixed-size pages named by a per-
+# sequence page table, not in one contiguous (L, D) slab. A dense gather
+# (k_pages[page_tables] -> (B, max_pages, ...)) materializes a batch-wide
+# padded COPY of every sequence's history in HBM per step; the Pallas
+# kernel instead streams one PAGE per grid step straight from the paged
+# array — the page table rides scalar-prefetch (SMEM), so the BlockSpec
+# index_map picks each sequence's next page and nothing is ever copied
+# out of the pool. Online softmax carries (m, l, acc) in VMEM scratch
+# across the page axis, exactly the flash_attention recurrence with
+# page-sized k-blocks. Known bound: the grid is static (B, max_pages), so
+# a short sequence still DMAs its table's padding pages (masked to zero
+# contribution) — per-sequence early exit needs dynamic grid bounds;
+# until then the streamed bytes scale with max_pages, not actual length.
+#
+# Gate: MXTPU_PALLAS_DECODE — `auto` = kernel on TPU, jnp gather fallback
+# elsewhere; `1` forces the kernel everywhere (interpret mode on CPU —
+# the parity tests); `0` forces the jnp path.
+# ---------------------------------------------------------------------------
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_tables, lengths,
+                              sm_scale):
+    """Dense-gather oracle (and CPU fallback): q (B, H, D); k_pages /
+    v_pages (P, H, page_size, D); page_tables (B, max_pages) int32;
+    lengths (B,) int32 — tokens [0, lengths[b]) of sequence b are live,
+    laid out page_tables[b, t // page_size] slot t % page_size. A row
+    with length 0 returns zeros-ish garbage that callers mask out (its
+    scores are uniformly _NEG_INF, which is finite by design — no NaNs)."""
+    import jax.numpy as jnp
+
+    b, h, d = q.shape
+    ps = k_pages.shape[2]
+    maxp = page_tables.shape[1]
+    k = k_pages[page_tables]            # (B, maxp, H, ps, D)
+    v = v_pages[page_tables]
+    k = jnp.moveaxis(k, 2, 1).reshape(b, h, maxp * ps, d)
+    v = jnp.moveaxis(v, 2, 1).reshape(b, h, maxp * ps, d)
+    s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    ids = jnp.arange(maxp * ps)[None, None, :]
+    s = jnp.where(ids < lengths[:, None, None], s, _NEG_INF)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    o = jnp.einsum("bhl,bhld->bhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, sm_scale, ps, n_pages):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (Hp, Dp)
+    k = k_ref[0].astype(jnp.float32)                     # (Hp, ps, Dp)
+    v = v_ref[0].astype(jnp.float32)
+    # per-head scores against this page: batch dim = head, contract = D
+    s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)  # (Hp, ps)
+    col = j * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < len_ref[b], s, _NEG_INF)
+    m = m_scr[:, 0:1]
+    l = l_scr[:, 0:1]
+    new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - new_m)
+    p = jnp.exp(s - new_m)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc_scr[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)              # (Hp, Dp)
+    m_scr[...] = jnp.broadcast_to(new_m, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l, l_scr.shape)
+    acc_scr[...] = acc
+
+    @pl.when(j == n_pages - 1)
+    def _():
+        o_ref[0] = (acc_scr[...]
+                    / jnp.maximum(l_scr[:, 0:1], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=128)
+def _paged_compiled(key):
+    (b, h, d, n_pages, maxp, ps, dtype, sm_scale, interpret) = key
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    hp = -(-h // 8) * 8
+    dp = -(-d // 128) * 128
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # page_tables, lengths (SMEM)
+        grid=(b, maxp),
+        in_specs=[
+            pl.BlockSpec((1, hp, dp), lambda bb, j, tbl, lens: (bb, 0, 0),
+                         memory_space=pltpu.VMEM),                   # q
+            # the paged gather: the page table names which KV page this
+            # grid step streams into VMEM
+            pl.BlockSpec((1, hp, ps, dp),
+                         lambda bb, j, tbl, lens: (tbl[bb, j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),                   # k
+            pl.BlockSpec((1, hp, ps, dp),
+                         lambda bb, j, tbl, lens: (tbl[bb, j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),                   # v
+        ],
+        out_specs=pl.BlockSpec((1, hp, dp),
+                               lambda bb, j, tbl, lens: (bb, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((hp, 128), jnp.float32),   # m
+                        pltpu.VMEM((hp, 128), jnp.float32),   # l
+                        pltpu.VMEM((hp, dp), jnp.float32)],   # acc
+    )
+    call = pl.pallas_call(
+        functools.partial(_paged_kernel, sm_scale=sm_scale, ps=ps,
+                          n_pages=maxp),
+        out_shape=jax.ShapeDtypeStruct((b, hp, dp), _np.dtype(dtype)),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+
+    def run(q, k_pages, v_pages, page_tables, lengths):
+        if hp == h and dp == d:
+            # aligned geometry (the production case: H >= 8, Dh a lane
+            # multiple): the page pool feeds the kernel directly and the
+            # only HBM traffic is the pages actually attended
+            return call(page_tables.astype(jnp.int32),
+                        lengths.astype(jnp.int32), q, k_pages, v_pages)
+        # unaligned geometry pays a padded COPY of the page pool per
+        # call — acceptable for tiny test models, wrong for production:
+        # pick H/Dh on the (8, 128) tile grid so this branch never runs
+        qp = jnp.pad(q, ((0, 0), (0, hp - h), (0, dp - d)))
+        kp = jnp.pad(k_pages, ((0, 0), (0, hp - h), (0, 0), (0, dp - d)))
+        vp = jnp.pad(v_pages, ((0, 0), (0, hp - h), (0, 0), (0, dp - d)))
+        out = call(page_tables.astype(jnp.int32),
+                   lengths.astype(jnp.int32), qp, kp, vp)
+        return out[:, :h, :d]
+
+    return run
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, lengths,
+                    sm_scale=None):
+    """Flash-decode attention: one query token per sequence against a
+    paged KV cache (docs/serving.md §Generation).
+
+    q: (B, H, D) — the current token's per-head queries. k_pages /
+    v_pages: (P, H, page_size, D) block-allocated cache. page_tables:
+    (B, max_pages) int32 — sequence b's token t lives in page
+    ``page_tables[b, t // page_size]`` slot ``t % page_size``; entries
+    past the sequence's used pages must still be VALID page indices
+    (they are masked by ``lengths``, never dereferenced out of bounds).
+    lengths: (B,) int32 live-token counts (0 disables a padding row).
+    """
+    from .. import env as _env
+
+    if sm_scale is None:
+        sm_scale = 1.0 / float(_np.sqrt(q.shape[-1]))
+    sm_scale = float(sm_scale)
+    gate = (_env.raw("MXTPU_PALLAS_DECODE") or "auto").strip().lower()
+    interpret = _use_interpret()
+    if gate == "0" or (gate == "auto" and interpret):
+        return paged_attention_reference(q, k_pages, v_pages, page_tables,
+                                         lengths, sm_scale)
+    b, h, d = q.shape
+    n_pages, _, ps, _ = k_pages.shape
+    maxp = page_tables.shape[1]
+    run = _paged_compiled((b, h, d, n_pages, maxp, ps, str(q.dtype),
+                           sm_scale, interpret))
+    return run(q, k_pages, v_pages, page_tables, lengths)

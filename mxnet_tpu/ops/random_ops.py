@@ -120,6 +120,66 @@ def shuffle(rng, data):
     return jax.random.permutation(rng, data, axis=0)
 
 
+# --------------------------------------------------------------------------
+# token sampling (serving.generate decode loop; SOSP'23 vLLM-style
+# sampling surface). One op covers the whole family — greedy is
+# temperature<=0, top-k/top-p are nucleus filters on the logits — so a
+# mixed decode batch with per-row parameters stays ONE executable
+# (`sample_token_logits` takes arrays; the registered op takes the attr
+# spelling for nd/symbol callers).
+# --------------------------------------------------------------------------
+
+def _top_k_logits(logits, k):
+    """Mask logits outside each row's top-k (k<=0 disables; k may be a
+    scalar or a per-row array)."""
+    v = logits.shape[-1]
+    kk = jnp.broadcast_to(jnp.asarray(k, jnp.int32), logits.shape[:-1])
+    kk = jnp.clip(jnp.where(kk <= 0, v, kk), 1, v)
+    desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    thr = jnp.take_along_axis(desc, (kk - 1)[..., None], axis=-1)
+    return jnp.where(logits >= thr, logits, -jnp.inf)
+
+
+def _top_p_logits(logits, p):
+    """Nucleus filter: keep the smallest prefix of descending-probability
+    tokens whose mass reaches p (always at least the argmax; p<=0 or
+    p>=1 disables). Scalar or per-row p."""
+    pp = jnp.broadcast_to(jnp.asarray(p, jnp.float32), logits.shape[:-1])
+    pp = jnp.where((pp <= 0.0) | (pp >= 1.0), 1.0, pp)
+    desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(desc, axis=-1)
+    keep = (jnp.cumsum(probs, axis=-1) - probs) < pp[..., None]
+    thr = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits >= thr, logits, -jnp.inf)
+
+
+def sample_token_logits(rng, logits, temperature=1.0, top_k=0, top_p=1.0):
+    """Sample one token id per row of ``logits`` (..., V): greedy argmax
+    where temperature<=0, else temperature-scaled categorical over the
+    top-k/top-p-filtered distribution. Parameters may be scalars or
+    per-row arrays (the decode scheduler batches requests with different
+    sampling knobs into one executable). Returns int32 (...)."""
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32),
+                         logits.shape[:-1])
+    lf = logits.astype(jnp.float32)
+    masked = _top_p_logits(_top_k_logits(lf, top_k), top_p)
+    scaled = masked / jnp.maximum(t, 1e-6)[..., None]
+    drawn = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(t <= 0.0, jnp.argmax(lf, axis=-1),
+                     drawn).astype(jnp.int32)
+
+
+@register("_sample_token", needs_rng=True, aliases=("sample_token",))
+def sample_token(rng, data, temperature=1.0, top_k=0, top_p=1.0,
+                 dtype="int32"):
+    """data: (..., V) logits -> (...) sampled token ids (greedy /
+    temperature / top-k / top-p per the attrs; one threefry subkey per
+    call, ops/random_ops.py convention)."""
+    out = sample_token_logits(rng, data, temperature=float(temperature),
+                              top_k=int(top_k), top_p=float(top_p))
+    return out.astype(np_dtype(dtype))
+
+
 @register("GridGenerator")
 def grid_generator(data, transform_type="affine", target_shape=()):
     h, w = target_shape

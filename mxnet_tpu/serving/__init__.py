@@ -19,7 +19,12 @@ high-occupancy inference (docs/serving.md):
     ejection + respawn (restart generations, exponential backoff,
     process-group teardown), exactly-once batch failover, deterministic
     load shedding (503 + Retry-After scaled to healthy replicas) and
-    per-request deadline propagation (replica_pool.py / supervisor.py).
+    per-request deadline propagation (replica_pool.py / supervisor.py);
+  * `generate` — continuous-batching autoregressive decode with a paged
+    KV cache: `GenerateScheduler` (token-level join/leave),
+    `KVPageAllocator`, the `TransformerLMEngine` incremental LM runner
+    and `ServedLM` (``POST /v1/models/<name>:generate``) — Orca-style
+    iteration scheduling + PagedAttention, TPU-native (generate.py).
 
 Launch with ``python tools/serve.py`` (``--replicas N`` for a pool);
 load-test with ``python tools/serve_bench.py`` (``--failover`` for the
@@ -34,6 +39,10 @@ from .batcher import (  # noqa: F401
     QueueFullError, ServeRequest,
     ServingError, bucket_for, pad_batch, power_of_two_buckets,
 )
+from .generate import (  # noqa: F401
+    GenerateScheduler, GenRequest, KVPageAllocator, ServedLM,
+    TransformerLMEngine, load_lm, save_lm,
+)
 from .model_repository import (  # noqa: F401
     ModelRepository, ServedModel, build_runner,
 )
@@ -47,4 +56,6 @@ __all__ = [
     "OverloadedError", "MemoryBudgetError", "power_of_two_buckets",
     "bucket_for", "pad_batch",
     "build_runner",
+    "GenerateScheduler", "GenRequest", "KVPageAllocator", "ServedLM",
+    "TransformerLMEngine", "save_lm", "load_lm",
 ]

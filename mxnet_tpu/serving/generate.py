@@ -1,0 +1,1141 @@
+"""Continuous-batching autoregressive decode with a paged KV cache.
+
+The serving stack through PR 9 (replica pools, failover, compile cache,
+memory-budget admission) serves batch-synchronous classification — the
+wrong shape for sequence generation, where requests FINISH AT DIFFERENT
+LENGTHS: a batch-synchronous batcher holds every finished sequence
+hostage to the longest one, and a naive contiguous KV cache reserves
+max-length memory per sequence. This module rebuilds the two techniques
+that fixed decode serving at scale, TPU-natively on the machinery the
+repo already has:
+
+  * **token-level continuous batching** (Orca, OSDI'22):
+    `GenerateScheduler` admits and retires requests at STEP granularity —
+    each scheduler lap first prefills any waiting requests that fit
+    (pages + batch slots), then runs ONE decode step for the whole active
+    set, padded to a power-of-two batch bucket. Prefill and decode are
+    separate executables, each resolved through the `mxnet_tpu.compile`
+    registry — one cached decode executable per (batch bucket, KV page
+    geometry), so steady-state decode is zero-compile and a late joiner
+    never restarts the running batch.
+  * **paged KV cache** (PagedAttention, SOSP'23): `KVPageAllocator` hands
+    out fixed-size pages from a free list; each sequence owns a page
+    table, pages return to the pool the step the sequence finishes. The
+    whole pool is allocated at load and priced into the model footprint,
+    so `MXTPU_SERVE_MEMORY_BUDGET` admission 507s a load whose KV pool
+    cannot fit BEFORE it can OOM the device mid-decode
+    (`mxtpu_serve_kv_pages_{total,used}` gauges track occupancy).
+    Admission reserves a sequence's worst-case pages up front
+    (prompt + max_new_tokens), so a running batch can never deadlock on
+    the pool.
+  * **decode attention** runs the flash-decode Pallas kernel
+    (`ops/pallas_kernels.paged_attention` — page tables via scalar
+    prefetch, online softmax over streamed pages) on TPU, the dense-
+    gather jnp fallback elsewhere (`MXTPU_PALLAS_DECODE`).
+  * **sampling** (greedy / temperature / top-k / top-p) is folded into
+    the decode executable with PER-ROW parameter arrays
+    (`ops/random_ops.sample_token_logits`), so a mixed batch of greedy
+    and stochastic requests stays one executable; every step consumes
+    one threefry subkey from the global chain.
+
+`TransformerLMEngine` runs a `gluon.model_zoo.transformer.TransformerLM`
+(decoder-only, tied embedding head) in incremental form: the pure-jax
+prefill/decode functions here compute exactly the block's full-sequence
+forward (tests/test_generate.py proves logits parity and greedy-sequence
+equality), with parameters passed as executable arguments so two models
+with one geometry share executables.
+
+`ServedLM` is the repository-facing model: in-process it owns a
+scheduler; with ``replicas=N`` it routes requests through a
+`ReplicaPool` in generate mode (each replica worker runs its own
+scheduler — continuous batching happens replica-side, request routing
+router-side) over the existing supervisor wire protocol.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import itertools
+import json
+import logging
+import math
+import os
+import threading
+import time
+
+import numpy as _np
+
+from .. import compile as _compile
+from .. import env as _env
+from .. import random as _random
+from .. import telemetry
+from ..base import MXNetError
+from ..telemetry import tracing as _tracing
+from .batcher import (DeadlineExceededError, DrainingError, QueueFullError,
+                      ServingError, bucket_for, drain_timeout_s,
+                      power_of_two_buckets)
+
+__all__ = ["KVPageAllocator", "GenRequest", "GenerateScheduler",
+           "TransformerLMEngine", "ServedLM", "save_lm", "load_lm"]
+
+_LOG = logging.getLogger("mxnet_tpu.serving.generate")
+
+_LM_FORMAT = "mxtpu-lm-v1"
+
+
+# ---------------------------------------------------------------------------
+# KV page allocator
+# ---------------------------------------------------------------------------
+
+class KVPageAllocator:
+    """Free-list allocator over a fixed pool of KV-cache pages.
+
+    Pages are identity-only here (integers 0..num_pages-1); the device
+    arrays they index live in the engine. Allocation is all-or-nothing
+    (`alloc` returns None rather than a partial grant) and O(n) in the
+    grant size; `free` returns pages for immediate reuse — a completed
+    sequence's pages serve the next admission the same scheduler lap.
+    Occupancy rides the `mxtpu_serve_kv_pages_{total,used}` gauges.
+    """
+
+    def __init__(self, num_pages, page_size, name="default"):
+        if num_pages < 1 or page_size < 1:
+            raise MXNetError("KV pool needs >=1 pages of >=1 tokens, got "
+                             "%d x %d" % (num_pages, page_size))
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        # LIFO free list: recently-freed pages are re-issued first (their
+        # cache lines / artifact pages are warmest)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        labels = {"model": name}
+        self._m_total = telemetry.gauge("mxtpu_serve_kv_pages_total", labels)
+        self._m_used = telemetry.gauge("mxtpu_serve_kv_pages_used", labels)
+        self._m_total.set(self.num_pages)
+        self._m_used.set(0)
+
+    def pages_for(self, tokens):
+        """Pages needed to hold ``tokens`` tokens."""
+        return -(-int(tokens) // self.page_size)
+
+    @property
+    def free_pages(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self):
+        return self.num_pages - self.free_pages
+
+    def alloc(self, n):
+        """Grant ``n`` pages, or None when the pool cannot serve them
+        (callers keep the request queued — backpressure, not failure)."""
+        n = int(n)
+        with self._lock:
+            if n < 0 or n > len(self._free):
+                return None
+            pages = self._free[-n:][::-1] if n else []
+            del self._free[len(self._free) - n:]
+            self._m_used.set(self.num_pages - len(self._free))
+        return pages
+
+    def free(self, pages):
+        """Return a grant to the pool (double-free is a bug upstream and
+        raises — a page owned by two sequences corrupts both)."""
+        with self._lock:
+            live = set(self._free)
+            for p in pages:
+                if p in live or not (0 <= p < self.num_pages):
+                    raise MXNetError("double-free/corrupt KV page %r" % (p,))
+            self._free.extend(pages)
+            self._m_used.set(self.num_pages - len(self._free))
+
+
+# ---------------------------------------------------------------------------
+# requests
+# ---------------------------------------------------------------------------
+
+class GenRequest:
+    """One admitted generation request. ``wait()`` yields the generated
+    token list (prompt excluded); `finish_reason` is ``eos`` / ``length``
+    after a normal completion."""
+
+    __slots__ = ("tokens", "max_new_tokens", "temperature", "top_k",
+                 "top_p", "deadline", "outputs", "finish_reason", "error",
+                 "trace", "retried", "tag", "on_complete", "queue_seconds",
+                 "_event", "_rlock", "_t_submit")
+
+    def __init__(self, tokens, max_new_tokens, temperature=0.0, top_k=0,
+                 top_p=1.0, deadline=None, trace=None):
+        self.tokens = [int(t) for t in tokens]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.deadline = deadline
+        self.outputs = None
+        self.finish_reason = None
+        self.error = None
+        self.queue_seconds = None
+        self.retried = False     # pooled failover: one retry per request
+        self.tag = None          # wire id (pooled mode)
+        self.on_complete = None  # worker-side completion hook
+        self.trace = trace if trace is not None else _tracing.capture()
+        self._event = threading.Event()
+        self._rlock = threading.Lock()
+        self._t_submit = time.monotonic()
+
+    def done(self):
+        return self._event.is_set()
+
+    def wait(self, timeout=None):
+        self._event.wait(timeout)
+        if not self._event.is_set():
+            raise DeadlineExceededError(
+                "generation expired after %.0f ms"
+                % ((time.monotonic() - self._t_submit) * 1e3))
+        if self.error is not None:
+            raise self.error
+        return self.outputs
+
+    def _resolve(self, outputs=None, finish_reason=None, error=None):
+        # first resolution wins, atomically (scheduler thread, pooled
+        # dispatch thread, abort paths and deadline expiry can race)
+        with self._rlock:
+            if self._event.is_set():
+                return
+            self.outputs = outputs
+            self.finish_reason = finish_reason
+            self.error = error
+            self._event.set()
+            cb = self.on_complete
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception as e:  # a dead socket must not kill the
+                _LOG.warning("generate completion hook failed: %r", e)
+
+
+# ---------------------------------------------------------------------------
+# the continuous-batching scheduler
+# ---------------------------------------------------------------------------
+
+class _Sequence:
+    """Scheduler-internal state of one RUNNING sequence."""
+
+    __slots__ = ("req", "pages", "page_row", "pos", "generated", "t_last",
+                 "n_steps")
+
+    def __init__(self, req, pages, page_row, pos, first_token):
+        self.req = req
+        self.pages = pages
+        self.page_row = page_row
+        self.pos = pos            # position of the NEXT token to feed
+        self.generated = [first_token]
+        self.t_last = time.monotonic()
+        self.n_steps = 0
+
+
+_SCHED_SEQ = itertools.count()
+
+
+class GenerateScheduler:
+    """Token-level continuous batching over one decode engine.
+
+    One worker thread (``mxtpu-decode-<name>``) owns the engine, the
+    active set and the page allocator's grants. Each lap:
+
+      1. **admit**: pop waiting requests while batch slots AND worst-case
+         pages are available; run one PREFILL each (its own bucketed
+         executable), which also samples the first token.
+      2. **decode**: one step for the whole active set, padded to the
+         smallest power-of-two batch bucket — one cached executable per
+         bucket, zero-compile steady state.
+      3. **retire**: sequences hitting EOS / ``max_new_tokens`` / their
+         deadline resolve immediately and return their pages — the next
+         lap's admissions reuse them. Requests join and leave at step
+         granularity; nobody waits for the longest sequence in the batch.
+
+    The engine must be single-threaded-driven; only the worker thread
+    (plus `close` after joining it) touches it.
+    """
+
+    def __init__(self, engine, name="default", queue_depth=None, warm=True):
+        self.engine = engine
+        self.name = str(name)
+        self.buckets = sorted(int(b) for b in engine.buckets)
+        self.max_active = self.buckets[-1]
+        if queue_depth is None:
+            queue_depth = _env.get("MXTPU_SERVE_QUEUE_DEPTH")
+        self.queue_depth = max(1, int(queue_depth))
+        self.allocator = KVPageAllocator(engine.num_pages, engine.page_size,
+                                         name=self.name)
+
+        self._cv = threading.Condition()
+        self._queue = collections.deque()
+        self._active = []     # _Sequence list; mutated under _cv
+        self._stop = False
+        self._draining = False
+
+        labels = {"model": self.name}
+        self._m_queue = telemetry.gauge("mxtpu_serve_queue_depth", labels)
+        self._m_reqs = telemetry.counter("mxtpu_serve_requests_total", labels)
+        self._m_active = telemetry.gauge("mxtpu_serve_active_sequences",
+                                         labels)
+        self._m_steps = telemetry.counter("mxtpu_serve_decode_steps_total",
+                                          labels)
+        self._m_tokens = telemetry.counter(
+            "mxtpu_serve_generated_tokens_total", labels)
+        self._m_rej_full = telemetry.counter(
+            "mxtpu_serve_rejected_total",
+            {"model": self.name, "reason": "queue_full"})
+        self._m_rej_dead = telemetry.counter(
+            "mxtpu_serve_rejected_total",
+            {"model": self.name, "reason": "deadline"})
+        # in-flight expiry (admitted, partially decoded, then timed out)
+        # is NOT an admission rejection: a dashboard alerting on
+        # rejected_total must not fire during slow-decode incidents
+        self._m_expired = telemetry.counter(
+            "mxtpu_serve_rejected_total",
+            {"model": self.name, "reason": "decode_expired"})
+        # inter-token latency IS decode serving latency: its p99 is the
+        # serve_bench decode row's headline SLO figure
+        self._m_intertoken = telemetry.histogram(
+            "mxtpu_serve_intertoken_seconds", labels,
+            bounds=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1., 2.5))
+        self._m_prefill = telemetry.histogram("mxtpu_serve_prefill_seconds",
+                                              labels)
+
+        # the RNG chain is thread-local (mxnet_tpu/random.py) and the
+        # worker thread would otherwise lazily seed itself with the
+        # DEFAULT seed — every replica/restart drawing one identical
+        # "random" stream, deaf to mx.random.seed(). Derive the worker
+        # chain from the CONSTRUCTING thread's seed (so an in-process
+        # seed() before load stays reproducible) folded with the pid and
+        # a per-process scheduler index (so co-located replicas and
+        # restarted workers decorrelate).
+        self._rng_seed = (_random.current_seed() * 1000003
+                          + os.getpid() * 10007
+                          + next(_SCHED_SEQ)) % (1 << 31)
+
+        self.warm_seconds = None
+        if warm:
+            self.warm_seconds = engine.warm()
+        self._worker = threading.Thread(
+            target=self._loop, name="mxtpu-decode-%s" % self.name,
+            daemon=True)
+        self._worker.start()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, tokens, max_new_tokens=None, temperature=0.0, top_k=0,
+               top_p=1.0, deadline=None, trace=None, on_complete=None):
+        """Admit one generation request; returns a `GenRequest`.
+        ``on_complete`` (optional) fires on EVERY resolution — success,
+        expiry or abort (the replica worker's reply hook)."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise MXNetError("generation needs at least one prompt token")
+        if len(tokens) > self.engine.max_prompt:
+            raise MXNetError(
+                "prompt has %d tokens; this model admits up to %d "
+                "(MXTPU_SERVE_MAX_PROMPT)" % (len(tokens),
+                                              self.engine.max_prompt))
+        vocab = self.engine.vocab_size
+        if any(t < 0 or t >= vocab for t in tokens):
+            raise MXNetError("prompt token out of range [0, %d)" % vocab)
+        cap = self.engine.max_new_tokens
+        if max_new_tokens is None:
+            max_new_tokens = cap
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1 or max_new_tokens > cap:
+            raise MXNetError(
+                "max_new_tokens must be in 1..%d (MXTPU_SERVE_MAX_NEW_"
+                "TOKENS), got %d" % (cap, max_new_tokens))
+        req = GenRequest(tokens, max_new_tokens, temperature=temperature,
+                         top_k=top_k, top_p=top_p, deadline=deadline,
+                         trace=trace)
+        req.on_complete = on_complete
+        with self._cv:
+            if self._stop or self._draining:
+                raise DrainingError("model %r is draining" % self.name)
+            if len(self._queue) >= self.queue_depth:
+                self._m_rej_full.inc()
+                raise QueueFullError(
+                    "generation queue for %r is full (%d requests; "
+                    "MXTPU_SERVE_QUEUE_DEPTH)" % (self.name,
+                                                  self.queue_depth))
+            self._queue.append(req)
+            self._m_queue.set(len(self._queue))
+            self._m_reqs.inc()
+            self._cv.notify()
+        return req
+
+    def pending(self):
+        with self._cv:
+            return len(self._queue) + len(self._active)
+
+    # -- shutdown ----------------------------------------------------------
+    def drain(self, timeout=None):
+        """Stop admitting; let running sequences finish. Bounded."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        if timeout is None:
+            timeout = drain_timeout_s()
+        deadline = time.monotonic() + timeout
+        while self.pending():
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+    def abort_pending(self, error=None):
+        """Force-resolve every queued and RUNNING request (bounded-drain
+        escape hatch). Running sequences' pages are reclaimed by the
+        worker on its next lap (or by `close` once the worker is joined);
+        first-resolution-wins makes the race benign."""
+        if error is None:
+            error = DrainingError(
+                "model %r drain timed out; generation force-completed"
+                % self.name)
+        with self._cv:
+            victims = list(self._queue) + [s.req for s in self._active
+                                           if not s.req.done()]
+            self._queue.clear()
+            self._m_queue.set(0)
+        for req in victims:
+            req._resolve(error=error)
+        return len(victims)
+
+    def close(self, drain=True, timeout=None):
+        drained = self.drain(timeout) if drain else False
+        with self._cv:
+            self._stop = True
+            self._draining = True
+            self._cv.notify_all()
+        self._worker.join(timeout=10.0)
+        self.abort_pending(DrainingError(
+            "model %r shut down before this generation ran" % self.name))
+        if not self._worker.is_alive():
+            # the worker is gone: reclaim whatever the aborted sequences
+            # still held so the used gauge reads 0 after shutdown
+            with self._cv:
+                leftovers, self._active = self._active, []
+            for seq in leftovers:
+                self.allocator.free(seq.pages)
+            self._m_active.set(0)
+        return drained
+
+    # -- the worker --------------------------------------------------------
+    def _loop(self):
+        _random.seed(self._rng_seed)   # this thread's sampling chain
+        while True:
+            with self._cv:
+                while not self._queue and not self._active:
+                    if self._stop:
+                        return
+                    self._cv.wait(0.05)
+                if self._stop:
+                    return
+            try:
+                self._admit()
+                if self._active:
+                    self._step()
+            except Exception as e:  # the lone decode worker must not die
+                telemetry.record_event("serve_decode_error",
+                                       model=self.name, error=repr(e))
+                _LOG.exception("decode loop error on %r", self.name)
+                err = ServingError("decode loop for %r failed: %r"
+                                   % (self.name, e))
+                err.__cause__ = e
+                with self._cv:
+                    dead, self._active = self._active, []
+                for seq in dead:
+                    self.allocator.free(seq.pages)
+                    seq.req._resolve(error=err)
+                self._m_active.set(0)
+
+    def _admit(self):
+        """Pop waiting requests while batch slots + worst-case pages are
+        available and run their prefill — the join-mid-decode half of
+        continuous batching."""
+        while len(self._active) < self.max_active:
+            with self._cv:
+                if not self._queue:
+                    break
+                req = self._queue[0]
+                now = time.monotonic()
+                if req.deadline is not None and now >= req.deadline:
+                    self._queue.popleft()
+                    self._m_queue.set(len(self._queue))
+                    self._m_rej_dead.inc()
+                    req._resolve(error=DeadlineExceededError(
+                        "deadline expired after %.0f ms in queue"
+                        % ((now - req._t_submit) * 1e3)))
+                    continue
+                if req.done():       # externally aborted while queued
+                    self._queue.popleft()
+                    self._m_queue.set(len(self._queue))
+                    continue
+                # worst-case reservation: prompt + max_new tokens. Pages
+                # are granted up front so a RUNNING sequence can never
+                # stall mid-decode waiting for the pool (no deadlock,
+                # no mid-flight eviction)
+                need = self.allocator.pages_for(
+                    len(req.tokens) + req.max_new_tokens)
+                pages = self.allocator.alloc(need)
+                if pages is None:
+                    break            # pool pressure: stays queued
+                self._queue.popleft()
+                self._m_queue.set(len(self._queue))
+            req.queue_seconds = time.monotonic() - req._t_submit
+            page_row = _np.zeros(self.engine.max_pages_per_seq, _np.int32)
+            page_row[:len(pages)] = pages
+            t0 = time.monotonic()
+            t0_wall = time.time()
+            try:
+                first = self.engine.prefill(
+                    req.tokens, page_row,
+                    (req.temperature, req.top_k, req.top_p),
+                    _random.next_key())
+            except Exception as e:  # bad prompt/model: answer, free pages
+                self.allocator.free(pages)
+                err = ServingError("prefill on %r failed: %r"
+                                   % (self.name, e))
+                err.__cause__ = e
+                telemetry.record_event("serve_decode_error",
+                                       model=self.name, error=repr(e))
+                req._resolve(error=err)
+                continue
+            prefill_s = time.monotonic() - t0
+            self._m_prefill.observe(
+                prefill_s,
+                exemplar=req.trace.trace_id if req.trace is not None
+                else None)
+            _tracing.emit_span(
+                "serve.queue", t0_wall - req.queue_seconds,
+                req.queue_seconds, req.trace, component="decode")
+            _tracing.emit_span(
+                "decode.prefill", t0_wall, prefill_s, req.trace,
+                component="decode",
+                attrs={"prompt": len(req.tokens), "pages": len(pages)})
+            self._m_tokens.inc()
+            seq = _Sequence(req, pages, page_row, len(req.tokens), first)
+            if not self._finish_if_done(seq):
+                with self._cv:
+                    self._active.append(seq)
+            self._m_active.set(len(self._active))
+
+    def _step(self):
+        """One decode step for the whole active set, padded to the
+        smallest batch bucket; then retire finished sequences."""
+        # sequences resolved externally (abort, expired deadline) retire
+        # first — never spend a step on an answer nobody is waiting for
+        now = time.monotonic()
+        live = []
+        for seq in self._active:
+            if seq.req.done():
+                self.allocator.free(seq.pages)
+            elif seq.req.deadline is not None and now >= seq.req.deadline:
+                self._retire(seq, None, error=DeadlineExceededError(
+                    "deadline expired after %d generated token(s)"
+                    % len(seq.generated)))
+            else:
+                live.append(seq)
+        if len(live) != len(self._active):
+            with self._cv:
+                self._active = live
+            self._m_active.set(len(live))
+        if not live:
+            return
+        n = len(live)
+        bucket = bucket_for(n, self.buckets)
+        ps = self.engine.page_size
+        nump = self.engine.num_pages
+        tokens = _np.zeros(bucket, _np.int32)
+        positions = _np.zeros(bucket, _np.int32)
+        dest_pages = _np.full(bucket, nump, _np.int32)  # OOB = dropped
+        dest_slots = _np.zeros(bucket, _np.int32)
+        tables = _np.zeros((bucket, self.engine.max_pages_per_seq),
+                           _np.int32)
+        lengths = _np.zeros(bucket, _np.int32)
+        temps = _np.zeros(bucket, _np.float32)
+        top_ks = _np.zeros(bucket, _np.int32)
+        top_ps = _np.ones(bucket, _np.float32)
+        for i, seq in enumerate(live):
+            tokens[i] = seq.generated[-1]
+            positions[i] = seq.pos
+            dest_pages[i] = seq.page_row[seq.pos // ps]
+            dest_slots[i] = seq.pos % ps
+            tables[i] = seq.page_row
+            lengths[i] = seq.pos + 1
+            temps[i] = seq.req.temperature
+            top_ks[i] = seq.req.top_k
+            top_ps[i] = seq.req.top_p
+        t0 = time.monotonic()
+        t0_wall = time.time()
+        nxt = self.engine.decode_step(tokens, positions, dest_pages,
+                                      dest_slots, tables, lengths, temps,
+                                      top_ks, top_ps, _random.next_key())
+        step_s = time.monotonic() - t0
+        self._m_steps.inc()
+        now = time.monotonic()
+        still = []
+        for i, seq in enumerate(live):
+            seq.pos += 1
+            seq.n_steps += 1
+            seq.generated.append(int(nxt[i]))
+            self._m_tokens.inc()
+            self._m_intertoken.observe(
+                now - seq.t_last,
+                exemplar=seq.req.trace.trace_id
+                if seq.req.trace is not None else None)
+            seq.t_last = now
+            _tracing.emit_span(
+                "decode.step", t0_wall, step_s, seq.req.trace,
+                component="decode",
+                attrs={"bucket": bucket, "n": n, "step": seq.n_steps})
+            if not self._finish_if_done(seq):
+                still.append(seq)
+        with self._cv:
+            self._active = still
+        self._m_active.set(len(still))
+
+    def _finish_if_done(self, seq):
+        """Retire a sequence that hit EOS or its token budget."""
+        eos = self.engine.eos_id
+        if eos is not None and seq.generated[-1] == eos:
+            self._retire(seq, "eos")
+            return True
+        if len(seq.generated) >= seq.req.max_new_tokens:
+            self._retire(seq, "length")
+            return True
+        return False
+
+    def _retire(self, seq, finish_reason, error=None):
+        self.allocator.free(seq.pages)
+        if error is not None:
+            self._m_expired.inc()
+            seq.req._resolve(error=error)
+        else:
+            seq.req._resolve(outputs=list(seq.generated),
+                             finish_reason=finish_reason)
+
+
+# ---------------------------------------------------------------------------
+# the Transformer-LM decode engine
+# ---------------------------------------------------------------------------
+
+def _ln(x, p):
+    from ..ops import nn as _opsnn
+
+    return _opsnn.layer_norm(x, p["g"], p["b"])
+
+
+def _dense(x, p):
+    return x @ p["w"].T + p["b"]
+
+
+class TransformerLMEngine:
+    """Incremental (paged-KV) execution of a `TransformerLM`.
+
+    Prefill computes the full causal forward of a padded prompt bucket,
+    writes every token's K/V into the sequence's pages and samples the
+    first token; decode_step feeds one token per active sequence, appends
+    its K/V and attends over the page table
+    (`ops/pallas_kernels.paged_attention`). Both are pure functions of
+    (params, kv, inputs) resolved through the `mxnet_tpu.compile`
+    registry — parameters ride as arguments, so the executables are keyed
+    purely by geometry. Single-threaded: only the scheduler worker may
+    drive an engine.
+    """
+
+    def __init__(self, lm=None, params=None, config=None, num_pages=None,
+                 page_size=None, max_prompt=None, max_new_tokens=None,
+                 max_batch=None, decode_buckets=None, prefill_buckets=None,
+                 eos_id=None, kv_dtype="float32"):
+        import jax
+
+        if lm is not None:
+            config = lm.config
+            params = lm.decode_params()
+        if config is None or params is None:
+            raise MXNetError("TransformerLMEngine needs an lm= block or "
+                             "params= + config=")
+        self.config = dict(config)
+        self.vocab_size = int(config["vocab_size"])
+        self.units = int(config["units"])
+        self.num_heads = int(config["num_heads"])
+        self.head_dim = self.units // self.num_heads
+        self.num_layers = int(config["num_layers"])
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.page_size = int(page_size if page_size is not None
+                             else _env.get("MXTPU_SERVE_KV_PAGE_SIZE"))
+        self.num_pages = int(num_pages if num_pages is not None
+                             else _env.get("MXTPU_SERVE_KV_PAGES"))
+        self.max_prompt = int(max_prompt if max_prompt is not None
+                              else _env.get("MXTPU_SERVE_MAX_PROMPT"))
+        self.max_new_tokens = int(
+            max_new_tokens if max_new_tokens is not None
+            else _env.get("MXTPU_SERVE_MAX_NEW_TOKENS"))
+        max_total = self.max_prompt + self.max_new_tokens
+        if max_total > int(config["max_length"]):
+            raise MXNetError(
+                "max_prompt + max_new_tokens = %d exceeds the model's "
+                "position table (max_length=%d)"
+                % (max_total, config["max_length"]))
+        self.max_pages_per_seq = -(-max_total // self.page_size)
+        if self.max_pages_per_seq > self.num_pages:
+            raise MXNetError(
+                "one sequence can need %d pages but the pool has only %d "
+                "(MXTPU_SERVE_KV_PAGES)" % (self.max_pages_per_seq,
+                                            self.num_pages))
+        if decode_buckets is None:
+            if max_batch is None:
+                max_batch = _env.get("MXTPU_SERVE_MAX_BATCH")
+            decode_buckets = power_of_two_buckets(max_batch)
+        self.buckets = sorted(int(b) for b in decode_buckets)
+        if prefill_buckets is None:
+            lo = min(8, self.max_prompt)
+            prefill_buckets = [b for b in
+                               power_of_two_buckets(self.max_prompt)
+                               if b >= lo]
+        self.prefill_buckets = sorted(int(b) for b in prefill_buckets)
+        self.kv_dtype = str(kv_dtype)
+
+        self._params = jax.tree_util.tree_map(
+            lambda a: jax.numpy.asarray(a, jax.numpy.float32), params)
+        self._param_bytes = int(sum(
+            a.size * a.dtype.itemsize
+            for a in jax.tree_util.tree_leaves(self._params)))
+        self._kv = jax.numpy.zeros(
+            (self.num_layers, 2, self.num_pages, self.num_heads,
+             self.page_size, self.head_dim), dtype=self.kv_dtype)
+        # executable identity: architecture + geometry (params are args,
+        # so two engines with one geometry share executables)
+        self._fingerprint = hashlib.sha256(json.dumps(
+            {"config": self.config, "pages": self.num_pages,
+             "page_size": self.page_size, "maxp": self.max_pages_per_seq,
+             "kv": self.kv_dtype}, sort_keys=True).encode()).hexdigest()[:32]
+
+    # -- sizing ------------------------------------------------------------
+    def kv_bytes(self):
+        """Device bytes of the page pool (allocated in full at load —
+        the figure `MXTPU_SERVE_MEMORY_BUDGET` admission prices)."""
+        return int(self._kv.size) * _np.dtype(self.kv_dtype).itemsize
+
+    def param_bytes(self):
+        return self._param_bytes
+
+    def geometry(self):
+        return {"num_pages": self.num_pages, "page_size": self.page_size,
+                "max_pages_per_seq": self.max_pages_per_seq,
+                "max_prompt": self.max_prompt,
+                "max_new_tokens": self.max_new_tokens,
+                "decode_buckets": list(self.buckets),
+                "prefill_buckets": list(self.prefill_buckets),
+                "kv_dtype": self.kv_dtype,
+                "kv_bytes": self.kv_bytes(),
+                "param_bytes": self.param_bytes()}
+
+    # -- executables -------------------------------------------------------
+    def _key(self, kind, shape_sig):
+        # no_persist: plain memory-tier entries (the decode loop's hit
+        # path is a dict get; serializing pallas/jnp decode graphs buys
+        # little and the artifact trust story nothing)
+        return _compile.ExecutableKey(
+            kind, self._fingerprint, shapes=shape_sig,
+            static=(("pages", self.num_pages),
+                    ("page_size", self.page_size),
+                    ("maxp", self.max_pages_per_seq),
+                    ("kv", self.kv_dtype)),
+            no_persist=True)
+
+    def _build_prefill(self, lp):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pallas_kernels import _NEG_INF
+        from ..ops.random_ops import sample_token_logits
+
+        H, Dh, ps = self.num_heads, self.head_dim, self.page_size
+        nump, scale = self.num_pages, 1.0 / math.sqrt(self.head_dim)
+
+        def fn(params, kv, tokens, length, page_row, temp, top_k, top_p,
+               key):
+            # tokens (lp,) int32 padded; length () int32; page_row (maxp,)
+            x = params["word"][tokens] + params["pos"][jnp.arange(lp)]
+            x = _ln(x, params["embed_norm"])                     # (lp, C)
+            causal = jnp.arange(lp)[None, :] <= jnp.arange(lp)[:, None]
+            t_idx = jnp.arange(lp)
+            tpage = jnp.where(t_idx < length, page_row[t_idx // ps], nump)
+            tslot = t_idx % ps
+            for li, layer in enumerate(params["layers"]):
+                qh = _dense(x, layer["q"]).reshape(lp, H, Dh)
+                kh = _dense(x, layer["k"]).reshape(lp, H, Dh)
+                vh = _dense(x, layer["v"]).reshape(lp, H, Dh)
+                kv = kv.at[li, 0, tpage, :, tslot, :].set(
+                    kh.astype(kv.dtype), mode="drop")
+                kv = kv.at[li, 1, tpage, :, tslot, :].set(
+                    vh.astype(kv.dtype), mode="drop")
+                s = jnp.einsum("qhd,khd->hqk", qh, kh) * scale
+                s = jnp.where(causal[None], s, _NEG_INF)
+                p = jax.nn.softmax(s, axis=-1)
+                att = jnp.einsum("hqk,khd->qhd", p, vh).reshape(lp, -1)
+                x = _ln(x + _dense(att, layer["o"]), layer["attn_norm"])
+                h = jax.nn.gelu(_dense(x, layer["ffn1"]), approximate=False)
+                x = _ln(x + _dense(h, layer["ffn2"]), layer["ffn_norm"])
+            logits = x[length - 1] @ params["word"].T            # (V,)
+            tok = sample_token_logits(key, logits[None], temp, top_k,
+                                      top_p)
+            return tok[0], kv
+
+        # the kv pool is DONATED: without it every call materializes a
+        # second full pool for the output (transient 2x kv_bytes — the
+        # exact OOM the load-time budget admission promises to preclude)
+        return lambda: jax.jit(fn, donate_argnums=(1,))
+
+    def _build_decode(self, bucket):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pallas_kernels import paged_attention
+        from ..ops.random_ops import sample_token_logits
+
+        H, Dh = self.num_heads, self.head_dim
+        scale = 1.0 / math.sqrt(self.head_dim)
+
+        def fn(params, kv, tokens, positions, dest_pages, dest_slots,
+               tables, lengths, temp, top_k, top_p, key):
+            b = tokens.shape[0]
+            x = params["word"][tokens] + params["pos"][positions]  # (b, C)
+            x = _ln(x, params["embed_norm"])
+            for li, layer in enumerate(params["layers"]):
+                qh = _dense(x, layer["q"]).reshape(b, H, Dh)
+                kh = _dense(x, layer["k"]).reshape(b, H, Dh)
+                vh = _dense(x, layer["v"]).reshape(b, H, Dh)
+                kv = kv.at[li, 0, dest_pages, :, dest_slots, :].set(
+                    kh.astype(kv.dtype), mode="drop")
+                kv = kv.at[li, 1, dest_pages, :, dest_slots, :].set(
+                    vh.astype(kv.dtype), mode="drop")
+                att = paged_attention(qh, kv[li, 0], kv[li, 1], tables,
+                                      lengths, sm_scale=scale)
+                att = att.astype(x.dtype).reshape(b, -1)
+                x = _ln(x + _dense(att, layer["o"]), layer["attn_norm"])
+                h = jax.nn.gelu(_dense(x, layer["ffn1"]), approximate=False)
+                x = _ln(x + _dense(h, layer["ffn2"]), layer["ffn_norm"])
+            logits = x @ params["word"].T                        # (b, V)
+            return sample_token_logits(key, logits, temp, top_k, top_p), kv
+
+        # kv donated: the per-step update must alias, not copy, the pool
+        return lambda: jax.jit(fn, donate_argnums=(1,))
+
+    def _prefill_exe(self, lp):
+        return _compile.get_or_build(
+            self._key("lm_prefill", ("prompt", lp)),
+            self._build_prefill(lp), label="lm_prefill:l%d" % lp)
+
+    def _decode_exe(self, bucket):
+        return _compile.get_or_build(
+            self._key("lm_decode", ("batch", bucket)),
+            self._build_decode(bucket), label="lm_decode:b%d" % bucket)
+
+    # -- driving -----------------------------------------------------------
+    def prefill(self, tokens, page_row, sampling, key):
+        """Run one prompt through its padded prefill bucket; writes the
+        prompt's K/V into `page_row`'s pages and returns the sampled
+        first token (int)."""
+        lp = bucket_for(len(tokens), self.prefill_buckets)
+        if lp is None:
+            raise MXNetError("prompt of %d tokens overflows the prefill "
+                             "buckets %s" % (len(tokens),
+                                             self.prefill_buckets))
+        padded = _np.zeros(lp, _np.int32)
+        padded[:len(tokens)] = tokens
+        temp, top_k, top_p = sampling
+        tok, self._kv = self._prefill_exe(lp)(
+            self._params, self._kv, padded,
+            _np.int32(len(tokens)), _np.asarray(page_row, _np.int32),
+            _np.float32([temp]), _np.int32([top_k]), _np.float32([top_p]),
+            key)
+        return int(tok)
+
+    def decode_step(self, tokens, positions, dest_pages, dest_slots,
+                    tables, lengths, temps, top_ks, top_ps, key):
+        """One token for every row (rows with length 0 are inert padding:
+        their K/V writes drop and their sampled token is discarded).
+        Returns an int32 numpy array of next tokens."""
+        out, self._kv = self._decode_exe(len(tokens))(
+            self._params, self._kv, tokens, positions, dest_pages,
+            dest_slots, tables, lengths, temps, top_ks, top_ps, key)
+        return _np.asarray(out)
+
+    def warm(self):
+        """Compile every prefill + decode bucket (dummy data, dropped
+        writes) so steady-state generation is zero-compile. Returns
+        seconds."""
+        t0 = time.monotonic()
+        maxp = self.max_pages_per_seq
+        for lp in self.prefill_buckets:
+            # a full-bucket prompt so EVERY prefill bucket compiles (a
+            # 1-token prompt would only ever warm the smallest)
+            self.prefill([1] * lp, _np.zeros(maxp, _np.int32),
+                         (0.0, 0, 1.0), _random.next_key())
+        for b in self.buckets:
+            self.decode_step(
+                _np.zeros(b, _np.int32), _np.zeros(b, _np.int32),
+                _np.full(b, self.num_pages, _np.int32),
+                _np.zeros(b, _np.int32), _np.zeros((b, maxp), _np.int32),
+                _np.zeros(b, _np.int32), _np.zeros(b, _np.float32),
+                _np.zeros(b, _np.int32), _np.ones(b, _np.float32),
+                _random.next_key())
+            telemetry.record_event("serve_decode_warm", model="engine",
+                                   bucket=b)
+        return time.monotonic() - t0
+
+
+# ---------------------------------------------------------------------------
+# artifact IO — <prefix>-lmconfig.json + <prefix>-lm.params
+# ---------------------------------------------------------------------------
+
+def save_lm(lm, prefix):
+    """Write a generation-serving artifact: the architecture header and
+    the parameters. This is what `tools/serve.py --model name=PREFIX@
+    generate` and replica workers load."""
+    from .. import nd
+    from ..base import atomic_writer
+
+    if any(p._data is None for p in lm.collect_params().values()):
+        # deferred Dense/LayerNorm shapes materialize on first forward
+        lm(nd.array([[0]], dtype="int32"))
+    prefix = os.fspath(prefix)
+    with atomic_writer(prefix + "-lmconfig.json", "w") as f:
+        json.dump({"format": _LM_FORMAT, "config": lm.config}, f, indent=1)
+    lm.save_parameters(prefix + "-lm.params")
+    return prefix
+
+
+def load_lm(prefix):
+    """Rebuild a `TransformerLM` from a `save_lm` artifact."""
+    from ..gluon.model_zoo.transformer import TransformerLM
+
+    prefix = os.fspath(prefix)
+    cfg_path = prefix + "-lmconfig.json"
+    if not os.path.exists(cfg_path):
+        raise MXNetError("no generation artifact at %r (expected %s)"
+                         % (prefix, cfg_path))
+    with open(cfg_path) as f:
+        header = json.load(f)
+    if header.get("format") != _LM_FORMAT:
+        raise MXNetError("%s: unknown LM artifact format %r"
+                         % (cfg_path, header.get("format")))
+    lm = TransformerLM(**header["config"])
+    lm.load_parameters(prefix + "-lm.params")
+    return lm
+
+
+# ---------------------------------------------------------------------------
+# the repository-facing served model
+# ---------------------------------------------------------------------------
+
+class ServedLM:
+    """One served generation model (`ModelRepository` duck type).
+
+    In-process it owns a `GenerateScheduler`; pooled (``replicas >= 1``)
+    it routes each request to a replica worker over the supervisor wire
+    protocol — every worker runs its own scheduler, so continuous
+    batching happens replica-side while routing, failover (exactly-once
+    re-dispatch) and health checks stay router-side.
+    """
+
+    def __init__(self, name, version, scheduler=None, pool=None, info=None,
+                 meta=None):
+        self.name = str(name)
+        self.version = int(version)
+        self._scheduler = scheduler
+        self._pool = pool
+        self.meta = dict(meta or {})
+        self.loaded_at = time.time()
+        self.warmed = True
+        if scheduler is not None:
+            self.generate_info = dict(scheduler.engine.geometry())
+            self.warm_seconds = scheduler.warm_seconds
+        else:
+            self.generate_info = dict((info or {}).get("generate") or {})
+            self.warm_seconds = (info or {}).get("warm_seconds")
+        self.memory_bytes = (
+            (self.generate_info.get("kv_bytes") or 0)
+            + (self.generate_info.get("param_bytes") or 0)) or None
+        if self.effective_memory_bytes:
+            telemetry.gauge("mxtpu_serve_model_memory_bytes",
+                            {"model": "%s/%d" % (self.name, self.version)}
+                            ).set(self.effective_memory_bytes)
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def load(name, version, prefix, replicas=0, queue_depth=None,
+             worker_args=None, pool_kwargs=None, **engine_kwargs):
+        """Load a `save_lm` artifact as a served generation model.
+
+        ``replicas`` = 0 runs the scheduler in-process; N >= 1 spawns a
+        supervised `ReplicaPool` in generate mode (``engine_kwargs`` with
+        geometry meaning — kv pages/page size/max batch — are forwarded
+        to the workers as argv so router and replicas agree)."""
+        version = int(version)
+        if replicas and replicas > 0:
+            from .replica_pool import ReplicaPool
+
+            if worker_args is None:
+                if prefix is None:
+                    raise MXNetError("pooled ServedLM.load needs an "
+                                     "artifact prefix (or worker_args)")
+                worker_args = ["--generate", os.fspath(prefix)]
+                flag_for = {"num_pages": "--kv-pages",
+                            "page_size": "--kv-page-size",
+                            "max_prompt": "--max-prompt",
+                            "max_new_tokens": "--max-new-tokens",
+                            "max_batch": "--max-batch"}
+                for k, flag in flag_for.items():
+                    if engine_kwargs.get(k) is not None:
+                        worker_args += [flag, str(engine_kwargs[k])]
+            pool = ReplicaPool("%s/%d" % (name, version), worker_args,
+                               replicas, generate=True,
+                               gen_queue_depth=queue_depth,
+                               **(pool_kwargs or {}))
+            try:
+                info = pool.wait_ready()
+            except Exception:
+                pool.close()
+                raise
+            return ServedLM(name, version, pool=pool, info=info,
+                            meta={"artifact": "generate",
+                                  "path": None if prefix is None
+                                  else os.fspath(prefix),
+                                  "replicas": int(replicas)})
+        engine = TransformerLMEngine(lm=load_lm(prefix), **engine_kwargs)
+        sched = GenerateScheduler(engine,
+                                  name="%s/%d" % (name, version),
+                                  queue_depth=queue_depth)
+        return ServedLM(name, version, scheduler=sched,
+                        meta={"artifact": "generate",
+                              "path": os.fspath(prefix)})
+
+    # -- serving surface ---------------------------------------------------
+    @property
+    def pool(self):
+        return self._pool
+
+    @property
+    def scheduler(self):
+        return self._scheduler
+
+    @property
+    def resident_copies(self):
+        try:
+            return max(1, int(self.meta.get("replicas") or 1))
+        except (TypeError, ValueError):
+            return 1
+
+    @property
+    def effective_memory_bytes(self):
+        if not self.memory_bytes:
+            return None
+        return self.memory_bytes * self.resident_copies
+
+    def generate(self, tokens, max_new_tokens=None, temperature=0.0,
+                 top_k=0, top_p=1.0, timeout_ms=None):
+        """Admit one generation request and wait for it: returns
+        ``{"tokens": [...], "finish_reason": ...}``. Raises the typed
+        admission errors (429/503/504/400 mapping) like predict."""
+        if timeout_ms is None:
+            timeout_ms = _env.get("MXTPU_SERVE_TIMEOUT_MS")
+        deadline = None
+        if timeout_ms and timeout_ms > 0:
+            deadline = time.monotonic() + float(timeout_ms) / 1e3
+        if self._pool is not None:
+            req = self._make_pool_request(tokens, max_new_tokens,
+                                          temperature, top_k, top_p,
+                                          deadline)
+            self._pool.submit_generate(req)
+        else:
+            req = self._scheduler.submit(
+                tokens, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                deadline=deadline)
+        timeout = None if deadline is None \
+            else max(0.0, deadline - time.monotonic())
+        out = req.wait(timeout)
+        return {"tokens": out, "finish_reason": req.finish_reason}
+
+    def _make_pool_request(self, tokens, max_new_tokens, temperature,
+                           top_k, top_p, deadline):
+        """Router-side validation mirrors the scheduler's (the worker
+        re-validates, but a malformed request should 400 here, not ride
+        the wire)."""
+        tokens = [int(t) for t in tokens]
+        gi = self.generate_info
+        if not tokens:
+            raise MXNetError("generation needs at least one prompt token")
+        if gi.get("max_prompt") and len(tokens) > gi["max_prompt"]:
+            raise MXNetError(
+                "prompt has %d tokens; this model admits up to %d"
+                % (len(tokens), gi["max_prompt"]))
+        cap = gi.get("max_new_tokens") \
+            or _env.get("MXTPU_SERVE_MAX_NEW_TOKENS")
+        if max_new_tokens is None:
+            max_new_tokens = cap
+        if int(max_new_tokens) < 1 or int(max_new_tokens) > cap:
+            raise MXNetError("max_new_tokens must be in 1..%d, got %s"
+                             % (cap, max_new_tokens))
+        return GenRequest(tokens, max_new_tokens, temperature=temperature,
+                          top_k=top_k, top_p=top_p, deadline=deadline)
+
+    # -- repository lifecycle ---------------------------------------------
+    def pending(self):
+        if self._scheduler is not None:
+            return self._scheduler.pending()
+        return self._pool.generate_pending()
+
+    def drain(self, timeout=None):
+        if self._scheduler is not None:
+            return self._scheduler.drain(timeout)
+        return self._pool.drain_generate(timeout)
+
+    def abort_pending(self, error=None):
+        if self._scheduler is not None:
+            return self._scheduler.abort_pending(error)
+        return self._pool.abort_generate(error)
+
+    def close(self, drain=True, timeout=None):
+        drained = False
+        if self._scheduler is not None:
+            drained = self._scheduler.close(drain=drain, timeout=timeout)
+        if self._pool is not None:
+            if drain:
+                drained = self._pool.drain_generate(timeout)
+            self._pool.close()
+        return drained
+
+    def describe(self):
+        out = {
+            "name": self.name,
+            "version": self.version,
+            "kind": "generate",
+            "generate": dict(self.generate_info),
+            "warmed": self.warmed,
+            "warm_seconds": self.warm_seconds,
+            "pending": self.pending(),
+            "loaded_at": self.loaded_at,
+            "meta": self.meta,
+            "memory": {"total_bytes": self.memory_bytes,
+                       "copies": self.resident_copies,
+                       "effective_bytes": self.effective_memory_bytes},
+        }
+        if self._scheduler is not None:
+            alloc = self._scheduler.allocator
+            out["kv"] = {"pages_total": alloc.num_pages,
+                         "pages_used": alloc.used_pages,
+                         "page_size": alloc.page_size}
+        if self._pool is not None:
+            out["pool"] = self._pool.describe()
+        return out

@@ -548,7 +548,8 @@ class ModelRepository:
 
     def load(self, name, path, version=None, input_shapes=None,
              input_dtypes=None, ctx=None, max_batch=None, max_delay_ms=None,
-             queue_depth=None, warm=True, replicas=0, **pool_kwargs):
+             queue_depth=None, warm=True, replicas=0, generate=False,
+             generate_opts=None, **pool_kwargs):
         """Load an artifact as ``name/version`` (auto-increment when
         ``version`` is None) and publish it after warmup. The version is
         RESERVED for the whole load, so two concurrent loads of the same
@@ -558,7 +559,15 @@ class ModelRepository:
         ``replicas`` > 0 serves the model through a supervised replica
         pool (`ServedModel.pooled`; ``pool_kwargs`` — heartbeat_ms,
         backoff_ms, extra_env, spawn_timeout_s, teardown_grace — pass
-        through) instead of in-process."""
+        through) instead of in-process.
+
+        ``generate=True`` loads ``path`` as a generation LM artifact
+        (`generate.save_lm` prefix) served through the continuous-
+        batching decode scheduler instead of the DynamicBatcher
+        (docs/serving.md §Generation; ``generate_opts`` forwards KV/
+        bucket geometry to `TransformerLMEngine`). The KV page pool is
+        part of the model footprint, so the memory-budget admission in
+        `add` 507s a load whose pages cannot fit."""
         with self._lock:
             have = self._models.get(name, {})
             reserved = [v for (n, v) in self._loading if n == name]
@@ -570,6 +579,30 @@ class ModelRepository:
                                  % (name, version))
             self._loading.add((name, version))
         try:
+            if generate:
+                from .generate import ServedLM
+
+                # predict-only knobs must not be silently ignored: a
+                # caller passing them believes they took effect
+                if input_shapes or input_dtypes or ctx is not None \
+                        or max_delay_ms is not None or not warm:
+                    raise MXNetError(
+                        "generate=True loads take geometry through "
+                        "generate_opts (and always warm); input_shapes/"
+                        "input_dtypes/ctx/max_delay_ms/warm=False do "
+                        "not apply")
+                opts = dict(generate_opts or {})
+                if max_batch is not None:
+                    opts.setdefault("max_batch", max_batch)
+                model = ServedLM.load(
+                    name, version, path, replicas=int(replicas or 0),
+                    queue_depth=queue_depth, pool_kwargs=pool_kwargs,
+                    **opts)
+                try:
+                    return self.add(model)
+                except Exception:
+                    model.close(drain=False, timeout=0)
+                    raise
             if replicas and replicas > 0:
                 model = ServedModel.pooled(
                     name, version, path, replicas,

@@ -38,6 +38,7 @@ docs/serving.md.
 """
 from __future__ import annotations
 
+import collections
 import hmac
 import math
 import queue
@@ -50,7 +51,9 @@ from .. import env as _env
 from .. import telemetry
 from ..telemetry import tracing as _tracing
 from ..base import MXNetError
-from .batcher import OverloadedError, ServingError, pad_batch
+from .batcher import (DeadlineExceededError, DrainingError, OverloadedError,
+                      QueueFullError, ServingError, drain_timeout_s,
+                      pad_batch)
 from .supervisor import (TOKEN_LEN, ReplicaProcess, backoff_s, recv_msg,
                          send_msg)
 
@@ -76,6 +79,11 @@ class _Slot:
         self.ready_info = None
         self.consecutive_restarts = 0
         self.msg_id = 0
+        # generate mode: stats round trips requested by the api thread,
+        # serviced by this slot's dispatch loop (deque append/popleft are
+        # GIL-atomic; waiter events close the handoff)
+        self.stats_requests = collections.deque()
+        self.stats_pending = {}   # msg id -> waiter (dispatch thread only)
 
 
 class ReplicaPool:
@@ -108,7 +116,8 @@ class ReplicaPool:
 
     def __init__(self, model, worker_args, replicas, heartbeat_ms=None,
                  backoff_ms=None, extra_env=None, spawn_timeout_s=120.0,
-                 teardown_grace=None, wedge_timeout_ms=None):
+                 teardown_grace=None, wedge_timeout_ms=None, generate=False,
+                 gen_queue_depth=None, gen_outstanding=None):
         if replicas < 1:
             raise MXNetError("replica pool needs >= 1 replicas, got %d"
                              % replicas)
@@ -131,6 +140,19 @@ class ReplicaPool:
         # 429/degraded-503 admission checks fire — an unbounded buffer
         # here would hide the backlog from admission control entirely
         self._work = queue.Queue(maxsize=max(1, self.size))
+        # generate mode (docs/serving.md §Generation): requests route
+        # individually — each replica worker runs its own continuous-
+        # batching scheduler, so the router's job is request routing,
+        # health and exactly-once failover, not batch assembly
+        self._generate = bool(generate)
+        if gen_queue_depth is None:
+            gen_queue_depth = _env.get("MXTPU_SERVE_QUEUE_DEPTH")
+        self._gen_queue_depth = max(1, int(gen_queue_depth))
+        self._gen_outstanding = max(1, int(gen_outstanding)) \
+            if gen_outstanding else 16
+        self._gen_cv = threading.Condition()
+        self._gen_pending = collections.deque()
+        self._gen_live = set()    # admitted + unresolved (guarded: _gen_cv)
 
         labels = {"model": self.model}
         self._m_healthy = telemetry.gauge("mxtpu_serve_pool_healthy", labels)
@@ -233,6 +255,298 @@ class ReplicaPool:
                 retry_after=math.ceil(self.size / healthy))
         return None
 
+    # -- generate-mode routing (docs/serving.md §Generation) ---------------
+    def submit_generate(self, req):
+        """Admit one `GenRequest` into the pool's routing queue. Healthy
+        replicas' dispatch threads pull from it; admission sheds
+        deterministically like predict (dead pool: 503 + backoff ETA,
+        full queue: 429). `healthy_count` is read BEFORE the queue lock —
+        the pool lock and the generate lock never nest."""
+        healthy = self.healthy_count
+        with self._gen_cv:
+            if self._stop:
+                raise DrainingError("model %r replica pool is shut down"
+                                    % self.model)
+            if healthy == 0:
+                eta = max((backoff_s(s.consecutive_restarts,
+                                     self._backoff_ms)
+                           for s in self._slots), default=1.0)
+                raise OverloadedError(
+                    "model %r has no healthy replicas (respawn in "
+                    "progress)" % self.model, retry_after=max(1.0, eta))
+            if len(self._gen_pending) >= self._gen_queue_depth:
+                raise QueueFullError(
+                    "generation queue for %r is full (%d requests; "
+                    "MXTPU_SERVE_QUEUE_DEPTH)"
+                    % (self.model, self._gen_queue_depth))
+            self._gen_pending.append(req)
+            self._gen_live.add(req)
+            self._gen_cv.notify()
+        return req
+
+    def generate_pending(self):
+        """Admitted-and-unresolved generation requests (drain progress)."""
+        with self._gen_cv:
+            return len(self._gen_live)
+
+    def drain_generate(self, timeout=None):
+        if timeout is None:
+            timeout = drain_timeout_s()
+        deadline = time.monotonic() + timeout
+        while self.generate_pending():
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def abort_generate(self, error=None):
+        """Force-resolve every admitted generation request (bounded-drain
+        escape hatch; first-resolution-wins makes the race with live
+        replies benign). Returns how many were force-resolved."""
+        if error is None:
+            error = DrainingError(
+                "model %r shut down before this generation completed"
+                % self.model)
+        with self._gen_cv:
+            victims = list(self._gen_live)
+            self._gen_pending.clear()
+        n = 0
+        for req in victims:
+            if not req.done():
+                req._resolve(error=error)
+                n += 1
+        with self._gen_cv:
+            self._gen_live.difference_update(victims)
+        return n
+
+    def replica_stats(self, replica_id, timeout=5.0):
+        """One stats round trip to a replica worker (KV-page occupancy,
+        post-warm jit count — the serve_bench/test evidence hooks).
+        Returns the worker's stats dict, or None on timeout/eject."""
+        slot = self._slots[replica_id]
+        waiter = {"event": threading.Event(), "result": None}
+        slot.stats_requests.append(waiter)
+        with self._gen_cv:
+            self._gen_cv.notify_all()   # nudge an idle dispatch loop
+        if not waiter["event"].wait(timeout):
+            return None
+        return waiter["result"]
+
+    def _gen_wire_error(self, msg):
+        """Map a worker ``gen_error`` frame back to the typed admission
+        error the HTTP layer knows how to answer."""
+        status = msg.get("status")
+        text = str(msg.get("error") or "replica generation error")
+        if status == 429:
+            return QueueFullError(text)
+        if status == 504:
+            return DeadlineExceededError(text)
+        if status == 503:
+            return OverloadedError(text)
+        if status == 400:
+            return MXNetError(text)
+        return ServingError(text)
+
+    def _requeue_generate(self, reqs):
+        """Failover: push a dead replica's unresolved generation requests
+        back to the routing queue's front, EXACTLY ONCE per request (the
+        decode prefix is recomputed on the new replica — generation from
+        a fixed prompt is idempotent for greedy and harmlessly re-drawn
+        for sampled requests). Expired members 504; twice-unlucky get a
+        retryable 503."""
+        now = time.monotonic()
+        requeued = 0
+        taken = set()
+        with self._gen_cv:
+            accept = not self._stop
+            for req in reversed(reqs):
+                if req.done():
+                    continue
+                if req.deadline is not None and now >= req.deadline:
+                    continue   # resolved below, outside the lock
+                if req.retried or not accept:
+                    continue
+                req.retried = True
+                req.tag = None
+                taken.add(req)
+                self._gen_pending.appendleft(req)
+                requeued += 1
+            if requeued:
+                self._gen_cv.notify_all()
+        for req in reqs:
+            if req in taken:
+                continue
+            # even already-resolved requests (router-side expiry fired
+            # while the batch was in flight) must leave _gen_live, or a
+            # dead replica's phantom entries pin generate_pending() > 0
+            # and every later drain spins to its timeout
+            with self._gen_cv:
+                self._gen_live.discard(req)
+            if req.done():
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                req._resolve(error=DeadlineExceededError(
+                    "deadline expired during replica failover"))
+            elif req.retried:
+                req._resolve(error=OverloadedError(
+                    "generation already failed over once on model %r"
+                    % self.model))
+            else:
+                req._resolve(error=OverloadedError(
+                    "model %r is draining; generation not retried"
+                    % self.model))
+        return requeued
+
+    def _serve_generate(self, slot):
+        """Generate-mode dispatch loop for one replica: pull requests
+        from the routing queue (bounded outstanding window), ship them as
+        ``generate`` frames, and resolve ``gen_result``/``gen_error``
+        replies as they arrive — OUT OF ORDER, matched by id, because the
+        worker's scheduler finishes sequences at different lengths. The
+        worker's receive thread answers pings while its scheduler
+        decodes, so liveness stays on the heartbeat clock even under
+        long generations. Returns (reason, unresolved) for ejection, or
+        None on clean shutdown."""
+        conn = slot.conn
+        outstanding = {}   # msg id -> (req, dispatch ref, t0, t0_wall)
+        last_frame = time.monotonic()
+        ping_pending = False
+
+        def unresolved():
+            return [e[0] for e in outstanding.values()]
+
+        try:
+            while not self._stop:
+                # drain the routing queue up to the outstanding window
+                # BEFORE blocking on the socket: a burst of admissions
+                # must not pay one recv timeout per dispatched request
+                while len(outstanding) < self._gen_outstanding:
+                    req = None
+                    with self._gen_cv:
+                        if self._gen_pending:
+                            req = self._gen_pending.popleft()
+                        elif not outstanding and not slot.stats_requests:
+                            self._gen_cv.wait(0.05)
+                    if req is None:
+                        break
+                    now = time.monotonic()
+                    if req.done():
+                        with self._gen_cv:
+                            self._gen_live.discard(req)
+                        continue
+                    if req.deadline is not None and now >= req.deadline:
+                        with self._gen_cv:
+                            self._gen_live.discard(req)
+                        req._resolve(error=DeadlineExceededError(
+                            "deadline expired before dispatch"))
+                        continue
+                    slot.msg_id += 1
+                    req.tag = slot.msg_id
+                    ref = _tracing.child_ref(req.trace)
+                    frame = {
+                        "kind": "generate", "id": slot.msg_id,
+                        "tokens": req.tokens,
+                        "max_new_tokens": req.max_new_tokens,
+                        "temperature": req.temperature,
+                        "top_k": req.top_k, "top_p": req.top_p,
+                        "remaining": None if req.deadline is None
+                        else max(0.0, req.deadline - now),
+                        "trace": _tracing.to_wire(ref)
+                        if ref is not None and ref.sampled else None,
+                    }
+                    try:
+                        send_msg(conn, frame)
+                    except OSError:
+                        return ("died_mid_batch", [req] + unresolved())
+                    outstanding[slot.msg_id] = (req, ref, now, time.time())
+                    self._m_inflight[slot.id].set(len(outstanding))
+                while slot.stats_requests:
+                    waiter = slot.stats_requests.popleft()
+                    slot.msg_id += 1
+                    slot.stats_pending[slot.msg_id] = waiter
+                    try:
+                        send_msg(conn, {"kind": "stats",
+                                        "id": slot.msg_id})
+                    except OSError:
+                        return ("died_mid_batch", unresolved())
+                try:
+                    msg = recv_msg(
+                        conn,
+                        first_timeout=0.01 if outstanding else 0.05,
+                        rest_timeout=max(1.0, self.heartbeat_s))
+                except socket.timeout:
+                    now = time.monotonic()
+                    if not slot.proc.alive():
+                        return ("died", unresolved())
+                    if now - last_frame > 2 * self.heartbeat_s \
+                            and ping_pending:
+                        return ("heartbeat_missed", unresolved())
+                    if now - last_frame > self.heartbeat_s \
+                            and not ping_pending:
+                        slot.msg_id += 1
+                        try:
+                            send_msg(conn, {"kind": "ping",
+                                            "id": slot.msg_id})
+                        except OSError:
+                            return ("died_mid_batch", unresolved())
+                        ping_pending = True
+                    # router-side expiry backstop (grace past the
+                    # deadline: the worker's own expiry normally answers
+                    # first; first-resolution-wins absorbs the race)
+                    for r, _, _, _ in list(outstanding.values()):
+                        if r.deadline is not None \
+                                and now >= r.deadline + 1.0 \
+                                and not r.done():
+                            r._resolve(error=DeadlineExceededError(
+                                "generation deadline expired"))
+                    continue
+                except OSError:
+                    return ("died_mid_batch", unresolved())
+                if msg is None:
+                    return ("died", unresolved())
+                last_frame = time.monotonic()
+                kind = msg.get("kind")
+                if kind == "pong":
+                    ping_pending = False
+                elif kind in ("gen_result", "gen_error"):
+                    entry = outstanding.pop(msg.get("id"), None)
+                    self._m_inflight[slot.id].set(len(outstanding))
+                    if entry is None:
+                        continue   # late reply for a resolved request
+                    r, ref, t0, t0_wall = entry
+                    with self._gen_cv:
+                        self._gen_live.discard(r)
+                    if kind == "gen_result":
+                        if ref is not None:
+                            _tracing.emit_span(
+                                "serve.dispatch", t0_wall,
+                                time.monotonic() - t0, r.trace,
+                                component="router", span_id=ref.span_id,
+                                attrs={"replica": slot.id,
+                                       "tokens":
+                                       len(msg.get("tokens") or ())})
+                        r._resolve(outputs=list(msg.get("tokens") or []),
+                                   finish_reason=msg.get("finish_reason"))
+                        # the generation proved itself: reset backoff
+                        if slot.consecutive_restarts:
+                            with self._lock:
+                                slot.consecutive_restarts = 0
+                    else:
+                        r._resolve(error=self._gen_wire_error(msg))
+                elif kind == "stats_result":
+                    waiter = slot.stats_pending.pop(msg.get("id"), None)
+                    if waiter is not None:
+                        waiter["result"] = msg.get("stats")
+                        waiter["event"].set()
+                else:
+                    return ("protocol_desync", unresolved())
+            return None
+        finally:
+            self._m_inflight[slot.id].set(0)
+            for waiter in slot.stats_pending.values():
+                waiter["event"].set()   # never park replica_stats callers
+            slot.stats_pending.clear()
+
     # -- state -------------------------------------------------------------
     @property
     def healthy_count(self):
@@ -261,6 +575,7 @@ class ReplicaPool:
         with self._lock:
             return {
                 "replicas": self.size,
+                "mode": "generate" if self._generate else "predict",
                 "healthy": sum(1 for s in self._slots
                                if s.state in (_READY, _BUSY)),
                 "states": {s.id: s.state for s in self._slots},
@@ -282,8 +597,14 @@ class ReplicaPool:
                 self._work.put_nowait(None)  # wake idle dispatch threads
             except queue.Full:
                 break  # full buffer: threads notice _stop on get timeout
+        with self._gen_cv:
+            self._gen_cv.notify_all()        # wake generate dispatch waits
         for t in self._threads:
             t.join(timeout=timeout)
+        if self._generate:
+            # anything still unresolved gets a deterministic answer, not
+            # a stranded waiter
+            self.abort_generate()
         for slot in self._slots:
             conn = slot.conn
             if conn is not None:
@@ -383,7 +704,8 @@ class ReplicaPool:
                     self._eject(slot, "spawn_failed", batch=None)
                     continue
                 # serve until ejection or shutdown
-                reason = self._serve_generation(slot)
+                reason = self._serve_generate(slot) if self._generate \
+                    else self._serve_generation(slot)
                 if self._stop or reason is None:
                     return
                 self._eject(slot, reason[0], batch=reason[1])
@@ -608,7 +930,8 @@ class ReplicaPool:
                 pass
         requeued = 0
         if batch:
-            requeued = self._batcher.requeue(batch)
+            requeued = self._requeue_generate(batch) if self._generate \
+                else self._batcher.requeue(batch)
             self._m_failover.inc()
             self._m_requeued.inc(requeued)
         self._m_restarts.inc()

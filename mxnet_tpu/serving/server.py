@@ -13,6 +13,13 @@ Routes (triton/KServe-shaped):
       body: ``{"inputs": {"<input>": <nested list>}, "timeout_ms": opt}``
       or ``{"instances": <nested list>}`` for single-input models;
       reply: ``{"outputs": [...], "model": ..., "version": ...}``.
+  * ``POST /v1/models/<name>[:versions/<v>]:generate``  (LM models,
+      docs/serving.md §Generation)
+      body: ``{"tokens": [int...], "max_new_tokens": opt,
+      "temperature": opt, "top_k": opt, "top_p": opt, "timeout_ms": opt}``
+      reply: ``{"tokens": [generated ids], "num_generated": ...,
+      "finish_reason": "eos"|"length", ...}`` (non-streaming; requests
+      join the model's running decode batch at token granularity).
   * ``GET /v1/models``        repository listing (buckets, signatures,
       warm state, pending counts)
   * ``GET /v1/models/<name>`` one model (``?version=``)
@@ -30,6 +37,7 @@ requests, then stops the server so the launcher sees exit 0.
 from __future__ import annotations
 
 import json
+import math
 import signal
 import threading
 import time
@@ -267,6 +275,8 @@ class ServingServer:
             version = _int_version(query.split("=", 1)[1].split("&")[0])
         if verb == "predict" and method == "POST":
             self._predict(handler, name, version)
+        elif verb == "generate" and method == "POST":
+            self._generate(handler, name, version)
         elif verb is None and method == "GET":
             model = self.repository.get(name, version)
             self._json(handler, 200, model.describe())
@@ -297,6 +307,9 @@ class ServingServer:
         if self._draining:
             raise DrainingError("server is draining")
         model = self.repository.get(name, version)
+        if not hasattr(model, "predict"):
+            raise MXNetError(
+                "model %r is a generation model; use :generate" % name)
         if not raw_body:
             raise MXNetError("empty request body")
         try:
@@ -333,6 +346,72 @@ class ServingServer:
                 "model": model.name,
                 "version": model.version,
                 "outputs": [o.tolist() for o in outputs],
+            })
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    # -- generate ----------------------------------------------------------
+    def _generate(self, handler, name, version):
+        ref = _tracing.parse_header(
+            handler.headers.get(_tracing.HEADER) or "")
+        ref = _tracing.mint(ref)
+        handler._mxtpu_trace = _tracing.header_value(ref)
+        with _tracing.root("serve.request", component="server", ref=ref,
+                           attrs={"model": name, "verb": "generate"}):
+            self._generate_traced(handler, name, version)
+
+    def _generate_traced(self, handler, name, version):
+        # body FIRST (keep-alive desync, same as predict)
+        length = int(handler.headers.get("Content-Length") or 0)
+        raw_body = handler.rfile.read(length) if length > 0 else b""
+        if self._draining:
+            raise DrainingError("server is draining")
+        model = self.repository.get(name, version)
+        gen = getattr(model, "generate", None)
+        if gen is None:
+            raise MXNetError(
+                "model %r does not serve :generate (it is a predict "
+                "model; load an LM artifact with generate=True)" % name)
+        if not raw_body:
+            raise MXNetError("empty request body")
+        try:
+            body = json.loads(raw_body.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise MXNetError("request body is not JSON: %s" % e)
+        tokens = body.get("tokens")
+        if not isinstance(tokens, list) or not tokens \
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           for t in tokens):
+            raise MXNetError("'tokens' must be a non-empty list of int "
+                             "token ids")
+        kwargs = {}
+        for field, cast in (("max_new_tokens", int), ("temperature", float),
+                            ("top_k", int), ("top_p", float),
+                            ("timeout_ms", float)):
+            if body.get(field) is None:
+                continue
+            try:
+                value = cast(body[field])
+            except (TypeError, ValueError):
+                value = None
+            # json.loads accepts NaN/Infinity literals; a non-finite knob
+            # would silently poison the sampling masks — it is the
+            # CLIENT's error (400), never a garbage 200 or a 500
+            if value is None or not math.isfinite(value):
+                raise MXNetError("%r must be a finite number, got %r"
+                                 % (field, body[field]))
+            kwargs[field] = value
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            result = gen(tokens, **kwargs)
+            self._json(handler, 200, {
+                "model": model.name,
+                "version": model.version,
+                "tokens": result["tokens"],
+                "num_generated": len(result["tokens"] or ()),
+                "finish_reason": result.get("finish_reason"),
             })
         finally:
             with self._inflight_lock:

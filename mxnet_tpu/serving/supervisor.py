@@ -22,13 +22,25 @@ artifact (or a test stub), warms every padding bucket, CONNECTS to the
 pool's localhost listener, and serves length-prefixed pickled messages:
 
     router -> replica   {kind: predict, id, arrays, bucket, n, remaining}
-                        {kind: ping, id} | {kind: shutdown}
+                        {kind: generate, id, tokens, max_new_tokens,
+                         temperature, top_k, top_p, remaining, trace}
+                        {kind: ping, id} | {kind: stats, id}
+                        {kind: shutdown}
     replica -> router   {kind: hello, replica, generation, pid}
                         {kind: ready, warm_seconds, bucket_flops,
-                         bucket_memory, compile_digests, ...}
+                         bucket_memory, compile_digests, generate, ...}
                         {kind: result, id, outputs, seconds}
+                        {kind: gen_result, id, tokens, finish_reason}
+                        {kind: gen_error, id, status, error}
                         {kind: expired, id} | {kind: error, id, error}
-                        {kind: pong, id}
+                        {kind: pong, id} | {kind: stats_result, id, stats}
+
+Generation workers (``--generate PREFIX``, docs/serving.md §Generation)
+run their own continuous-batching scheduler: ``generate`` frames enqueue
+into it and the receive loop keeps answering pings while the scheduler
+thread decodes, so liveness stays on the heartbeat clock under long
+generations; ``gen_result`` replies are pushed OUT OF ORDER as sequences
+finish (the completion hook owns a send lock).
 
 ``remaining`` is the batch deadline budget in seconds (per-request
 deadlines are process-local monotonic times, so the ROUTER converts to a
@@ -298,6 +310,146 @@ def _parse_inputs(specs):
     return shapes, (dtypes or None)
 
 
+def _connect_and_hello(args):
+    """Dial the pool's listener, present the handshake secret and the
+    hello frame; returns the connected socket (shared by the predict and
+    generate worker paths)."""
+    host, _, port = args.connect.rpartition(":")
+    sock = socket.create_connection((host, int(port)), timeout=30)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # authenticate BEFORE the first pickled frame: the router unpickles
+    # nothing from a connection that has not presented the pool secret
+    token = (_env.raw("MXTPU_SERVE_POOL_TOKEN") or "").encode("ascii")
+    sock.sendall(token.ljust(TOKEN_LEN, b"\0")[:TOKEN_LEN])
+    send_msg(sock, {"kind": "hello", "replica": args.replica,
+                    "generation": args.generation, "pid": os.getpid()})
+    return sock
+
+
+def _generate_worker_main(args):
+    """Generation replica (docs/serving.md §Generation): build the LM
+    decode engine, warm every prefill/decode bucket, report ready with
+    the KV geometry, then serve ``generate`` frames by feeding the local
+    continuous-batching scheduler — replies are pushed as sequences
+    finish, out of order, while this receive loop keeps answering
+    pings/stats."""
+    from .. import compile as _compile
+    from .. import telemetry
+    from ..telemetry import tracing
+    from .batcher import ServingError
+    from .generate import GenerateScheduler, TransformerLMEngine, load_lm
+
+    compile_cursor = _compile.mark()
+    engine = TransformerLMEngine(
+        lm=load_lm(args.generate), num_pages=args.kv_pages,
+        page_size=args.kv_page_size, max_prompt=args.max_prompt,
+        max_new_tokens=args.max_new_tokens, max_batch=args.max_batch)
+    sched = GenerateScheduler(engine, name="replica%d" % args.replica,
+                              warm=not args.no_warm)
+    compile_entries = _compile.keys_since(compile_cursor)
+
+    sock = _connect_and_hello(args)
+    send_lock = threading.Lock()
+
+    def _send(obj):
+        with send_lock:     # scheduler completion hook + this loop share
+            send_msg(sock, obj)
+
+    misses = telemetry.get_registry().counter("mxtpu_jit_cache_miss_total")
+    base_miss = misses.value
+
+    def stats():
+        # the acceptance evidence: zero-compile steady state + KV pages
+        # reclaimed, observable from the router (pool.replica_stats)
+        return {"kv_pages_total": sched.allocator.num_pages,
+                "kv_pages_used": sched.allocator.used_pages,
+                "jit_after_warm": misses.value - base_miss,
+                "pending": sched.pending()}
+
+    _send({"kind": "ready", "replica": args.replica,
+           "generation": args.generation,
+           "warm_seconds": sched.warm_seconds,
+           "buckets": list(engine.buckets),
+           "example_shapes": {}, "input_dtypes": None,
+           "bucket_flops": None, "bucket_memory": None,
+           "generate": engine.geometry(),
+           "compile_digests":
+               sorted({d for _, d in compile_entries}) or None,
+           "compile_prefetched": 0})
+    _LOG.info("generate replica %d gen %d ready (warm %.2fs, buckets %s)",
+              args.replica, args.generation, sched.warm_seconds or 0.0,
+              list(engine.buckets))
+
+    def on_complete(req):
+        if req.tag is None:
+            return
+        if req.error is not None:
+            _send({"kind": "gen_error", "id": req.tag,
+                   "status": getattr(req.error, "status", 500),
+                   "error": str(req.error)})
+        else:
+            _send({"kind": "gen_result", "id": req.tag,
+                   "tokens": list(req.outputs or []),
+                   "finish_reason": req.finish_reason})
+
+    served = 0
+    try:
+        while not _STOP[0]:
+            try:
+                msg = recv_msg(sock, first_timeout=0.25)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if msg is None or msg.get("kind") == "shutdown":
+                break
+            kind = msg.get("kind")
+            if kind == "ping":
+                _send({"kind": "pong", "id": msg.get("id")})
+                continue
+            if kind == "stats":
+                _send({"kind": "stats_result", "id": msg.get("id"),
+                       "stats": stats()})
+                continue
+            if kind != "generate":
+                _LOG.warning("generate replica %d: unknown message "
+                             "kind %r", args.replica, kind)
+                continue
+            served += 1
+            deadline = None if msg.get("remaining") is None \
+                else time.monotonic() + float(msg["remaining"])
+            ref = tracing.from_wire(msg["trace"]) \
+                if msg.get("trace") else None
+            try:
+                req = sched.submit(
+                    msg["tokens"],
+                    max_new_tokens=msg.get("max_new_tokens"),
+                    temperature=msg.get("temperature") or 0.0,
+                    top_k=msg.get("top_k") or 0,
+                    top_p=msg.get("top_p") if msg.get("top_p") is not None
+                    else 1.0,
+                    deadline=deadline, trace=ref, on_complete=on_complete)
+                req.tag = msg["id"]
+                if req.done():   # resolved before the tag landed
+                    on_complete(req)
+            except ServingError as e:
+                _send({"kind": "gen_error", "id": msg["id"],
+                       "status": e.status, "error": str(e)})
+            except Exception as e:   # malformed request: 400, never die
+                _send({"kind": "gen_error", "id": msg["id"],
+                       "status": 400,
+                       "error": "%s: %s" % (type(e).__name__, e)})
+    finally:
+        sched.close(drain=False, timeout=0)
+        try:
+            sock.close()
+        except OSError:
+            pass
+    _LOG.info("generate replica %d gen %d exiting after %d requests",
+              args.replica, args.generation, served)
+    return 0
+
+
 def worker_main(argv=None):
     import argparse
 
@@ -315,12 +467,22 @@ def worker_main(argv=None):
                    help="serve a numpy stub instead of an artifact (tests)")
     p.add_argument("--stub-delay-ms", type=float, default=0.0)
     p.add_argument("--no-warm", action="store_true")
+    p.add_argument("--generate", default=None, metavar="PREFIX",
+                   help="serve a generation LM artifact (save_lm prefix) "
+                        "through the continuous-batching scheduler")
+    p.add_argument("--kv-pages", type=int, default=None)
+    p.add_argument("--kv-page-size", type=int, default=None)
+    p.add_argument("--max-prompt", type=int, default=None)
+    p.add_argument("--max-new-tokens", type=int, default=None)
     args = p.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(message)s", stream=sys.stderr)
     signal.signal(signal.SIGTERM, _on_term)
+
+    if args.generate:
+        return _generate_worker_main(args)
 
     from .. import compile as _compile
     from ..parallel.resilience import maybe_inject_serving_fault
@@ -362,15 +524,7 @@ def worker_main(argv=None):
     else:
         p.error("need --artifact or --stub")
 
-    host, _, port = args.connect.rpartition(":")
-    sock = socket.create_connection((host, int(port)), timeout=30)
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    # authenticate BEFORE the first pickled frame: the router unpickles
-    # nothing from a connection that has not presented the pool secret
-    token = (_env.raw("MXTPU_SERVE_POOL_TOKEN") or "").encode("ascii")
-    sock.sendall(token.ljust(TOKEN_LEN, b"\0")[:TOKEN_LEN])
-    send_msg(sock, {"kind": "hello", "replica": args.replica,
-                    "generation": args.generation, "pid": os.getpid()})
+    sock = _connect_and_hello(args)
 
     # warm every bucket BEFORE ready: a replica never joins the pool with a
     # cold executable cache (the same publish-after-warm rule as in-process

@@ -38,6 +38,7 @@ EXAMPLES = [
     ("ctc/lstm_ocr.py", ["--steps", "12", "--batch", "8"], []),
     ("sparse/linear_classification.py", ["--steps", "60"], []),
     ("serving/serve_mlp.py", ["--requests", "12", "--clients", "4"], []),
+    ("serving/generate_lm.py", ["--requests", "4", "--max-new", "6"], []),
 ]
 
 

@@ -1,0 +1,587 @@
+"""serving.generate tests: KV page allocator units, paged decode-attention
+Pallas-vs-jnp parity, sampling-op contracts, continuous-batching scheduler
+semantics (stub engine), Transformer-LM engine greedy parity against the
+gluon full-sequence oracle, the HTTP ``:generate`` surface, and THE
+acceptance e2e: a 2-replica pooled LM under >=8 concurrent generations
+with unequal budgets, late joiners, zero post-warm compiles and full
+KV-page reclaim.
+
+Everything runs on CPU with tiny configs (2 layers, d<=32, vocab<=128) —
+the tier-1 budget has no headroom (ROADMAP.md).
+"""
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo.transformer import TransformerLM, lm_mini
+from mxnet_tpu.serving import (
+    DeadlineExceededError, GenerateScheduler, KVPageAllocator,
+    ModelRepository, QueueFullError, ServedLM, ServingServer,
+    TransformerLMEngine, load_lm, save_lm,
+)
+
+
+# ---------------------------------------------------------------------------
+# KV page allocator units
+# ---------------------------------------------------------------------------
+
+def test_kv_allocator_alloc_free_roundtrip():
+    a = KVPageAllocator(8, 4, name="alloc/1")
+    assert a.pages_for(1) == 1 and a.pages_for(4) == 1
+    assert a.pages_for(5) == 2 and a.pages_for(12) == 3
+    g1 = a.alloc(3)
+    g2 = a.alloc(5)
+    assert len(g1) == 3 and len(g2) == 5
+    assert not set(g1) & set(g2)          # disjoint grants
+    assert a.free_pages == 0 and a.used_pages == 8
+    assert a.alloc(1) is None             # exhausted: None, not partial
+    a.free(g1)
+    assert a.free_pages == 3
+    g3 = a.alloc(2)
+    assert set(g3) <= set(g1)             # freed pages are reused
+    a.free(g3)
+    a.free(g2)
+    assert a.free_pages == 8 and a.used_pages == 0
+
+
+def test_kv_allocator_fragmentation_interleaved():
+    """Interleaved alloc/free must keep serving from a fragmented free
+    list — pages are identity-only, any free page serves any grant."""
+    a = KVPageAllocator(6, 2, name="alloc/2")
+    grants = [a.alloc(2) for _ in range(3)]
+    a.free(grants[1])                      # free the MIDDLE grant
+    g = a.alloc(2)
+    assert g is not None and set(g) == set(grants[1])
+    # page-table reuse after sequence completion: all pages cycle
+    a.free(grants[0])
+    a.free(grants[2])
+    a.free(g)
+    seen = set()
+    for _ in range(3):
+        g = a.alloc(2)
+        seen.update(g)
+        a.free(g)
+    assert a.used_pages == 0
+
+
+def test_kv_allocator_double_free_raises():
+    a = KVPageAllocator(4, 2, name="alloc/3")
+    g = a.alloc(2)
+    a.free(g)
+    with pytest.raises(MXNetError):
+        a.free(g)
+    with pytest.raises(MXNetError):
+        a.free([99])
+    with pytest.raises(MXNetError):
+        KVPageAllocator(0, 2)
+
+
+def test_kv_allocator_gauges():
+    a = KVPageAllocator(5, 2, name="allocg/1")
+    snap = telemetry.snapshot()
+    assert snap['mxtpu_serve_kv_pages_total{model="allocg/1"}'][
+        "value"] == 5
+    g = a.alloc(3)
+    assert telemetry.snapshot()[
+        'mxtpu_serve_kv_pages_used{model="allocg/1"}']["value"] == 3
+    a.free(g)
+    assert telemetry.snapshot()[
+        'mxtpu_serve_kv_pages_used{model="allocg/1"}']["value"] == 0
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention: Pallas (interpret) vs dense-gather jnp oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype,tol", [("float32", 2e-6), ("bfloat16", 4e-2)])
+def test_paged_attention_pallas_vs_jnp(monkeypatch, dtype, tol):
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(7)
+    b, h, d, pages, ps, maxp = 4, 2, 32, 16, 4, 5
+    q = jnp.asarray(rng.randn(b, h, d), dtype=dtype)
+    kp = jnp.asarray(rng.randn(pages, h, ps, d), dtype=dtype)
+    vp = jnp.asarray(rng.randn(pages, h, ps, d), dtype=dtype)
+    tbl = jnp.asarray(rng.randint(0, pages, (b, maxp)), jnp.int32)
+    # ragged lengths incl. a full row, a page-straddling row, a 1-token
+    # row and an INERT row (length 0 — the scheduler's batch padding)
+    lens = jnp.asarray([maxp * ps, 7, 1, 0], jnp.int32)
+    ref = pk.paged_attention_reference(q, kp, vp, tbl, lens,
+                                       1.0 / np.sqrt(d))
+    monkeypatch.setenv("MXTPU_PALLAS_DECODE", "1")   # force the kernel
+    out = pk.paged_attention(q, kp, vp, tbl, lens)
+    # live rows match to dtype tolerance; the inert row is unused garbage
+    err = np.max(np.abs(np.asarray(ref, np.float32)[:3]
+                        - np.asarray(out, np.float32)[:3]))
+    assert err < tol, err
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+
+def test_paged_attention_gate_fallback(monkeypatch):
+    """`0` forces the jnp path; `auto` off-TPU is the jnp path too — all
+    three spellings agree numerically."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 2, 16), jnp.float32)
+    kp = jnp.asarray(rng.randn(8, 2, 4, 16), jnp.float32)
+    vp = jnp.asarray(rng.randn(8, 2, 4, 16), jnp.float32)
+    tbl = jnp.asarray(rng.randint(0, 8, (2, 3)), jnp.int32)
+    lens = jnp.asarray([5, 9], jnp.int32)
+    outs = {}
+    for gate in ("0", "auto", "1"):
+        monkeypatch.setenv("MXTPU_PALLAS_DECODE", gate)
+        outs[gate] = np.asarray(pk.paged_attention(q, kp, vp, tbl, lens))
+    assert np.allclose(outs["0"], outs["auto"])
+    assert np.max(np.abs(outs["0"] - outs["1"])) < 2e-6
+
+
+# ---------------------------------------------------------------------------
+# sampling ops
+# ---------------------------------------------------------------------------
+
+def test_sample_token_greedy_is_argmax():
+    logits = mx.nd.array(np.random.RandomState(0).randn(6, 24)
+                         .astype(np.float32))
+    out = mx.nd.sample_token(logits, temperature=0.0).asnumpy()
+    assert np.array_equal(out, np.argmax(logits.asnumpy(), axis=-1))
+
+
+def test_sample_token_top_k_top_p_masks():
+    rng = np.random.RandomState(1)
+    logits = mx.nd.array(rng.randn(64, 16).astype(np.float32))
+    top3 = np.argsort(logits.asnumpy(), axis=-1)[:, -3:]
+    out = mx.nd.sample_token(logits, temperature=1.0, top_k=3).asnumpy()
+    for o, allowed in zip(out, top3):
+        assert o in allowed, (o, allowed)
+    # top_k=1 degenerates to greedy regardless of temperature
+    out1 = mx.nd.sample_token(logits, temperature=5.0, top_k=1).asnumpy()
+    assert np.array_equal(out1, np.argmax(logits.asnumpy(), axis=-1))
+    # a tiny top_p keeps only the argmax too
+    outp = mx.nd.sample_token(logits, temperature=5.0,
+                              top_p=1e-6).asnumpy()
+    assert np.array_equal(outp, np.argmax(logits.asnumpy(), axis=-1))
+
+
+def test_sample_token_seeded_reproducible_and_symbolic():
+    import mxnet_tpu.symbol as sym
+
+    logits = mx.nd.array(np.random.RandomState(2).randn(8, 32)
+                         .astype(np.float32))
+    mx.random.seed(11)
+    a = mx.nd.sample_token(logits, temperature=1.0).asnumpy()
+    mx.random.seed(11)
+    b = mx.nd.sample_token(logits, temperature=1.0).asnumpy()
+    assert np.array_equal(a, b)
+    # registered in the symbol namespace too (nd+symbol parity)
+    s = sym.sample_token(sym.var("logits"), temperature=0.0)
+    ex = s.bind(mx.cpu(), {"logits": logits})
+    (out,) = ex.forward()
+    assert np.array_equal(out.asnumpy(),
+                          np.argmax(logits.asnumpy(), axis=-1))
+
+
+def test_sample_token_logits_per_row_params():
+    """The decode executable's form: per-row temperature/top_k/top_p
+    arrays — greedy rows exact, stochastic rows inside their top-k."""
+    import jax
+
+    from mxnet_tpu.ops.random_ops import sample_token_logits
+
+    rng = np.random.RandomState(4)
+    logits = rng.randn(5, 12).astype(np.float32)
+    temps = np.asarray([0.0, 1.0, 0.0, 2.0, 0.0], np.float32)
+    ks = np.asarray([0, 2, 0, 4, 0], np.int32)
+    ps = np.ones(5, np.float32)
+    out = np.asarray(sample_token_logits(
+        jax.random.PRNGKey(0), logits, temps, ks, ps))
+    greedy = np.argmax(logits, axis=-1)
+    for i in (0, 2, 4):
+        assert out[i] == greedy[i]
+    assert out[1] in np.argsort(logits[1])[-2:]
+    assert out[3] in np.argsort(logits[3])[-4:]
+
+
+# ---------------------------------------------------------------------------
+# scheduler semantics on a stub engine (no jax compiles: fast, exact)
+# ---------------------------------------------------------------------------
+
+class StubEngine:
+    """Deterministic no-model engine: prefill answers (sum(prompt)+1)
+    mod vocab, decode answers last+1 mod vocab. Records each decode
+    step's live-row count so tests can assert batch composition."""
+
+    def __init__(self, vocab=64, buckets=(1, 2, 4), page_size=2,
+                 num_pages=12, max_prompt=4, max_new_tokens=8,
+                 eos_id=None, step_sleep=0.0, prefill_gate=None):
+        self.vocab_size = vocab
+        self.buckets = list(buckets)
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_prompt = max_prompt
+        self.max_new_tokens = max_new_tokens
+        self.max_pages_per_seq = -(-(max_prompt + max_new_tokens)
+                                   // page_size)
+        self.eos_id = eos_id
+        self.step_sleep = step_sleep
+        self.prefill_gate = prefill_gate   # Event: hold prefill (tests)
+        self.step_counts = []
+
+    def warm(self):
+        return 0.0
+
+    def prefill(self, tokens, page_row, sampling, key):
+        if self.prefill_gate is not None:
+            self.prefill_gate.wait(5.0)
+        return (sum(tokens) + 1) % self.vocab_size
+
+    def decode_step(self, tokens, positions, dest_pages, dest_slots,
+                    tables, lengths, temps, top_ks, top_ps, key):
+        if self.step_sleep:
+            time.sleep(self.step_sleep)
+        self.step_counts.append(int((np.asarray(lengths) > 0).sum()))
+        return ((np.asarray(tokens) + 1) % self.vocab_size).astype(np.int32)
+
+    def geometry(self):
+        return {"num_pages": self.num_pages, "page_size": self.page_size}
+
+
+def _stub_expected(prompt, n, vocab=64):
+    first = (sum(prompt) + 1) % vocab
+    out = [first]
+    for _ in range(n - 1):
+        out.append((out[-1] + 1) % vocab)
+    return out
+
+
+def test_scheduler_stub_continuous_batching_join_leave():
+    eng = StubEngine(step_sleep=0.01)
+    sched = GenerateScheduler(eng, name="stub/1", queue_depth=8)
+    try:
+        ra = sched.submit([1, 2], max_new_tokens=8)
+        time.sleep(0.05)                       # A is decoding alone
+        rb = sched.submit([3], max_new_tokens=3)   # late joiner
+        a = ra.wait(10)
+        b = rb.wait(10)
+        assert a == _stub_expected([1, 2], 8)
+        assert b == _stub_expected([3], 3)
+        # the batch really changed size at step granularity: A ran alone,
+        # then A+B together, then A alone again after B finished
+        assert 2 in eng.step_counts and 1 in eng.step_counts
+        assert eng.step_counts.index(2) > 0    # A started solo
+        assert sched.allocator.used_pages == 0
+    finally:
+        sched.close(drain=False, timeout=0)
+
+
+def test_scheduler_stub_eos_and_validation():
+    eng = StubEngine(eos_id=7)
+    sched = GenerateScheduler(eng, name="stub/2", queue_depth=8)
+    try:
+        # (sum=4)+1=5, then 6, then 7=eos: stops early with reason "eos"
+        r = sched.submit([4], max_new_tokens=8)
+        out = r.wait(10)
+        assert out[-1] == 7 and len(out) == 3
+        assert r.finish_reason == "eos"
+        with pytest.raises(MXNetError):
+            sched.submit([], max_new_tokens=2)
+        with pytest.raises(MXNetError):
+            sched.submit([1] * 99, max_new_tokens=2)   # prompt too long
+        with pytest.raises(MXNetError):
+            sched.submit([1], max_new_tokens=0)
+        with pytest.raises(MXNetError):
+            sched.submit([999], max_new_tokens=2)      # token out of range
+    finally:
+        sched.close(drain=False, timeout=0)
+
+
+def test_scheduler_stub_deadline_and_queue_full():
+    gate = threading.Event()
+    eng = StubEngine(prefill_gate=gate)
+    sched = GenerateScheduler(eng, name="stub/3", queue_depth=1)
+    try:
+        r1 = sched.submit([1], max_new_tokens=2)   # worker parks in prefill
+        time.sleep(0.05)
+        r2 = sched.submit([2], max_new_tokens=2)   # fills the queue
+        with pytest.raises(QueueFullError):
+            sched.submit([3], max_new_tokens=2)
+        gate.set()
+        assert r1.wait(10) == _stub_expected([1], 2)
+        assert r2.wait(10) == _stub_expected([2], 2)
+        # expired-in-queue: deadline already past at admission
+        gate.clear()
+        r4 = sched.submit([1], max_new_tokens=2,
+                          deadline=time.monotonic() - 0.001)
+        gate.set()
+        with pytest.raises(DeadlineExceededError):
+            r4.wait(10)
+        assert sched.allocator.used_pages == 0
+    finally:
+        sched.close(drain=False, timeout=0)
+
+
+def test_scheduler_stub_page_pressure_serializes():
+    """Worst-case page reservation: two sequences that each need the
+    whole pool run one after the other — pressure queues admissions,
+    never deadlocks or evicts a running sequence."""
+    eng = StubEngine(page_size=2, num_pages=6, max_prompt=4,
+                     max_new_tokens=8)
+    assert eng.max_pages_per_seq == 6          # one seq = the whole pool
+    sched = GenerateScheduler(eng, name="stub/4", queue_depth=8)
+    try:
+        r1 = sched.submit([1, 2, 3, 4], max_new_tokens=8)
+        r2 = sched.submit([2, 2, 2, 2], max_new_tokens=8)
+        assert r1.wait(10) == _stub_expected([1, 2, 3, 4], 8)
+        assert r2.wait(10) == _stub_expected([2, 2, 2, 2], 8)
+        # never more than one resident batch: every step ran solo
+        assert set(eng.step_counts) == {1}
+        assert sched.allocator.used_pages == 0
+    finally:
+        sched.close(drain=False, timeout=0)
+
+
+def test_scheduler_abort_reclaims_pages():
+    eng = StubEngine(step_sleep=0.02)
+    sched = GenerateScheduler(eng, name="stub/5", queue_depth=8)
+    try:
+        r = sched.submit([1], max_new_tokens=8)
+        time.sleep(0.05)                       # mid-decode
+        n = sched.abort_pending()
+        assert n >= 1
+        with pytest.raises(Exception):
+            r.wait(5)
+        deadline = time.monotonic() + 5
+        while sched.allocator.used_pages and time.monotonic() < deadline:
+            time.sleep(0.01)                   # worker lap reclaims
+        assert sched.allocator.used_pages == 0
+    finally:
+        sched.close(drain=False, timeout=0)
+
+
+# ---------------------------------------------------------------------------
+# the real engine: greedy parity vs the gluon full-sequence oracle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    lm = lm_mini(vocab_size=96)
+    lm.initialize(mx.init.Xavier())
+    return lm
+
+
+def _gluon_greedy(lm, prompt, n):
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = lm(mx.nd.array([toks], dtype="int32")).asnumpy()[0, -1]
+        t = int(np.argmax(logits))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+@pytest.fixture(scope="module")
+def lm_scheduler(tiny_lm):
+    eng = TransformerLMEngine(lm=tiny_lm, num_pages=32, page_size=4,
+                              max_prompt=8, max_new_tokens=12, max_batch=4)
+    sched = GenerateScheduler(eng, name="lm/1", queue_depth=16)
+    yield sched
+    sched.close(drain=False, timeout=0)
+
+
+def test_engine_greedy_matches_gluon_oracle(lm_scheduler, tiny_lm):
+    """THE correctness core: incremental paged-KV decode computes the
+    same function as the gluon block's full causal forward — greedy
+    token sequences match exactly, and batching requests together
+    changes nothing (batch invariance)."""
+    prompts = [[3, 5, 7], [2], [9, 4, 6, 1, 8], [1, 2, 3, 4]]
+    budgets = [5, 9, 3, 7]
+    oracles = [_gluon_greedy(tiny_lm, p, n)
+               for p, n in zip(prompts, budgets)]
+    misses = telemetry.get_registry().counter("mxtpu_jit_cache_miss_total")
+    base = misses.value
+    reqs = [lm_scheduler.submit(p, max_new_tokens=n)
+            for p, n in zip(prompts, budgets)]
+    outs = [r.wait(60) for r in reqs]
+    assert outs == oracles
+    # zero-compile steady state: every bucket was covered by warm
+    assert misses.value - base == 0
+    assert lm_scheduler.allocator.used_pages == 0
+
+
+def test_engine_sampled_tokens_stay_in_vocab(lm_scheduler):
+    r = lm_scheduler.submit([5, 6], max_new_tokens=6, temperature=0.8,
+                            top_k=4)
+    out = r.wait(60)
+    assert len(out) == 6
+    assert all(0 <= t < 96 for t in out)
+    assert lm_scheduler.allocator.used_pages == 0
+
+
+# ---------------------------------------------------------------------------
+# artifact roundtrip + HTTP surface (in-process ServedLM)
+# ---------------------------------------------------------------------------
+
+def test_save_load_lm_roundtrip(tiny_lm, tmp_path):
+    prefix = save_lm(tiny_lm, str(tmp_path / "lm"))
+    lm2 = load_lm(prefix)
+    ids = mx.nd.array(np.random.RandomState(0).randint(0, 96, (2, 5)),
+                      dtype="int32")
+    assert np.array_equal(tiny_lm(ids).asnumpy(), lm2(ids).asnumpy())
+    with pytest.raises(MXNetError):
+        load_lm(str(tmp_path / "nope"))
+
+
+def test_http_generate_e2e(tiny_lm, tmp_path):
+    prefix = save_lm(tiny_lm, str(tmp_path / "lm"))
+    repo = ModelRepository()
+    model = repo.load("lm", prefix, generate=True,
+                      generate_opts=dict(num_pages=32, page_size=4,
+                                         max_prompt=8, max_new_tokens=12,
+                                         max_batch=4))
+    srv = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    url = "http://127.0.0.1:%d/v1/models/lm:generate" % srv.port
+    try:
+        oracle = _gluon_greedy(tiny_lm, [3, 1, 4], 6)
+        body = json.dumps({"tokens": [3, 1, 4], "max_new_tokens": 6,
+                           "timeout_ms": 60000}).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=90) as r:
+            resp = json.loads(r.read())
+        assert resp["tokens"] == oracle
+        assert resp["num_generated"] == 6
+        assert resp["finish_reason"] == "length"
+        # repository listing carries the generate geometry + kv state
+        desc = repo.describe()["models"][0]
+        assert desc["kind"] == "generate"
+        assert desc["kv"]["pages_used"] == 0
+        # malformed bodies are the client's fault: 400, not 500
+        for bad in ({"tokens": "abc"}, {"tokens": []},
+                    {"tokens": [1], "max_new_tokens": 0},
+                    {"tokens": [1], "max_new_tokens": "abc"},
+                    {"tokens": [1], "temperature": []}, {}):
+            breq = urllib.request.Request(
+                url, data=json.dumps(bad).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(breq, timeout=30)
+            ei.value.read()
+            assert ei.value.code == 400, bad
+    finally:
+        srv.shutdown()
+        repo.unload("lm", timeout=1.0)
+
+
+def test_generate_on_predict_model_is_400(tmp_path):
+    """:generate against a predict model answers a clear 400."""
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential(prefix="p_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    net(mx.nd.array(np.zeros((1, 3), np.float32)))
+    prefix = str(tmp_path / "model")
+    net.export(prefix, epoch=0)
+    repo = ModelRepository()
+    repo.load("p", prefix, input_shapes={"data": (3,)}, max_batch=2)
+    srv = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    try:
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/v1/models/p:generate" % srv.port,
+            data=json.dumps({"tokens": [1]}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        ei.value.read()
+        assert ei.value.code == 400
+    finally:
+        srv.shutdown()
+        repo.unload("p", timeout=1.0)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance e2e (ISSUE 13): 2-replica pooled LM, >=8 concurrent
+# generations with unequal budgets, late joiner mid-decode, zero
+# post-warm compiles, KV pages fully reclaimed at drain
+# ---------------------------------------------------------------------------
+
+def test_pooled_lm_generate_e2e(tmp_path):
+    lm = lm_mini(vocab_size=96)
+    lm.initialize(mx.init.Xavier())
+    prefix = save_lm(lm, str(tmp_path / "lm"))
+    repo = ModelRepository()
+    model = repo.load(
+        "lm", prefix, generate=True, replicas=2,
+        generate_opts=dict(num_pages=32, page_size=4, max_prompt=8,
+                           max_new_tokens=16, max_batch=4),
+        heartbeat_ms=500, backoff_ms=50, teardown_grace=1.0)
+    srv = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    url = "http://127.0.0.1:%d/v1/models/lm:generate" % srv.port
+    try:
+        assert model.pool.describe()["mode"] == "generate"
+        prompts = [[3, 5, 7], [2], [9, 4, 6, 1, 8], [1, 2, 3, 4],
+                   [8, 8], [5], [7, 6, 5, 4, 3], [1]]
+        budgets = [5, 9, 3, 7, 4, 8, 6, 2]   # unequal: sequences leave
+        #                                      the running batch early
+        oracles = [_gluon_greedy(lm, p, n)
+                   for p, n in zip(prompts, budgets)]
+
+        results = [None] * len(prompts)
+
+        def client(i, delay=0.0):
+            if delay:
+                time.sleep(delay)
+            body = json.dumps({"tokens": prompts[i],
+                               "max_new_tokens": budgets[i],
+                               "timeout_ms": 90000}).encode()
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                results[i] = json.loads(r.read())
+
+        # 6 immediate clients + 2 LATE JOINERS landing mid-decode: they
+        # must be admitted into the running batches without restarting
+        # anyone (every output still matches the one-request oracle)
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        threads += [threading.Thread(target=client, args=(i, 0.15))
+                    for i in (6, 7)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert not any(t.is_alive() for t in threads)
+        for i in range(len(prompts)):
+            assert results[i] is not None, i
+            assert results[i]["tokens"] == oracles[i], \
+                (i, results[i]["tokens"], oracles[i])
+            assert results[i]["finish_reason"] == "length"
+        # worker-side acceptance counters via the stats round trip:
+        # ZERO jit_compile events after warm on every replica, and the
+        # KV used-gauge back to 0 at drain
+        for rid in (0, 1):
+            s = model.pool.replica_stats(rid)
+            assert s is not None, rid
+            assert s["jit_after_warm"] == 0, s
+            assert s["kv_pages_used"] == 0, s
+            assert s["pending"] == 0, s
+    finally:
+        srv.shutdown()
+        model.close(drain=False, timeout=0)

@@ -798,6 +798,19 @@ case("_sample_unique_zipfian", attrs={"range_max": 50, "shape": (1, 20)},
      check=lambda outs, c: (outs[0].shape == (1, 20)
                             and len(set(outs[0].ravel().tolist())) == 20) or
      pytest.fail("zipfian not unique"))
+# temperature<=0 is the greedy contract: exact argmax, rng ignored
+case("_sample_token", P(4, 16, lo=-3.0, hi=3.0),
+     attrs={"temperature": 0.0}, naive=False,
+     check=lambda outs, c: assert_almost_equal(
+         outs[0], np.argmax(c.arrays[0], axis=-1).astype(np.int32)))
+case("_sample_token", P(4, 16, lo=-3.0, hi=3.0),
+     attrs={"temperature": 0.7, "top_k": 3, "top_p": 0.9}, naive=False,
+     cid="_sample_token_topk",
+     check=lambda outs, c: (outs[0].shape == (4,)
+                            and all(o in np.argsort(row)[-3:]
+                                    for o, row in zip(outs[0],
+                                                      c.arrays[0]))) or
+     pytest.fail("top-k sample escaped the top 3: %s" % outs[0]))
 
 
 def _seeded_rng_reproducible():

@@ -166,6 +166,23 @@ if ls "$SERVE_TDIR"/*.jsonl >/dev/null 2>&1; then
 fi
 rm -rf "$SERVE_TDIR"
 
+# serving generation: the decode row (docs/serving.md §Generation) —
+# continuous batching + paged KV cache over a tiny decoder-only LM:
+# tokens/sec, inter-token p99, KV-page peak occupancy, and the
+# zero-jit-compile-after-warm proof, with the scheduler's telemetry
+# (kv gauges, decode counters, intertoken histogram) archived
+echo "[bench_capture] serve bench (decode)" >&2
+DEC_TDIR=$(mktemp -d "telemetry_${TAG}_decode.XXXX")
+env MXTPU_TELEMETRY_DIR="$DEC_TDIR" PYTHONPATH=".:${PYTHONPATH:-}" \
+  timeout 900 python tools/serve_bench.py --generate \
+  --clients 16 --requests 8 \
+  > "BENCH_${TAG}_decode.json" 2> "BENCH_${TAG}_decode.log"
+echo "[bench_capture] serve decode rc=$?" >&2
+if ls "$DEC_TDIR"/*.jsonl >/dev/null 2>&1; then
+  cat "$DEC_TDIR"/*.jsonl > "BENCH_${TAG}_decode_telemetry.jsonl"
+fi
+rm -rf "$DEC_TDIR"
+
 # serving resilience: the failover row (docs/serving.md chaos playbook) —
 # SIGKILL one replica of a 2-replica pool mid-run; the evidence is
 # error-rate 0 with every request resolving 200/429/503/504, loss-window

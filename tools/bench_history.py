@@ -65,6 +65,16 @@ def _extract(doc):
             _fmt(doc.get("speedup_batched_vs_sequential")),
             _fmt(b.get("p99_ms"), 1))
         return ("serve_batched_rps", b.get("rps"), "req/s", detail)
+    if mode == "serve_decode":
+        kv = doc.get("kv") or {}
+        detail = "inter-token p99 %sms, kv peak %s/%s pages, %s jit " \
+                 "after warm" % (
+                     _fmt(doc.get("intertoken_p99_ms"), 1),
+                     _fmt(kv.get("peak_pages_used"), 0),
+                     _fmt(kv.get("pages_total"), 0),
+                     _fmt(doc.get("jit_compiles_after_warmup"), 0))
+        return ("decode_tokens_per_sec", doc.get("tokens_per_sec"),
+                "tok/s", detail)
     if mode == "serve_failover":
         lw = doc.get("loss_window") or {}
         return ("failover_rps", doc.get("rps_overall"), "req/s",

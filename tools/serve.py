@@ -14,12 +14,17 @@ Model specs (repeatable ``--model``):
       dims, 'x'-separated, batch dim excluded)
   name=PATH.mxc                                  compiled AOT artifact
       (geometry frozen at build; its batch size is the padding bucket)
+  name=PREFIX@generate                           generation LM artifact
+      (PREFIX-lmconfig.json + PREFIX-lm.params from `serving.save_lm`;
+      served via the continuous-batching decode scheduler and
+      ``POST /v1/models/<name>:generate`` — docs/serving.md §Generation)
 
 Examples:
 
   python tools/serve.py --model mlp=/models/mlp/model@data=8
   python tools/serve.py --model rn18=/models/rn18/model@data=3x224x224 \\
                         --model rn18mxc=/models/rn18.mxc --port 8500
+  python tools/serve.py --model lm=/models/lm/model@generate --replicas 2
 
 Knobs default to the typed ``MXTPU_SERVE_*`` registry (docs/env_vars.md);
 CLI flags override per process.
@@ -36,13 +41,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def parse_model_spec(spec):
     """``name=path[@in=DIMS[:dtype][,in2=...]]`` -> (name, path, shapes,
-    dtypes); shapes/dtypes are None for compiled artifacts."""
+    dtypes); shapes/dtypes are None for compiled artifacts. The
+    ``@generate`` signature marks a generation LM artifact (shapes =
+    the string ``"generate"``)."""
     if "=" not in spec:
         raise ValueError("model spec %r needs name=path" % spec)
     name, rest = spec.split("=", 1)
     if "@" not in rest:
         return name, rest, None, None
     path, sig = rest.split("@", 1)
+    if sig == "generate":
+        return name, path, "generate", None
     shapes, dtypes = {}, {}
     for part in sig.split(","):
         if "=" not in part:
@@ -96,6 +105,18 @@ def main(argv=None):
         name, path, shapes, dtypes = parse_model_spec(spec)
         log.info("loading %s from %s%s ...", name, path,
                  " (%d replicas)" % replicas if replicas else "")
+        if shapes == "generate":
+            opts = {}
+            if args.max_batch is not None:
+                opts["max_batch"] = args.max_batch
+            model = repo.load(name, path, generate=True,
+                              generate_opts=opts,
+                              queue_depth=args.queue_depth,
+                              replicas=replicas)
+            log.info("loaded %s/%d (generate) %s warm=%.2fs", model.name,
+                     model.version, model.generate_info.get("decode_buckets"),
+                     model.warm_seconds or 0.0)
+            continue
         model = repo.load(name, path, input_shapes=shapes,
                           input_dtypes=dtypes, max_batch=args.max_batch,
                           max_delay_ms=args.delay_ms,

@@ -16,6 +16,15 @@ stack (`ServingServer` on 127.0.0.1), and measures four phases:
   4. ``open`` (optional, ``--open-rate``) — Poisson arrivals at a fixed
      rate: latency under a load the server does not control.
 
+``--generate`` runs the decode row instead (docs/serving.md
+§Generation): a tiny decoder-only LM is exported and served through the
+continuous-batching scheduler + paged KV cache, N closed-loop clients
+fire ``:generate`` requests with RANDOM prompt lengths and UNEQUAL
+``max_new_tokens`` (the workload shape batch-synchronous serving cannot
+batch), and the row reports tokens/sec, inter-token p50/p99 from the
+``mxtpu_serve_intertoken_seconds`` histogram, KV-page peak occupancy,
+and the post-warm jit-compile count (must be 0).
+
 ``--failover`` runs the resilience row instead (docs/serving.md
 chaos-testing playbook): the model is served through a supervised
 ``--replicas N`` pool, a closed-loop workload runs for
@@ -95,6 +104,177 @@ def _build_resnet18(tmpdir, image_size):
     prefix = os.path.join(tmpdir, "resnet18")
     net.export(prefix, epoch=0)
     return prefix, {"data": shape}
+
+
+def _build_lm(tmpdir, vocab=512):
+    """A small decoder-only LM (2 layers, d=64) exported as a generation
+    artifact — big enough that a decode step does real matmuls, small
+    enough that the CPU row stays fast. NOTE: this geometry (4 heads,
+    head_dim 16) is NOT (8, 128)-tile-aligned; on real TPU the paged
+    kernel would take its padded-copy branch, so a silicon capture
+    should serve an aligned model instead (the result carries a
+    `tile_aligned` flag so the row is honest either way)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerLM
+    from mxnet_tpu.serving import save_lm
+
+    lm = TransformerLM(vocab_size=vocab, units=64, hidden_size=128,
+                       num_layers=2, num_heads=4, max_length=128)
+    lm.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    return save_lm(lm, os.path.join(tmpdir, "lm")), vocab
+
+
+def _hist_quantile(snap_entry, q):
+    """Approximate a quantile from a cumulative-bucket histogram
+    snapshot (upper-bound of the bucket where the quantile falls)."""
+    if not snap_entry or not snap_entry.get("count"):
+        return None
+    total = snap_entry["count"]
+    items = []
+    for bound, cum in snap_entry.get("buckets", {}).items():
+        items.append((float("inf") if bound == "+Inf" else float(bound),
+                      cum))
+    items.sort()
+    target = q * total
+    for bound, cum in items:
+        if cum >= target:
+            return None if bound == float("inf") else bound
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the decode row (docs/serving.md §Generation)
+# ---------------------------------------------------------------------------
+
+def _run_generate(args, log):
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import ModelRepository, ServingServer
+
+    tmpdir = tempfile.mkdtemp(prefix="serve_bench_lm_")
+    log("building + exporting LM (vocab %d) ..." % args.gen_vocab)
+    prefix, vocab = _build_lm(tmpdir, vocab=args.gen_vocab)
+    repo = ModelRepository()
+    t0 = time.perf_counter()
+    model = repo.load(
+        "bench", prefix, generate=True,
+        generate_opts=dict(num_pages=args.kv_pages,
+                           page_size=args.kv_page_size,
+                           max_prompt=args.max_prompt,
+                           max_new_tokens=args.max_new_tokens,
+                           max_batch=args.gen_max_batch),
+        queue_depth=max(256, args.clients * 4))
+    load_s = time.perf_counter() - t0
+    gi = model.generate_info
+    log("loaded: decode buckets %s, prefill buckets %s, kv %d pages x %d "
+        "tokens, warm %.1fs"
+        % (gi["decode_buckets"], gi["prefill_buckets"], gi["num_pages"],
+           gi["page_size"], model.warm_seconds or 0.0))
+
+    misses = telemetry.get_registry().counter("mxtpu_jit_cache_miss_total")
+    base_miss = misses.value
+
+    server = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    endpoint = ("127.0.0.1", server.port, "/v1/models/bench:generate")
+    timeout_s = args.timeout_ms / 1e3 + 10.0
+
+    # random prompts + UNEQUAL budgets: the continuous-batching workload
+    rng = random.Random(0)
+    nprng = np.random.RandomState(0)
+    payloads = []
+    for _ in range(64):
+        plen = rng.randint(2, args.max_prompt)
+        payloads.append(json.dumps({
+            "tokens": [int(t) for t in nprng.randint(1, vocab, plen)],
+            "max_new_tokens": rng.randint(max(2, args.max_new_tokens // 4),
+                                          args.max_new_tokens),
+            "timeout_ms": args.timeout_ms,
+        }).encode())
+
+    # KV occupancy watcher (scheduler-side gauge, sampled)
+    alloc = model.scheduler.allocator
+    peak = {"used": 0}
+    stop = threading.Event()
+
+    def watch():
+        while not stop.is_set():
+            peak["used"] = max(peak["used"], alloc.used_pages)
+            time.sleep(0.002)
+
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+
+    log("closed loop: %d clients x %d generations ..."
+        % (args.clients, args.requests))
+    t0 = time.perf_counter()
+    phase = _closed_loop(endpoint, payloads, clients=args.clients,
+                         requests_each=args.requests, timeout_s=timeout_s)
+    wall = time.perf_counter() - t0
+    stop.set()
+    watcher.join(timeout=1.0)
+
+    snap = telemetry.snapshot()
+    label = '{model="%s/%d"}' % (model.name, model.version)
+    tokens = snap.get("mxtpu_serve_generated_tokens_total" + label,
+                      {}).get("value", 0)
+    steps = snap.get("mxtpu_serve_decode_steps_total" + label,
+                     {}).get("value", 0)
+    inter = snap.get("mxtpu_serve_intertoken_seconds" + label, {})
+    prefill = snap.get("mxtpu_serve_prefill_seconds" + label, {})
+    # first tokens are sampled by PREFILL, not decode steps — exclude
+    # them so the mean decode batch is honest occupancy, not inflated
+    # by one request's worth per admission
+    decode_tokens = tokens - (prefill.get("count") or 0)
+    jit_after_warm = misses.value - base_miss
+    p50 = _hist_quantile(inter, 0.50)
+    p99 = _hist_quantile(inter, 0.99)
+    result = {
+        "mode": "serve_decode",
+        "net": "transformer_lm",
+        "device": "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu"
+                  else "default",
+        # 4 heads x head_dim 16 is off the (8, 128) TPU tile grid: a
+        # silicon capture of THIS geometry would measure the kernel's
+        # padded-copy branch, not the zero-copy paged path
+        "tile_aligned": False,
+        "generate": gi,
+        "clients": args.clients,
+        "requests": phase["requests"],
+        "codes": phase["codes"],
+        "wall_s": round(wall, 3),
+        "load_s": round(load_s, 2),
+        "warm_s": round(model.warm_seconds or 0.0, 2),
+        "generated_tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 2) if wall else None,
+        "decode_steps": steps,
+        "mean_decode_batch": round(decode_tokens / steps, 2)
+                             if steps else None,
+        "request_p50_ms": phase["p50_ms"],
+        "request_p99_ms": phase["p99_ms"],
+        "intertoken_p50_ms": round(p50 * 1e3, 3) if p50 else None,
+        "intertoken_p99_ms": round(p99 * 1e3, 3) if p99 else None,
+        "prefill_mean_ms": round(prefill["sum"] / prefill["count"] * 1e3, 3)
+                           if prefill.get("count") else None,
+        "kv": {
+            "pages_total": alloc.num_pages,
+            "page_size": alloc.page_size,
+            "peak_pages_used": peak["used"],
+            "peak_occupancy": round(peak["used"] / alloc.num_pages, 3),
+            "pages_used_at_drain": alloc.used_pages,
+        },
+        "jit_compiles_after_warmup": jit_after_warm,
+    }
+    log("decode: %.1f tok/s, inter-token p99 %sms, kv peak %d/%d pages, "
+        "jit after warm %d, pages at drain %d"
+        % (result["tokens_per_sec"] or 0.0, result["intertoken_p99_ms"],
+           peak["used"], alloc.num_pages, jit_after_warm,
+           alloc.used_pages))
+    server.drain(shutdown=True)
+    telemetry.flush(reason="serve_bench_decode")
+    json.dump(result, sys.stdout, indent=1)
+    sys.stdout.write("\n")
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -487,6 +667,18 @@ def main(argv=None):
                    help="distributed-tracing sample rate for the bench "
                         "(1.0 = every request contributes to the "
                         "per-phase breakdown; 0 disables spans)")
+    p.add_argument("--generate", action="store_true",
+                   help="run the decode row instead: a tiny decoder-only "
+                        "LM served through the continuous-batching "
+                        "scheduler + paged KV cache (tokens/sec, "
+                        "inter-token p99, KV occupancy, jit-after-warm)")
+    p.add_argument("--gen-vocab", type=int, default=512)
+    p.add_argument("--gen-max-batch", type=int, default=8,
+                   help="decode batch buckets = powers of two up to this")
+    p.add_argument("--kv-pages", type=int, default=128)
+    p.add_argument("--kv-page-size", type=int, default=8)
+    p.add_argument("--max-prompt", type=int, default=16)
+    p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--failover", action="store_true",
                    help="run the resilience row instead of the throughput "
                         "phases: closed-loop load over a --replicas pool "
@@ -508,6 +700,9 @@ def main(argv=None):
     from mxnet_tpu.serving import ModelRepository, ServingServer
 
     log = lambda msg: print("[serve_bench] " + msg, file=sys.stderr)  # noqa: E731
+
+    if args.generate:
+        return _run_generate(args, log)
 
     tmpdir = tempfile.mkdtemp(prefix="serve_bench_")
     input_shapes = None
