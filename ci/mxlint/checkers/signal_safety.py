@@ -47,6 +47,7 @@ _SCOPE_FILES = (
     "mxnet_tpu/telemetry/recorder.py",
     "mxnet_tpu/telemetry/core.py",
     "mxnet_tpu/telemetry/memory.py",
+    "mxnet_tpu/telemetry/slo.py",
     "mxnet_tpu/telemetry/__init__.py",
     "mxnet_tpu/env.py",
     "mxnet_tpu/serving/supervisor.py",
@@ -55,8 +56,14 @@ _SCOPE_FILES = (
 # entry names may be nested defs (the serving drain handler is defined
 # inside install_signal_handlers); resolution falls back to a whole-tree
 # search when the name is not module-level
+#
+# statusz_payload is held to the same bar as the dump path BY DESIGN
+# (docs/observability.md §SLOs): /statusz is the "what is wrong right
+# now" page, so it must keep answering when the process is wedged on a
+# library lock — snapshot and ring reads only.
 _ENTRY = (("mxnet_tpu/telemetry/recorder.py", "_on_sigusr1"),
           ("mxnet_tpu/telemetry/recorder.py", "dump"),
+          ("mxnet_tpu/telemetry/slo.py", "statusz_payload"),
           ("mxnet_tpu/serving/supervisor.py", "_on_term"),
           ("mxnet_tpu/serving/server.py", "_on_signal"))
 

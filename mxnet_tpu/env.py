@@ -520,6 +520,87 @@ _var("MXTPU_STEP_FLOPS", "float", None,
      "Overrides the automatic cost-analysis accounting "
      "(`MXTPU_TRACE_FLOPS`).")
 
+# -- SLO engine -------------------------------------------------------------
+_var("MXTPU_SLO", "bool", True,
+     "master switch for the SLO engine (docs/observability.md §SLOs): "
+     "objective registration, the burn-rate evaluator thread and the "
+     "`mxtpu_slo_*` gauges. `0` disables everything except the raw "
+     "windowed-view machinery (rings still roll on the flusher cadence).")
+_var("MXTPU_SLO_SPEC", "str", None,
+     "path of a JSON SLO spec file (`{\"objectives\": [...]}`); objectives "
+     "declared there are registered next to the built-in serving/training "
+     "ones at evaluator start. Malformed JSON, an unknown objective kind "
+     "or an unknown metric name raise a typed `SLOSpecError` EAGERLY — a "
+     "typo'd objective silently never evaluating would be an alert that "
+     "can never fire.")
+_var("MXTPU_SLO_WINDOW_MS", "float", 5000.0,
+     "resolution of the windowed-telemetry snapshot rings: how often "
+     "`roll_windows` appends one per-metric snapshot (rolled on the JSONL "
+     "flusher cadence and each SLO evaluator lap, throttled to this "
+     "period). Windowed `rate(60s)` / `quantile(0.99, 60s)` views diff "
+     "the live value against the ring.")
+_var("MXTPU_SLO_EVAL_MS", "float", None,
+     "period of the SLO evaluator thread's laps (compute burn rates, "
+     "publish `mxtpu_slo_*` gauges, emit breach/recovery events). Default: "
+     "the `MXTPU_SLO_WINDOW_MS` resolution.")
+_var("MXTPU_SLO_FAST_WINDOWS", "str", "60,300",
+     "comma-separated fast (page-level) burn-rate windows in seconds, "
+     "SRE-style: an objective pages only when EVERY fast window is "
+     "burning (the short window proves it is happening now, the long one "
+     "that it is not a blip).")
+_var("MXTPU_SLO_SLOW_WINDOW_S", "float", 1800.0,
+     "slow (ticket-level) burn-rate window in seconds; also sizes the "
+     "snapshot rings (ring length = slow window / resolution, capped at "
+     "4096 entries).")
+_var("MXTPU_SLO_BURN_PAGE", "float", 1.0,
+     "fast-window burn-rate threshold for the page-level (breaching) "
+     "verdict: 1.0 pages as soon as the objective is violated at a "
+     "budget-consuming rate across every fast window; raise it to page "
+     "only on faster budget burn.")
+_var("MXTPU_SLO_BURN_TICKET", "float", 1.0,
+     "slow-window burn-rate threshold for the ticket-level verdict.")
+_var("MXTPU_SLO_ALERTS", "int", 64,
+     "size of the bounded alerts ring (last `slo_breach`/`slo_recovered` "
+     "transitions) carried in flight-recorder dumps and `/statusz` — a "
+     "watchdog/SIGUSR1 dump names which objective was burning when the "
+     "process hung.")
+_var("MXTPU_SLO_SERVE_P99_MS", "float", 1000.0,
+     "built-in serving latency objective: p99 of "
+     "`mxtpu_serve_request_seconds` (admission to resolution, per model) "
+     "must stay under this many ms. Registered for every served model at "
+     "load.")
+_var("MXTPU_SLO_SERVE_AVAILABILITY", "float", 0.999,
+     "built-in serving availability objective: the fraction of requests "
+     "NOT deterministically rejected (429/504/503 sheds) must stay at or "
+     "above this target; the error budget is `1 - target`.")
+_var("MXTPU_SLO_SERVE_QUEUE_FRAC", "float", 0.8,
+     "built-in serving queue-depth ceiling: `mxtpu_serve_queue_depth` "
+     "must stay under this fraction of `MXTPU_SERVE_QUEUE_DEPTH` — the "
+     "queue sitting near its admission limit is the page BEFORE 429s "
+     "start (and the ROADMAP item-4 autoscaler's scale-up signal).")
+_var("MXTPU_SLO_INTERTOKEN_P99_MS", "float", 250.0,
+     "built-in generation objective: p99 of "
+     "`mxtpu_serve_intertoken_seconds` (what a streaming client feels) "
+     "must stay under this many ms.")
+_var("MXTPU_SLO_KV_OCCUPANCY", "float", 0.95,
+     "built-in generation objective: `mxtpu_serve_kv_occupancy` (used/"
+     "total KV pages) ceiling — occupancy pinned above it means "
+     "admissions are about to queue on page pressure.")
+_var("MXTPU_SLO_STEP_SECONDS", "float", None,
+     "optional training objective (registered at the first `observe_step` "
+     "when set): p99 step time in seconds per trainer kind — a fleet's "
+     "step-time regression page.")
+_var("MXTPU_SLO_MFU_FLOOR", "float", None,
+     "optional training objective (registered at the first `observe_step` "
+     "when set): `mxtpu_step_mfu` floor, 0..1 — pages when achieved MFU "
+     "drops below it (input starvation, a de-optimized step, a sick "
+     "chip).")
+_var("MXTPU_SLO_STEP_STALENESS_S", "float", None,
+     "optional training staleness objective (registered at the first "
+     "`observe_step` when set): seconds `mxtpu_steps_total` may sit "
+     "without advancing before the objective burns — the SLO-shaped "
+     "cousin of the flight-recorder watchdog.")
+
 # -- distributed tracing ----------------------------------------------------
 _var("MXTPU_TRACE_SAMPLE", "float", 0.0,
      "distributed tracing (docs/observability.md §Tracing): fraction of "

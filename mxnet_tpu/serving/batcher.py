@@ -37,6 +37,7 @@ import numpy as _np
 
 from .. import env as _env
 from .. import telemetry
+from ..telemetry import slo as _slo
 from ..telemetry import tracing as _tracing
 from ..base import MXNetError, unpad_outputs
 
@@ -318,6 +319,15 @@ class DynamicBatcher:
                                               labels)
         self._m_compute_s = telemetry.histogram("mxtpu_serve_compute_seconds",
                                                 labels)
+        # end-to-end admission→resolution latency per request: THE serving
+        # SLO figure (the built-in p99 objective and /statusz windowed
+        # rates read it), with trace-id exemplars so a breach names a
+        # renderable trace
+        self._m_request_s = telemetry.histogram("mxtpu_serve_request_seconds",
+                                                labels)
+        # built-in SLOs for this model: p99 / availability / queue-depth
+        # ceiling (docs/observability.md §SLOs); dropped again in close()
+        _slo.wire_serving_objectives(name, queue_depth=self.queue_depth)
 
         self._worker = threading.Thread(
             target=self._loop, name="mxtpu-serve-batcher-%s" % name,
@@ -414,6 +424,8 @@ class DynamicBatcher:
         # anything still queued after a failed/skipped drain gets an answer
         self.abort_pending(DrainingError(
             "model %r shut down before this request ran" % self.name))
+        # verdicts for a gone model are noise on /statusz
+        _slo.unregister_model(self.name)
         return drained
 
     # -- the worker --------------------------------------------------------
@@ -551,6 +563,8 @@ class DynamicBatcher:
             req.compute_seconds = compute_s
             trace_id = req.trace.trace_id if req.trace is not None else None
             self._m_queue_s.observe(req.queue_seconds, exemplar=trace_id)
+            self._m_request_s.observe(max(0.0, now - req._t_submit),
+                                      exemplar=trace_id)
             # queue-phase span, start rebased to the request's submit time
             # (wall clock = now minus the monotonic elapsed)
             _tracing.emit_span(

@@ -69,6 +69,7 @@ from .. import compile as _compile
 from .. import env as _env
 from .. import random as _random
 from .. import telemetry
+from ..telemetry import slo as _slo
 from ..base import MXNetError
 from ..telemetry import tracing as _tracing
 from .batcher import (DeadlineExceededError, DrainingError, QueueFullError,
@@ -111,8 +112,12 @@ class KVPageAllocator:
         labels = {"model": name}
         self._m_total = telemetry.gauge("mxtpu_serve_kv_pages_total", labels)
         self._m_used = telemetry.gauge("mxtpu_serve_kv_pages_used", labels)
+        # used/total as one ratio gauge: the SLO occupancy-ceiling
+        # objective and /statusz read a single windowed series
+        self._m_occ = telemetry.gauge("mxtpu_serve_kv_occupancy", labels)
         self._m_total.set(self.num_pages)
         self._m_used.set(0)
+        self._m_occ.set(0.0)
 
     def pages_for(self, tokens):
         """Pages needed to hold ``tokens`` tokens."""
@@ -136,7 +141,9 @@ class KVPageAllocator:
                 return None
             pages = self._free[-n:][::-1] if n else []
             del self._free[len(self._free) - n:]
-            self._m_used.set(self.num_pages - len(self._free))
+            used = self.num_pages - len(self._free)
+            self._m_used.set(used)
+            self._m_occ.set(used / float(self.num_pages))
         return pages
 
     def free(self, pages):
@@ -148,7 +155,9 @@ class KVPageAllocator:
                 if p in live or not (0 <= p < self.num_pages):
                     raise MXNetError("double-free/corrupt KV page %r" % (p,))
             self._free.extend(pages)
-            self._m_used.set(self.num_pages - len(self._free))
+            used = self.num_pages - len(self._free)
+            self._m_used.set(used)
+            self._m_occ.set(used / float(self.num_pages))
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +314,10 @@ class GenerateScheduler:
             bounds=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1., 2.5))
         self._m_prefill = telemetry.histogram("mxtpu_serve_prefill_seconds",
                                               labels)
+        # built-in generation SLOs: inter-token p99 + KV-occupancy
+        # ceiling + admission-queue ceiling (docs/observability.md §SLOs)
+        _slo.wire_generate_objectives(self.name,
+                                      queue_depth=self.queue_depth)
 
         # the RNG chain is thread-local (mxnet_tpu/random.py) and the
         # worker thread would otherwise lazily seed itself with the
@@ -424,6 +437,8 @@ class GenerateScheduler:
             for seq in leftovers:
                 self.allocator.free(seq.pages)
             self._m_active.set(0)
+        # verdicts for a gone model are noise on /statusz
+        _slo.unregister_model(self.name)
         return drained
 
     # -- the worker --------------------------------------------------------

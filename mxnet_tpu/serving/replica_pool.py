@@ -165,6 +165,7 @@ class ReplicaPool:
         self._m_restarts = telemetry.counter(
             "mxtpu_serve_replica_restart_total", labels)
         self._m_inflight = {}  # replica id -> per-replica in-flight gauge
+        self._m_generation = {}  # replica id -> restart-generation gauge
 
         # per-pool handshake secret: a connection must present it before
         # the accept loop will unpickle a single frame (localhost TCP is
@@ -188,6 +189,12 @@ class ReplicaPool:
             slot = _Slot(k, proc)
             self._m_inflight[k] = telemetry.gauge(
                 "mxtpu_serve_replica_inflight",
+                {"model": self.model, "replica": str(k)})
+            # restart generation per replica, published as a gauge so the
+            # lock-free /statusz page can show pool health generations
+            # without touching the pool's own locked describe()
+            self._m_generation[k] = telemetry.gauge(
+                "mxtpu_serve_replica_generation",
                 {"model": self.model, "replica": str(k)})
             self._slots.append(slot)
 
@@ -748,6 +755,7 @@ class ReplicaPool:
             # respawn at the constant initial backoff forever — the reset
             # waits until the generation serves a batch cleanly
         self._set_healthy_gauge()
+        self._m_generation[slot.id].set(slot.proc.generation)
         telemetry.record_event(
             "serve_replica_ready", model=self.model, replica=slot.id,
             generation=slot.proc.generation,

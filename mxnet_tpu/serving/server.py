@@ -46,6 +46,7 @@ import numpy as _np
 
 from .. import env as _env
 from .. import telemetry
+from ..telemetry import slo as _slo
 from ..telemetry import tracing as _tracing
 from ..base import MXNetError
 from .batcher import DrainingError, ServingError, drain_timeout_s
@@ -235,6 +236,25 @@ class ServingServer:
                     self._text(handler, 503, "draining\n")
                 else:
                     self._text(handler, 200, "ok\n")
+            elif path.rstrip("/") == "/statusz" and method == "GET":
+                # the "what is wrong right now" page (docs/observability.md
+                # §SLOs): SLO verdicts + windowed rates + pool/memory/
+                # compile state. Reads lock-free snapshots only — it must
+                # answer even when a model's batcher is wedged, so it
+                # never touches repository/batcher locks (admission-free:
+                # works while draining too)
+                ctype, body = _slo.render_statusz(
+                    "text" if "format=text" in query else "json",
+                    extra={"server": {"port": self.port,
+                                      "draining": self._draining,
+                                      "drain_failed": self._drain_failed,
+                                      "inflight": self._inflight}})
+                self._count(200)
+                handler.send_response(200)
+                handler.send_header("Content-Type", ctype)
+                handler.send_header("Content-Length", str(len(body)))
+                handler.end_headers()
+                handler.wfile.write(body)
             elif path.rstrip("/") == "/drainz":
                 self._drain_event.set()  # idempotent: wakes the waiter
                 self._json(handler, 200, {

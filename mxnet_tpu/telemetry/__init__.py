@@ -32,6 +32,7 @@ from .recorder import (  # noqa: F401
 from . import core as _core
 from . import flops  # noqa: F401  (automatic FLOP accounting)
 from . import memory  # noqa: F401  (HBM/RSS attribution + live gauges)
+from . import slo  # noqa: F401  (windowed SLO engine + /statusz)
 from . import tracing  # noqa: F401  (distributed request/step spans)
 
 __all__ = [
@@ -40,7 +41,7 @@ __all__ = [
     "record_event", "record_step", "events", "dump", "dump_path",
     "last_step", "install_signal_handler", "observe_step", "set_step_flops",
     "rank", "restart_generation", "telemetry_dir", "tracing", "flops",
-    "memory", "LATENCY_BOUNDS", "BYTE_BOUNDS",
+    "memory", "slo", "LATENCY_BOUNDS", "BYTE_BOUNDS",
 ]
 
 
@@ -115,6 +116,11 @@ def observe_step(duration_s, examples=None, step=None, kind="train"):
     FLOPs instrumented executables actually ran since the last step."""
     if not _core._STATE.enabled:
         return
+    # first step of each trainer kind registers its optional SLO
+    # objectives (step-time ceiling / MFU floor / staleness — only the
+    # knobs that are set); later steps pay one set-membership check
+    if kind not in slo._STATE.wired_train:
+        slo.wire_training(kind)
     hist, c_steps, c_examples, g_eps, g_mfu, g_auto = _step_metrics(kind)
     trace_id = tracing.current_trace_id()
     hist.observe(duration_s, exemplar=trace_id)

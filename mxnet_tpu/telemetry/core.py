@@ -31,6 +31,7 @@ hard dependency. ``MXTPU_TELEMETRY=0`` turns the whole layer into no-ops.
 from __future__ import annotations
 
 import atexit
+import collections
 import json
 import os
 import threading
@@ -41,7 +42,8 @@ from .. import env as _env
 __all__ = [
     "enabled", "set_enabled", "counter", "gauge", "histogram", "get_registry",
     "snapshot", "prometheus_text", "flush", "start_http_server", "rank",
-    "restart_generation", "telemetry_dir", "LATENCY_BOUNDS", "BYTE_BOUNDS",
+    "restart_generation", "telemetry_dir", "roll_windows",
+    "LATENCY_BOUNDS", "BYTE_BOUNDS",
 ]
 
 
@@ -56,6 +58,7 @@ class _State:
         self.http_server = None      # (server, thread, port) or None
         self.http_decided = False
         self.flush_fail_logged = False
+        self.last_roll = None        # wall ts of the last window roll
 
 
 _STATE = _State()
@@ -116,6 +119,106 @@ LATENCY_BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 BYTE_BOUNDS = tuple(float(4096 * 4 ** i) for i in range(11))
 
 
+# ---------------------------------------------------------------------------
+# windowed views (docs/observability.md §SLOs)
+#
+# Every cumulative metric can additionally keep a bounded ring of periodic
+# snapshots; diffing the live value against the newest snapshot at-or-before
+# the window start yields "rate over the last 60s" / "p99 over the last 60s"
+# without touching the lock-free dispatch hot path (inc/observe are
+# unchanged — the roller reads cumulative state from the side). Rings are
+# created at the first `roll_windows()` call, so processes that never roll
+# pay nothing. Resolution is `MXTPU_SLO_WINDOW_MS`; the ring is sized to
+# cover `MXTPU_SLO_SLOW_WINDOW_S` (the longest burn-rate window the SLO
+# evaluator asks for), capped so a misconfigured resolution cannot grow it
+# without bound.
+# ---------------------------------------------------------------------------
+
+def _window_s():
+    return max(0.05, _env.get("MXTPU_SLO_WINDOW_MS") / 1e3)
+
+
+def _win_maxlen(window_s):
+    slow = max(60.0, _env.get("MXTPU_SLO_SLOW_WINDOW_S"))
+    return max(16, min(4096, int(slow / window_s) + 2))
+
+
+def _win_entries(win):
+    """Stable list copy of a snapshot ring. A roller appending during the
+    copy raises RuntimeError (deque mutated during iteration) — retry a
+    few times; the ring mutates at window cadence, so one retry wins."""
+    for _ in range(4):
+        try:
+            return list(win)
+        except RuntimeError:
+            continue
+    return []
+
+
+def _win_base(entries, cutoff):
+    """The ring entry CLOSEST to ``cutoff`` (ties to the older side) —
+    the window baseline. Picking strictly the entry before the cutoff
+    would attribute everything since a long-quiet epoch's last roll to
+    the window; the closest entry bounds the attribution error by half
+    the roll resolution instead. Falls back to the oldest entry (partial
+    coverage: the ring does not span the window yet); None on an empty
+    ring."""
+    older = None
+    newer = None
+    for e in reversed(entries):
+        if e[0] <= cutoff:
+            older = e
+            break
+        newer = e
+    if older is None:
+        return entries[0] if entries else None
+    if newer is not None and (newer[0] - cutoff) < (cutoff - older[0]):
+        return newer
+    return older
+
+
+def quantile_from_deltas(bounds, deltas, count, q):
+    """Bucket-interpolated quantile from per-bucket counts (the windowed
+    delta shape). Shared by `Histogram.windowed_quantile` and the SLO
+    evaluator's multi-series merge. +Inf overflow clamps to the top
+    finite bound."""
+    target = max(1e-12, q * count)
+    cum = 0.0
+    lower = 0.0
+    for bound, d in zip(bounds, deltas):
+        if d:
+            if cum + d >= target:
+                return lower + (bound - lower) * ((target - cum) / d)
+            cum += d
+        lower = bound
+    return bounds[-1] if bounds else None
+
+
+def roll_windows(now=None, force=False):
+    """Append one snapshot to every metric's window ring. Called from the
+    JSONL flusher and the SLO evaluator (both off the hot path); throttled
+    to the `MXTPU_SLO_WINDOW_MS` resolution so racing callers do not burn
+    ring coverage. Returns the number of metrics rolled (0 when skipped)."""
+    if not _STATE.enabled:
+        return 0
+    if now is None:
+        now = time.time()
+    w = _window_s()
+    last = _STATE.last_roll
+    if not force and last is not None and now - last < 0.9 * w:
+        return 0
+    # two rollers racing the throttle at worst append two entries for one
+    # interval — queries diff by timestamp, so coverage only improves
+    _STATE.last_roll = now  # mxlint: gil-atomic — roll-throttle stamp
+    maxlen = _win_maxlen(w)
+    n = 0
+    for m in _REGISTRY.metrics():
+        if hasattr(m, "_roll"):
+            m._roll(now, maxlen)
+            n += 1
+    return n
+
+
 def _render_labels(labels):
     if not labels:
         return ""
@@ -128,12 +231,14 @@ class Counter:
     """Monotonic counter (int or float). `inc` is lock-free."""
 
     kind = "counter"
-    __slots__ = ("name", "labels", "_value")
+    __slots__ = ("name", "labels", "_value", "_win", "_win_changed")
 
     def __init__(self, name, labels=None):
         self.name = name
         self.labels = dict(labels or {})
         self._value = 0
+        self._win = None          # snapshot ring: (ts, cumulative value)
+        self._win_changed = None  # ts of the last roll that saw growth
 
     def inc(self, amount=1):
         if _STATE.enabled:
@@ -142,6 +247,49 @@ class Counter:
     @property
     def value(self):
         return self._value
+
+    def _roll(self, now, maxlen):
+        win = self._win
+        v = self._value
+        if win is None:
+            win = self._win = collections.deque(maxlen=maxlen)
+            self._win_changed = now
+        elif win[-1][1] != v:
+            # staleness signal: when did this counter last move?
+            self._win_changed = now  # mxlint: gil-atomic — roller-only stamp
+        win.append((now, v))  # mxlint: gil-atomic — lock-free ring
+
+    def windowed_delta(self, seconds, now=None):
+        """``(delta, elapsed_s)`` of this counter over the trailing window
+        (diffed against the rolled ring); None before the first roll. The
+        elapsed figure is the REAL baseline age — shorter than ``seconds``
+        while the ring is still filling."""
+        win = self._win
+        if not win:
+            return None
+        if now is None:
+            now = time.time()
+        base = _win_base(_win_entries(win), now - seconds)
+        if base is None:
+            return None
+        return (self._value - base[1], max(1e-9, now - base[0]))
+
+    def windowed_rate(self, seconds, now=None):
+        """Per-second increase over the trailing window (None: no ring)."""
+        d = self.windowed_delta(seconds, now)
+        if d is None:
+            return None
+        return d[0] / d[1]
+
+    def seconds_since_change(self, now=None):
+        """Seconds since a roll last observed this counter moving (the SLO
+        staleness signal); None before the first roll."""
+        ts = self._win_changed
+        if ts is None:
+            return None
+        if now is None:
+            now = time.time()
+        return max(0.0, now - ts)
 
     def snapshot(self):
         return {"type": "counter", "value": self._value}
@@ -155,12 +303,13 @@ class Gauge:
     """Last-value gauge. `set`/`inc`/`dec` are lock-free."""
 
     kind = "gauge"
-    __slots__ = ("name", "labels", "_value")
+    __slots__ = ("name", "labels", "_value", "_win")
 
     def __init__(self, name, labels=None):
         self.name = name
         self.labels = dict(labels or {})
         self._value = 0.0
+        self._win = None  # snapshot ring: (ts, value) samples
 
     def set(self, value):
         if _STATE.enabled:
@@ -177,6 +326,35 @@ class Gauge:
     @property
     def value(self):
         return self._value
+
+    def _roll(self, now, maxlen):
+        win = self._win
+        if win is None:
+            win = self._win = collections.deque(maxlen=maxlen)
+        win.append((now, self._value))  # mxlint: gil-atomic — lock-free ring
+
+    def windowed_values(self, seconds, now=None):
+        """Rolled ``(ts, value)`` samples inside the trailing window, plus
+        the live value as the newest sample ([] before the first roll —
+        the live value alone is not window evidence)."""
+        win = self._win
+        if not win:
+            return []
+        if now is None:
+            now = time.time()
+        cutoff = now - seconds
+        out = [(ts, v) for ts, v in _win_entries(win) if ts >= cutoff]
+        out.append((now, self._value))
+        return out
+
+    def windowed_stats(self, seconds, now=None):
+        """{'min','max','avg','samples'} over the trailing window, or None
+        before the first roll."""
+        vals = [v for _, v in self.windowed_values(seconds, now)]
+        if not vals:
+            return None
+        return {"min": min(vals), "max": max(vals),
+                "avg": sum(vals) / len(vals), "samples": len(vals)}
 
     def snapshot(self):
         return {"type": "gauge", "value": self._value}
@@ -196,7 +374,7 @@ class Histogram:
 
     kind = "histogram"
     __slots__ = ("name", "labels", "bounds", "_counts", "_count", "_sum",
-                 "_min", "_max", "_exemplars")
+                 "_min", "_max", "_exemplars", "_win")
 
     def __init__(self, name, labels=None, bounds=None):
         self.name = name
@@ -208,6 +386,7 @@ class Histogram:
         self._min = None
         self._max = None
         self._exemplars = None  # bucket index -> (value, trace_id, ts)
+        self._win = None        # ring: (ts, counts tuple, count, sum)
 
     def observe(self, value, exemplar=None):
         """Record one observation. ``exemplar`` (a trace id) attaches the
@@ -243,6 +422,47 @@ class Histogram:
     @property
     def sum(self):
         return self._sum
+
+    def _roll(self, now, maxlen):
+        win = self._win
+        if win is None:
+            win = self._win = collections.deque(maxlen=maxlen)
+        # tuple() of the live counts list may interleave with a concurrent
+        # observe — one torn sample per roll is the accepted lock-free trade
+        win.append((now, tuple(self._counts), self._count,
+                    self._sum))  # mxlint: gil-atomic — lock-free ring
+
+    def windowed(self, seconds, now=None):
+        """Delta view over the trailing window, diffed against the rolled
+        ring: ``{'count','sum','rate','elapsed','bounds','bucket_deltas'}``
+        (bucket_deltas are PER-BUCKET deltas, len(bounds)+1 with the +Inf
+        overflow last). None before the first roll."""
+        win = self._win
+        if not win:
+            return None
+        if now is None:
+            now = time.time()
+        base = _win_base(_win_entries(win), now - seconds)
+        if base is None:
+            return None
+        counts = list(self._counts)
+        deltas = [max(0, c - b) for c, b in zip(counts, base[1])]
+        dcount = max(0, self._count - base[2])
+        elapsed = max(1e-9, now - base[0])
+        return {"count": dcount, "sum": self._sum - base[3],
+                "rate": dcount / elapsed, "elapsed": elapsed,
+                "bounds": self.bounds, "bucket_deltas": deltas}
+
+    def windowed_quantile(self, q, seconds, now=None):
+        """Bucket-interpolated quantile of the observations inside the
+        trailing window; None when the window saw none (or no ring yet).
+        Observations in the +Inf overflow bucket clamp to the top finite
+        bound — windowed quantiles can never exceed it."""
+        w = self.windowed(seconds, now)
+        if not w or w["count"] <= 0:
+            return None
+        return quantile_from_deltas(self.bounds, w["bucket_deltas"],
+                                    w["count"], q)
 
     def _bucket_le(self, i):
         return "%g" % self.bounds[i] if i < len(self.bounds) else "+Inf"
@@ -321,6 +541,27 @@ class _NullMetric:
     def exemplars(self):
         return {}
 
+    def windowed_delta(self, seconds, now=None):
+        return None
+
+    def windowed_rate(self, seconds, now=None):
+        return None
+
+    def seconds_since_change(self, now=None):
+        return None
+
+    def windowed_values(self, seconds, now=None):
+        return []
+
+    def windowed_stats(self, seconds, now=None):
+        return None
+
+    def windowed(self, seconds, now=None):
+        return None
+
+    def windowed_quantile(self, q, seconds, now=None):
+        return None
+
     def snapshot(self):
         return {"type": "null"}
 
@@ -361,6 +602,16 @@ class Registry:
 
     def histogram(self, name, labels=None, bounds=None):
         return self._get_or_make(Histogram, name, labels, bounds=bounds)
+
+    def remove(self, name, labels=None):
+        """Drop one metric series (exact name + labels). The SLO engine
+        retires its per-objective gauges here when an objective is
+        unregistered — a model unloaded mid-breach must not export a
+        permanently-breaching `mxtpu_slo_healthy` series forever. Returns
+        True when the series existed."""
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            return self._metrics.pop(key, None) is not None
 
     def metrics(self):
         # dict copy is atomic enough under the GIL; callers iterate the copy
@@ -441,6 +692,10 @@ def flush(directory=None, reason="manual"):
     # refresh the memory gauges (RSS/VmHWM, NDArray live, device stats)
     # so every snapshot line carries current residency figures
     memory.sample()
+    # the window roller rides the flusher cadence: every flush appends one
+    # ring snapshot (throttled to MXTPU_SLO_WINDOW_MS) so windowed
+    # rate/quantile views stay live even without the SLO evaluator thread
+    roll_windows()
     path = _jsonl_path(directory)
     try:
         os.makedirs(directory, exist_ok=True)
@@ -555,7 +810,23 @@ def start_http_server(port=None, addr="0.0.0.0"):
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.rstrip("/") not in ("", "/metrics"):
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path == "/statusz":
+                # the always-on debug page (docs/observability.md §SLOs):
+                # SLO verdicts + windowed rates + memory/compile/pool state
+                from . import slo
+
+                query = self.path.split("?", 1)[1] if "?" in self.path \
+                    else ""
+                fmt = "text" if "format=text" in query else "json"
+                ctype, body = slo.render_statusz(fmt)
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path not in ("", "/metrics"):
                 self.send_error(404)
                 return
             from . import memory

@@ -45,7 +45,8 @@ from . import memory  # imported HERE, not inside dump(): an import in a
 from . import tracing  # signal handler could deadlock on the import lock
 
 __all__ = ["record_event", "record_step", "events", "dump", "dump_path",
-           "last_step", "install_signal_handler", "drain_pending_events"]
+           "last_step", "install_signal_handler", "drain_pending_events",
+           "record_alert", "alerts"]
 
 
 def _ring_size():
@@ -56,6 +57,12 @@ class _RecState:
     def __init__(self):
         self.ring = collections.deque(maxlen=_ring_size())
         self.pending = collections.deque(maxlen=4096)  # JSONL flush queue
+        # SLO breach/recovery transitions, kept SEPARATELY from the event
+        # ring: a busy process churns hundreds of events between two
+        # alerts, and the one question a hang dump must answer — "which
+        # objective was burning?" — must not age out of a shared ring
+        self.alerts = collections.deque(
+            maxlen=max(4, _env.get("MXTPU_SLO_ALERTS")))
         self.last_step = None        # (step, monotonic_t, wall_t)
         self.watchdog = None
         self.watchdog_decided = False  # env checked once (hot-path guard)
@@ -114,6 +121,23 @@ def events():
     """Snapshot of the ring (oldest first)."""
     return [{"ts": ts, "event": kind, "fields": dict(fields)}
             for ts, kind, fields in list(_REC.ring)]
+
+
+def record_alert(kind, fields):
+    """Append one SLO transition (`slo_breach` / `slo_recovered`) to the
+    bounded alerts ring (`MXTPU_SLO_ALERTS`). Same lock-free deque
+    discipline as the event ring — dumps read it from signal context."""
+    if not core._STATE.enabled:
+        return
+    _REC.alerts.append(  # mxlint: gil-atomic — signal-safe alerts ring
+        (time.time(), kind, dict(fields or {})))
+
+
+def alerts():
+    """Snapshot of the alerts ring (oldest first) — carried in every
+    flight-recorder dump and the /statusz page."""
+    return [{"ts": ts, "event": kind, "fields": dict(fields)}
+            for ts, kind, fields in list(_REC.alerts)]
 
 
 def last_step():
@@ -192,6 +216,9 @@ def dump(reason, path=None):
             # device stats, NDArray live counts, top executables by temp
             # bytes — every hang/OOM dump says where the memory went
             "memory": memory.snapshot(),
+            # which objective was burning when the process hung: the
+            # bounded slo_breach/slo_recovered ring (docs §SLOs)
+            "alerts": alerts(),
             "threads": _thread_stacks(),
             "events": events(),
             "metrics": core.snapshot(),

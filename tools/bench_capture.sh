@@ -254,4 +254,11 @@ echo "[bench_capture] smoke rc=$?" >&2
 echo "[bench_capture] bench history" >&2
 PYTHONPATH=".:${PYTHONPATH:-}" timeout 120 python tools/bench_history.py \
   2>> /dev/stderr || echo "[bench_capture] bench history failed" >&2
+
+# regression gate over the refreshed trajectory, WARN-ONLY here (a capture
+# must land even when it regressed — the table in the log is the signal;
+# CI/reviewers run `python -m tools.bench_history --check` blocking)
+PYTHONPATH=".:${PYTHONPATH:-}" timeout 120 python tools/bench_history.py \
+  --check 2>> /dev/stderr \
+  || echo "[bench_capture] WARNING: bench_history --check flagged a >15% headline regression (see table above)" >&2
 echo "[bench_capture] done" >&2

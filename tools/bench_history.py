@@ -19,6 +19,14 @@ Run it directly or let ``tools/bench_capture.sh`` append the current
 capture's rows at the end of every run:
 
     python tools/bench_history.py [--root DIR] [--quiet]
+
+``--check`` turns the trajectory from write-only evidence into a
+regression gate: for each headline metric family (serving rps, decode
+tokens/sec, failover rps, cold-start time-to-ready, training MFU), the
+newest round's row is compared against the BEST prior non-stale,
+non-failed row of the same kind; a >15% regression (``--tolerance``)
+prints a table and exits 2. ``bench_capture.sh`` runs it warn-only at
+the end of every capture; CI can run it blocking.
 """
 from __future__ import annotations
 
@@ -142,6 +150,9 @@ def collect(root):
             "value": value,
             "unit": unit,
             "device": device,
+            # MFU rides along where the artifact reports it, so --check
+            # can gate on it next to the throughput headline
+            "mfu": doc.get("mfu") if isinstance(doc, dict) else None,
             "detail": detail,
             "utc": utc,
         })
@@ -178,15 +189,143 @@ def render_markdown(rows):
     return "\n".join(lines)
 
 
+# headline metric families the --check gate compares across rounds, and
+# which direction is "better". Values are per-row `metric` names from
+# `_extract`; MFU is gated separately off each row's `mfu` field.
+_CHECK_METRICS = {
+    "serve_batched_rps": "higher",
+    "decode_tokens_per_sec": "higher",
+    "failover_rps": "higher",
+    "coldstart_ready": "lower",     # warm time-to-ready, seconds
+}
+
+
+def _check_one(label, newest, best, direction, tolerance):
+    """One comparison row, or None when within tolerance. ``newest`` and
+    ``best`` are (value, file) pairs."""
+    if not newest[0] or not best[0]:
+        return None
+    if direction == "higher":
+        change = (best[0] - newest[0]) / best[0]
+    else:
+        change = (newest[0] - best[0]) / best[0]
+    if change <= tolerance:
+        return None
+    return {"metric": label, "newest": newest[0], "newest_file": newest[1],
+            "best_prior": best[0], "best_file": best[1],
+            "regression_pct": round(change * 100.0, 1),
+            "direction": direction}
+
+
+def check(rows, tolerance=0.15):
+    """Regression gate over trajectory rows: for each headline family,
+    newest-round row vs the best prior NON-STALE, non-failed row. Returns
+    the list of regressions (empty = gate passes)."""
+    regressions = []
+    usable = [r for r in rows
+              if not r["stale"] and r["round"] is not None
+              and r["metric"] not in ("capture_failed", "unparsed",
+                                      "unknown_schema")]
+
+    def gate(label, group, value_of, direction):
+        group = [r for r in group if value_of(r)]
+        if len(group) < 2:
+            return  # nothing to compare against — not a failure
+        newest_round = max(r["round"] for r in group)
+        newest = [r for r in group if r["round"] == newest_round]
+        prior = [r for r in group if r["round"] < newest_round]
+        if not prior:
+            return
+        pick = max if direction == "higher" else min
+        best = pick(prior, key=value_of)
+        new = pick(newest, key=value_of)  # best of the newest round
+        hit = _check_one(label, (value_of(new), new["file"]),
+                         (value_of(best), best["file"]), direction,
+                         tolerance)
+        if hit:
+            regressions.append(hit)
+
+    for metric, direction in _CHECK_METRICS.items():
+        if metric == "coldstart_ready":
+            # coldstart metric names are per-model-geometry
+            # (coldstart_resnet18_mb8, ...): gate each family on its own
+            # history — comparing different models' ready-times would
+            # both false-alarm and mask real regressions
+            names = sorted({str(r["metric"]) for r in usable
+                            if str(r["metric"]).startswith("coldstart")})
+            for name in names:
+                gate(name, [r for r in usable if r["metric"] == name],
+                     lambda r: r["value"], direction)
+            continue
+        gate(metric, [r for r in usable if r["metric"] == metric],
+             lambda r: r["value"], direction)
+    # MFU gate: per (metric, row) family so train MFU never races score MFU
+    mfu_rows = [r for r in usable if r.get("mfu")]
+    for key in sorted({(r["metric"], r["row"]) for r in mfu_rows}):
+        group = [r for r in mfu_rows
+                 if (r["metric"], r["row"]) == key]
+        gate("mfu:%s/%s" % key, group, lambda r: r.get("mfu"), "higher")
+    return regressions
+
+
+def render_check_table(regressions):
+    lines = ["| Metric | Newest | Best prior | Regression | Files |",
+             "|---|---|---|---|---|"]
+    for r in regressions:
+        lines.append("| %s | %s | %s | %.1f%% | `%s` vs `%s` |" % (
+            r["metric"], _fmt(r["newest"]), _fmt(r["best_prior"]),
+            r["regression_pct"], r["newest_file"], r["best_file"]))
+    return "\n".join(lines)
+
+
+def run_check(root, tolerance, quiet=False):
+    """The --check entry: prefer the committed BENCH_TRAJECTORY.json
+    (what reviewers see), fall back to a fresh collect()."""
+    traj = os.path.join(root, "BENCH_TRAJECTORY.json")
+    rows = None
+    if os.path.exists(traj):
+        try:
+            with open(traj) as f:
+                rows = json.load(f).get("rows")
+        except (OSError, ValueError) as e:
+            sys.stderr.write("[bench_history] unreadable %s (%s); "
+                             "re-collecting\n" % (traj, e))
+    if not rows:
+        rows = collect(root)
+    regressions = check(rows, tolerance)
+    if regressions:
+        sys.stderr.write(
+            "[bench_history] REGRESSION: %d headline metric(s) worse than "
+            "%.0f%% vs the best prior non-stale row:\n%s\n"
+            % (len(regressions), tolerance * 100.0,
+               render_check_table(regressions)))
+        return 2
+    if not quiet:
+        sys.stderr.write("[bench_history] check ok: no headline metric "
+                         ">%.0f%% below its best prior non-stale row "
+                         "(%d rows)\n" % (tolerance * 100.0, len(rows)))
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--root", default=None,
                    help="repo root holding BENCH_*.json (default: the "
                         "checkout this tool lives in)")
+    p.add_argument("--check", action="store_true",
+                   help="regression gate: compare the newest round's "
+                        "headline metrics against the best prior "
+                        "non-stale row; exit 2 and print a table on "
+                        "a regression beyond --tolerance")
+    p.add_argument("--tolerance", type=float, default=0.15,
+                   help="--check regression tolerance as a fraction "
+                        "(default 0.15 = 15%%)")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
     root = args.root or os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
+    if args.check:
+        return run_check(root, args.tolerance, quiet=args.quiet)
     rows = collect(root)
     md_path = os.path.join(root, "docs", "bench_trajectory.md")
     os.makedirs(os.path.dirname(md_path), exist_ok=True)

@@ -264,6 +264,9 @@ def _run_generate(args, log):
             "pages_used_at_drain": alloc.used_pages,
         },
         "jit_compiles_after_warmup": jit_after_warm,
+        # decode rows carry health verdicts too (inter-token p99 + KV
+        # occupancy objectives register at scheduler load)
+        "slo": _slo_block([_slo_sample("decode")], args.slo_spec),
     }
     log("decode: %.1f tok/s, inter-token p99 %sms, kv peak %d/%d pages, "
         "jit after warm %d, pages at drain %d"
@@ -280,6 +283,31 @@ def _run_generate(args, log):
 # ---------------------------------------------------------------------------
 # load phases
 # ---------------------------------------------------------------------------
+
+def _slo_sample(phase):
+    """Condensed SLO verdicts (one row per objective) sampled at a phase
+    boundary — the health trail a committed bench row carries."""
+    from mxnet_tpu.telemetry import slo as _slo
+
+    return {"phase": phase, "verdicts": [
+        {"slo": v["slo"], "healthy": v["healthy"], "page": v["page"],
+         "ticket": v["ticket"], "no_data": v["no_data"],
+         "burn_rate": v["burn_rate"], "value": v["value"],
+         "budget_remaining": v["budget_remaining"]}
+        for v in _slo.verdicts()]}
+
+
+def _slo_block(samples, spec_path):
+    """The output `slo` block: per-phase samples + the final full
+    verdicts (the machine-readable health stamp next to the latency
+    points)."""
+    from mxnet_tpu.telemetry import slo as _slo
+
+    return {"spec": spec_path,
+            "evaluator_running": _slo.running(),
+            "samples": samples,
+            "final": _slo.verdicts()}
+
 
 def _phase_breakdown(spans):
     """Aggregate collected span records into the per-phase latency table
@@ -679,6 +707,12 @@ def main(argv=None):
     p.add_argument("--kv-page-size", type=int, default=8)
     p.add_argument("--max-prompt", type=int, default=16)
     p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--slo-spec", default=None, metavar="PATH",
+                   help="JSON SLO spec (MXTPU_SLO_SPEC format) loaded "
+                        "before serving starts; the run's verdicts and "
+                        "burn rates land in the output's `slo` block "
+                        "either way (built-in objectives evaluate "
+                        "without a spec)")
     p.add_argument("--failover", action="store_true",
                    help="run the resilience row instead of the throughput "
                         "phases: closed-loop load over a --replicas pool "
@@ -700,6 +734,13 @@ def main(argv=None):
     from mxnet_tpu.serving import ModelRepository, ServingServer
 
     log = lambda msg: print("[serve_bench] " + msg, file=sys.stderr)  # noqa: E731
+
+    # committed BENCH rows carry machine-readable health verdicts, not
+    # just latency points: load any spec objectives up front and sample
+    # verdicts/burn rates per phase (docs/observability.md §SLOs)
+    if args.slo_spec:
+        telemetry.slo.load_spec(args.slo_spec)
+        telemetry.slo.start()
 
     if args.generate:
         return _run_generate(args, log)
@@ -770,6 +811,7 @@ def main(argv=None):
     log("  sequential: %.1f req/s p50=%.1fms p99=%.1fms"
         % (seq["rps"], seq["p50_ms"], seq["p99_ms"]))
     mem_phases["sequential"] = phase_mem()
+    slo_samples = [_slo_sample("sequential")]
 
     log("phase 2/3: batched closed-loop %d clients x%d ..."
         % (args.clients, args.requests))
@@ -778,6 +820,7 @@ def main(argv=None):
     log("  batched: %.1f req/s p50=%.1fms p99=%.1fms"
         % (batched["rps"], batched["p50_ms"], batched["p99_ms"]))
     mem_phases["batched"] = phase_mem()
+    slo_samples.append(_slo_sample("batched"))
 
     # mixed per-request example counts: every bucket gets traffic, and the
     # executable cache must already hold them all
@@ -797,6 +840,7 @@ def main(argv=None):
     log("  mixed: %.1f req/s; jit compiles during traffic: %d"
         % (mixed["rps"], jit_after_warm))
     mem_phases["mixed"] = phase_mem()
+    slo_samples.append(_slo_sample("mixed"))
 
     open_phase = None
     if args.open_rate > 0:
@@ -858,6 +902,10 @@ def main(argv=None):
                          "per_bucket": {str(b): f for b, f in
                                         sorted(model.bucket_memory.items())}},
         "memory_phases": mem_phases,
+        # machine-readable health verdicts sampled during the run
+        # (docs/observability.md §SLOs): committed BENCH rows say whether
+        # the run was healthy, not just how fast it went
+        "slo": _slo_block(slo_samples, args.slo_spec),
         "occupancy": {
             "batches": batches,
             "examples": examples,
