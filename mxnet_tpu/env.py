@@ -446,6 +446,47 @@ _var("MXTPU_SERVE_MAX_PROMPT", "int", 64,
      "prefill executable per bucket, so steady-state admission never "
      "compiles.")
 
+# -- elastic autoscaling (docs/serving.md §Autoscaling) ---------------------
+_var("MXTPU_AUTOSCALE", "bool", False,
+     "arm the elastic autoscaler in `tools/serve.py`: one named "
+     "controller thread per server (`serving.Autoscaler`) that consumes "
+     "`slo.verdicts()` and resizes replica pools in place — scale up on "
+     "sustained SLO breach (admitted against `MXTPU_SERVE_MEMORY_BUDGET` "
+     "headroom, warm via manifest prefetch), scale down + drain on idle. "
+     "Library callers construct `Autoscaler` directly; this gate is the "
+     "launcher's.")
+_var("MXTPU_AUTOSCALE_INTERVAL_MS", "float", 1000.0,
+     "autoscaler evaluation-lap period. Each lap reads the current SLO "
+     "verdicts and takes at most one scaling action per model.")
+_var("MXTPU_AUTOSCALE_UP_WINDOWS", "int", 2,
+     "consecutive breached evaluation laps (any paging SLO objective "
+     "scoped to the model) before a scale-up — the fast-side hysteresis: "
+     "one noisy window never adds a replica.")
+_var("MXTPU_AUTOSCALE_IDLE_S", "float", 60.0,
+     "sustained idle (seconds since the model's request counters last "
+     "moved — the windowed staleness view) before the autoscaler drains "
+     "one replica away, never below the model's `min_replicas`. Also the "
+     "\"cold\" threshold budget-pressure shrinking uses.")
+_var("MXTPU_AUTOSCALE_COOLDOWN_S", "float", 5.0,
+     "minimum seconds between two scaling actions on one model (up or "
+     "down), so a decision's effect — a warming replica, a drained one — "
+     "lands in the windows before the next decision reads them.")
+_var("MXTPU_AUTOSCALE_MIN_REPLICAS", "int", 1,
+     "default per-model replica floor for scale-down and budget-pressure "
+     "shrinking (`ModelRepository.load(min_replicas=)` overrides per "
+     "model).")
+_var("MXTPU_AUTOSCALE_MAX_REPLICAS", "int", 8,
+     "default per-model replica ceiling for scale-up "
+     "(`ModelRepository.load(max_replicas=)` overrides per model); a "
+     "breach at the ceiling records an `autoscale_blocked` decision "
+     "instead of growing.")
+_var("MXTPU_AUTOSCALE_EVICT_TTL_S", "float", 300.0,
+     "budget-pressure eviction TTL: a model idle longer than this (and "
+     "not `pinned`) may be UNLOADED by `ModelRepository.reclaim_memory` "
+     "when a new load or scale-up needs headroom — coldest first, after "
+     "shrinking pooled models toward their floors. Its persisted warmup "
+     "manifest makes a later reload warm in seconds.")
+
 # -- accelerator dial -------------------------------------------------------
 _var("MXTPU_DIAL_TIMEOUT_S", "float", 60.0,
      "`runtime.dial_devices`: seconds the PJRT device dial (`jax."

@@ -30,6 +30,7 @@ import logging
 import os
 import shutil
 import tempfile
+import threading
 import time
 import zlib
 
@@ -38,7 +39,8 @@ from ..base import MXNetError, atomic_writer, _fsync_dir
 from .. import telemetry
 
 __all__ = ["CheckpointManager", "maybe_inject_fault",
-           "maybe_inject_serving_fault", "fault_spec", "restart_generation"]
+           "maybe_inject_serving_fault", "maybe_inject_load_surge",
+           "fault_spec", "restart_generation"]
 
 _LOG = logging.getLogger("mxnet_tpu.resilience")
 
@@ -393,19 +395,35 @@ class CheckpointManager:
 #                                                   (deadline-propagation
 #                                                   test vector)
 #
+# Server-side surge action (armed per published model by the repository —
+# `maybe_inject_load_surge`; `after=` seconds into serving replaces the
+# when-condition):
+#
+#   MXTPU_FAULT_INJECT="load_surge@after=0,rps=200,duration=3"
+#                                                   synthetic OPEN-LOOP
+#                                                   burst injected at the
+#                                                   model's admission queue
+#                                                   (the autoscaler chaos
+#                                                   vector: drives queue
+#                                                   depth + p99 burn, sheds
+#                                                   count as 429/503)
+#
 # Conditions: step (required for training actions) / batch (required for
-# serving actions), rank / replica (default: any), gen (supervision or
-# replica-respawn generation, default 0 so a restarted run or respawned
-# replica does NOT re-trigger), code (exit status for kill/kill_replica,
-# default 42), ms (slow_reply delay, default 1000), dir (corrupt_ckpt
-# target; falls back to $MXTPU_CKPT_DIR). The training hook sits at the
-# trainer step boundary — after the optimizer update for `step` completes,
-# before anything later runs — which is exactly the crash window that loses
-# un-checkpointed progress.
+# serving actions) / after (required for load_surge, seconds), rank /
+# replica (default: any), gen (supervision or replica-respawn generation,
+# default 0 so a restarted run or respawned replica does NOT re-trigger),
+# code (exit status for kill/kill_replica, default 42), ms (slow_reply
+# delay, default 1000), rps / duration (load_surge arrival rate and
+# length, default 100/s for 2s), dir (corrupt_ckpt target; falls back to
+# $MXTPU_CKPT_DIR). The training hook sits at the trainer step boundary —
+# after the optimizer update for `step` completes, before anything later
+# runs — which is exactly the crash window that loses un-checkpointed
+# progress.
 
 _FAULT_EXIT_CODE = 42
 _TRAIN_ACTIONS = ("kill", "exc", "hang", "corrupt_ckpt")
 _SERVE_ACTIONS = ("kill_replica", "wedge_replica", "slow_reply")
+_SURGE_ACTIONS = ("load_surge",)
 _UNPARSED = object()
 _fault_cache = _UNPARSED
 
@@ -417,16 +435,16 @@ def fault_spec(env=None):
     test using it."""
     raw = (_env.raw("MXTPU_FAULT_INJECT") or "") if env is None else env
     entries = []
+    known = _TRAIN_ACTIONS + _SERVE_ACTIONS + _SURGE_ACTIONS
     for part in raw.replace(";", " ").split():
         action, _, conds = part.partition("@")
-        if action not in _TRAIN_ACTIONS + _SERVE_ACTIONS:
+        if action not in known:
             raise MXNetError("MXTPU_FAULT_INJECT: unknown action %r in %r "
-                             "(%s)" % (action, part,
-                                       "|".join(_TRAIN_ACTIONS
-                                                + _SERVE_ACTIONS)))
+                             "(%s)" % (action, part, "|".join(known)))
         entry = {"action": action, "step": None, "rank": None,
                  "gen": 0, "code": _FAULT_EXIT_CODE, "dir": None,
-                 "batch": None, "replica": None, "ms": 1000}
+                 "batch": None, "replica": None, "ms": 1000,
+                 "after": None, "rps": 100, "duration": 2}
         for cond in filter(None, conds.split(",")):
             k, eq, v = cond.partition("=")
             if not eq or k not in entry or k == "action":
@@ -438,7 +456,8 @@ def fault_spec(env=None):
                 raise MXNetError(
                     "MXTPU_FAULT_INJECT: %s= wants an integer, got %r in %r"
                     % (k, v, part)) from None
-        when = "batch" if action in _SERVE_ACTIONS else "step"
+        when = "after" if action in _SURGE_ACTIONS \
+            else ("batch" if action in _SERVE_ACTIONS else "step")
         if entry[when] is None:
             raise MXNetError("MXTPU_FAULT_INJECT: %r needs a %s= condition"
                              % (part, when))
@@ -480,8 +499,8 @@ def maybe_inject_fault(step):
     gen = restart_generation()
     rank = _current_rank()
     for e in _entries():
-        if e["action"] in _SERVE_ACTIONS:
-            continue  # fired by the replica-worker batch hook, not trainers
+        if e["action"] not in _TRAIN_ACTIONS:
+            continue  # fired by the serving hooks, not trainers
         if e["step"] != step or e["gen"] != gen:
             continue
         if e["rank"] is not None and e["rank"] != rank:
@@ -527,6 +546,82 @@ def _fire_serving(entry, batch, replica):
         import time as _t
 
         _t.sleep(entry["ms"] / 1e3)
+
+
+def maybe_inject_load_surge(model):
+    """Server-side chaos hook (`ModelRepository.add`): arm one synthetic
+    OPEN-LOOP burst thread per matching ``load_surge@after=,rps=,
+    duration=`` entry against the just-published model's admission queue.
+    The burst submits fire-and-forget single-example requests at ``rps``
+    for ``duration`` seconds — real admissions, so queue depth, the
+    `mxtpu_serve_request_seconds` histogram and the SLO burn rates all
+    move exactly as they would under a real traffic surge (the
+    autoscaler chaos vector, docs/serving.md §Autoscaling). Sheds
+    (429/503) are counted, not raised. Predict models only (a model
+    without `example_shapes` is skipped). Returns the threads armed."""
+    if not _entries():
+        return []
+    shapes = getattr(model, "example_shapes", None)
+    if not shapes:
+        return []
+    gen = restart_generation()
+    threads = []
+    for e in _entries():
+        if e["action"] not in _SURGE_ACTIONS or e["gen"] != gen:
+            continue
+        t = threading.Thread(target=_surge_worker, args=(model, dict(e)),
+                             name="mxtpu-fault-load-surge", daemon=True)
+        t.start()
+        threads.append(t)
+    return threads
+
+
+def _surge_worker(model, entry):
+    import numpy as _np
+
+    # lazy import: resilience must stay importable without the serving
+    # package loaded (model_repository imports THIS module at top level)
+    from ..serving.batcher import DrainingError, ModelUnavailableError
+
+    time.sleep(max(0, entry["after"]))
+    rps = max(1, entry["rps"])
+    duration = max(0, entry["duration"])
+    timeout_s = _env.get("MXTPU_SERVE_TIMEOUT_MS") / 1e3
+    dtypes = getattr(model, "input_dtypes", None) or {}
+    arrays = {k: _np.zeros((1,) + tuple(s), dtype=dtypes.get(k, "float32"))
+              for k, s in model.example_shapes.items()}
+    telemetry.record_event("fault_load_surge", model=model.name,
+                           version=model.version, rps=rps,
+                           duration_s=duration)
+    _LOG.warning("MXTPU_FAULT_INJECT firing: load_surge on %s/%s "
+                 "(%d rps for %ds)", model.name, model.version, rps,
+                 duration)
+    fired = shed = 0
+    period = 1.0 / rps
+    end = time.monotonic() + duration
+    next_t = time.monotonic()
+    while time.monotonic() < end:
+        try:
+            # open loop: submit and walk away — the resolution (or 504)
+            # lands on the request object nobody is waiting on
+            model._batcher.submit(arrays,
+                                  deadline=time.monotonic() + timeout_s)
+            fired += 1
+        except (DrainingError, ModelUnavailableError):
+            break  # model draining/unloaded under the surge: stop —
+            #        hammering a gone model for the remaining duration
+            #        would pollute the very shed/availability series the
+            #        chaos vector exists to exercise
+        except MXNetError:
+            shed += 1  # 429/503 shed: the admission layer doing its job
+        except Exception:
+            break  # model torn down under the surge: stop quietly
+        next_t += period
+        delay = next_t - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+    telemetry.record_event("fault_load_surge_done", model=model.name,
+                           version=model.version, fired=fired, shed=shed)
 
 
 def _fire(entry, step, rank):

@@ -24,15 +24,24 @@ high-occupancy inference (docs/serving.md):
     KV cache: `GenerateScheduler` (token-level join/leave),
     `KVPageAllocator`, the `TransformerLMEngine` incremental LM runner
     and `ServedLM` (``POST /v1/models/<name>:generate``) — Orca-style
-    iteration scheduling + PagedAttention, TPU-native (generate.py).
+    iteration scheduling + PagedAttention, TPU-native (generate.py);
+  * `Autoscaler` — the elastic loop over all of the above: SLO-verdict
+    driven in-place replica scale-up (admitted against the memory
+    budget, warm via manifest prefetch), idle scale-down with drain,
+    and budget-pressure bin-packing in the repository — shrink cold
+    pools, evict idle models — instead of flat 507s (autoscaler.py,
+    docs/serving.md §Autoscaling).
 
-Launch with ``python tools/serve.py`` (``--replicas N`` for a pool);
-load-test with ``python tools/serve_bench.py`` (``--failover`` for the
-chaos row). All knobs are typed ``MXTPU_SERVE_*`` variables in
-`mxnet_tpu.env` (docs/env_vars.md).
+Launch with ``python tools/serve.py`` (``--replicas N`` for a pool,
+``--autoscale`` for the elastic loop); load-test with ``python
+tools/serve_bench.py`` (``--failover`` for the chaos row,
+``--autoscale`` for the surge row). All knobs are typed
+``MXTPU_SERVE_*`` / ``MXTPU_AUTOSCALE_*`` variables in `mxnet_tpu.env`
+(docs/env_vars.md).
 """
 from __future__ import annotations
 
+from .autoscaler import Autoscaler  # noqa: F401
 from .batcher import (  # noqa: F401
     DeadlineExceededError, DrainingError, DynamicBatcher,
     MemoryBudgetError, ModelUnavailableError, OverloadedError,
@@ -50,6 +59,7 @@ from .replica_pool import ReplicaPool  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
+    "Autoscaler",
     "DynamicBatcher", "ServeRequest", "ModelRepository", "ServedModel",
     "ServingServer", "ReplicaPool", "ServingError", "QueueFullError",
     "DeadlineExceededError", "ModelUnavailableError", "DrainingError",

@@ -125,9 +125,18 @@ class MemoryBudgetError(ServingError):
     `memory_analysis()` figures, docs/observability.md §Memory) exceeds
     ``MXTPU_SERVE_MEMORY_BUDGET``: the load is rejected BEFORE publish —
     at admission time, deterministically — instead of letting the
-    process OOM under traffic. 507 Insufficient Storage."""
+    process OOM under traffic. 507 Insufficient Storage.
+
+    ``details`` carries the machine-readable footprint breakdown
+    (requested bytes, per-resident-model ``effective_memory_bytes``,
+    budget, headroom, shortfall) so an operator can see WHAT to evict;
+    the HTTP layer ships it in the 507 body."""
 
     status = 507
+
+    def __init__(self, msg, details=None):
+        super().__init__(msg)
+        self.details = details
 
 
 # ---------------------------------------------------------------------------

@@ -971,6 +971,10 @@ class ServedLM:
         self._pool = pool
         self.meta = dict(meta or {})
         self.loaded_at = time.time()
+        # autoscaling policy (docs/serving.md §Autoscaling)
+        self.min_replicas = None
+        self.max_replicas = None
+        self.pinned = False
         self.warmed = True
         if scheduler is not None:
             self.generate_info = dict(scheduler.engine.geometry())
@@ -1022,6 +1026,12 @@ class ServedLM:
             except Exception:
                 pool.close()
                 raise
+            # router-side SLOs over the pool's own admission→resolution
+            # latency/volume series (the workers' scheduler objectives
+            # are per-replica-process): THE breach signal the autoscaler
+            # reads for pooled LMs (docs/serving.md §Autoscaling).
+            # queue_depth=None: the router has no queue-depth gauge
+            _slo.wire_serving_objectives("%s/%d" % (name, version))
             return ServedLM(name, version, pool=pool, info=info,
                             meta={"artifact": "generate",
                                   "path": None if prefix is None
@@ -1046,6 +1056,9 @@ class ServedLM:
 
     @property
     def resident_copies(self):
+        # live pool size, so budget math tracks autoscaler resizes
+        if self._pool is not None:
+            return max(1, int(self._pool.size))
         try:
             return max(1, int(self.meta.get("replicas") or 1))
         except (TypeError, ValueError):
@@ -1129,6 +1142,9 @@ class ServedLM:
             if drain:
                 drained = self._pool.drain_generate(timeout)
             self._pool.close()
+            # retire the router-side objectives wired at pooled load —
+            # verdicts for a gone model are noise on /statusz
+            _slo.unregister_model("%s/%d" % (self.name, self.version))
         return drained
 
     def describe(self):

@@ -35,6 +35,7 @@ from .. import compile as _compile
 from .. import env as _env
 from .. import telemetry
 from ..base import MXNetError
+from ..parallel import resilience as _resilience
 from ..telemetry import memory as _tm_memory
 from .batcher import (DynamicBatcher, MemoryBudgetError,
                       ModelUnavailableError, drain_timeout_s,
@@ -73,6 +74,12 @@ class ServedModel:
                              for k in self.example_shapes}
         self.meta = dict(meta or {})
         self.loaded_at = time.time()
+        # autoscaling policy (docs/serving.md §Autoscaling): None defers
+        # to the MXTPU_AUTOSCALE_{MIN,MAX}_REPLICAS defaults; `pinned`
+        # exempts the model from budget-pressure eviction
+        self.min_replicas = None
+        self.max_replicas = None
+        self.pinned = False
         self.warmed = False
         self.warm_seconds = None
         self.manifest_id = None     # warmup-manifest id (artifact models)
@@ -284,7 +291,11 @@ class ServedModel:
     def resident_copies(self):
         """How many full copies of the model are resident: each replica
         worker process warms its own weights + executables, so a pooled
-        model costs N× its single-copy footprint."""
+        model costs N× its single-copy footprint. Read LIVE from the
+        pool so budget math tracks autoscaler resizes, not the size the
+        model loaded with."""
+        if self._pool is not None:
+            return max(1, int(self._pool.size))
         try:
             return max(1, int(self.meta.get("replicas") or 1))
         except (TypeError, ValueError):
@@ -549,7 +560,8 @@ class ModelRepository:
     def load(self, name, path, version=None, input_shapes=None,
              input_dtypes=None, ctx=None, max_batch=None, max_delay_ms=None,
              queue_depth=None, warm=True, replicas=0, generate=False,
-             generate_opts=None, **pool_kwargs):
+             generate_opts=None, min_replicas=None, max_replicas=None,
+             pinned=False, **pool_kwargs):
         """Load an artifact as ``name/version`` (auto-increment when
         ``version`` is None) and publish it after warmup. The version is
         RESERVED for the whole load, so two concurrent loads of the same
@@ -567,7 +579,15 @@ class ModelRepository:
         (docs/serving.md §Generation; ``generate_opts`` forwards KV/
         bucket geometry to `TransformerLMEngine`). The KV page pool is
         part of the model footprint, so the memory-budget admission in
-        `add` 507s a load whose pages cannot fit."""
+        `add` 507s a load whose pages cannot fit.
+
+        ``min_replicas`` / ``max_replicas`` bound the autoscaler for
+        this model (None = the ``MXTPU_AUTOSCALE_{MIN,MAX}_REPLICAS``
+        defaults); ``pinned=True`` exempts it from budget-pressure
+        eviction. A load that would overflow the memory budget first
+        tries to reclaim residency (shrink cold pools, evict
+        idle-beyond-TTL unpinned models) before 507ing
+        (docs/serving.md §Autoscaling)."""
         with self._lock:
             have = self._models.get(name, {})
             reserved = [v for (n, v) in self._loading if n == name]
@@ -598,8 +618,11 @@ class ModelRepository:
                     name, version, path, replicas=int(replicas or 0),
                     queue_depth=queue_depth, pool_kwargs=pool_kwargs,
                     **opts)
+                model.min_replicas = min_replicas
+                model.max_replicas = max_replicas
+                model.pinned = bool(pinned)
                 try:
-                    return self.add(model)
+                    return self._add_with_reclaim(model)
                 except Exception:
                     model.close(drain=False, timeout=0)
                     raise
@@ -629,10 +652,13 @@ class ModelRepository:
                     # drop staged prefetch entries the warm never claimed
                     # (stale manifest rows must not stay pinned)
                     _compile.clear_staged()
+                model.min_replicas = min_replicas
+                model.max_replicas = max_replicas
+                model.pinned = bool(pinned)
                 # memory-budget admission happens inside add(), under the
-                # repository lock — a rejected load raises the typed
-                # MemoryBudgetError here and tears the model down below
-                return self.add(model)
+                # repository lock; a short load first reclaims cold
+                # residency (shrink/evict) before the 507 stands
+                return self._add_with_reclaim(model)
             except Exception:
                 model.close(drain=False, timeout=0)  # no thread/weight leak
                 raise
@@ -648,13 +674,29 @@ class ModelRepository:
         over-budget message for warn-only mode, raises `MemoryBudgetError`
         (HTTP 507) otherwise; unknown footprints (no figures recorded —
         accounting off, or a backend without memory_analysis) never
-        block a load."""
+        block a load.
+
+        The rejection carries a full footprint breakdown — requested
+        bytes, every resident model's ``effective_memory_bytes``, the
+        budget, headroom and shortfall — in the message AND a
+        machine-readable ``details`` dict the HTTP 507 body ships, so an
+        operator can see WHAT to evict, not just that nothing fit."""
         limit, warn_only = _tm_memory.serve_memory_budget()
         needed = model.effective_memory_bytes  # N replicas = N copies
         if not limit or not needed:
             return None
-        resident = sum(m.effective_memory_bytes or 0
-                       for vs in self._models.values() for m in vs.values())
+        resident = 0
+        resident_models = []
+        for vs in self._models.values():
+            for m in vs.values():
+                eff = m.effective_memory_bytes or 0
+                resident += eff
+                resident_models.append({
+                    "model": "%s/%d" % (m.name, m.version),
+                    "effective_bytes": eff or None,
+                    "copies": m.resident_copies,
+                    "pinned": bool(getattr(m, "pinned", False)),
+                })
         total = resident + needed
         if total <= limit:
             return None
@@ -663,14 +705,147 @@ class ModelRepository:
             footprint_bytes=needed, copies=model.resident_copies,
             resident_bytes=resident, budget_bytes=limit,
             action="warn" if warn_only else "reject")
+        details = {
+            "requested_bytes": needed,
+            "per_copy_bytes": model.memory_bytes,
+            "copies": model.resident_copies,
+            "budget_bytes": limit,
+            "resident_bytes": resident,
+            "headroom_bytes": max(0, limit - resident),
+            "shortfall_bytes": total - limit,
+            "resident_models": resident_models,
+        }
         msg = ("loading %s/%d needs %d bytes (%d bytes/copy x %d "
-               "replica(s); %d already resident); budget "
-               "MXTPU_SERVE_MEMORY_BUDGET=%d cannot fit it"
+               "replica(s)); budget MXTPU_SERVE_MEMORY_BUDGET=%d has %d "
+               "bytes headroom (%d resident), short %d bytes — resident: "
+               "%s"
                % (model.name, model.version, needed, model.memory_bytes,
-                  model.resident_copies, resident, limit))
+                  model.resident_copies, limit, details["headroom_bytes"],
+                  resident, details["shortfall_bytes"],
+                  ", ".join("%s=%s bytes (x%d%s)"
+                            % (r["model"], r["effective_bytes"],
+                               r["copies"],
+                               ", pinned" if r["pinned"] else "")
+                            for r in resident_models) or "nothing"))
         if not warn_only:
-            raise MemoryBudgetError(msg)
+            raise MemoryBudgetError(msg, details=details)
         return msg
+
+    def reclaim_memory(self, needed_bytes, exclude=None, reason="load",
+                       now=None):
+        """Budget-pressure bin-packing (docs/serving.md §Autoscaling):
+        try to free at least ``needed_bytes`` of budgeted residency so a
+        new load (or an autoscaler scale-up) fits, instead of answering
+        a flat 507 while cold models pin HBM. Two phases, coldest first
+        (LRU by the windowed request-rate staleness of each model's
+        request counters):
+
+          1. **shrink** idle pooled models toward their ``min_replicas``
+             (`ReplicaPool.remove_replica(drain=True)` — zero request
+             loss, each removal frees one ``memory_bytes`` copy);
+          2. **evict** whole models that are unpinned and idle beyond
+             ``MXTPU_AUTOSCALE_EVICT_TTL_S`` (a drained `unload`; the
+             model's warmup manifest persists, so a future reload warms
+             in seconds).
+
+        Emits ``autoscale_down`` / ``autoscale_evict`` decisions. Never
+        touches ``exclude`` (the model being admitted) and never runs
+        under the repository lock — drains block. Returns bytes freed."""
+        from . import autoscaler as _asc
+
+        needed = int(needed_bytes or 0)
+        if needed <= 0:
+            return 0
+        if now is None:
+            now = time.time()
+        idle_s = _env.get("MXTPU_AUTOSCALE_IDLE_S")
+        ttl_s = _env.get("MXTPU_AUTOSCALE_EVICT_TTL_S")
+        freed = 0
+        candidates = [m for m in self.models()
+                      if "%s/%d" % (m.name, m.version) != exclude]
+        # coldest first: the model whose request counters have been
+        # still the longest gives up residency first
+        candidates.sort(key=lambda m: -_asc.idle_age_s(m, now))
+        for m in candidates:
+            if freed >= needed:
+                break
+            pool = getattr(m, "pool", None)
+            per_copy = getattr(m, "memory_bytes", None)
+            if pool is None or not per_copy:
+                continue
+            if _asc.idle_age_s(m, now) < idle_s:
+                continue  # hot pools keep their replicas
+            label = "%s/%d" % (m.name, m.version)
+            floor = _asc.min_replicas(m)
+            while pool.size > floor and freed < needed:
+                try:
+                    # floor re-checked atomically inside remove_replica:
+                    # a concurrent autoscaler drain racing this loop
+                    # must not shrink below the model's min_replicas
+                    # (and the loser's MXNetError must not escape as a
+                    # 400 where the caller expects the enriched 507)
+                    replica = pool.remove_replica(drain=True, floor=floor)
+                except MXNetError:
+                    break  # lost the race: this pool is done shrinking
+                freed += per_copy
+                _asc.record_decision(
+                    "down", label, reason="budget_pressure",
+                    trigger=reason, replica=replica, size=pool.size,
+                    freed_bytes=per_copy)
+        for m in candidates:
+            if freed >= needed:
+                break
+            if getattr(m, "pinned", False):
+                continue
+            eff = getattr(m, "effective_memory_bytes", None)
+            if not eff:
+                continue
+            age = _asc.idle_age_s(m, now)
+            if age < ttl_s:
+                continue
+            label = "%s/%d" % (m.name, m.version)
+            try:
+                self.unload(m.name, m.version)
+            except ModelUnavailableError:
+                continue  # a concurrent unload beat us to it
+            freed += eff
+            _asc.record_decision(
+                "evict", label, reason=reason, idle_s=round(age, 3),
+                freed_bytes=eff)
+        return freed
+
+    def _add_with_reclaim(self, model):
+        """Publish, and on a budget rejection try to reclaim the
+        shortfall (shrink cold pools / evict idle models) ONCE before
+        retrying — the retry's admission check runs fresh under the
+        lock, so concurrent loads stay consistent. A load that still
+        cannot fit raises the enriched 507 and records an
+        ``autoscale_blocked`` decision."""
+        from . import autoscaler as _asc
+
+        label = "%s/%d" % (model.name, model.version)
+        try:
+            return self.add(model)
+        except MemoryBudgetError as e:
+            details = getattr(e, "details", None) or {}
+            shortfall = details.get("shortfall_bytes") \
+                or model.effective_memory_bytes or 0
+            freed = self.reclaim_memory(shortfall, exclude=label,
+                                        reason="load")
+            if freed > 0:
+                try:
+                    return self.add(model)
+                except MemoryBudgetError as e2:
+                    _asc.record_decision(
+                        "blocked", label, reason="load_budget",
+                        freed_bytes=freed,
+                        shortfall_bytes=(getattr(e2, "details", None)
+                                         or {}).get("shortfall_bytes"))
+                    raise
+            _asc.record_decision(
+                "blocked", label, reason="load_budget", freed_bytes=0,
+                shortfall_bytes=shortfall)
+            raise
 
     def add(self, model):
         """Publish an already-built ServedModel (tests inject stubs here).
@@ -693,6 +868,10 @@ class ModelRepository:
                 "%s (warn-only budget: publishing anyway)", over_budget)
         telemetry.record_event("serve_model_load", model=model.name,
                                version=model.version)
+        # chaos hook: a `load_surge@` MXTPU_FAULT_INJECT entry arms a
+        # synthetic open-loop burst against this model's admission queue
+        # (docs/fault_tolerance.md §4 — the autoscaler test vector)
+        _resilience.maybe_inject_load_surge(model)
         return model
 
     def get(self, name, version=None):

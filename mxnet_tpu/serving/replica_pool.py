@@ -70,7 +70,7 @@ class _Slot:
     """Mutable state for one replica slot (owned by its dispatch thread;
     `state`/`conn` transitions are published under the pool lock)."""
 
-    def __init__(self, replica_id, proc):
+    def __init__(self, replica_id, proc, joining=False):
         self.id = replica_id
         self.proc = proc          # ReplicaProcess (generation counter)
         self.state = _DEAD
@@ -79,6 +79,15 @@ class _Slot:
         self.ready_info = None
         self.consecutive_restarts = 0
         self.msg_id = 0
+        self.thread = None        # this slot's dispatch thread
+        # resize protocol (docs/serving.md §Autoscaling): `stop` asks the
+        # dispatch thread to finish its in-flight work and exit (set under
+        # the pool lock; the thread polls it between batches); `joining`
+        # marks a scale-up member that has not reported ready yet — the
+        # degraded-admission gate must not shed while a NEW replica warms
+        # (only when an ESTABLISHED one is lost)
+        self.stop = False
+        self.joining = joining
         # generate mode: stats round trips requested by the api thread,
         # serviced by this slot's dispatch loop (deque append/popleft are
         # GIL-atomic; waiter events close the handoff)
@@ -155,9 +164,31 @@ class ReplicaPool:
         self._gen_live = set()    # admitted + unresolved (guarded: _gen_cv)
 
         labels = {"model": self.model}
+        if self._generate:
+            # router-side admission volume + end-to-end latency for
+            # pooled GENERATE models (predict pools get these from their
+            # DynamicBatcher; the LM scheduler's copies live in the
+            # worker processes under per-replica labels) — without them
+            # the autoscaler's idle clock and p99 objective would read a
+            # busy LM pool as eternally cold (docs/serving.md
+            # §Autoscaling)
+            self._m_gen_reqs = telemetry.counter(
+                "mxtpu_serve_requests_total", labels)
+            self._m_gen_request_s = telemetry.histogram(
+                "mxtpu_serve_request_seconds", labels)
+            self._m_gen_shed = {
+                reason: telemetry.counter(
+                    "mxtpu_serve_rejected_total",
+                    {"model": self.model, "reason": reason})
+                for reason in ("queue_full", "shed")}
         self._m_healthy = telemetry.gauge("mxtpu_serve_pool_healthy", labels)
         self._m_size = telemetry.gauge("mxtpu_serve_pool_size", labels)
+        # the autoscaler-facing replica-count gauge (same value as
+        # pool_size, named for the scaling loop's dashboards — the series
+        # a `mxtpu_autoscale_decisions_total` spike should move)
+        self._m_replicas = telemetry.gauge("mxtpu_serve_replicas", labels)
         self._m_size.set(self.size)
+        self._m_replicas.set(self.size)
         self._m_failover = telemetry.counter("mxtpu_serve_failover_total",
                                              labels)
         self._m_requeued = telemetry.counter(
@@ -176,27 +207,26 @@ class ReplicaPool:
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("127.0.0.1", 0))
-        self._listener.listen(replicas * 2)
+        self._listener.listen(max(8, replicas * 2))
         self._listener.settimeout(0.25)
         addr = self._listener.getsockname()
 
+        # kept for in-place resize: add_replica spawns new slots with the
+        # SAME serving spec the pool was built with
+        self._addr = (addr[0], addr[1])
+        self._worker_args = list(worker_args)
+        self._extra_env = extra_env
+        self._teardown_grace = teardown_grace
+        self._next_id = 0
+
+        # `_slots` is REPLACED wholesale (never mutated in place) under
+        # the pool lock, so lock-free readers iterate a consistent
+        # snapshot even while a resize is landing; slot/gauge creation
+        # holds the lock for the same discipline add_replica follows
         self._slots = []
-        for k in range(replicas):
-            proc = ReplicaProcess(self.model, k, (addr[0], addr[1]),
-                                  worker_args, extra_env=extra_env,
-                                  teardown_grace=teardown_grace,
-                                  token=self._token)
-            slot = _Slot(k, proc)
-            self._m_inflight[k] = telemetry.gauge(
-                "mxtpu_serve_replica_inflight",
-                {"model": self.model, "replica": str(k)})
-            # restart generation per replica, published as a gauge so the
-            # lock-free /statusz page can show pool health generations
-            # without touching the pool's own locked describe()
-            self._m_generation[k] = telemetry.gauge(
-                "mxtpu_serve_replica_generation",
-                {"model": self.model, "replica": str(k)})
-            self._slots.append(slot)
+        with self._lock:
+            for _ in range(replicas):
+                self._slots = self._slots + [self._new_slot()]
 
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
@@ -204,12 +234,50 @@ class ReplicaPool:
         self._accept_thread.start()
         self._threads = []
         for slot in self._slots:
-            t = threading.Thread(target=self._replica_loop, args=(slot,),
-                                 daemon=True,
-                                 name="mxtpu-pool-%s-r%d" % (self.model,
-                                                             slot.id))
-            self._threads.append(t)
-            t.start()
+            self._start_slot_thread(slot)
+
+    def _new_slot(self, joining=False):
+        """Build one slot + its telemetry gauges (caller publishes it into
+        `_slots` and starts its dispatch thread)."""
+        k = self._next_id
+        self._next_id += 1
+        proc = ReplicaProcess(self.model, k, self._addr, self._worker_args,
+                              extra_env=self._extra_env,
+                              teardown_grace=self._teardown_grace,
+                              token=self._token)
+        slot = _Slot(k, proc, joining=joining)
+        self._m_inflight[k] = telemetry.gauge(
+            "mxtpu_serve_replica_inflight",
+            {"model": self.model, "replica": str(k)})
+        # restart generation per replica, published as a gauge so the
+        # lock-free /statusz page can show pool health generations
+        # without touching the pool's own locked describe()
+        self._m_generation[k] = telemetry.gauge(
+            "mxtpu_serve_replica_generation",
+            {"model": self.model, "replica": str(k)})
+        return slot
+
+    def _start_slot_thread(self, slot):
+        t = threading.Thread(target=self._replica_loop, args=(slot,),
+                             daemon=True,
+                             name="mxtpu-pool-%s-r%d" % (self.model,
+                                                         slot.id))
+        slot.thread = t
+        self._threads.append(t)  # mxlint: gil-atomic — append-only roster
+        t.start()
+
+    def _slot_by_id(self, replica_id):
+        for s in self._slots:
+            if s.id == replica_id:
+                return s
+        return None
+
+    def _resize_work_queue(self):
+        """Track the bounded dispatch handoff to the live pool size (one
+        buffered batch per replica — the backpressure contract)."""
+        with self._work.mutex:
+            self._work.maxsize = max(1, self.size)
+            self._work.not_full.notify_all()
 
     # -- batcher wiring ----------------------------------------------------
     def bind(self, batcher):
@@ -239,13 +307,26 @@ class ReplicaPool:
         lock on every submit. Healthy pool: admit (the depth check still
         applies). Degraded pool: scale the admissible queue to the healthy
         fraction. Dead pool: shed everything, Retry-After = the respawn
-        backoff horizon."""
-        healthy = self.healthy_count
-        if healthy >= self.size:
+        backoff horizon.
+
+        `size`/`expected_count` are read LIVE on every call, so after an
+        autoscaler resize the shed quota and the ``Retry-After =
+        ceil(N/h)`` horizon are computed against the POST-resize pool —
+        never a size captured before the resize landed. A scale-up member
+        that has not warmed yet (`joining`) is excluded from `expected`:
+        growing the pool must not trigger shedding while the new replica
+        compiles."""
+        with self._lock:  # ONE acquisition per admission (hot path)
+            healthy = sum(1 for s in self._slots
+                          if s.state in (_READY, _BUSY))
+            expected = max(1, self.size - sum(1 for s in self._slots
+                                              if s.joining))
+        if healthy >= expected:
             return None
         if healthy == 0:
+            slots = self._slots  # consistent snapshot (replaced wholesale)
             eta = max((backoff_s(s.consecutive_restarts, self._backoff_ms)
-                       for s in self._slots), default=1.0)
+                       for s in slots), default=1.0)
             return OverloadedError(
                 "model %r has no healthy replicas (respawn in progress)"
                 % self.model, retry_after=max(1.0, eta))
@@ -253,14 +334,118 @@ class ReplicaPool:
         # small queue depths would otherwise floor the quota to 0 and turn
         # a single-replica loss into a total outage
         allowed = max(1, int(self._batcher.queue_depth * healthy
-                             / self.size)) \
+                             / expected)) \
             if self._batcher is not None else 0
         if queued_len >= allowed:
             return OverloadedError(
                 "model %r is degraded (%d/%d replicas healthy; queue "
-                "scaled to %d)" % (self.model, healthy, self.size, allowed),
+                "scaled to %d)" % (self.model, healthy, expected, allowed),
                 retry_after=math.ceil(self.size / healthy))
         return None
+
+    # -- in-place resize (docs/serving.md §Autoscaling) --------------------
+    def add_replica(self):
+        """Grow the pool by one replica IN PLACE: spawn a fresh worker
+        (same serving spec, fresh id) and start its dispatch thread. The
+        new member joins the rotation when its warm finishes (a warmup-
+        manifest prefetch makes that seconds, docs/compile_cache.md);
+        until then the admission gate treats the pool at its pre-grow
+        capacity instead of shedding. Returns the new replica id."""
+        with self._lock:
+            if self._stop:
+                raise MXNetError("replica pool %r is shut down" % self.model)
+            slot = self._new_slot(joining=True)
+            self._slots = self._slots + [slot]
+            self.size += 1
+            size = self.size
+        self._resize_work_queue()
+        self._m_size.set(size)
+        self._m_replicas.set(size)
+        self._start_slot_thread(slot)
+        telemetry.record_event("serve_replica_add", model=self.model,
+                               replica=slot.id, size=size)
+        return slot.id
+
+    def remove_replica(self, replica_id=None, drain=True, timeout=None,
+                       floor=1):
+        """Shrink the pool by one replica IN PLACE with zero request
+        loss: the victim (default: the newest slot) stops taking new work
+        immediately, finishes what it has in flight, and is then torn
+        down. If the worker dies mid-drain its unresolved work rides the
+        existing exactly-once failover re-enqueue instead of being lost.
+        ``drain=False`` (or a drain past ``timeout``) forces teardown —
+        in-flight work then fails over. ``floor`` is checked UNDER the
+        pool lock, so concurrent removers (the autoscaler's idle drain
+        racing a load's budget-pressure reclaim) cannot both pass a
+        caller-side check and shrink below a model's ``min_replicas``.
+        Returns the removed replica id."""
+        if timeout is None:
+            timeout = drain_timeout_s()
+        floor = max(1, int(floor))
+        with self._lock:
+            if self.size <= floor:
+                raise MXNetError(
+                    "replica pool %r cannot shrink below %d replica(s)"
+                    % (self.model, floor))
+            slots = self._slots
+            if replica_id is None:
+                slot = slots[-1]
+            else:
+                slot = next((s for s in slots if s.id == replica_id), None)
+                if slot is None:
+                    raise MXNetError("replica pool %r has no replica %r"
+                                     % (self.model, replica_id))
+            # published BEFORE the drain: admission/quota math and the
+            # healthy gauge see the post-resize pool immediately
+            slot.stop = True
+            self._slots = [s for s in slots if s is not slot]
+            self.size -= 1
+            size = self.size
+        self._resize_work_queue()
+        self._m_size.set(size)
+        self._m_replicas.set(size)
+        self._set_healthy_gauge()
+        with self._gen_cv:
+            self._gen_cv.notify_all()  # wake an idle generate dispatch wait
+        t = slot.thread
+        if not drain:
+            # no-drain removal: tear the worker down now; the dispatch
+            # thread ejects on the dead socket and fails in-flight work
+            # over exactly once
+            slot.proc.teardown()
+        if t is not None:
+            t.join(timeout=max(0.1, timeout))
+            if t.is_alive():
+                # drain overran its budget: force the worker out — the
+                # dispatch thread sees the dead socket, ejects, and fails
+                # any in-flight work over exactly once
+                slot.proc.teardown()
+                t.join(timeout=10.0)
+        conn = slot.conn
+        if conn is not None:
+            try:
+                send_msg(conn, {"kind": "shutdown"})
+            except OSError:
+                pass
+        slot.proc.teardown()
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        # retire the removed slot's per-replica series — a gauge for a
+        # replica that no longer exists would read as a ghost on /statusz
+        reg = telemetry.get_registry()
+        for name in ("mxtpu_serve_replica_inflight",
+                     "mxtpu_serve_replica_generation"):
+            reg.remove(name, {"model": self.model, "replica": str(slot.id)})
+        with self._lock:
+            self._m_inflight.pop(slot.id, None)
+            self._m_generation.pop(slot.id, None)
+        telemetry.record_event("serve_replica_remove", model=self.model,
+                               replica=slot.id, size=size,
+                               drained=not (t is not None and t.is_alive()))
+        return slot.id
 
     # -- generate-mode routing (docs/serving.md §Generation) ---------------
     def submit_generate(self, req):
@@ -278,16 +463,19 @@ class ReplicaPool:
                 eta = max((backoff_s(s.consecutive_restarts,
                                      self._backoff_ms)
                            for s in self._slots), default=1.0)
+                self._m_gen_shed["shed"].inc()
                 raise OverloadedError(
                     "model %r has no healthy replicas (respawn in "
                     "progress)" % self.model, retry_after=max(1.0, eta))
             if len(self._gen_pending) >= self._gen_queue_depth:
+                self._m_gen_shed["queue_full"].inc()
                 raise QueueFullError(
                     "generation queue for %r is full (%d requests; "
                     "MXTPU_SERVE_QUEUE_DEPTH)"
                     % (self.model, self._gen_queue_depth))
             self._gen_pending.append(req)
             self._gen_live.add(req)
+            self._m_gen_reqs.inc()
             self._gen_cv.notify()
         return req
 
@@ -330,7 +518,9 @@ class ReplicaPool:
         """One stats round trip to a replica worker (KV-page occupancy,
         post-warm jit count — the serve_bench/test evidence hooks).
         Returns the worker's stats dict, or None on timeout/eject."""
-        slot = self._slots[replica_id]
+        slot = self._slot_by_id(replica_id)
+        if slot is None:
+            return None
         waiter = {"event": threading.Event(), "result": None}
         slot.stats_requests.append(waiter)
         with self._gen_cv:
@@ -426,8 +616,11 @@ class ReplicaPool:
             while not self._stop:
                 # drain the routing queue up to the outstanding window
                 # BEFORE blocking on the socket: a burst of admissions
-                # must not pay one recv timeout per dispatched request
-                while len(outstanding) < self._gen_outstanding:
+                # must not pay one recv timeout per dispatched request.
+                # A draining slot (removal in progress) admits nothing
+                # new but keeps servicing replies for what it dispatched.
+                while len(outstanding) < self._gen_outstanding \
+                        and not slot.stop:
                     req = None
                     with self._gen_cv:
                         if self._gen_pending:
@@ -476,6 +669,8 @@ class ReplicaPool:
                                         "id": slot.msg_id})
                     except OSError:
                         return ("died_mid_batch", unresolved())
+                if slot.stop and not outstanding:
+                    return None  # removal drain complete: nothing in flight
                 try:
                     msg = recv_msg(
                         conn,
@@ -532,6 +727,14 @@ class ReplicaPool:
                                 attrs={"replica": slot.id,
                                        "tokens":
                                        len(msg.get("tokens") or ())})
+                        # router-side end-to-end latency (admission →
+                        # resolution): the series the pooled-LM p99
+                        # objective and the autoscaler read
+                        self._m_gen_request_s.observe(
+                            max(0.0, time.monotonic() - r._t_submit),
+                            exemplar=r.trace.trace_id
+                            if r.trace is not None and r.trace.recorded
+                            else None)
                         r._resolve(outputs=list(msg.get("tokens") or []),
                                    finish_reason=msg.get("finish_reason"))
                         # the generation proved itself: reset backoff
@@ -560,6 +763,16 @@ class ReplicaPool:
         with self._lock:
             return sum(1 for s in self._slots
                        if s.state in (_READY, _BUSY))
+
+    @property
+    def expected_count(self):
+        """How many replicas the pool is SUPPOSED to have serving right
+        now: the live size minus scale-up members still warming. The
+        degraded-admission denominator — a joining replica must not
+        count as a loss."""
+        with self._lock:
+            return max(1, self.size - sum(1 for s in self._slots
+                                          if s.joining))
 
     def wait_ready(self, timeout=None):
         """Block until every replica reported ready once (load + warm).
@@ -592,7 +805,13 @@ class ReplicaPool:
 
     def replica_pid(self, replica_id):
         """Pid of a replica's current process (serve_bench chaos hook)."""
-        return self._slots[replica_id].proc.pid
+        slot = self._slot_by_id(replica_id)
+        return slot.proc.pid if slot is not None else None
+
+    def replica_ids(self):
+        """Live replica ids (sparse after resizes — ids never recycle)."""
+        with self._lock:
+            return [s.id for s in self._slots]
 
     # -- lifecycle ---------------------------------------------------------
     def close(self, timeout=5.0):
@@ -675,8 +894,9 @@ class ReplicaPool:
             k = hello.get("replica")
             gen = hello.get("generation")
             with self._lock:
-                slot = self._slots[k] if isinstance(k, int) \
-                    and 0 <= k < self.size else None
+                # slots are found BY ID, not index: after resizes the id
+                # space is sparse (removed ids are never reused)
+                slot = self._slot_by_id(k) if isinstance(k, int) else None
                 if slot is None or gen != slot.proc.generation \
                         or slot.conn is not None:
                     slot = None
@@ -694,7 +914,7 @@ class ReplicaPool:
         supervision — an escaped exception would silently shrink the pool
         forever (no eject event, no respawn), so any surprise ejects and
         respawns like a replica death."""
-        while not self._stop:
+        while not self._stop and not slot.stop:
             try:
                 # spawn the next generation
                 slot.conn_event.clear()
@@ -706,16 +926,20 @@ class ReplicaPool:
                     "serve_replica_spawn", model=self.model,
                     replica=slot.id, generation=gen, pid=slot.proc.pid)
                 if not self._await_ready(slot):
-                    if self._stop:
+                    if self._stop or slot.stop:
                         return
                     self._eject(slot, "spawn_failed", batch=None)
                     continue
-                # serve until ejection or shutdown
+                # serve until ejection, removal drain, or shutdown
                 reason = self._serve_generate(slot) if self._generate \
                     else self._serve_generation(slot)
                 if self._stop or reason is None:
                     return
+                # a removed slot's failure still fails its in-flight work
+                # over (exactly-once), but never respawns
                 self._eject(slot, reason[0], batch=reason[1])
+                if slot.stop:
+                    return
             except Exception as e:
                 if self._stop:
                     return
@@ -731,12 +955,13 @@ class ReplicaPool:
         """Wait for this generation's connection + ready message (load +
         warm happen replica-side first). True on success."""
         deadline = time.monotonic() + self._spawn_timeout_s
-        while time.monotonic() < deadline and not self._stop:
+        while time.monotonic() < deadline and not self._stop \
+                and not slot.stop:
             if slot.conn_event.wait(timeout=0.1):
                 break
             if not slot.proc.alive():
                 return False  # died before connecting (bad artifact, OOM)
-        if self._stop or slot.conn is None:
+        if self._stop or slot.stop or slot.conn is None:
             return False
         try:
             msg = recv_msg(slot.conn,
@@ -750,6 +975,9 @@ class ReplicaPool:
         with self._lock:
             slot.ready_info = msg
             slot.state = _READY
+            # a scale-up member is established from its first ready: it
+            # now counts toward the degraded-admission denominator
+            slot.joining = False
             # consecutive_restarts is NOT reset here: an artifact that
             # warms on zeros but crashes on real input would otherwise
             # respawn at the constant initial backoff forever — the reset
@@ -765,8 +993,10 @@ class ReplicaPool:
     def _serve_generation(self, slot):
         """Dispatch batches on this replica until it dies or wedges.
         Returns (reason, batch_or_None) for ejection, or None on clean
-        pool shutdown."""
-        while not self._stop:
+        pool shutdown — or on a removal drain (`slot.stop`): the slot
+        finishes the batch it holds, takes nothing new, and exits with
+        zero request loss."""
+        while not self._stop and not slot.stop:
             try:
                 item = self._work.get(timeout=self.heartbeat_s / 2)
             except queue.Empty:
@@ -955,7 +1185,8 @@ class ReplicaPool:
                 "serve_failover", model=self.model, replica=slot.id,
                 requeued=requeued, dropped=len(batch) - requeued)
         deadline = time.monotonic() + delay
-        while time.monotonic() < deadline and not self._stop:
+        while time.monotonic() < deadline and not self._stop \
+                and not slot.stop:
             time.sleep(0.02)
 
     def _set_healthy_gauge(self):

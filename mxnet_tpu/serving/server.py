@@ -70,6 +70,7 @@ class ServingServer:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.repository = repository
+        self._autoscaler = None
         self._draining = False
         self._drain_failed = False
         self._inflight = 0
@@ -130,11 +131,26 @@ class ServingServer:
         self._serve_thread.start()
         return self
 
+    def attach_autoscaler(self, autoscaler):
+        """Adopt this server's autoscaling controller (docs/serving.md
+        §Autoscaling): its decision trail joins ``/statusz`` and
+        `shutdown` stops (and joins) its thread — the PR-12 hygiene
+        contract for the per-server controller. Returns the autoscaler."""
+        self._autoscaler = autoscaler
+        return autoscaler
+
+    @property
+    def autoscaler(self):
+        return self._autoscaler
+
     def shutdown(self):
         # monotonic False->True flag (drain waiter + api callers race
         # benignly: both write the same value, readers poll)
         self._closed = True  # mxlint: gil-atomic — monotonic shutdown flag
         self._drain_event.set()  # release an idle drain waiter
+        if self._autoscaler is not None:
+            # scaling decisions must stop before models start dropping
+            self._autoscaler.stop()
         self._http.shutdown()
         self._http.server_close()
         if self._serve_thread is not None:
@@ -158,6 +174,13 @@ class ServingServer:
         # monotonic admission flag: the /drainz waiter thread and direct
         # api callers both only ever flip it False->True
         self._draining = True  # mxlint: gil-atomic — monotonic drain flag
+        if self._autoscaler is not None:
+            # scaling decisions stop BEFORE models drain: the controller
+            # must not spawn (or drain) replicas into a server that is
+            # shutting down — stop() joins its thread, so no lap is
+            # mid-flight when drain_all starts; idempotent for the later
+            # shutdown() call
+            self._autoscaler.stop()
         if timeout is None:
             # drain_timeout_s honors the deprecated seconds-typed
             # MXTPU_SERVE_DRAIN_TIMEOUT_S with a one-time warning
@@ -243,12 +266,17 @@ class ServingServer:
                 # answer even when a model's batcher is wedged, so it
                 # never touches repository/batcher locks (admission-free:
                 # works while draining too)
+                extra = {"server": {"port": self.port,
+                                    "draining": self._draining,
+                                    "drain_failed": self._drain_failed,
+                                    "inflight": self._inflight}}
+                if self._autoscaler is not None:
+                    # the decision trail that explains every replica-count
+                    # change (lock-free snapshot reads)
+                    extra["autoscaler"] = self._autoscaler.describe()
                 ctype, body = _slo.render_statusz(
                     "text" if "format=text" in query else "json",
-                    extra={"server": {"port": self.port,
-                                      "draining": self._draining,
-                                      "drain_failed": self._drain_failed,
-                                      "inflight": self._inflight}})
+                    extra=extra)
                 self._count(200)
                 handler.send_response(200)
                 handler.send_header("Content-Type", ctype)
@@ -273,7 +301,13 @@ class ServingServer:
         except BrokenPipeError:
             pass  # client went away mid-reply
         except ServingError as e:
-            self._json(handler, e.status, {"error": str(e)},
+            payload = {"error": str(e)}
+            details = getattr(e, "details", None)
+            if details:
+                # 507s carry the footprint breakdown (what to evict) —
+                # docs/serving.md §Autoscaling
+                payload["details"] = details
+            self._json(handler, e.status, payload,
                        retry_after=e.retry_after)
         except MXNetError as e:
             self._json(handler, 400, {"error": str(e)})

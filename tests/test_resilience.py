@@ -197,7 +197,8 @@ def test_checkpoint_rank_gating(tmp_path, monkeypatch):
 def test_fault_spec_parsing(monkeypatch):
     assert fault_spec("kill@step=7,rank=1") == [
         {"action": "kill", "step": 7, "rank": 1, "gen": 0, "code": 42,
-         "dir": None, "batch": None, "replica": None, "ms": 1000}]
+         "dir": None, "batch": None, "replica": None, "ms": 1000,
+         "after": None, "rps": 100, "duration": 2}]
     assert fault_spec("exc@step=3 corrupt_ckpt@step=5,dir=/tmp/x")[1]["dir"] \
         == "/tmp/x"
     # serving actions key on batch=/replica= instead of step=/rank=

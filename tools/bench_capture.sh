@@ -200,6 +200,23 @@ if ls "$FAIL_TDIR"/*.jsonl >/dev/null 2>&1; then
 fi
 rm -rf "$FAIL_TDIR"
 
+# serving elasticity: the autoscale row (docs/serving.md §Autoscaling
+# surge playbook) — open-loop surge over a 1-replica pool with the
+# autoscaler armed; the evidence is the measured scale-up latency (surge
+# start -> grown pool serving), the p99-verdict recovery time, the idle
+# scale-down, zero 500s, and the decision counters/events archived in
+# the telemetry JSONL next to the artifact
+echo "[bench_capture] serve bench (autoscale)" >&2
+ASC_TDIR=$(mktemp -d "telemetry_${TAG}_autoscale.XXXX")
+env MXTPU_TELEMETRY_DIR="$ASC_TDIR" PYTHONPATH=".:${PYTHONPATH:-}" \
+  timeout 900 python tools/serve_bench.py --autoscale \
+  > "BENCH_${TAG}_autoscale.json" 2> "BENCH_${TAG}_autoscale.log"
+echo "[bench_capture] serve autoscale rc=$?" >&2
+if ls "$ASC_TDIR"/*.jsonl >/dev/null 2>&1; then
+  cat "$ASC_TDIR"/*.jsonl > "BENCH_${TAG}_autoscale_telemetry.jsonl"
+fi
+rm -rf "$ASC_TDIR"
+
 # cold start: serving replica time-to-ready, cold vs persistent-warm
 # compile cache (docs/compile_cache.md) — run 1 populates an empty
 # MXTPU_COMPILE_CACHE dir, run 2's fresh replica must reach ready with

@@ -89,6 +89,13 @@ def _extract(doc):
                 "loss-window %s rps, %s errors, recovery %ss" % (
                     _fmt(lw.get("rps"), 1), _fmt(doc.get("unresolved"), 0),
                     _fmt(doc.get("recovery_s"), 1)))
+    if mode == "serve_autoscale":
+        return ("autoscale_scale_up_s", doc.get("scale_up_latency_s"), "s",
+                "1->%s replicas, p99 recovered %ss, down %ss, 500s=%s" % (
+                    _fmt(doc.get("scaled_to"), 0),
+                    _fmt(doc.get("p99_recovery_s"), 1),
+                    _fmt(doc.get("scale_down_s"), 1),
+                    "no" if doc.get("zero_500s") else "YES"))
     if mode == "serve_memory":
         return ("serve_memory", doc.get("footprint_bytes"), "bytes",
                 "budget reject=%s accept=%s, donation aliased=%s" % (
@@ -197,6 +204,7 @@ _CHECK_METRICS = {
     "decode_tokens_per_sec": "higher",
     "failover_rps": "higher",
     "coldstart_ready": "lower",     # warm time-to-ready, seconds
+    "autoscale_scale_up_s": "lower",  # surge -> grown pool serving
 }
 
 

@@ -87,6 +87,21 @@ def main(argv=None):
                         "N >= 1 serves through a supervised pool with "
                         "health-checked failover (docs/serving.md "
                         "resilience)")
+    p.add_argument("--autoscale", action="store_true",
+                   default=None,
+                   help="arm the elastic autoscaler (default "
+                        "MXTPU_AUTOSCALE): SLO-breach scale-up / idle "
+                        "scale-down of every pooled model, in place "
+                        "(docs/serving.md §Autoscaling)")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help="per-model autoscaling floor (default "
+                        "MXTPU_AUTOSCALE_MIN_REPLICAS)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="per-model autoscaling ceiling (default "
+                        "MXTPU_AUTOSCALE_MAX_REPLICAS)")
+    p.add_argument("--pin", action="store_true",
+                   help="pin the loaded models: exempt from "
+                        "budget-pressure eviction")
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -105,6 +120,8 @@ def main(argv=None):
         name, path, shapes, dtypes = parse_model_spec(spec)
         log.info("loading %s from %s%s ...", name, path,
                  " (%d replicas)" % replicas if replicas else "")
+        scale_kw = dict(min_replicas=args.min_replicas,
+                        max_replicas=args.max_replicas, pinned=args.pin)
         if shapes == "generate":
             opts = {}
             if args.max_batch is not None:
@@ -112,7 +129,7 @@ def main(argv=None):
             model = repo.load(name, path, generate=True,
                               generate_opts=opts,
                               queue_depth=args.queue_depth,
-                              replicas=replicas)
+                              replicas=replicas, **scale_kw)
             log.info("loaded %s/%d (generate) %s warm=%.2fs", model.name,
                      model.version, model.generate_info.get("decode_buckets"),
                      model.warm_seconds or 0.0)
@@ -121,11 +138,24 @@ def main(argv=None):
                           input_dtypes=dtypes, max_batch=args.max_batch,
                           max_delay_ms=args.delay_ms,
                           queue_depth=args.queue_depth,
-                          warm=not args.no_warm, replicas=replicas)
+                          warm=not args.no_warm, replicas=replicas,
+                          **scale_kw)
         log.info("loaded %s/%d buckets=%s warm=%.2fs", model.name,
                  model.version, model.buckets, model.warm_seconds or 0.0)
 
     server = ServingServer(repo, port=args.port, addr=args.addr)
+    autoscale = args.autoscale
+    if autoscale is None:
+        autoscale = _env.get("MXTPU_AUTOSCALE")
+    if autoscale:
+        from mxnet_tpu.serving import Autoscaler
+
+        server.attach_autoscaler(Autoscaler(repo))
+        log.info("autoscaler armed (interval %.0fms, up after %d breached "
+                 "windows, idle scale-down after %.0fs)",
+                 _env.get("MXTPU_AUTOSCALE_INTERVAL_MS"),
+                 _env.get("MXTPU_AUTOSCALE_UP_WINDOWS"),
+                 _env.get("MXTPU_AUTOSCALE_IDLE_S"))
     server.install_signal_handlers()
     log.info("serving %s on %s:%d (SIGTERM drains and exits 0)",
              repo.names(), args.addr, server.port)

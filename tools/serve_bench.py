@@ -25,6 +25,16 @@ batch), and the row reports tokens/sec, inter-token p50/p99 from the
 ``mxtpu_serve_intertoken_seconds`` histogram, KV-page peak occupancy,
 and the post-warm jit-compile count (must be 0).
 
+``--autoscale`` runs the elasticity row instead (docs/serving.md
+§Autoscaling surge playbook): the model is served through a 1-replica
+pool with the `Autoscaler` armed, an open-loop surge overdrives it, and
+the row reports the measured scale-up latency (surge start -> the grown
+pool fully serving), the p99-verdict recovery time, the idle
+scale-down, the decision counters, and that no request answered 500.
+Closed-loop clients in every row honor ``Retry-After`` on 429/503
+(the honored count rides the JSON) — hammering a shedding server both
+skews the loss-window rps and fights the recovery window.
+
 ``--failover`` runs the resilience row instead (docs/serving.md
 chaos-testing playbook): the model is served through a supervised
 ``--replicas N`` pool, a closed-loop workload runs for
@@ -355,13 +365,19 @@ class _Client:
     """One persistent keep-alive connection (the realistic steady-client
     shape: no TCP setup or server thread spawn per request)."""
 
+    # well-behaved clients honor Retry-After, but a bench must stay
+    # bounded: a server-suggested backoff is capped here
+    RETRY_AFTER_CAP_S = 5.0
+
     def __init__(self, host, port, path, timeout_s):
         self.host, self.port, self.path = host, port, path
         self.timeout_s = timeout_s
         self.conn = None
+        self.retry_after_honored = 0
 
     def post(self, body):
         t0 = time.perf_counter()
+        retry_after = None
         try:
             if self.conn is None:
                 self.conn = http.client.HTTPConnection(
@@ -371,6 +387,7 @@ class _Client:
             r = self.conn.getresponse()
             r.read()
             code = r.status
+            retry_after = r.getheader("Retry-After")
             if r.will_close:
                 self.conn.close()
                 self.conn = None
@@ -379,7 +396,26 @@ class _Client:
             if self.conn is not None:
                 self.conn.close()
                 self.conn = None
-        return (time.perf_counter() - t0) * 1e3, code
+        return (time.perf_counter() - t0) * 1e3, code, retry_after
+
+    def backoff(self, code, retry_after):
+        """Honor a 429/503's Retry-After before the next closed-loop
+        request. Hammering a shedding server immediately both skews the
+        measured loss-window rps and FIGHTS the recovery the autoscaler
+        (or a respawning replica) is buying — the exact anti-pattern the
+        header exists to prevent. Returns True when a backoff was
+        served."""
+        if code not in (429, 503) or not retry_after:
+            return False
+        try:
+            delay = float(retry_after)
+        except ValueError:
+            return False
+        if delay <= 0:
+            return False
+        time.sleep(min(delay, self.RETRY_AFTER_CAP_S))
+        self.retry_after_honored += 1
+        return True
 
     def close(self):
         if self.conn is not None:
@@ -389,20 +425,26 @@ class _Client:
 
 def _closed_loop(endpoint, payloads, clients, requests_each, timeout_s):
     """`clients` threads, each firing `requests_each` back-to-back posts
-    over its own persistent connection."""
+    over its own persistent connection — honoring ``Retry-After`` on
+    429/503 sheds like a well-behaved client (the honored count rides
+    the phase result)."""
     lats, codes, lock = [], {}, threading.Lock()
+    honored = [0]
 
     def worker(wid):
         cli = _Client(*endpoint, timeout_s=timeout_s)
         mine = []
         my_codes = {}
         for i in range(requests_each):
-            ms, code = cli.post(payloads[(wid + i) % len(payloads)])
+            ms, code, retry_after = cli.post(
+                payloads[(wid + i) % len(payloads)])
             mine.append(ms)
             my_codes[code] = my_codes.get(code, 0) + 1
+            cli.backoff(code, retry_after)
         cli.close()
         with lock:
             lats.extend(mine)
+            honored[0] += cli.retry_after_honored
             for c, n in my_codes.items():
                 codes[c] = codes.get(c, 0) + n
 
@@ -424,6 +466,7 @@ def _closed_loop(endpoint, payloads, clients, requests_each, timeout_s):
         "p99_ms": round(_percentile(lats, 0.99), 3),
         "mean_ms": round(sum(lats) / len(lats), 3),
         "codes": {str(k): v for k, v in sorted(codes.items())},
+        "retry_after_honored": honored[0],
     }
 
 
@@ -437,8 +480,8 @@ def _open_loop(endpoint, payloads, rate, duration, timeout_s):
     def one(body):
         try:
             cli = _Client(*endpoint, timeout_s=timeout_s)
-            ms, code = cli.post(body)
-            cli.close()
+            ms, code, _ = cli.post(body)  # open loop: arrivals are not
+            cli.close()                   # paced by the server's hints
             with lock:
                 lats.append(ms)
                 codes[code] = codes.get(code, 0) + 1
@@ -478,10 +521,14 @@ def _open_loop(endpoint, payloads, rate, duration, timeout_s):
 
 def _closed_loop_timed(endpoint, payloads, clients, duration_s, timeout_s):
     """`clients` threads firing back-to-back posts until `duration_s`
-    elapses. Returns per-request (t_done, ms, code) records (t_done on the
-    shared perf_counter clock) so callers can window the timeline around
-    an injected failure."""
+    elapses, honoring ``Retry-After`` on sheds (a closed-loop client
+    that hammers a degraded pool skews the loss-window rps AND fights
+    the recovery window). Returns per-request (t_done, ms, code) records
+    (t_done on the shared perf_counter clock) plus the honored-backoff
+    count, so callers can window the timeline around an injected
+    failure."""
     recs, lock = [], threading.Lock()
+    honored = [0]
     t0 = time.perf_counter()
 
     def worker(wid):
@@ -489,12 +536,15 @@ def _closed_loop_timed(endpoint, payloads, clients, duration_s, timeout_s):
         mine = []
         i = 0
         while time.perf_counter() - t0 < duration_s:
-            ms, code = cli.post(payloads[(wid + i) % len(payloads)])
+            ms, code, retry_after = cli.post(
+                payloads[(wid + i) % len(payloads)])
             mine.append((time.perf_counter() - t0, ms, code))
             i += 1
+            cli.backoff(code, retry_after)
         cli.close()
         with lock:
             recs.extend(mine)
+            honored[0] += cli.retry_after_honored
 
     threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                for w in range(clients)]
@@ -502,7 +552,7 @@ def _closed_loop_timed(endpoint, payloads, clients, duration_s, timeout_s):
         t.start()
     for t in threads:
         t.join()
-    return t0, recs
+    return t0, recs, honored[0]
 
 
 def _watch_pool(pool, timeline, stop, interval_s=0.005):
@@ -581,8 +631,9 @@ def _run_failover(args, prefix, input_shapes, log):
     threading.Thread(target=killer, daemon=True).start()
     log("closed loop: %d clients for %.0fs, kill at %.0fs ..."
         % (args.clients, args.failover_duration, args.kill_after))
-    t_run, recs = _closed_loop_timed(endpoint, payloads, args.clients,
-                                     args.failover_duration, timeout_s)
+    t_run, recs, honored = _closed_loop_timed(
+        endpoint, payloads, args.clients, args.failover_duration,
+        timeout_s)
     # let the respawn land even when the kill came late in the window
     recovery_deadline = time.perf_counter() + 60.0
     while pool.healthy_count < args.replicas and \
@@ -632,6 +683,7 @@ def _run_failover(args, prefix, input_shapes, log):
         "unresolved": codes.get(-1, 0),
         "all_resolved_deterministically": resolved,
         "rps_overall": round(len(recs) / wall, 2) if recs else 0.0,
+        "retry_after_honored": honored,
         "p50_ms": round(_percentile(lats, 0.50), 3) if lats else None,
         "p99_ms": round(_percentile(lats, 0.99), 3) if lats else None,
         "recovery_s": round(recovery_s, 3) if recovery_s is not None
@@ -657,6 +709,175 @@ def _run_failover(args, prefix, input_shapes, log):
            result["loss_window"]["rps"]))
     server.drain(shutdown=True)
     telemetry.flush(reason="serve_bench_failover")
+    json.dump(result, sys.stdout, indent=1)
+    sys.stdout.write("\n")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# the autoscale row (docs/serving.md §Autoscaling surge playbook)
+# ---------------------------------------------------------------------------
+
+def _run_autoscale(args, prefix, input_shapes, log):
+    """Open-loop surge over a 1-replica pool with the autoscaler armed.
+    The evidence this row commits: the surge breaches the serving SLOs,
+    the pool scales up IN PLACE (measured scale-up latency = surge start
+    to the new replica serving), the p99 verdict recovers (measured
+    recovery time), and sustained idle drains the pool back down — with
+    every request resolving deterministically (no 500s)."""
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import (Autoscaler, ModelRepository,
+                                   ServingServer)
+    from mxnet_tpu.telemetry import slo as _slo
+
+    # bench-scale SLO windows: breach + recovery must fit in a ~60s row
+    # (the tier-1 chaos e2e uses the same shape at a smaller scale)
+    for k, v in (("MXTPU_SLO_WINDOW_MS", "500"),
+                 ("MXTPU_SLO_FAST_WINDOWS", "5"),
+                 ("MXTPU_SLO_SLOW_WINDOW_S", "60"),
+                 ("MXTPU_SLO_SERVE_P99_MS", "500")):
+        os.environ.setdefault(k, v)
+    _slo.stop()  # a fresh evaluator picks up the bench cadence
+
+    repo = ModelRepository()
+    t0 = time.perf_counter()
+    model = repo.load("bench", prefix, input_shapes=input_shapes,
+                      max_batch=args.max_batch, max_delay_ms=args.delay_ms,
+                      queue_depth=max(256, args.clients * 4),
+                      replicas=1, max_replicas=args.max_replicas)
+    load_s = time.perf_counter() - t0
+    model.min_replicas = 1
+    pool = model.pool
+    server = ServingServer(repo, port=0, addr="127.0.0.1").start()
+    asc = server.attach_autoscaler(Autoscaler(
+        repo, interval_ms=500, up_windows=2, idle_s=args.idle_s,
+        cooldown_s=2.0))
+    endpoint = ("127.0.0.1", server.port, "/v1/models/bench:predict")
+    timeout_s = args.timeout_ms / 1e3 + 10.0
+    shape = next(iter(input_shapes.values()))
+    rng = np.random.RandomState(0)
+    payloads = [_payload(rng.uniform(-1, 1, (1,) + shape).astype(np.float32),
+                         args.timeout_ms) for _ in range(8)]
+
+    # pool size/health timeline (the scale-up latency evidence)
+    timeline, stop = [], threading.Event()
+
+    def watch():
+        last = None
+        while not stop.is_set():
+            cur = (pool.size, pool.healthy_count)
+            if cur != last:
+                timeline.append((time.perf_counter(), cur[0], cur[1]))
+                last = cur
+            time.sleep(0.01)
+
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+
+    log("phase 1/4: baseline closed loop (%d clients x 10) ..."
+        % args.clients)
+    baseline = _closed_loop(endpoint, payloads, clients=args.clients,
+                            requests_each=10, timeout_s=timeout_s)
+    log("  baseline: %.1f rps p99=%.1fms" % (baseline["rps"],
+                                             baseline["p99_ms"]))
+
+    # the surge ships HEAVY requests (up to 8 examples each): the
+    # overload is measured in examples/sec, so a batching-efficient pool
+    # is still genuinely overdriven and the p99/queue objectives breach
+    surge_n = min(8, model.max_batch)
+    surge_payloads = [
+        _payload(rng.uniform(-1, 1, (surge_n,) + shape).astype(np.float32),
+                 args.timeout_ms) for _ in range(8)]
+    surge_rate = args.surge_rate or max(150.0, 1.5 * baseline["rps"])
+    log("phase 2/4: open-loop surge @ %.0f req/s x %d examples for "
+        "%.0fs ..." % (surge_rate, surge_n, args.surge_duration))
+    t_surge = time.perf_counter()
+    surge = _open_loop(endpoint, surge_payloads, surge_rate,
+                       args.surge_duration, timeout_s)
+    t_surge_end = time.perf_counter()
+    # scale-up latency: surge start -> the grown pool fully serving
+    scale_up_s = None
+    scaled_to = max((s for _, s, _ in timeline), default=1)
+    if scaled_to > 1:
+        serving = [t for t, s, h in timeline if s > 1 and h >= s]
+        if serving:
+            scale_up_s = serving[0] - t_surge
+    log("  surge: %d reqs, codes=%s; scaled to %d (scale-up %.1fs)"
+        % (surge["requests"], surge["codes"], scaled_to,
+           scale_up_s or -1.0))
+
+    log("phase 3/4: p99 recovery ...")
+    objective = "serve-p99:%s/%d" % (model.name, model.version)
+    recovery_s = None
+    deadline = time.perf_counter() + 60.0
+    while recovery_s is None and time.perf_counter() < deadline:
+        v = next((v for v in _slo.verdicts() if v["slo"] == objective),
+                 None)
+        if v is not None and v["healthy"] and not v["no_data"]:
+            recovery_s = time.perf_counter() - t_surge_end
+            break
+        time.sleep(0.25)
+    log("  p99 verdict recovered in %s s" % (round(recovery_s, 2)
+                                             if recovery_s else "NEVER"))
+
+    log("phase 4/4: idle scale-down ...")
+    scale_down_s = None
+    deadline = time.perf_counter() + 60.0
+    while pool.size > 1 and time.perf_counter() < deadline:
+        time.sleep(0.25)
+    if pool.size == 1 and scaled_to > 1:
+        scale_down_s = time.perf_counter() - t_surge_end
+    time.sleep(1.5)  # let the last remove's drain/decision records land
+    stop.set()
+    watcher.join(timeout=2.0)
+
+    codes = dict(baseline["codes"])
+    for c, n in surge["codes"].items():
+        codes[str(c)] = codes.get(str(c), 0) + n
+    snap = telemetry.snapshot()
+
+    def decisions(action):
+        return snap.get('mxtpu_autoscale_decisions_total{action="%s"}'
+                        % action, {}).get("value", 0)
+
+    result = {
+        "mode": "serve_autoscale",
+        "net": os.path.basename(args.model) if args.model else args.net,
+        "device": "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu"
+                  else "default",
+        "buckets": model.buckets,
+        "load_s": round(load_s, 2),
+        "baseline": dict(baseline, clients=args.clients),
+        "surge": dict(surge, rate=surge_rate),
+        "min_replicas": 1,
+        "max_replicas": args.max_replicas,
+        "scaled_to": scaled_to,
+        "scale_up_latency_s": round(scale_up_s, 3)
+                              if scale_up_s is not None else None,
+        "p99_recovery_s": round(recovery_s, 3)
+                          if recovery_s is not None else None,
+        "scale_down_s": round(scale_down_s, 3)
+                        if scale_down_s is not None else None,
+        "final_replicas": pool.size,
+        "codes": codes,
+        "zero_500s": all(int(c) in (200, 429, 503, 504)
+                         for c in codes),
+        "retry_after_honored": baseline["retry_after_honored"],
+        "decisions": {a: decisions(a)
+                      for a in ("up", "down", "evict", "blocked")},
+        "decision_trail": asc.describe()["decisions"],
+        "size_timeline": [[round(t - t_surge, 3), s, h]
+                          for t, s, h in timeline],
+        "slo": _slo_block([_slo_sample("surge")], args.slo_spec),
+    }
+    log("autoscale: scaled 1->%d in %ss, p99 recovered %ss, down in %ss, "
+        "codes=%s" % (scaled_to, result["scale_up_latency_s"],
+                      result["p99_recovery_s"], result["scale_down_s"],
+                      codes))
+    server.drain(shutdown=True)
+    telemetry.flush(reason="serve_bench_autoscale")
     json.dump(result, sys.stdout, indent=1)
     sys.stdout.write("\n")
     return 0
@@ -717,6 +938,21 @@ def main(argv=None):
                    help="run the resilience row instead of the throughput "
                         "phases: closed-loop load over a --replicas pool "
                         "with a SIGKILLed replica at --kill-after")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the elasticity row instead: open-loop surge "
+                        "over a 1-replica pool with the autoscaler armed "
+                        "(surge -> measured scale-up latency -> p99 "
+                        "recovery -> idle scale-down)")
+    p.add_argument("--surge-rate", type=float, default=0.0,
+                   help="--autoscale surge arrival rate per second "
+                        "(0 = 1.5x the measured baseline, min 150; each "
+                        "surge request carries up to 8 examples)")
+    p.add_argument("--surge-duration", type=float, default=8.0,
+                   help="--autoscale surge length in seconds")
+    p.add_argument("--max-replicas", type=int, default=3,
+                   help="--autoscale ceiling")
+    p.add_argument("--idle-s", dest="idle_s", type=float, default=4.0,
+                   help="--autoscale idle window before scale-down")
     p.add_argument("--replicas", type=int, default=2,
                    help="pool size for --failover (>= 2 so the endpoint "
                         "survives a single-replica loss)")
@@ -762,6 +998,9 @@ def main(argv=None):
 
     if args.failover:
         return _run_failover(args, prefix, input_shapes, log)
+
+    if args.autoscale:
+        return _run_autoscale(args, prefix, input_shapes, log)
 
     # per-phase peak-RSS bookkeeping (telemetry.memory): the serving
     # memory budget's committed CPU evidence needs real residency numbers
