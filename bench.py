@@ -33,6 +33,13 @@ vs_baseline is measured against BASELINE.json's ≥60%-MFU target instead.
 MXTPU_BENCH_MODE=lstm runs the word-LM 2x650 LSTM (reference
 example/rnn/word_lm defaults, PTB-shaped synthetic data) and reports
 tokens/sec + MFU under the same stance as the bert mode.
+
+MXTPU_BENCH_MODE=train_sharded runs the hot-path promotion A/B
+(docs/sharded_training.md): op-by-op gluon.Trainer loop vs the fused
+ShardedTrainer whole-step executable on a dispatch-bound MLP, reporting
+the speedup, per-step dispatch-count delta, donation aliased_fraction
+and the data-wait/compute split (MXTPU_BENCH_SHARDED_IMPL selects the
+headline implementation).
 """
 from __future__ import annotations
 
@@ -149,19 +156,37 @@ def bench_train():
     # and telemetry publishes achieved MFU on its own; the bench keeps its
     # analytic flops_per_img for the headline number and reports both
 
-    def timed_train(xb, yb, batch):
+    def timed_train(xb, yb, batch, split=None):
         """warmup -> drain -> free-running timed loop (async dispatch
-        pipelines host & device) -> imgs/sec."""
+        pipelines host & device) -> imgs/sec. `split` (when given)
+        receives the data-wait vs dispatch/compute decomposition of the
+        timed region — the same two-phase accounting module.fit publishes
+        as mxtpu_data_{wait,compute}_seconds_total, here with a pre-staged
+        generator standing in for the input pipeline's next()."""
         for _ in range(WARMUP):
             trainer.step(xb, yb)
         trainer.step(xb, yb).asnumpy()  # drain dispatch before timed region
+        batches = ((xb, yb) for _ in range(ITERS))
+        wait = 0.0
         t0 = time.perf_counter()
-        for _ in range(ITERS):
-            loss = trainer.step(xb, yb)
+        while True:
+            tw = time.perf_counter()
+            try:
+                xs, ys = next(batches)
+            except StopIteration:
+                break
+            wait += time.perf_counter() - tw
+            loss = trainer.step(xs, ys)
         loss.asnumpy()
-        return batch * ITERS / (time.perf_counter() - t0)
+        total = time.perf_counter() - t0
+        if split is not None:
+            split.update(data_wait_s=round(wait, 4),
+                         compute_s=round(total - wait, 4),
+                         data_wait_fraction=round(wait / total, 4))
+        return batch * ITERS / total
 
-    imgs_per_sec = timed_train(x, label, BATCH)
+    split = {}
+    imgs_per_sec = timed_train(x, label, BATCH, split=split)
 
     if os.environ.get("MXTPU_BENCH_PROFILE"):
         # capture an XLA (xplane) trace of a few steady-state steps next to
@@ -217,6 +242,7 @@ def bench_train():
                           / (BATCH * peak * 1e12), 4)
                     if peak and auto_step_flops and imgs_per_sec else None,
     }
+    out.update(split)
     out.update(_percentiles(step_ms))
 
     _sweep_segment(out, dev, flops_per_img,
@@ -228,6 +254,140 @@ def bench_train():
     if "sweep_batch" in out:
         seg_x = _sweep_batch_arrays(ctx, out["sweep_batch"], hw)[0]
     _mfu_segments(out, dev, net, ctx, seg_x, flops_per_img / 3)
+    print(json.dumps(out))
+
+
+def bench_train_sharded():
+    """A/B over the user-facing hot path (MXTPU_BENCH_MODE=train_sharded):
+    the op-by-op gluon.Trainer loop (autograd.record -> loss.backward ->
+    trainer.step; one host dispatch per op) against the promoted fused
+    ShardedTrainer whole-step executable (docs/sharded_training.md). The
+    model is a deliberately dispatch-bound MLP: per-op Python/dispatch
+    overhead is exactly the cost the fused step removes, so the gap IS the
+    measurement. MXTPU_BENCH_SHARDED_IMPL=opbyop emits the op-by-op row
+    alone; the default `fused` row times BOTH loops under the same init
+    and data and reports the in-row speedup, the per-step dispatch-count
+    delta, the donation verifier's aliased_fraction, and the data-wait vs
+    compute split of the timed region."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.telemetry import memory as _tm_memory
+
+    impl = os.environ.get("MXTPU_BENCH_SHARDED_IMPL", "fused")
+    ctx = mx.tpu()
+    dev = jax.devices()[0]
+    in_dim, hidden, classes = 784, 1024, 10
+    # fwd FLOPs: 2 MACs per weight element across the three Dense layers
+    fwd_flops = 2 * (in_dim * hidden + hidden * hidden + hidden * classes)
+    flops_per_img = 3 * fwd_flops  # train = fwd + bwd-input + bwd-weight
+
+    def build(prefix):
+        with ctx:
+            net = nn.HybridSequential(prefix=prefix)
+            with net.name_scope():
+                net.add(nn.Dense(hidden, activation="relu", prefix="fc1_"))
+                net.add(nn.Dense(hidden, activation="relu", prefix="fc2_"))
+                net.add(nn.Dense(classes, prefix="fc3_"))
+            net.initialize(ctx=ctx)
+        return net
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.uniform(-1, 1, (BATCH, in_dim))
+                    .astype(np.float32), ctx=ctx)
+    y = mx.nd.array(rng.randint(0, classes, (BATCH,))
+                    .astype(np.float32), ctx=ctx)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt_args = {"learning_rate": 0.05, "momentum": 0.9}
+
+    def dispatches():
+        # total op dispatches across categories (imperative/autograd/...)
+        return sum(v.get("value", 0) for k, v in
+                   telemetry.snapshot().items()
+                   if k.startswith("mxtpu_op_dispatch_total"))
+
+    def timed(step, drain):
+        for _ in range(WARMUP):
+            step()
+        drain(step())
+        d0 = dispatches()
+        batches = (None for _ in range(ITERS))
+        wait = 0.0
+        t0 = time.perf_counter()
+        while True:
+            tw = time.perf_counter()
+            try:
+                next(batches)
+            except StopIteration:
+                break
+            wait += time.perf_counter() - tw
+            out = step()
+        drain(out)
+        total = time.perf_counter() - t0
+        return {"imgs_per_sec": round(BATCH * ITERS / total, 2),
+                "dispatch_per_step": round((dispatches() - d0) / ITERS, 1),
+                "data_wait_s": round(wait, 4),
+                "compute_s": round(total - wait, 4),
+                "data_wait_fraction": round(wait / total, 4)}
+
+    def run_opbyop():
+        net = build("ab_op_")
+        net(x)
+        tr = gluon.Trainer(net.collect_params(), "sgd", dict(opt_args))
+
+        def step():
+            with autograd.record():
+                ls = loss_fn(net(x), y)
+            ls.backward()
+            tr.step(BATCH)
+            return ls
+
+        return timed(step, lambda ls: ls.asnumpy())
+
+    def run_fused():
+        net = build("ab_fz_")
+        net(x)
+        tr = gluon.Trainer(net.collect_params(), "sgd", dict(opt_args),
+                           sharded=True, block=net, loss=loss_fn)
+        res = timed(lambda: tr.step_batch(x, y), lambda ls: ls.asnumpy())
+        rep = _tm_memory.last_donation_report() or {}
+        res["aliased_fraction"] = rep.get("aliased_fraction")
+        return res
+
+    peak = _chip_peak_tflops(dev)
+    out = {
+        "metric": "mlp_train_sharded_%s_bs%d_imgs_per_sec" % (impl, BATCH),
+        "unit": "imgs/sec",
+        "batch": BATCH,
+        "device": getattr(dev, "device_kind", str(dev)),
+        "flops_per_img": flops_per_img,
+    }
+    if impl == "opbyop":
+        a = run_opbyop()
+        out.update(value=a["imgs_per_sec"], vs_baseline=None, opbyop=a)
+    else:
+        a = run_opbyop()
+        b = run_fused()
+        speedup = b["imgs_per_sec"] / a["imgs_per_sec"] \
+            if a["imgs_per_sec"] else None
+        out.update(
+            value=b["imgs_per_sec"],
+            # in-row baseline: the op-by-op loop under identical init/data
+            vs_baseline=round(speedup, 3) if speedup else None,
+            baseline={"value": a["imgs_per_sec"], "hw": "op-by-op",
+                      "batch": BATCH},
+            opbyop=a, fused=b,
+            speedup_fused_vs_opbyop=round(speedup, 3) if speedup else None,
+            dispatch_per_step_opbyop=a["dispatch_per_step"],
+            dispatch_per_step_fused=b["dispatch_per_step"],
+            aliased_fraction=b.get("aliased_fraction"),
+            data_wait_s=b["data_wait_s"], compute_s=b["compute_s"],
+            data_wait_fraction=b["data_wait_fraction"])
+        if peak:
+            out["mfu"] = round(out["value"] * flops_per_img
+                               / (peak * 1e12), 4)
     print(json.dumps(out))
 
 
@@ -852,7 +1012,10 @@ def _device_watchdog(timeout_s=None):
     metric = {"score": "%s_score_bs%d_imgs_per_sec" % (NET, BATCH),
               "score_int8": "%s_score_int8_bs%d_imgs_per_sec" % (NET, BATCH),
               "bert": "bert_base_train_tokens_per_sec",
-              "lstm": "lstm_word_lm_train_tokens_per_sec"}.get(
+              "lstm": "lstm_word_lm_train_tokens_per_sec",
+              "train_sharded": "mlp_train_sharded_%s_bs%d_imgs_per_sec"
+                               % (os.environ.get("MXTPU_BENCH_SHARDED_IMPL",
+                                                 "fused"), BATCH)}.get(
                   MODE, "%s_train_bs%d_imgs_per_sec" % (NET, BATCH))
     if os.environ.get("MXTPU_BENCH_FORCE_DIAL_FAIL"):
         # test hook: exercise the unreachable-device contract (incl. the
@@ -932,6 +1095,8 @@ def main():
         bench_bert()
     elif MODE == "lstm":
         bench_lstm()
+    elif MODE == "train_sharded":
+        bench_train_sharded()
     else:
         bench_train()
 

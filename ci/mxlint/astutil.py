@@ -85,6 +85,20 @@ def arrayish_params(func):
     return out
 
 
+def self_method_calls(func):
+    """Method names this function calls on ``self`` (``self.f(...)``),
+    nested defs excluded — the class-scoped counterpart of
+    called_names()."""
+    out = set()
+    for node in body_walk(func):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self":
+            out.add(node.func.attr)
+    return out
+
+
 def names_in(node):
     """All bare Name ids appearing in an expression subtree."""
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}

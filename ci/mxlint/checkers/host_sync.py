@@ -20,8 +20,11 @@ What counts as traced (the roots), per file:
     (every registered op is eager-jitted and inlined into outer traces)
     unless registered ``host=True`` (the dgl-style host ops).
 
-Tracedness then propagates through same-file bare-name calls to a fixpoint
-(a helper called from a traced function is traced).
+Tracedness then propagates to a fixpoint through same-file bare-name calls
+AND same-class ``self.<method>(...)`` calls (a helper called from a traced
+function is traced) — the class propagation covers step-builder methods
+like ``parallel.sharded_trainer``'s, whose jitted inner functions call
+``self._trace_forward`` / ``self._traced_update``.
 
 Inside traced functions the checker flags:
 
@@ -45,8 +48,9 @@ from __future__ import annotations
 import ast
 
 from .. import Finding
-from ..astutil import (arrayish_params, body_walk, called_names, dotted,
-                       iter_functions, keyword_value, names_in)
+from ..astutil import (arrayish_params, body_walk, build_parents,
+                       called_names, dotted, iter_functions, keyword_value,
+                       names_in, self_method_calls)
 
 # callables whose first positional argument is traced
 _TRACE_TAKING = {
@@ -134,15 +138,36 @@ class HostSyncChecker:
                         traced.setdefault(
                             fn, "passed to %s" % (cname or "defvjp"))
 
-        # propagate through same-file bare-name calls to a fixpoint
+        # class scope: enclosing ClassDef per function (nested defs — a
+        # step builder's jitted closure — inherit the builder's class), so
+        # `self.helper(...)` resolves against the right method table
+        parents = build_parents(tree)
+        owner = {}
+        methods = {}  # ClassDef -> name -> [method nodes]
+        for fn in funcs:
+            node = parents.get(fn)
+            while node is not None and not isinstance(node, ast.ClassDef):
+                node = parents.get(node)
+            if node is not None:
+                owner[fn] = node
+                table = methods.setdefault(node, {})
+                table.setdefault(fn.name, []).append(fn)
+
+        # propagate through same-file bare-name calls and same-class
+        # self-method calls to a fixpoint
         calls = {fn: called_names(fn) for fn in funcs}
+        self_calls = {fn: self_method_calls(fn) for fn in funcs}
         roots = set(traced)
         changed = True
         while changed:
             changed = False
             for fn, reason in list(traced.items()):
-                for callee_name in calls[fn]:
-                    for callee in by_name.get(callee_name, ()):
+                callees = [by_name.get(n, ()) for n in calls[fn]]
+                if fn in owner:
+                    table = methods[owner[fn]]
+                    callees += [table.get(n, ()) for n in self_calls[fn]]
+                for group in callees:
+                    for callee in group:
                         if callee not in traced:
                             traced[callee] = "called from traced `%s`" \
                                 % fn.name

@@ -23,6 +23,16 @@ registry drops every entry carrying a tag when that tag is invalidated
 anything staging a `jax.pure_callback` into the program (custom ops, host
 ops): the serialized executable would carry a dangling host-callback
 reference into the next process. Those keys live in the memory tier only.
+
+``topology`` is the device-topology fingerprint (mesh axis names x mesh
+shape x device kinds x process count — parallel.mesh.mesh_fingerprint)
+that makes a SHARDED executable's identity honest across processes: a
+serialized sharded step is only valid on the same mesh geometry it was
+compiled for, so sharded keys reach the persistent tier only when they
+carry one (registry._dir), and a different mesh resolves to a different
+digest — an honest miss, never a wrong load. The component joins the
+canonical JSON only when set, so every pre-existing unsharded key keeps
+its on-disk digest.
 """
 from __future__ import annotations
 
@@ -56,10 +66,11 @@ class ExecutableKey:
     """One executable's identity across the memory and persistent tiers."""
 
     __slots__ = ("kind", "fingerprint", "shapes", "static", "sharded",
-                 "donation", "tags", "no_persist", "_hash")
+                 "donation", "tags", "no_persist", "topology", "_hash")
 
     def __init__(self, kind, fingerprint, shapes=None, static=(),
-                 sharded=False, donation=(), tags=(), no_persist=False):
+                 sharded=False, donation=(), tags=(), no_persist=False,
+                 topology=None):
         self.kind = str(kind)
         self.fingerprint = str(fingerprint)
         self.shapes = _freeze(shapes) if shapes is not None else None
@@ -68,13 +79,15 @@ class ExecutableKey:
         self.donation = _freeze(tuple(donation))
         self.tags = tuple(str(t) for t in tags)
         self.no_persist = bool(no_persist)
+        self.topology = str(topology) if topology else None
         self._hash = hash((self.kind, self.fingerprint, self.shapes,
-                           self.static, self.sharded, self.donation))
+                           self.static, self.sharded, self.donation,
+                           self.topology))
 
     # -- identity ----------------------------------------------------------
     def _ident(self):
         return (self.kind, self.fingerprint, self.shapes, self.static,
-                self.sharded, self.donation)
+                self.sharded, self.donation, self.topology)
 
     def __hash__(self):
         return self._hash
@@ -100,7 +113,8 @@ class ExecutableKey:
         return ExecutableKey(self.kind, self.fingerprint, shapes=self.shapes,
                             static=(self.static, _freeze(extra)),
                             sharded=self.sharded, donation=self.donation,
-                            tags=self.tags, no_persist=self.no_persist)
+                            tags=self.tags, no_persist=self.no_persist,
+                            topology=self.topology)
 
     def with_shapes(self, shapes):
         """The concrete per-shape key derived from a lazy base key (the
@@ -108,13 +122,14 @@ class ExecutableKey:
         return ExecutableKey(self.kind, self.fingerprint, shapes=shapes,
                             static=self.static, sharded=self.sharded,
                             donation=self.donation, tags=self.tags,
-                            no_persist=self.no_persist)
+                            no_persist=self.no_persist,
+                            topology=self.topology)
 
     # -- persistence -------------------------------------------------------
     def to_json(self):
         """Canonical JSON-able rendering (stable across processes — the
         digest input and the artifact-header record)."""
-        return {
+        doc = {
             "kind": self.kind,
             "fingerprint": self.fingerprint,
             "shapes": _jsonable(self.shapes),
@@ -122,6 +137,10 @@ class ExecutableKey:
             "sharded": self.sharded,
             "donation": _jsonable(self.donation),
         }
+        # only when set: pre-topology keys keep their on-disk digests
+        if self.topology is not None:
+            doc["topology"] = self.topology
+        return doc
 
     def digest(self, backend, jax_version):
         """Artifact name in the persistent tier: sha256 over the canonical

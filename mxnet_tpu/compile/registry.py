@@ -203,9 +203,12 @@ class Registry:
 
     def _dir(self, key):
         """Persistent-tier directory for this key, or None (tier off, or
-        the key cannot persist: process-local fingerprints/callbacks,
-        sharded executables)."""
-        if key.no_persist or key.sharded:
+        the key cannot persist: process-local fingerprints/callbacks, and
+        sharded executables that carry NO topology fingerprint — without
+        one, a serialized sharded step could resurrect onto a different
+        mesh geometry; keys that declare their topology (the
+        ShardedTrainer promoted path) persist like any other)."""
+        if key.no_persist or (key.sharded and key.topology is None):
             return None
         if self._persist_dir is not None:
             return self._persist_dir or None
@@ -305,9 +308,9 @@ class Registry:
     def _fill_concrete(self, key, build, args, label, on_fill, event_fields):
         """Fill ONE executable for pinned shapes: disk hit (no compile) or
         AOT trace+compile (+ store when armed). Sharded/donating keys the
-        persistent tier refuses (the fused trainer steps) still take the
-        AOT path when memory accounting is on, so their memory figures —
-        and the donation verifier — come from the compile the fill pays
+        persistent tier refuses (topology-less sharded steps) still take
+        the AOT path when memory accounting is on, so their memory figures
+        — and the donation verifier — come from the compile the fill pays
         anyway."""
         directory = self._dir(key)
         if directory is not None:

@@ -167,6 +167,23 @@ _var("MXTPU_JAX_COMPILE_CACHE", "str", None,
      "composable with — the `MXTPU_COMPILE_CACHE` executable-artifact "
      "tier: jax's cache skips XLA backend compilation but still pays "
      "trace+lower per process; the artifact tier skips everything.")
+_var("MXTPU_SHARDED_STEP", "bool", False,
+     "Promote user-facing training loops onto the fused whole-step "
+     "executable (forward + loss + backward + optimizer update as ONE "
+     "jit with donated param/state buffers, docs/sharded_training.md): "
+     "`gluon.Trainer(..., block=)` internally becomes a "
+     "`parallel.ShardedTrainer`, and `module.fit()` routes each step "
+     "through `Module.fused_step` — no model-code changes. Fused keys "
+     "carry a device-topology fingerprint, so with "
+     "`MXTPU_COMPILE_CACHE` armed their executables persist and a "
+     "restarted run reaches step 1 with zero `jit_compile` events. "
+     "Exported fleet-wide by `tools/launch.py --sharded-step`.")
+_var("MXTPU_SHARDED_PREFETCH", "bool", True,
+     "On the first fused-step cache miss, batch-stage every artifact "
+     "listed in the trainer's warmup manifest from the persistent tier "
+     "before building (`compile.prefetch`): a restarted generation "
+     "loads its whole executable set in one pass instead of "
+     "one-disk-probe-per-shape. `0` falls back to per-key probing.")
 _var("MXTPU_PY_RECORDIO", "bool", False,
      "Force the Python recordio reader/writer even when the native library "
      "is built (used by rec2idx for `tell()` positions).")
@@ -219,7 +236,13 @@ _var("MXTPU_BENCH_ITERS", "int", 10, "bench.py measured iterations.")
 _var("MXTPU_BENCH_MODE", "str", "train",
      "bench.py mode: `train`, `score` (reference benchmark_score.py "
      "analogue), `score_int8` (quantize_model int8 deployment path), "
-     "`bert` (BERT-base tokens/sec + MFU), `lstm` (word-LM).")
+     "`bert` (BERT-base tokens/sec + MFU), `lstm` (word-LM), "
+     "`train_sharded` (ShardedTrainer fused-step vs op-by-op A/B, "
+     "docs/sharded_training.md).")
+_var("MXTPU_BENCH_SHARDED_IMPL", "str", "fused",
+     "train_sharded mode implementation under test: `fused` times BOTH "
+     "the op-by-op baseline and the promoted fused step (the A/B row); "
+     "`opbyop` times only the baseline (its own committed row).")
 _var("MXTPU_BENCH_NET", "str", "resnet50",
      "model for train/score modes (`resnet152`, `inception_v3` for score; "
      "`inception_v3`, `alexnet` for train — the BASELINE.md V100 rows).")

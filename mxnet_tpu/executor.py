@@ -176,11 +176,19 @@ class Executor:
         fingerprint, no_persist = self._graph_meta()
         aux_sig = tuple(tuple(a.shape) + (str(a.dtype),)
                         for a in self.aux_arrays)
+        topology = None
+        if self._mesh is not None:
+            # the topology fingerprint lets the MESH-sharded executor
+            # executables reach the persistent tier (registry._dir)
+            from .parallel.mesh import mesh_fingerprint
+
+            topology = mesh_fingerprint(self._mesh)
         return _compile.ExecutableKey(
             kind, fingerprint, shapes=(sig[0], aux_sig),
             static=static + (self._mesh_desc(),
                              tuple(sorted(self._data_arg_names))),
-            sharded=self._mesh is not None, no_persist=no_persist)
+            sharded=self._mesh is not None, no_persist=no_persist,
+            topology=topology)
 
     # -- execution ---------------------------------------------------------
     def forward(self, is_train=False, **kwargs):

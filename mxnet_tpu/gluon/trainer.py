@@ -7,7 +7,15 @@ TPU-native: for the single-process multi-device case the grad reduction is a
 kvstore('device') push/pull which lowers onto one XLA add over device buffers;
 the *scaled* path is mxnet_tpu.parallel.DistributedTrainer, which keeps ONE
 sharded copy of each parameter on the mesh and lets XLA insert the
-all-reduces inside the compiled step (SURVEY §2.3 row 1)."""
+all-reduces inside the compiled step (SURVEY §2.3 row 1).
+
+Promotion (`sharded=True` + ``block=``/``loss=``, or fleet-wide via
+``MXTPU_SHARDED_STEP`` when a block is supplied): the trainer internally
+becomes a `parallel.ShardedTrainer` — forward + loss + backward + optimizer
+update run as ONE compiled executable with donated param/state buffers, and
+``step_batch(data, label)`` replaces the record/backward/step() triplet
+(the loss scalar stays on device until the caller asks). Promoted
+executables persist across processes (docs/sharded_training.md)."""
 from __future__ import annotations
 
 import time
@@ -23,7 +31,9 @@ __all__ = ["Trainer"]
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None, sharded=None,
+                 block=None, loss=None, mesh=None, sharding_rules=None,
+                 amp_dtype=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -38,6 +48,33 @@ class Trainer:
         self._compression_params = compression_params
         self._contexts = self._check_contexts()
         optimizer_params = optimizer_params or {}
+        # -- promotion to the fused sharded step -------------------------
+        # sharded=None defers to MXTPU_SHARDED_STEP (armed fleet-wide by
+        # tools/launch.py --sharded-step), which only promotes when the
+        # caller supplied the block — op-by-op callers are untouched
+        if sharded is None:
+            sharded = block is not None and _env.get("MXTPU_SHARDED_STEP")
+        self._sharded = None
+        if sharded:
+            if block is None:
+                raise MXNetError(
+                    "Trainer(sharded=True) needs block= (and usually "
+                    "loss=): the fused step traces the block's forward — "
+                    "see docs/sharded_training.md")
+            from ..parallel.sharded_trainer import ShardedTrainer
+
+            self._sharded = ShardedTrainer(
+                block, optimizer, optimizer_params=optimizer_params,
+                loss=loss, mesh=mesh, rules=sharding_rules,
+                amp_dtype=amp_dtype)
+            self._optimizer = self._sharded.optimizer
+            self._scale = self._optimizer.rescale_grad
+            self._kvstore_type = None
+            self._kvstore = None
+            self._kv_initialized = True
+            self._update_on_kvstore = None
+            self._step_count = 0
+            return
         self._init_optimizer(optimizer, optimizer_params)
         self._scale = self._optimizer.rescale_grad
         self._kvstore_type = kvstore
@@ -48,6 +85,12 @@ class Trainer:
         # saved/restored with the optimizer states so an auto-resumed run
         # keeps a monotonically correct step count (parallel/resilience.py)
         self._step_count = 0
+
+    @property
+    def sharded(self):
+        """The promoted `parallel.ShardedTrainer` (None on the op-by-op
+        path)."""
+        return self._sharded
 
     def _check_contexts(self):
         contexts = None
@@ -115,10 +158,39 @@ class Trainer:
     @property
     def step_count(self):
         """Number of completed step() calls (survives save/load_states)."""
+        if self._sharded is not None:
+            return self._sharded._step_count
         return self._step_count
+
+    def step_batch(self, data, label=None):
+        """The promoted hot path: one fused forward+loss+backward+update
+        over the batch, returning the (device-resident) scalar loss
+        NDArray — no host sync happens unless the caller asks for one.
+        Requires promotion (``sharded=True``/``MXTPU_SHARDED_STEP``)."""
+        if self._sharded is None:
+            raise MXNetError(
+                "step_batch() needs a promoted trainer: construct with "
+                "sharded=True, block= and loss= (docs/sharded_training.md)")
+        return self._sharded.step(data, label)
+
+    def sync_params(self):
+        """Copy mesh-trained values back into the block's Parameters (the
+        promoted path keeps ONE sharded copy per param; call this before
+        save_parameters/export). No-op on the op-by-op path, where the
+        Parameters themselves are the training copies."""
+        if self._sharded is not None:
+            self._sharded.sync_params()
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Allreduce grads + update (reference: trainer.py:298)."""
+        if self._sharded is not None:
+            raise MXNetError(
+                "this Trainer is promoted to the fused sharded step "
+                "(sharded=True/MXTPU_SHARDED_STEP): the parameters live on "
+                "the mesh and forward+backward+update run as one "
+                "executable — drive it with step_batch(data, label) "
+                "instead of record()/backward()/step() "
+                "(docs/sharded_training.md)")
         t0 = time.perf_counter()
         # distributed tracing: a sampled step records allreduce/optimizer
         # phase spans (no-op span when tracing is unarmed)
@@ -146,6 +218,8 @@ class Trainer:
             resilience.maybe_inject_fault(self._step_count)
 
     def allreduce_grads(self):
+        if self._sharded is not None:
+            return  # the fused step's psum already reduced (in-graph)
         if not self._kv_initialized:
             self._init_kvstore()
         self._allreduce_grads()
@@ -168,6 +242,10 @@ class Trainer:
                     g._set_data(total.as_in_context(g.context)._data)
 
     def update(self, batch_size, ignore_stale_grad=False):
+        if self._sharded is not None:
+            raise MXNetError(
+                "promoted Trainer: the optimizer update is fused into "
+                "step_batch() — there is no separate update() phase")
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -206,6 +284,9 @@ class Trainer:
 
         from ..base import atomic_writer
 
+        if self._sharded is not None:
+            self._sharded.save_states(fname)
+            return
         assert self._optimizer is not None
         blob = {"__mxtpu_trainer_states__": 1,
                 "updater": self._updaters[0].get_states(dump_optimizer=True),
@@ -217,6 +298,9 @@ class Trainer:
         """reference: trainer.py:458 (legacy raw updater blobs still load)."""
         import pickle
 
+        if self._sharded is not None:
+            self._sharded.load_states(fname)
+            return
         with open(fname, "rb") as f:
             raw = f.read()
         states = raw

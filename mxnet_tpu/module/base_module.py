@@ -13,6 +13,7 @@ import time
 import numpy as _np
 
 from ..base import MXNetError, unpad_outputs
+from .. import env as _env
 from .. import metric as metric_mod
 from .. import io as io_mod
 from .. import ndarray as nd
@@ -67,6 +68,15 @@ class BaseModule(object):
         """reference: base_module.py:193."""
         self.forward(data_batch, is_train=True)
         self.backward()
+
+    def supports_fused_step(self):
+        """Whether fit() may replace forward_backward()+update() with one
+        fused compiled step (Module overrides; everything else stays on
+        the op-by-op composite path)."""
+        return False
+
+    def fused_step(self, data_batch):
+        raise NotImplementedError()
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
@@ -208,6 +218,13 @@ class BaseModule(object):
         tm_compute = telemetry.counter("mxtpu_data_compute_seconds_total",
                                        {"src": "fit"})
 
+        # MXTPU_SHARDED_STEP: run forward+backward+update as ONE compiled
+        # donated executable per step (module doc: docs/sharded_training.md).
+        # A monitor needs per-op intermediate outputs, so it forces the
+        # op-by-op composite path.
+        use_fused = (monitor is None and _env.get("MXTPU_SHARDED_STEP")
+                     and self.supports_fused_step())
+
         fit_updates = 0
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -236,10 +253,14 @@ class BaseModule(object):
                         "train.data_wait",
                         time.time() - (t_step - t_wait), t_step - t_wait,
                         t_span, component="train")
-                    with telemetry.tracing.span("train.fwd_bwd"):
-                        self.forward_backward(data_batch)
-                    with telemetry.tracing.span("train.optimizer"):
-                        self.update()
+                    if use_fused:
+                        with telemetry.tracing.span("train.fused_step"):
+                            self.fused_step(data_batch)
+                    else:
+                        with telemetry.tracing.span("train.fwd_bwd"):
+                            self.forward_backward(data_batch)
+                        with telemetry.tracing.span("train.optimizer"):
+                            self.update()
                     fit_updates += 1
                     examples = None
                     try:

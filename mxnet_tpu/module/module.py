@@ -73,6 +73,7 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._grad_req = "write"
+        self._fused = None  # ModuleFusedStep when MXTPU_SHARDED_STEP armed
 
     # -- properties --------------------------------------------------------
     @property
@@ -263,6 +264,42 @@ class Module(BaseModule):
                 continue
             self._updater(i, grad, self._exec.arg_dict[name])
 
+    # -- the fused whole-step path (MXTPU_SHARDED_STEP) ---------------------
+    def supports_fused_step(self):
+        """Whether fit() may run this module through ONE compiled
+        forward+backward+update executable (parallel.sharded_trainer.
+        ModuleFusedStep): bound for training with an optimizer, plain
+        'write' grads, and no input-gradient consumers."""
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized and self.for_training):
+            return False
+        if self.inputs_need_grad:
+            return False
+        return any(self._exec.grad_req.get(n, "null") == "write"
+                   for n in self._param_names)
+
+    def fused_step(self, data_batch):
+        """One fused train step (forward + backward + optimizer update as
+        a single donated executable); outputs land in get_outputs() on
+        device. fit() calls this instead of forward_backward()+update()
+        when MXTPU_SHARDED_STEP is armed — no model-code changes."""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        if self._fused is None:
+            from ..parallel.sharded_trainer import ModuleFusedStep
+
+            self._fused = ModuleFusedStep(self._exec, self._optimizer,
+                                          self._param_names)
+        feeds = {}
+        data = data_batch.data if hasattr(data_batch, "data") else data_batch
+        for name_shape, arr in zip(self._data_shapes, data):
+            feeds[name_shape[0]] = arr
+        labels = getattr(data_batch, "label", None) or []
+        for name_shape, arr in zip(self._label_shapes, labels):
+            if name_shape[0] in self._exec._arg_names:
+                feeds[name_shape[0]] = arr
+        return self._fused(feeds)
+
     def get_outputs(self, merge_multi_context=True):
         assert self.binded
         return list(self._exec.outputs)
@@ -315,6 +352,10 @@ class Module(BaseModule):
         from ..base import atomic_writer
 
         assert self.optimizer_initialized
+        if self._fused is not None:
+            # fused steps keep optimizer state device-side; write it back
+            # into the op-by-op updater so the states file stays portable
+            self._fused.sync_updater(self._updater)
         # atomic (temp + fsync + rename): save_checkpoint's .states file
         # gets the same crash-consistency as its .params file
         with atomic_writer(fname, "wb") as f:

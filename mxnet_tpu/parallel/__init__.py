@@ -9,6 +9,11 @@ with mesh-sharded compiled steps:
                 + multi-host bootstrap (jax.distributed rendezvous)
   trainer     — DistributedTrainer: fwd+loss+bwd+optimizer as ONE compiled
                 sharded step with donated buffers
+  sharded_trainer — ShardedTrainer: the same fused step with a
+                cross-process-stable key + device-topology fingerprint, so
+                its executables persist (MXTPU_COMPILE_CACHE) and restarts
+                reach step 1 with zero compiles; also ModuleFusedStep, the
+                module.fit() promotion
   ring_attention — exact sequence-parallel attention over the sp axis
   pipeline    — GPipe-style microbatch pipeline over the pp axis
   pipeline_trainer — PipelineTrainer: pipeline a real Gluon model
@@ -26,6 +31,7 @@ from . import collectives
 from .collectives import (init_process_group, rank, num_workers, barrier,
                           all_reduce_arrays)
 from .trainer import DistributedTrainer
+from .sharded_trainer import ShardedTrainer, ModuleFusedStep
 from . import resilience
 from .resilience import CheckpointManager, maybe_inject_fault
 from .ring_attention import ring_attention, ring_attention_sharded
@@ -38,6 +44,7 @@ __all__ = [
     "ShardingRules", "named_sharding", "shard_array", "batch_spec",
     "param_spec", "constraint", "collectives", "init_process_group", "rank",
     "num_workers", "barrier", "all_reduce_arrays", "DistributedTrainer",
+    "ShardedTrainer", "ModuleFusedStep",
     "resilience", "CheckpointManager", "maybe_inject_fault",
     "ring_attention", "ring_attention_sharded",
     "pipeline_apply", "pipeline_stack_params", "PipelineTrainer",

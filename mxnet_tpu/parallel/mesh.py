@@ -25,6 +25,7 @@ import numpy as np
 
 __all__ = [
     "make_mesh", "default_mesh", "current_mesh", "use_mesh", "local_devices",
+    "mesh_fingerprint",
     "DP", "FSDP", "TP", "PP", "SP", "EP",
 ]
 
@@ -72,6 +73,25 @@ def make_mesh(axes=None, devices=None):
                          f"{math.prod(sizes)} devices, have {n}")
     dev_array = np.asarray(devices).reshape(sizes)
     return Mesh(dev_array, tuple(names))
+
+
+def mesh_fingerprint(mesh):
+    """Device-topology fingerprint of a mesh: named axes x shape x sorted
+    device kinds x process count, as one deterministic string (e.g.
+    ``dp=2,tp=4|cpu|procs=1``). This is the `ExecutableKey.topology`
+    component that lets SHARDED executables reach the persistent compile
+    cache honestly: a serialized sharded step deserializes only onto the
+    same mesh geometry and device fleet it was compiled for, so the
+    fingerprint rides the artifact digest — same topology across a restart
+    hits, any other topology is a clean miss (docs/compile_cache.md)."""
+    import jax
+
+    axes = ",".join("%s=%d" % (str(n), int(s))
+                    for n, s in zip(mesh.axis_names, mesh.devices.shape))
+    devices = list(mesh.devices.flat)
+    kinds = sorted({str(getattr(d, "device_kind", None) or d.platform)
+                    for d in devices})
+    return "%s|%s|procs=%d" % (axes, "+".join(kinds), jax.process_count())
 
 
 def default_mesh():

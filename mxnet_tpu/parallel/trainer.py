@@ -227,6 +227,33 @@ class DistributedTrainer:
         return _traced_update(self._optimizer, self._params[0].list_ctx()[0],
                               self._trainable, weights, grads, states, t, lr)
 
+    # -- executable identity (ShardedTrainer overrides) -----------------
+    def _step_key(self, sig):
+        """Cache key for the fused step at one batch signature. The base
+        trainer's fingerprint is a process-local instance token, so the
+        key is quarantined from the persistent tier (no_persist);
+        ShardedTrainer substitutes a stable cross-process fingerprint +
+        topology and drops the quarantine."""
+        from .. import compile as _compile
+
+        return _compile.ExecutableKey("dist_step", self._compile_token,
+                                      shapes=sig, sharded=True,
+                                      donation=(3, 4), no_persist=True)
+
+    def _forward_key(self, sig):
+        from .. import compile as _compile
+
+        return _compile.ExecutableKey("dist_forward", self._compile_token,
+                                      shapes=sig, sharded=True,
+                                      no_persist=True)
+
+    def _resolve(self, key, build, **kw):
+        """Registry resolution hook: ShardedTrainer brackets this with
+        manifest prefetch/record so its fills land in a warmup manifest."""
+        from .. import compile as _compile
+
+        return _compile.get_or_build(key, build, **kw)
+
     def _build_step(self, batch_shapes):
         import jax
         import jax.numpy as jnp
@@ -336,7 +363,6 @@ class DistributedTrainer:
         # optimizer's own value.
 
         sig = tuple((tuple(b.shape), str(b.dtype)) for b in batch)
-        from .. import compile as _compile
         from .. import telemetry
 
         # the step's RNG key is minted BEFORE the executable fill: the AOT
@@ -360,10 +386,8 @@ class DistributedTrainer:
                     jax.tree_util.tree_map(aval, list(self._states)),
                     *[aval(b) for b in batch])
 
-        fn = _compile.get_or_build(
-            _compile.ExecutableKey("dist_step", self._compile_token,
-                                   shapes=sig, sharded=True,
-                                   donation=(3, 4), no_persist=True),
+        fn = self._resolve(
+            self._step_key(sig),
             lambda: self._build_step([b.shape for b in batch]),
             label="dist_trainer_step",
             example_args=example_avals,
@@ -445,12 +469,8 @@ class DistributedTrainer:
                     list(self._shardings),
                     named_sharding(self._mesh, batch_spec(self._mesh, x.ndim))))
 
-            from .. import compile as _compile
-
-            fn = _compile.get_or_build(
-                _compile.ExecutableKey("dist_forward", self._compile_token,
-                                       shapes=sig, sharded=True,
-                                       no_persist=True),
+            fn = self._resolve(
+                self._forward_key(sig),
                 build, label="dist_trainer_forward",
                 example_args=lambda: (
                     jax.ShapeDtypeStruct(key.shape, key.dtype),

@@ -1,0 +1,368 @@
+"""ShardedTrainer: the promoted whole-step hot path (ISSUE 16 tentpole).
+
+Covers the quarantine lift end to end on the 8-virtual-device CPU mesh:
+
+  * numeric equivalence — the fused forward+loss+backward+update
+    executable reproduces the op-by-op gluon.Trainer loop exactly (fp32,
+    1-device mesh, tiny steps), and module.fit's fused promotion
+    reproduces op-by-op fit;
+  * cross-process persistence — a sharded+donated step key (topology
+    fingerprint attached) round-trips the persistent artifact tier: a
+    fresh process reaches its first step with zero ``jit_compile``
+    events and a stable manifest id;
+  * topology honesty — a key whose mesh topology differs digests
+    differently (honest miss, never a wrong-mesh artifact), and
+    topology-less sharded keys stay quarantined from disk;
+  * restart e2e — ``tools/launch.py --max-restarts --compile-cache
+    --sharded-step``: the respawned generation trains to step 1 with
+    ZERO compiles, riding the warmup manifest generation 0 wrote.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel as par
+from mxnet_tpu.compile import ExecutableKey
+from mxnet_tpu.gluon import nn, loss as gloss
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LAUNCH = os.path.join(_ROOT, "tools", "launch.py")
+
+
+def _mlp(prefix):
+    # explicit prefixes: auto-numbered dense counters break cross-net
+    # weight pairing when the whole suite runs (see test_parallel._mlp)
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", prefix="d1_"))
+        net.add(nn.Dense(3, prefix="d2_"))
+    net.initialize()
+    return net
+
+
+def _one_device_mesh():
+    import jax
+
+    return par.make_mesh([("dp", 1)], devices=[jax.devices()[0]])
+
+
+# --------------------------------------------------------------------------
+# numeric equivalence
+# --------------------------------------------------------------------------
+
+def test_sharded_trainer_matches_opbyop_gluon():
+    """fp32, tiny model, 1-device mesh: 3 fused steps == 3 op-by-op
+    record/backward/step triplets, to float tolerance."""
+    from mxnet_tpu import autograd
+
+    np.random.seed(0)
+    x = mx.nd.array(np.random.randn(4, 5).astype("float32"))
+    y = mx.nd.array(np.random.randint(0, 3, (4,)).astype("float32"))
+    mx.random.seed(11)
+    net_a = _mlp("sta_")
+    net_a(x)
+    mx.random.seed(12)
+    net_b = _mlp("stb_")
+    net_b(x)
+    pa = sorted(net_a.collect_params().items())
+    pb = sorted(net_b.collect_params().items())
+    for (_, a), (_, b) in zip(pa, pb):
+        b.set_data(a.data())
+
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9},
+                         sharded=True, block=net_b, loss=loss_fn,
+                         mesh=_one_device_mesh())
+    assert tr_b.sharded is not None
+    assert tr_b.sharded.topology.startswith("dp=1|")
+
+    for step in range(3):
+        with autograd.record():
+            la = loss_fn(net_a(x), y)
+        la.backward()
+        tr_a.step(4)
+        lb = tr_b.step_batch(x, y)
+        np.testing.assert_allclose(float(la.mean().asscalar()),
+                                   float(lb.asscalar()),
+                                   rtol=1e-5, atol=1e-6)
+    assert tr_b.step_count == 3
+
+    # promoted trainer refuses the op-by-op driving surface
+    with pytest.raises(mx.base.MXNetError):
+        tr_b.step(4)
+    with pytest.raises(mx.base.MXNetError):
+        tr_b.update(4)
+
+    tr_b.sync_params()
+    for (_, a), (_, b) in zip(pa, pb):
+        np.testing.assert_allclose(a.data().asnumpy(), b.data().asnumpy(),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_trainer_sharded_requires_block():
+    net = _mlp("stc_")
+    with pytest.raises(mx.base.MXNetError):
+        gluon.Trainer(net.collect_params(), "sgd", sharded=True)
+
+
+def test_module_fit_fused_matches_opbyop(monkeypatch):
+    """module.fit under MXTPU_SHARDED_STEP routes through ONE fused
+    executable per step (no model-code change) and reproduces the
+    op-by-op forward_backward+update schedule exactly."""
+    import mxnet_tpu.symbol as S
+    from mxnet_tpu import module as mod
+
+    data = S.Variable("data")
+    h = S.FullyConnected(data, num_hidden=8, name="ff1")
+    h = S.Activation(h, act_type="relu")
+    h = S.FullyConnected(h, num_hidden=3, name="ff2")
+    net = S.SoftmaxOutput(h, name="softmax")
+
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (12, 5)).astype(np.float32)
+    Y = rng.randint(0, 3, (12,)).astype(np.float32)
+
+    def run(fused, tmpdir=None):
+        monkeypatch.setenv("MXTPU_SHARDED_STEP", "1" if fused else "0")
+        mx.random.seed(3)
+        np.random.seed(3)
+        m = mod.Module(net, data_names=["data"],
+                       label_names=["softmax_label"])
+        it = mx.io.NDArrayIter(X, Y, batch_size=4,
+                               label_name="softmax_label")
+        m.bind(data_shapes=it.provide_data,
+               label_shapes=it.provide_label)
+        m.init_params(mx.init.Xavier())
+        m.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9})
+        assert m.supports_fused_step()
+        m.fit(it, num_epoch=2, eval_metric="acc")
+        if fused:
+            assert m._fused is not None and m._fused._step_count == 6
+            # fused optimizer state flows back into the op-by-op updater
+            # (portable .states file)
+            states = os.path.join(str(tmpdir), "m.states") if tmpdir \
+                else None
+            if states:
+                m.save_optimizer_states(states)
+                assert m._updater.states_synced
+        else:
+            assert m._fused is None
+        return {k: v.asnumpy() for k, v in m.get_params()[0].items()}
+
+    a = run(False)
+    b = run(True)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+
+
+# --------------------------------------------------------------------------
+# key topology / persistence admission
+# --------------------------------------------------------------------------
+
+def test_mesh_fingerprint_shape():
+    m = par.make_mesh([("dp", 2), ("tp", 4)])
+    fp = par.mesh.mesh_fingerprint(m)
+    assert fp.startswith("dp=2,tp=4|") and fp.endswith("|procs=1")
+    m1 = par.make_mesh([("dp", 8)])
+    assert par.mesh.mesh_fingerprint(m1) != fp
+
+
+def test_topology_mismatch_is_honest_miss():
+    """Same step, different mesh topology -> different digest: a restart
+    on different hardware can NEVER load the wrong mesh's executable."""
+    base = dict(kind="sharded_step", fingerprint="sharded:abc",
+                shapes=((4, 5),), sharded=True, donation=(3, 4))
+    k1 = ExecutableKey(topology="dp=1|cpu|procs=1", **base)
+    k2 = ExecutableKey(topology="dp=2|cpu|procs=1", **base)
+    k3 = ExecutableKey(topology="dp=1|cpu|procs=1", **base)
+    assert k1.digest("cpu", "0.4") != k2.digest("cpu", "0.4")
+    assert k1.digest("cpu", "0.4") == k3.digest("cpu", "0.4")
+    assert k1 != k2 and k1 == k3
+
+    # pre-topology keys keep their on-disk digests: topology only joins
+    # the canonical JSON when set
+    plain = ExecutableKey("fwd", "fp", shapes=((2, 2),))
+    assert "topology" not in plain.to_json()
+    assert "topology" in k1.to_json()
+
+
+def test_registry_admits_topology_sharded_quarantines_topologyless(
+        tmp_path, monkeypatch):
+    """The quarantine lift itself: sharded+donated keys WITH a topology
+    fingerprint reach the persistent tier; topology-less sharded keys
+    (plus anything no_persist) still never touch disk."""
+    from mxnet_tpu.compile.registry import Registry
+
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE", str(tmp_path))
+    reg = Registry()
+    lifted = ExecutableKey("sharded_step", "fp", shapes=((2,),),
+                           sharded=True, donation=(3, 4),
+                           topology="dp=1|cpu|procs=1")
+    legacy = ExecutableKey("dist_step", "fp", shapes=((2,),), sharded=True)
+    pinned = ExecutableKey("sharded_step", "fp", shapes=((2,),),
+                           sharded=True, topology="dp=1|cpu|procs=1",
+                           no_persist=True)
+    local = ExecutableKey("fwd", "fp", shapes=((2,),))
+    assert reg._dir(lifted) is not None
+    assert reg._dir(legacy) is None
+    assert reg._dir(pinned) is None
+    assert reg._dir(local) is not None
+
+
+# --------------------------------------------------------------------------
+# cross-process persistence + restart e2e
+# --------------------------------------------------------------------------
+
+_ROUNDTRIP = r"""
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel as par, telemetry
+from mxnet_tpu.gluon import nn, loss as gloss
+import jax
+
+np.random.seed(0); mx.random.seed(0)
+net = nn.HybridSequential(prefix="rt_")
+with net.name_scope():
+    net.add(nn.Dense(4, activation="relu", prefix="d1_"))
+    net.add(nn.Dense(3, prefix="d2_"))
+net.initialize()
+x = mx.nd.array(np.random.randn(4, 5).astype("float32"))
+y = mx.nd.array(np.random.randint(0, 3, (4,)).astype("float32"))
+net(x)
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                   sharded=True, block=net,
+                   loss=gloss.SoftmaxCrossEntropyLoss(),
+                   mesh=par.make_mesh([("dp", 1)],
+                                      devices=[jax.devices()[0]]))
+for _ in range(2):
+    tr.step_batch(x, y).asscalar()
+print("misses=%d persist_hits=%d manifest=%s" % (
+    telemetry.counter("mxtpu_jit_cache_miss_total").value,
+    telemetry.counter("mxtpu_compile_cache_persist_hit_total").value,
+    tr.sharded.manifest_id))
+"""
+
+
+def test_sharded_persist_cross_process_roundtrip(tmp_path):
+    """A sharded+donated step key round-trips the persistent tier: run 2
+    (fresh process, same declared topology) fills nothing and loads
+    everything, under the SAME cross-process manifest id."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXTPU_COMPILE_CACHE=str(tmp_path), PYTHONPATH=_ROOT)
+    env.pop("MXTPU_TELEMETRY_DIR", None)
+
+    def run():
+        r = subprocess.run([sys.executable, "-c", _ROUNDTRIP], env=env,
+                           capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, r.stderr[-3000:]
+        return r.stdout.strip().splitlines()[-1]
+
+    out1 = run()
+    assert "persist_hits=0" in out1 and "misses=0" not in out1, out1
+    out2 = run()
+    assert "misses=0" in out2, out2
+    assert "persist_hits=0" not in out2, out2
+    # the stable fingerprint survives the process boundary
+    assert out1.split("manifest=")[1] == out2.split("manifest=")[1]
+    assert os.path.isdir(os.path.join(str(tmp_path), "manifests"))
+
+
+_RESTART_WORKER = r"""
+import os, sys
+gen = os.environ.get("MXTPU_RESTART_GENERATION", "0")
+tdir = os.path.join(os.environ["TRB_TDIR"], "gen" + gen)
+os.makedirs(tdir, exist_ok=True)
+os.environ["MXTPU_TELEMETRY_DIR"] = tdir
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn, loss as gloss
+
+np.random.seed(0); mx.random.seed(0)
+net = nn.HybridSequential(prefix="rw_")
+with net.name_scope():
+    net.add(nn.Dense(4, activation="relu", prefix="d1_"))
+    net.add(nn.Dense(3, prefix="d2_"))
+net.initialize()
+# batch 8: divisible by the default data-parallel mesh whether the
+# worker sees 1 real CPU device or the suite's 8 virtual ones
+x = mx.nd.array(np.random.randn(8, 5).astype("float32"))
+y = mx.nd.array(np.random.randint(0, 3, (8,)).astype("float32"))
+net(x)
+# promotion via the launcher-armed env (MXTPU_SHARDED_STEP=1): block=
+# supplied, sharded= left to default
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                   block=net, loss=gloss.SoftmaxCrossEntropyLoss())
+assert tr.sharded is not None, "env promotion did not arm"
+loss = float(tr.step_batch(x, y).asscalar())
+print("TRAIN_OK gen=%s loss=%.6f" % (gen, loss), flush=True)
+# generation 0 dies after seeding the cache; generation 1 must reach
+# step 1 without compiling anything
+sys.exit(0 if gen == "1" else 5)
+"""
+
+
+def test_launch_restart_zero_compiles(tmp_path):
+    """THE restart acceptance: tools/launch.py --max-restarts
+    --compile-cache --sharded-step; generation 0 compiles + persists and
+    dies, generation 1 re-trains to step 1 with ZERO jit_compile
+    events."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_RESTART_WORKER)
+    cache = tmp_path / "cache"
+    tbase = tmp_path / "telemetry"
+    cache.mkdir()
+    tbase.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MXTPU_TELEMETRY_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, _LAUNCH, "-n", "1", "--max-restarts", "2",
+         "--restart-backoff", "0.2",
+         "--compile-cache", str(cache), "--sharded-step",
+         "--env", "TRB_TDIR=%s" % tbase,
+         "--env", "PYTHONPATH=%s" % _ROOT,
+         "--", sys.executable, str(worker)],
+        env=env, capture_output=True, text=True, timeout=420)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert "TRAIN_OK gen=0" in out and "TRAIN_OK gen=1" in out, out[-4000:]
+
+    def events(gen):
+        counts = {}
+        gdir = tbase / ("gen%d" % gen)
+        for name in os.listdir(gdir):
+            if not name.endswith(".jsonl"):
+                continue
+            with open(gdir / name) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("kind") == "event":
+                        ev = rec.get("event")
+                        counts[ev] = counts.get(ev, 0) + 1
+        return counts
+
+    e0, e1 = events(0), events(1)
+    assert e0.get("jit_compile", 0) > 0, e0      # gen 0 paid the compiles
+    assert e1.get("jit_compile", 0) == 0, e1     # gen 1 paid NONE
+    assert e1.get("compile_persist_hit", 0) > 0, e1
+    # both lives trained the same first step from the same seed
+    losses = sorted(set(
+        ln.split("loss=")[1] for ln in out.splitlines()
+        if "TRAIN_OK" in ln))
+    assert len(losses) == 1, losses

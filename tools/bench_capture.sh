@@ -112,6 +112,18 @@ run_one() {  # run_one <suffix> [extra ENV=VAL ...]
 run_one train           MXTPU_BENCH_MODE=train
 run_one score           MXTPU_BENCH_MODE=score
 
+# hot-path promotion A/B (docs/sharded_training.md): op-by-op gluon loop
+# vs the fused ShardedTrainer whole-step executable on a dispatch-bound
+# MLP. The fused row times BOTH impls in-process (speedup, per-step
+# dispatch delta, donation aliased_fraction, data-wait/compute split);
+# the opbyop row pins the op-by-op number on its own trajectory
+run_one train_sharded_opbyop MXTPU_BENCH_MODE=train_sharded \
+                             MXTPU_BENCH_SHARDED_IMPL=opbyop \
+                             MXTPU_BENCH_BATCH=256
+run_one train_sharded_fused  MXTPU_BENCH_MODE=train_sharded \
+                             MXTPU_BENCH_SHARDED_IMPL=fused \
+                             MXTPU_BENCH_BATCH=256
+
 echo "[bench_capture] step profile" >&2
 rm -rf step_trace
 PYTHONPATH=".:${PYTHONPATH:-}" timeout 1200 python tools/step_profile.py 256 \
@@ -234,6 +246,23 @@ if ls "$COLD_TDIR"/coldstart_bench_*/telemetry_*/*.jsonl >/dev/null 2>&1; then
     > "BENCH_${TAG}_coldstart_telemetry.jsonl"
 fi
 rm -rf "$COLD_TDIR"
+
+# fused-restart cold start: TRAINING time-to-step-1, cold vs warm
+# persistent cache (docs/sharded_training.md) — the quarantine-lift
+# proof: a restarted promoted-trainer life must reach step 1 with ZERO
+# jit_compile events (rc=4 otherwise), riding the warmup manifest its
+# cold life wrote
+echo "[bench_capture] train restart (fused sharded step, compile cache)" >&2
+TRB_TDIR=$(mktemp -d "telemetry_${TAG}_train_restart.XXXX")
+env PYTHONPATH=".:${PYTHONPATH:-}" TMPDIR="$TRB_TDIR" \
+  timeout 900 python tools/train_restart_bench.py \
+  > "BENCH_${TAG}_train_restart.json" 2> "BENCH_${TAG}_train_restart.log"
+echo "[bench_capture] train restart rc=$?" >&2
+if ls "$TRB_TDIR"/train_restart_bench_*/telemetry_*/*.jsonl >/dev/null 2>&1; then
+  cat "$TRB_TDIR"/train_restart_bench_*/telemetry_*/*.jsonl \
+    > "BENCH_${TAG}_train_restart_telemetry.jsonl"
+fi
+rm -rf "$TRB_TDIR"
 
 # memory row: the serving memory budget's evidence (docs/observability.md
 # §Memory) — per-bucket memory_analysis footprint, over-budget load

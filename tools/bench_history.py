@@ -111,10 +111,35 @@ def _extract(doc):
                     _fmt(cold.get("ready_s"), 1),
                     _fmt(doc.get("ready_speedup")),
                     _fmt(warm.get("jit_compiles"), 0)))
+    if "train_sharded" in metric and "value" in doc:
+        # the hot-path promotion A/B row (bench.py bench_train_sharded):
+        # surface the fused-vs-op-by-op evidence, the dispatch-overhead
+        # delta, the donation aliasing and the data-wait share
+        detail = []
+        if doc.get("speedup_fused_vs_opbyop") is not None:
+            detail.append("x%s vs op-by-op"
+                          % _fmt(doc["speedup_fused_vs_opbyop"]))
+        if doc.get("dispatch_per_step_opbyop") is not None:
+            detail.append("dispatch %s->%s/step" % (
+                _fmt(doc["dispatch_per_step_opbyop"], 0),
+                _fmt(doc.get("dispatch_per_step_fused"), 0)))
+        if doc.get("aliased_fraction") is not None:
+            detail.append("aliased %s" % _fmt(doc["aliased_fraction"]))
+        if doc.get("data_wait_fraction") is not None:
+            detail.append("wait %s%%"
+                          % _fmt(100 * doc["data_wait_fraction"], 1))
+        if doc.get("stale"):
+            detail.append("STALE")
+        return (metric, doc.get("value"), doc.get("unit") or "",
+                ", ".join(detail))
     if metric and "value" in doc:
         detail = []
         if doc.get("mfu") is not None:
             detail.append("MFU %s" % _fmt(doc["mfu"], 3))
+        if doc.get("data_wait_fraction") is not None:
+            # data-wait vs compute split of the timed region (train rows)
+            detail.append("wait %s%%"
+                          % _fmt(100 * doc["data_wait_fraction"], 1))
         if doc.get("vs_baseline") is not None:
             detail.append("x%s vs %s" % (_fmt(doc["vs_baseline"]),
                                          (doc.get("baseline") or {}).get(
@@ -204,7 +229,9 @@ _CHECK_METRICS = {
     "decode_tokens_per_sec": "higher",
     "failover_rps": "higher",
     "coldstart_ready": "lower",     # warm time-to-ready, seconds
+    # (includes coldstart_train_*: fused-restart time-to-step-1)
     "autoscale_scale_up_s": "lower",  # surge -> grown pool serving
+    "train_sharded": "higher",      # promotion A/B imgs/sec, per impl+bs
 }
 
 
@@ -261,6 +288,17 @@ def check(rows, tolerance=0.15):
             # both false-alarm and mask real regressions
             names = sorted({str(r["metric"]) for r in usable
                             if str(r["metric"]).startswith("coldstart")})
+            for name in names:
+                gate(name, [r for r in usable if r["metric"] == name],
+                     lambda r: r["value"], direction)
+            continue
+        if metric == "train_sharded":
+            # per-impl-per-batch families (mlp_train_sharded_fused_bs256_
+            # imgs_per_sec, ...): fused and op-by-op each gate on their
+            # own history — racing them would mask a fused regression
+            # behind an op-by-op improvement
+            names = sorted({str(r["metric"]) for r in usable
+                            if "train_sharded" in str(r["metric"])})
             for name in names:
                 gate(name, [r for r in usable if r["metric"] == name],
                      lambda r: r["value"], direction)

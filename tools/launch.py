@@ -487,6 +487,21 @@ def main(argv=None):
                              "env flags (scheduler forwards the env)")
     parser.add_argument("--env", action="append", default=[],
                         help="extra KEY=VAL for every worker")
+    parser.add_argument("--compile-cache", nargs="?", const="1",
+                        default=None, metavar="DIR",
+                        help="arm the persistent executable-artifact tier "
+                             "(MXTPU_COMPILE_CACHE, docs/compile_cache.md) "
+                             "for every worker in every generation: a "
+                             "restarted generation reloads its compiled "
+                             "steps from DIR (default: the repo-local "
+                             "cache) and reaches step 1 with zero "
+                             "jit_compile events")
+    parser.add_argument("--sharded-step", action="store_true",
+                        help="export MXTPU_SHARDED_STEP=1 fleet-wide: "
+                             "gluon.Trainer(block=)/module.fit() promote "
+                             "to the fused whole-step executable "
+                             "(docs/sharded_training.md); pair with "
+                             "--compile-cache so restarts skip compiles")
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="elastic supervision: after a group failure "
                              "(escalating SIGTERM→SIGKILL teardown) respawn "
@@ -505,6 +520,17 @@ def main(argv=None):
         args.command = args.command[1:]
     if not args.command:
         parser.error("no command given")
+    # restart-path arming: fold the cache/promotion flags into the --env
+    # list so every launcher AND every elastic restart generation
+    # (_protocol_env) exports them — explicit --env KEY=VAL still wins
+    # because later entries overwrite earlier ones
+    armed = []
+    if args.compile_cache is not None:
+        armed.append("MXTPU_COMPILE_CACHE=%s" % args.compile_cache)
+    if args.sharded_step:
+        armed.append("MXTPU_SHARDED_STEP=1")
+    if armed:
+        args.env = armed + args.env
 
     return {"local": _launch_local,
             "ssh": _launch_ssh,

@@ -1,0 +1,187 @@
+"""Fused-restart cold-start bench: TRAINING time-to-step-1, cold vs warm
+persistent compile cache (docs/sharded_training.md, docs/compile_cache.md).
+
+The serving coldstart bench (tools/coldstart_bench.py) proves the replica
+path; this one proves the ShardedTrainer quarantine lift — that a fused
+sharded+donated TRAIN step round-trips the persistent artifact tier.
+It spawns the same tiny promoted-trainer job TWICE against one
+``MXTPU_COMPILE_CACHE`` directory:
+
+  * run 1 (**cold**): empty cache — the whole-step executable is traced,
+    compiled, verified for donation aliasing, persisted, and recorded in
+    the trainer's warmup manifest;
+  * run 2 (**restart**): a fresh process rebuilds the same trainer; its
+    topology-fingerprinted key digests identically, the manifest
+    prefetches, and the acceptance contract is ZERO ``jit_compile``
+    events in its telemetry (exit 4 otherwise) with a measurably lower
+    time-to-step-1.
+
+One JSON row on stdout (``bench_capture.sh`` archives it as
+``BENCH_<tag>_train_restart.json``; ``coldstart_train_*`` metrics join
+the coldstart family in ``tools/bench_history.py --check``).
+
+Usage: python tools/train_restart_bench.py [--steps 4] [--cache-dir DIR]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def log(msg):
+    sys.stderr.write("[train_restart_bench] %s\n" % msg)
+    sys.stderr.flush()
+
+
+def _jsonl_events(tdir):
+    counts = {}
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "event":
+                    ev = rec.get("event")
+                    counts[ev] = counts.get(ev, 0) + 1
+    return counts
+
+
+def _worker(steps):
+    """One training life: build the promoted trainer, time to the first
+    completed fused step (trace + compile or persist-load + run), then a
+    few steady steps. Prints one JSON line."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    t0 = time.monotonic()
+    ctx = mx.cpu()
+    net = nn.HybridSequential(prefix="tr_")
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu", prefix="fc1_"))
+        net.add(nn.Dense(10, prefix="fc2_"))
+    net.initialize(ctx=ctx)
+    x = mx.nd.array(np.random.uniform(-1, 1, (16, 32)).astype(np.float32))
+    y = mx.nd.array(np.random.randint(0, 10, (16,)).astype(np.float32))
+    net(x)
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd", {"learning_rate": 0.05},
+        sharded=True, block=net, loss=gloss.SoftmaxCrossEntropyLoss())
+    loss = float(trainer.step_batch(x, y).asscalar())
+    ready_s = time.monotonic() - t0
+    for _ in range(steps - 1):
+        loss = float(trainer.step_batch(x, y).asscalar())
+    print(json.dumps({"ready_s": round(ready_s, 3),
+                      "total_s": round(time.monotonic() - t0, 3),
+                      "steps": steps, "final_loss": round(loss, 6),
+                      "manifest_id": trainer.sharded.manifest_id,
+                      "topology": trainer.sharded.topology}))
+    return 0
+
+
+def _spawn_run(tag, steps, cache_dir, workdir, timeout_s):
+    tdir = os.path.join(workdir, "telemetry_" + tag)
+    os.makedirs(tdir, exist_ok=True)
+    env = dict(os.environ, MXTPU_COMPILE_CACHE=cache_dir,
+               MXTPU_TELEMETRY_DIR=tdir, PYTHONPATH=_ROOT)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--steps", str(steps)],
+        env=env, capture_output=True, text=True, timeout=timeout_s)
+    if r.returncode != 0:
+        raise RuntimeError("%s worker failed rc=%d:\n%s"
+                           % (tag, r.returncode, r.stderr[-2000:]))
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    events = _jsonl_events(tdir)
+    row["jit_compiles"] = events.get("jit_compile", 0)
+    row["persist_hits"] = events.get("compile_persist_hit", 0)
+    row["persist_bad"] = events.get("compile_persist_bad", 0)
+    row["manifest_prefetches"] = events.get("sharded_manifest_prefetch", 0)
+    return row
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--steps", type=int, default=4,
+                   help="fused steps per life (step 1 is the timed one)")
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent cache dir (default: fresh temp dir)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-life budget (seconds)")
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return _worker(args.steps)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the bench process itself never trains; nothing here may seed the
+    # cache the COLD life must find empty
+    workdir = tempfile.mkdtemp(prefix="train_restart_bench_")
+    cache_dir = args.cache_dir or os.path.join(workdir, "compile_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+
+    log("life 1/2: COLD (empty cache %s)" % cache_dir)
+    cold = _spawn_run("cold", args.steps, cache_dir, workdir, args.timeout)
+    log("cold: step-1 %.2fs, %d jit_compiles"
+        % (cold["ready_s"], cold["jit_compiles"]))
+
+    artifacts, artifact_bytes = 0, 0
+    objects = os.path.join(cache_dir, "objects")
+    if os.path.isdir(objects):
+        for name in os.listdir(objects):
+            artifacts += 1
+            artifact_bytes += os.path.getsize(os.path.join(objects, name))
+
+    log("life 2/2: RESTART (warm cache)")
+    warm = _spawn_run("warm", args.steps, cache_dir, workdir, args.timeout)
+    log("restart: step-1 %.2fs, %d jit_compiles, %d persist hits"
+        % (warm["ready_s"], warm["jit_compiles"], warm["persist_hits"]))
+
+    try:
+        sha = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             capture_output=True, text=True, cwd=_ROOT,
+                             timeout=10).stdout.strip() or None
+    except Exception:
+        sha = None
+    result = {
+        "metric": "coldstart_train_sharded_mlp",
+        "steps": args.steps,
+        "cold": cold,
+        "warm": warm,
+        "ready_speedup": round(cold["ready_s"] / warm["ready_s"], 2)
+        if warm["ready_s"] else None,
+        "zero_compile_on_warm": warm["jit_compiles"] == 0,
+        # a restart that recompiled nothing must still have trained: the
+        # two lives are numerically the same schedule from the same seed
+        "loss_match": cold["final_loss"] == warm["final_loss"],
+        "cache_artifacts": artifacts,
+        "cache_bytes": artifact_bytes,
+        "backend": "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu"
+        else "device",
+        "sha": sha,
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    json.dump(result, sys.stdout, indent=1)
+    sys.stdout.write("\n")
+    # acceptance: the restarted life must not have compiled anything
+    return 0 if result["zero_compile_on_warm"] else 4
+
+
+if __name__ == "__main__":
+    sys.exit(main())
