@@ -382,10 +382,37 @@ _var("MXTPU_FAULT_INJECT", "str", None,
      "deterministic fault injection at the trainer step boundary, e.g. "
      "`kill@step=7,rank=1`, `exc@step=3`, `hang@step=5,rank=1` (park the "
      "rank forever — watchdog/flight-recorder test vector), "
-     "`corrupt_ckpt@step=5,dir=/ckpts` (docs/fault_tolerance.md §4).")
+     "`corrupt_ckpt@step=5,dir=/ckpts`, `preempt@step=7,rank=1,grace=30` "
+     "(SIGTERM-with-grace — the cloud preemption notice), "
+     "`kill_during_ckpt@step=4,rank=0` (die mid-save, pre-publish — the "
+     "torn-write window) (docs/fault_tolerance.md §5).")
 _var("MXTPU_CKPT_DIR", "str", None,
      "default checkpoint directory for the `corrupt_ckpt` injection "
      "action (tests' resilience workers also read it).")
+_var("MXTPU_CKPT_ASYNC", "bool", True,
+     "route `CheckpointManager.save_async`/`save_sharded_async` through "
+     "the named background writer thread (`mxtpu-ckpt-writer`): the "
+     "training thread pays only the host snapshot, serialize+fsync+"
+     "atomic-rename happen off-thread (at-most-one in flight, honest "
+     "backpressure). `0` degrades both to the synchronous save path — "
+     "the escape hatch when the extra host copy is the scarcer resource "
+     "(docs/fault_tolerance.md §Preemption & elastic resume).")
+_var("MXTPU_CKPT_SHARD_TIMEOUT_S", "float", 120.0,
+     "sharded checkpoints: how long rank 0 waits for every peer rank's "
+     "staged shard before abandoning the manifest publish (the staging "
+     "dir stays invisible to `latest()`, so a peer death mid-save can "
+     "never tear a checkpoint).")
+_var("MXTPU_PREEMPT_GRACE_S", "float", 15.0,
+     "graceful-preemption budget: seconds between the SIGTERM notice and "
+     "the expected SIGKILL. `maybe_preempt_exit` finishes the in-flight "
+     "step and emergency-checkpoints inside this window; a fault entry's "
+     "`grace=` or `install_preemption_handler(grace_s=)` overrides it.")
+_var("MXTPU_PREEMPT_EXIT_CODE", "int", 83,
+     "rc a gracefully-preempted worker exits with after its emergency "
+     "checkpoint. `tools/launch.py` treats a generation where any rank "
+     "exited with this rc as a preemption: free restart (no "
+     "`--max-restarts` budget consumed) and backoff reset. rc+1 (84) "
+     "means preempted WITHOUT a checkpoint — budget-consuming.")
 
 # -- serving ----------------------------------------------------------------
 _var("MXTPU_SERVE_MAX_BATCH", "int", 32,

@@ -294,6 +294,34 @@ class Trainer:
         with atomic_writer(fname, "wb") as f:
             pickle.dump(blob, f)
 
+    def _require_sharded(self, what):
+        if self._sharded is None:
+            raise MXNetError(
+                "%s needs the promoted sharded trainer (sharded=True + "
+                "block=, or MXTPU_SHARDED_STEP=1); op-by-op trainers "
+                "checkpoint via save_states/load_states" % what)
+        return self._sharded
+
+    def save_sharded_checkpoint(self, manager, step=None, meta=None):
+        """This rank's shard of an async sharded checkpoint
+        (parallel.resilience.CheckpointManager.save_sharded_async);
+        promoted trainers only."""
+        return self._require_sharded("save_sharded_checkpoint").\
+            save_sharded_checkpoint(manager, step=step, meta=meta)
+
+    def emergency_sharded_checkpoint(self, manager, meta=None):
+        """Solo synchronous preemption checkpoint (flushes the async
+        writer first); promoted trainers only."""
+        return self._require_sharded("emergency_sharded_checkpoint").\
+            emergency_sharded_checkpoint(manager, meta=meta)
+
+    def restore_sharded_checkpoint(self, manager, step=None):
+        """Restore the newest sharded checkpoint onto the current mesh,
+        resharding elastically when the topology changed; promoted
+        trainers only. Returns the manifest header or None."""
+        return self._require_sharded("restore_sharded_checkpoint").\
+            restore_sharded_checkpoint(manager, step=step)
+
     def load_states(self, fname):
         """reference: trainer.py:458 (legacy raw updater blobs still load)."""
         import pickle

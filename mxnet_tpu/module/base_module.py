@@ -188,6 +188,7 @@ class BaseModule(object):
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        resume_skip = 0
         if mgr is not None and resume is not None:
             header = mgr.restore(
                 load_params=self.load_params,
@@ -199,15 +200,31 @@ class BaseModule(object):
             if header is not None:
                 begin_epoch = int(header["meta"].get(
                     "epoch", header["step"])) + 1
+                # a preemption checkpoint lands MID-epoch: its weights
+                # already include the first `batches_done` updates of the
+                # interrupted epoch, so the resumed epoch fast-forwards
+                # the iterator past them instead of re-applying them
+                resume_skip = int(header["meta"].get("batches_done", 0))
                 self.logger.info(
                     "resumed from checkpoint step %d (%s); continuing at "
-                    "epoch %d", header["step"], mgr.directory, begin_epoch)
+                    "epoch %d%s", header["step"], mgr.directory, begin_epoch,
+                    " batch %d" % resume_skip if resume_skip else "")
         if validation_metric is None:
             validation_metric = eval_metric
         eval_metric = _as_metric(eval_metric)
 
+        from ..parallel import resilience
         from ..parallel.resilience import maybe_inject_fault
         from .. import telemetry
+
+        # Graceful preemption (docs/fault_tolerance.md): once checkpoints
+        # are configured, SIGTERM stops killing the process mid-step —
+        # the handler just raises a flag, the in-flight step finishes,
+        # and the step-boundary check below lands an emergency checkpoint
+        # inside MXTPU_PREEMPT_GRACE_S before exiting with the
+        # preemption rc (a free restart under tools/launch.py).
+        if mgr is not None:
+            resilience.install_preemption_handler()
 
         # input-pipeline starvation metrics: seconds spent WAITING on the
         # data iterator vs. seconds spent in forward/backward/update — the
@@ -232,6 +249,13 @@ class BaseModule(object):
             nbatch = 0
             train_data.reset()
             batch_iter = iter(train_data)
+            if epoch == begin_epoch and resume_skip:
+                for _ in range(resume_skip):
+                    try:
+                        next(batch_iter)
+                    except StopIteration:
+                        break
+                    nbatch += 1
             while True:
                 t_wait = time.perf_counter()
                 try:
@@ -273,6 +297,20 @@ class BaseModule(object):
                 # step-boundary fault hook: counts updates since THIS
                 # process started (no-op unless MXTPU_FAULT_INJECT is set)
                 maybe_inject_fault(fit_updates)
+                if mgr is not None and resilience.preemption_requested():
+                    def _emergency_save(_epoch=epoch, _done=nbatch + 1):
+                        arg_p, aux_p = self.get_params()
+                        self.set_params(arg_p, aux_p)  # sync exec copies
+                        # meta epoch = _epoch - 1 + batches_done: resume
+                        # re-enters the interrupted epoch but fast-forwards
+                        # past the batches whose updates these weights
+                        # already carry (exact resume-equivalence)
+                        mgr.save(_epoch, save_params=self.save_params,
+                                 save_states=self.save_optimizer_states,
+                                 meta={"epoch": _epoch - 1, "preempt": True,
+                                       "batches_done": _done})
+                    resilience.maybe_preempt_exit(
+                        emergency_save=_emergency_save)
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()

@@ -22,6 +22,25 @@ around three pieces (docs/fault_tolerance.md):
 `MXTPU_FAULT_INJECT` gives tests a deterministic way to kill a worker at an
 exact step boundary and prove the restart→resume→converge path end to end
 (tests/test_resilience.py).
+
+On top of that PR-2 base this module carries the elastic-resilience layer
+(docs/fault_tolerance.md §Preemption & elastic resume):
+
+  * **async checkpointing** — `save_async` / `save_sharded_async` push
+    serialize+fsync+atomic-rename onto ONE named background writer thread
+    (`mxtpu-ckpt-writer`, bounded queue, at-most-one in flight) so the
+    fused training step only ever pays the host snapshot;
+  * a **per-rank sharded format** — every rank stages its own
+    `shard-r<rank>.bin`, rank 0 publishes a manifest (`meta.json`, still
+    written last) carrying the `parallel.mesh.mesh_fingerprint` topology —
+    replacing gather-to-rank0;
+  * **graceful preemption** — `install_preemption_handler` +
+    `maybe_preempt_exit` turn SIGTERM into finish-step → emergency
+    checkpoint inside `MXTPU_PREEMPT_GRACE_S` → exit
+    `MXTPU_PREEMPT_EXIT_CODE`, which tools/launch.py restarts for free;
+  * **elastic resume** — `restore_sharded` reads the manifest and, when
+    the new generation's topology/world size differs, hands the loader
+    EVERY shard so the trainer reshards onto the new mesh (N→M ranks).
 """
 from __future__ import annotations
 
@@ -40,7 +59,9 @@ from .. import telemetry
 
 __all__ = ["CheckpointManager", "maybe_inject_fault",
            "maybe_inject_serving_fault", "maybe_inject_load_surge",
-           "fault_spec", "restart_generation"]
+           "fault_spec", "restart_generation",
+           "install_preemption_handler", "preemption_requested",
+           "maybe_preempt_exit", "preempt_exit_code", "preempt_grace_s"]
 
 _LOG = logging.getLogger("mxnet_tpu.resilience")
 
@@ -48,6 +69,9 @@ CKPT_FORMAT_VERSION = 1
 _META = "meta.json"
 _PARAMS = "data.params"
 _STATES = "trainer.states"
+_SHARD = "shard-r%05d.bin"
+_SHARD_OK = "shard-r%05d.ok.json"
+_WRITER_THREAD = "mxtpu-ckpt-writer"
 
 
 def restart_generation():
@@ -71,6 +95,114 @@ def _current_rank():
             except ValueError:
                 pass
     return 0
+
+
+# --------------------------------------------------------------------------
+# Async checkpoint writer
+# --------------------------------------------------------------------------
+
+class _AsyncCkptWriter:
+    """Background checkpoint serializer: ONE named daemon thread
+    (`mxtpu-ckpt-writer`), a bounded queue of at-most-one pending job
+    behind the in-flight one, and honest backpressure — submit() blocks
+    when the slot is taken, so a slow disk degrades checkpoint cadence
+    instead of growing an unbounded backlog of host snapshots. The thread
+    is daemon (the conftest leaked-thread report counts live non-daemon
+    threads) AND explicitly joinable via close(); a failed async save is
+    captured and re-raised on the next flush()/submit() so it can never
+    pass silently."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._job = None            # (fn, step) queued, not yet started
+        self._busy = False          # a job is executing right now
+        self._closed = False
+        self._error = None          # first exception a job raised
+        self._submitted_step = None
+        self._completed_step = None
+        self._thread = threading.Thread(target=self._run,
+                                        name=_WRITER_THREAD, daemon=True)
+        self._thread.start()
+
+    def submit(self, fn, step):
+        with self._cv:
+            if self._closed:
+                raise MXNetError("async checkpoint writer is closed")
+            self._raise_error_locked()
+            while self._job is not None:   # at-most-one pending: block
+                self._cv.wait()
+                self._raise_error_locked()
+            self._job = (fn, int(step))
+            self._submitted_step = int(step)
+            self._cv.notify_all()
+        self._export_gauges()
+
+    def flush(self, timeout=None):
+        """Block until everything submitted so far is durably written;
+        False on timeout. Re-raises the first error an async save hit."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._job is not None or self._busy:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            self._raise_error_locked()
+        return True
+
+    def close(self, timeout=5.0):
+        """flush + join: checkpoint-heavy tests end with the writer thread
+        actually gone, not merely daemonized."""
+        try:
+            ok = self.flush(timeout)
+        finally:
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            self._thread.join(timeout)
+        return ok and not self._thread.is_alive()
+
+    def _raise_error_locked(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while self._job is None and not self._closed:
+                    self._cv.wait()
+                if self._job is None:
+                    return  # closed and drained
+                fn, step = self._job
+                self._job = None
+                self._busy = True
+                self._cv.notify_all()
+            try:
+                fn()
+            except BaseException as e:
+                with self._cv:
+                    self._error = e if isinstance(e, Exception) else \
+                        MXNetError("async checkpoint writer died: %r" % (e,))
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._completed_step = step
+                    self._cv.notify_all()
+                self._export_gauges()
+                telemetry.record_event("ckpt_async_complete", step=step)
+
+    def _export_gauges(self):
+        with self._cv:
+            sub = self._submitted_step or 0
+            done = self._completed_step or 0
+            pending = (1 if self._job is not None else 0) + \
+                (1 if self._busy else 0)
+        # how far the newest DURABLE checkpoint trails the newest snapshot
+        telemetry.gauge("mxtpu_checkpoint_async_lag_steps").set(
+            max(0, sub - done))
+        telemetry.gauge("mxtpu_checkpoint_async_pending").set(pending)
 
 
 # --------------------------------------------------------------------------
@@ -119,6 +251,7 @@ class CheckpointManager:
         self._prefix = prefix
         self._save_every = save_every
         self._rank0_only = rank0_only
+        self._async_writer = None  # lazily started on first *_async save
         os.makedirs(self._dir, exist_ok=True)
 
     # -- naming ------------------------------------------------------------
@@ -173,6 +306,10 @@ class CheckpointManager:
                 files[_STATES] = None
             for name in files:
                 files[name] = self._fsync_and_crc(os.path.join(tmp, name))
+            # chaos hook: payload staged, meta.json not yet written — the
+            # exact window where a torn write would surface if the format
+            # were not crash-consistent
+            _maybe_kill_during_ckpt(step)
             from .. import random as _random
 
             header = {
@@ -216,10 +353,20 @@ class CheckpointManager:
                             {"what": "save"}).observe(seconds)
         telemetry.counter("mxtpu_checkpoint_bytes_total",
                           {"what": "save"}).inc(nbytes)
+        self._observe_stall(seconds)
         telemetry.record_event("checkpoint_save", step=int(step),
                                seconds=round(seconds, 4), bytes=nbytes,
                                path=final)
         return final
+
+    @staticmethod
+    def _observe_stall(seconds):
+        """Training-thread stall attribution: a save running on the
+        background writer costs the training loop nothing, so only
+        non-writer-thread saves land in the sync-stall series."""
+        if threading.current_thread().name != _WRITER_THREAD:
+            telemetry.histogram("mxtpu_checkpoint_stall_seconds",
+                                {"mode": "sync"}).observe(seconds)
 
     def _fsync_and_crc(self, path):
         crc = 0
@@ -233,9 +380,19 @@ class CheckpointManager:
         return crc & 0xFFFFFFFF
 
     def _sweep_stale_tmp(self):
-        """Remove staging dirs a previous (killed) generation left behind."""
+        """Remove staging dirs a previous (killed) generation left behind.
+        Shard staging dirs are generation-tagged so a dead generation's
+        half-staged shards (with their stale `.ok` markers) can never
+        satisfy the current generation's manifest wait — only FOREIGN
+        generations' dirs are swept; the current one may be in flight on
+        the async writer."""
+        gen_tag = "-g%d" % restart_generation()
         for name in os.listdir(self._dir):
             if name.startswith(".tmp-%s-" % self._prefix):
+                shutil.rmtree(os.path.join(self._dir, name),
+                              ignore_errors=True)
+            elif (name.startswith(".shards-%s-" % self._prefix)
+                    and not name.endswith(gen_tag)):
                 shutil.rmtree(os.path.join(self._dir, name),
                               ignore_errors=True)
 
@@ -338,6 +495,11 @@ class CheckpointManager:
                 raise MXNetError(
                     "checkpoint %s failed verification: %s" % (path, reason))
         header = self.read_meta(path)
+        if header.get("format") == "sharded" and (load_params is not None
+                                                 or load_states is not None):
+            raise MXNetError(
+                "checkpoint %s is sharded (per-rank shards + manifest); "
+                "load it with restore_sharded()" % path)
         files = header.get("crc32") or {}
         if load_params is not None and _PARAMS in files:
             load_params(os.path.join(path, _PARAMS))
@@ -357,6 +519,261 @@ class CheckpointManager:
         telemetry.record_event("checkpoint_restore", step=int(step),
                                seconds=round(seconds, 4), bytes=nbytes,
                                generation=restart_generation())
+        return header
+
+    # -- async façade ------------------------------------------------------
+    @staticmethod
+    def _async_on():
+        return bool(_env.get("MXTPU_CKPT_ASYNC"))
+
+    def _writer(self):
+        w = self._async_writer
+        if w is None or not w._thread.is_alive():
+            w = self._async_writer = _AsyncCkptWriter()
+        return w
+
+    def flush(self, timeout=None):
+        """Wait until any async save submitted so far is durable. No-op
+        (True) when nothing is pending; False on timeout; re-raises the
+        first error a background save hit."""
+        w = self._async_writer
+        return True if w is None else w.flush(timeout)
+
+    def close(self, timeout=5.0):
+        """flush + join the background writer thread (idempotent)."""
+        w, self._async_writer = self._async_writer, None
+        return True if w is None else w.close(timeout)
+
+    def maybe_save_async(self, step, **kwargs):
+        """save_async() when `step` hits the manager's save_every period."""
+        if self._save_every is None or step % self._save_every != 0:
+            return None
+        return self.save_async(step, **kwargs)
+
+    def save_async(self, step, snapshot_params=None, snapshot_states=None,
+                   meta=None):
+        """Asynchronous save(): the `snapshot_*` callables run NOW on the
+        calling thread — they must capture a host-side copy of the live
+        state and return the save()-style writer callable — then
+        serialize+fsync+atomic-rename runs on the named background writer
+        (`mxtpu-ckpt-writer`). The training thread's only stall is
+        snapshot+submit. MXTPU_CKPT_ASYNC=0 degrades to a plain
+        synchronous save() with the same payload (the escape hatch when
+        the extra host copy is the scarcer resource)."""
+        if self._rank0_only and _current_rank() != 0:
+            return None
+        t0 = time.perf_counter()
+        wp = snapshot_params() if snapshot_params is not None else None
+        ws = snapshot_states() if snapshot_states is not None else None
+        if not self._async_on():
+            return self.save(step, save_params=wp, save_states=ws, meta=meta)
+        self._writer().submit(
+            lambda: self.save(step, save_params=wp, save_states=ws,
+                              meta=meta), step)
+        stall = time.perf_counter() - t0
+        telemetry.histogram("mxtpu_checkpoint_stall_seconds",
+                            {"mode": "async"}).observe(stall)
+        telemetry.record_event("ckpt_async_submit", step=int(step),
+                               stall_s=round(stall, 5))
+        return None
+
+    # -- per-rank sharded format -------------------------------------------
+    def _shard_stage_dir(self, step):
+        return os.path.join(self._dir, ".shards-%s-%08d-g%d" % (
+            self._prefix, int(step), restart_generation()))
+
+    def save_sharded(self, step, payload, rank=0, world_size=1,
+                     topology=None, meta=None, shard_timeout=None):
+        """Per-rank sharded checkpoint (replaces gather-to-rank0): EVERY
+        rank calls this with its own picklable `payload`. Each rank stages
+        `shard-r<rank>.bin` + an `.ok` marker into a shared
+        generation-tagged staging dir; rank 0 then waits (up to
+        MXTPU_CKPT_SHARD_TIMEOUT_S) for all `world_size` shards and
+        publishes the manifest — `meta.json` written LAST, one atomic
+        rename — so the PR-2 crash-consistency discipline, `latest()`
+        discovery, retention and corruption-skip all work unchanged on
+        sharded steps. `topology` (parallel.mesh.mesh_fingerprint) rides
+        the manifest so restore_sharded() can detect an elastic resume.
+        Returns the published path on rank 0, None elsewhere.
+        `rank0_only` does not apply: the sharded format needs every rank's
+        payload by construction."""
+        import pickle
+
+        t0 = time.perf_counter()
+        self._sweep_stale_tmp()
+        stage = self._shard_stage_dir(step)
+        os.makedirs(stage, exist_ok=True)
+        name = _SHARD % int(rank)
+        with atomic_writer(os.path.join(stage, name), "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        crc = self._fsync_and_crc(os.path.join(stage, name))
+        # chaos hook: shard staged, manifest absent — the torn window
+        _maybe_kill_during_ckpt(step)
+        with atomic_writer(os.path.join(stage, _SHARD_OK % int(rank)),
+                           "w") as f:
+            json.dump({"rank": int(rank), "crc32": crc}, f)
+        if int(rank) != 0:
+            self._observe_stall(time.perf_counter() - t0)
+            return None
+        timeout = shard_timeout if shard_timeout is not None \
+            else _env.get("MXTPU_CKPT_SHARD_TIMEOUT_S")
+        deadline = time.monotonic() + timeout
+        files = {}
+        for r in range(int(world_size)):
+            okp = os.path.join(stage, _SHARD_OK % r)
+            while not os.path.exists(okp):
+                if time.monotonic() >= deadline:
+                    raise MXNetError(
+                        "sharded checkpoint step %d: shard %d/%d never "
+                        "arrived within %.0fs (%s) — a peer likely died "
+                        "mid-save; the staging dir stays invisible to "
+                        "latest()" % (step, r, world_size, timeout, stage))
+                time.sleep(0.02)
+            with open(okp) as f:
+                files[_SHARD % r] = json.load(f)["crc32"]
+        from .. import random as _random
+
+        header = {
+            "version": CKPT_FORMAT_VERSION,
+            "format": "sharded",
+            "step": int(step),
+            "time": time.time(),
+            "crc32": files,
+            "shards": int(world_size),
+            "world_size": int(world_size),
+            "topology": topology,
+            "rng": _random.get_state(),
+            "meta": dict(meta or {}),
+        }
+        with atomic_writer(os.path.join(stage, _META), "w") as f:
+            json.dump(header, f, indent=1)
+        # the manifest's crc32 map is now authoritative; drop the markers
+        for r in range(int(world_size)):
+            try:
+                os.unlink(os.path.join(stage, _SHARD_OK % r))
+            except OSError:
+                pass
+        _fsync_dir(stage)
+        final = self.step_path(step)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(stage, final)
+        _fsync_dir(self._dir)
+        try:
+            nbytes = sum(os.path.getsize(os.path.join(final, n))
+                         for n in os.listdir(final))
+        except OSError:
+            nbytes = 0
+        self._retain()
+        seconds = time.perf_counter() - t0
+        telemetry.tracing.emit_span(
+            "train.checkpoint", time.time() - seconds, seconds,
+            telemetry.tracing.current(), component="train",
+            attrs={"step": int(step), "bytes": nbytes, "sharded": True})
+        telemetry.histogram("mxtpu_checkpoint_seconds",
+                            {"what": "save"}).observe(seconds)
+        telemetry.counter("mxtpu_checkpoint_bytes_total",
+                          {"what": "save"}).inc(nbytes)
+        self._observe_stall(seconds)
+        telemetry.record_event("checkpoint_save", step=int(step),
+                               seconds=round(seconds, 4), bytes=nbytes,
+                               path=final, sharded=True,
+                               shards=int(world_size))
+        return final
+
+    def save_sharded_async(self, step, payload, rank=0, world_size=1,
+                           topology=None, meta=None):
+        """save_sharded() with staging+publish on the background writer:
+        the caller already paid the only synchronous cost (snapshotting
+        `payload` to host), and rank 0's wait for peer shards happens on
+        the writer thread too, so a straggler rank never stalls training
+        anywhere else. MXTPU_CKPT_ASYNC=0 degrades to the sync path."""
+        t0 = time.perf_counter()
+        if not self._async_on():
+            return self.save_sharded(step, payload, rank=rank,
+                                     world_size=world_size,
+                                     topology=topology, meta=meta)
+        self._writer().submit(
+            lambda: self.save_sharded(step, payload, rank=rank,
+                                      world_size=world_size,
+                                      topology=topology, meta=meta), step)
+        stall = time.perf_counter() - t0
+        telemetry.histogram("mxtpu_checkpoint_stall_seconds",
+                            {"mode": "async"}).observe(stall)
+        telemetry.record_event("ckpt_async_submit", step=int(step),
+                               stall_s=round(stall, 5), sharded=True)
+        return None
+
+    def restore_sharded(self, load_shards, step=None, rank=0, world_size=1,
+                        topology=None, restore_rng=True):
+        """Restore a sharded checkpoint through ``load_shards(payloads,
+        header)``, where ``payloads`` maps saved shard rank → unpickled
+        payload.
+
+        Fast path — the manifest's topology equals this run's `topology`
+        AND its shard count equals `world_size`: each rank reads ONLY its
+        own shard. Elastic path (any mismatch): EVERY shard is read and
+        handed to the loader, which reassembles the global state and
+        reshards it onto the new mesh (N→M ranks, both directions). The
+        caller's compile key carries the same topology fingerprint, so an
+        elastic resume honestly misses the executable cache exactly once.
+        Returns the manifest header, or None when no complete checkpoint
+        exists; an explicitly requested `step` that fails verification
+        raises."""
+        import pickle
+
+        t0 = time.perf_counter()
+        if step is None:
+            found = self.latest()
+            if found is None:
+                return None
+            step, path = found
+        else:
+            path = self.step_path(step)
+            reason = self._verify_reason(path)
+            if reason is not None:
+                raise MXNetError(
+                    "checkpoint %s failed verification: %s" % (path, reason))
+        header = self.read_meta(path)
+        if header.get("format") != "sharded":
+            raise MXNetError(
+                "checkpoint %s is not sharded — restore() is the loader "
+                "for rank0-only checkpoints" % path)
+        shards = int(header.get("shards") or 0)
+        elastic = not (header.get("topology") == topology
+                       and shards == int(world_size))
+        ranks = range(shards) if elastic else [int(rank)]
+        payloads = {}
+        for r in ranks:
+            with open(os.path.join(path, _SHARD % r), "rb") as f:
+                payloads[r] = pickle.load(f)
+        if elastic:
+            _LOG.warning(
+                "elastic resume: checkpoint step %d saved on %r (%d "
+                "shard(s)) -> restoring onto %r (world %d); resharding",
+                step, header.get("topology"), shards, topology,
+                int(world_size))
+            telemetry.record_event(
+                "ckpt_reshard", step=int(step), from_shards=shards,
+                to_world=int(world_size),
+                from_topology=header.get("topology"), to_topology=topology)
+        load_shards(payloads, header)
+        if restore_rng and header.get("rng"):
+            from .. import random as _random
+
+            _random.set_state(header["rng"])
+        seconds = time.perf_counter() - t0
+        files = header.get("crc32") or {}
+        nbytes = sum(os.path.getsize(os.path.join(path, n)) for n in files
+                     if os.path.exists(os.path.join(path, n)))
+        telemetry.histogram("mxtpu_checkpoint_seconds",
+                            {"what": "restore"}).observe(seconds)
+        telemetry.counter("mxtpu_checkpoint_bytes_total",
+                          {"what": "restore"}).inc(nbytes)
+        telemetry.record_event("checkpoint_restore", step=int(step),
+                               seconds=round(seconds, 4), bytes=nbytes,
+                               generation=restart_generation(),
+                               sharded=True, elastic=elastic)
         return header
 
 
@@ -380,6 +797,25 @@ class CheckpointManager:
 #   MXTPU_FAULT_INJECT="corrupt_ckpt@step=5,dir=/tmp/ck"
 #                                                   garble the newest
 #                                                   checkpoint's params file
+#   MXTPU_FAULT_INJECT="preempt@step=7,rank=1,grace=30"
+#                                                   deliver SIGTERM to the
+#                                                   rank at the step
+#                                                   boundary (the cloud
+#                                                   preemption notice);
+#                                                   grace= overrides
+#                                                   MXTPU_PREEMPT_GRACE_S.
+#                                                   The worker finishes the
+#                                                   step, emergency-
+#                                                   checkpoints and exits
+#                                                   MXTPU_PREEMPT_EXIT_CODE
+#   MXTPU_FAULT_INJECT="kill_during_ckpt@step=4,rank=0"
+#                                                   die MID-SAVE of the
+#                                                   step-4 checkpoint —
+#                                                   payload staged, manifest
+#                                                   not yet published (the
+#                                                   torn-write window;
+#                                                   latest() must stay on
+#                                                   the previous step)
 #
 # Serving actions (fired by the replica worker at its batch boundary —
 # mxnet_tpu/serving/supervisor.py; `batch=` replaces `step=` as the
@@ -415,13 +851,17 @@ class CheckpointManager:
 # code (exit status for kill/kill_replica, default 42), ms (slow_reply
 # delay, default 1000), rps / duration (load_surge arrival rate and
 # length, default 100/s for 2s), dir (corrupt_ckpt target; falls back to
-# $MXTPU_CKPT_DIR). The training hook sits at the trainer step boundary —
-# after the optimizer update for `step` completes, before anything later
-# runs — which is exactly the crash window that loses un-checkpointed
-# progress.
+# $MXTPU_CKPT_DIR), grace (preempt only: grace-window seconds overriding
+# MXTPU_PREEMPT_GRACE_S). The training hook sits at the trainer step
+# boundary — after the optimizer update for `step` completes, before
+# anything later runs — which is exactly the crash window that loses
+# un-checkpointed progress. kill_during_ckpt instead fires from INSIDE the
+# save paths via `_maybe_kill_during_ckpt` (step= matches the checkpoint's
+# step), between payload staging and manifest publish.
 
 _FAULT_EXIT_CODE = 42
-_TRAIN_ACTIONS = ("kill", "exc", "hang", "corrupt_ckpt")
+_TRAIN_ACTIONS = ("kill", "exc", "hang", "corrupt_ckpt", "preempt")
+_CKPT_ACTIONS = ("kill_during_ckpt",)
 _SERVE_ACTIONS = ("kill_replica", "wedge_replica", "slow_reply")
 _SURGE_ACTIONS = ("load_surge",)
 _UNPARSED = object()
@@ -430,12 +870,12 @@ _fault_cache = _UNPARSED
 
 def fault_spec(env=None):
     """Parse MXTPU_FAULT_INJECT into a list of {action, step, rank, gen,
-    code, dir, batch, replica, ms} dicts. Malformed entries raise MXNetError
-    eagerly — a typo'd injection silently never firing would invalidate the
-    test using it."""
+    code, dir, batch, replica, ms, grace} dicts. Malformed entries raise
+    MXNetError eagerly — a typo'd injection silently never firing would
+    invalidate the test using it."""
     raw = (_env.raw("MXTPU_FAULT_INJECT") or "") if env is None else env
     entries = []
-    known = _TRAIN_ACTIONS + _SERVE_ACTIONS + _SURGE_ACTIONS
+    known = _TRAIN_ACTIONS + _CKPT_ACTIONS + _SERVE_ACTIONS + _SURGE_ACTIONS
     for part in raw.replace(";", " ").split():
         action, _, conds = part.partition("@")
         if action not in known:
@@ -444,7 +884,7 @@ def fault_spec(env=None):
         entry = {"action": action, "step": None, "rank": None,
                  "gen": 0, "code": _FAULT_EXIT_CODE, "dir": None,
                  "batch": None, "replica": None, "ms": 1000,
-                 "after": None, "rps": 100, "duration": 2}
+                 "after": None, "rps": 100, "duration": 2, "grace": None}
         for cond in filter(None, conds.split(",")):
             k, eq, v = cond.partition("=")
             if not eq or k not in entry or k == "action":
@@ -647,6 +1087,40 @@ def _fire(entry, step, rank):
         if not directory:
             raise MXNetError("corrupt_ckpt needs dir=... or MXTPU_CKPT_DIR")
         _corrupt_latest(directory)
+    if action == "preempt":
+        # deterministic stand-in for the cloud preemption notice: deliver
+        # a REAL SIGTERM to ourselves so the production handler + grace
+        # path runs, not a shortcut around it
+        import signal as _signal
+
+        if entry["grace"] is not None:
+            _PREEMPT["grace_override"] = float(entry["grace"])
+        install_preemption_handler()
+        os.kill(os.getpid(), _signal.SIGTERM)
+
+
+def _maybe_kill_during_ckpt(step):
+    """Mid-save chaos hook — called from inside save()/save_sharded()
+    AFTER the payload is staged but BEFORE the manifest/meta publish:
+    exactly the window where a torn write would be visible if the format
+    were not crash-consistent. In async mode this fires on the writer
+    thread; os._exit still takes the whole process down, as a real
+    mid-write death would."""
+    if not _entries():
+        return
+    gen = restart_generation()
+    rank = _current_rank()
+    for e in _entries():
+        if e["action"] not in _CKPT_ACTIONS:
+            continue
+        if e["step"] != step or e["gen"] != gen:
+            continue
+        if e["rank"] is not None and e["rank"] != rank:
+            continue
+        _LOG.warning("MXTPU_FAULT_INJECT firing: kill_during_ckpt at "
+                     "step=%d rank=%d gen=%d (mid-save, pre-publish)",
+                     step, rank, gen)
+        _exit_hard(e["code"])
 
 
 def _corrupt_latest(directory):
@@ -670,3 +1144,115 @@ def _corrupt_latest(directory):
                 f.write(bytes([b[0] ^ 0xFF]))
             _LOG.warning("corrupt_ckpt: flipped a byte in %s", fp)
             return
+
+
+# --------------------------------------------------------------------------
+# Graceful preemption (SIGTERM + grace window)
+# --------------------------------------------------------------------------
+#
+# Contract (docs/fault_tolerance.md §Preemption & elastic resume): the
+# preempting agent sends SIGTERM and waits MXTPU_PREEMPT_GRACE_S before the
+# SIGKILL. The handler below only records the arrival time — the real work
+# happens at the NEXT STEP BOUNDARY via maybe_preempt_exit(): finish the
+# in-flight step, emergency-checkpoint inside the remaining grace, exit
+# MXTPU_PREEMPT_EXIT_CODE (83). tools/launch.py treats that rc as a
+# preemption: the generation restarts WITHOUT consuming --max-restarts
+# budget and the restart backoff resets (the generation checkpointed
+# cleanly). A failed emergency save exits code+1 (84) instead — that
+# generation lost progress, so its restart must consume budget.
+
+# single-slot state written by the signal handler, read at step boundaries.
+# mxlint: gil-atomic — a signal handler cannot take locks (it may interrupt
+# the very thread holding them); one dict-slot store is atomic under the GIL
+_PREEMPT = {"requested_at": None, "grace_override": None, "installed": False,
+            "prev_handler": None}
+
+
+def install_preemption_handler(grace_s=None):
+    """Arm the SIGTERM-with-grace contract for this process. Idempotent;
+    returns True when the handler is installed. Main thread only —
+    signal.signal refuses elsewhere, in which case this returns False and
+    SIGTERM keeps its default (immediate-death) behavior."""
+    import signal
+
+    if grace_s is not None:
+        _PREEMPT["grace_override"] = float(grace_s)
+    if _PREEMPT["installed"]:
+        return True
+    try:
+        _PREEMPT["prev_handler"] = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        return False
+    _PREEMPT["installed"] = True
+    return True
+
+
+def _on_sigterm(signum, frame):
+    # handler body: ONE store, nothing that allocates or locks. The actual
+    # work (finish the in-flight step, emergency checkpoint, exit) happens
+    # at the next step boundary via maybe_preempt_exit().
+    if _PREEMPT["requested_at"] is None:
+        _PREEMPT["requested_at"] = time.monotonic()
+
+
+def preemption_requested():
+    """True once SIGTERM arrived (checked by training loops at each step
+    boundary; cleared only by process exit — preemption is one-way)."""
+    return _PREEMPT["requested_at"] is not None
+
+
+def preempt_grace_s():
+    """The grace window in seconds: a per-run override (installer arg or
+    the fault entry's grace=) wins over MXTPU_PREEMPT_GRACE_S."""
+    ov = _PREEMPT["grace_override"]
+    return float(ov) if ov is not None \
+        else float(_env.get("MXTPU_PREEMPT_GRACE_S"))
+
+
+def preempt_exit_code():
+    return int(_env.get("MXTPU_PREEMPT_EXIT_CODE"))
+
+
+def maybe_preempt_exit(emergency_save=None, rank=None):
+    """Step-boundary preemption gate: no-op until SIGTERM arrived; then run
+    `emergency_save()` within the grace budget and exit with the preempt
+    rc. `emergency_save` must be SYNCHRONOUS and self-contained — flush
+    any async writer first (CheckpointManager.flush) so the emergency
+    state lands AFTER whatever periodic save was in flight. On save
+    failure the exit code is preempt_exit_code()+1: no checkpoint landed,
+    so the launcher must treat the restart as budget-consuming."""
+    if _PREEMPT["requested_at"] is None:
+        return
+    grace = preempt_grace_s()
+    deadline = _PREEMPT["requested_at"] + grace
+    rank = _current_rank() if rank is None else rank
+    code = preempt_exit_code()
+    _LOG.warning("preemption: SIGTERM received; emergency checkpoint within "
+                 "%.1fs grace, then exit rc=%d (rank %d)", grace, code, rank)
+    telemetry.record_event("preempt_begin", rank=rank, grace_s=grace,
+                           generation=restart_generation())
+    try:
+        if emergency_save is not None:
+            emergency_save()
+        margin = deadline - time.monotonic()
+        if margin < 0:
+            _LOG.warning("preemption: emergency checkpoint overran the "
+                         "grace window by %.1fs — raise "
+                         "MXTPU_PREEMPT_GRACE_S or shrink the payload",
+                         -margin)
+        telemetry.record_event("preempt_checkpoint", rank=rank,
+                               margin_s=round(margin, 3),
+                               generation=restart_generation())
+    except Exception:
+        _LOG.exception("preemption: emergency checkpoint FAILED; exiting "
+                       "rc=%d (budget-consuming)", code + 1)
+        telemetry.record_event("preempt_checkpoint_failed", rank=rank,
+                               generation=restart_generation())
+        code = code + 1
+    try:
+        # os._exit skips atexit: flush the telemetry JSONL explicitly so
+        # the preempt events above survive into the flight record
+        telemetry.flush(reason="preempt")
+    except Exception:
+        pass
+    _exit_hard(code)

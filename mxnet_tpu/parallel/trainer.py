@@ -598,3 +598,180 @@ class DistributedTrainer:
         self._states = [
             _tree_map(lambda a, sh: jax.device_put(a, sh), st, shs)
             for st, shs in zip(loaded, self._state_shardings)]
+
+    # -- per-rank sharded checkpoints (parallel.resilience format) ---------
+    def shard_snapshot(self):
+        """Host snapshot of THIS process's shards of every parameter and
+        optimizer-state leaf — the only work the training thread pays on
+        the async checkpoint path. Each array is recorded as its global
+        shape/dtype plus the addressable pieces keyed by normalized
+        (start, stop)-per-dim index, so `_install_shard_payloads` can
+        either place pieces directly (same topology) or reassemble the
+        global array and reshard it (elastic resume). Replicated shards
+        (identical index on several local devices) are deduplicated."""
+        import jax
+
+        def entry(arr):
+            shape = tuple(int(d) for d in arr.shape)
+            pieces, seen = [], set()
+            for s in arr.addressable_shards:
+                key = tuple(sl.indices(dim)[:2]
+                            for sl, dim in zip(s.index, shape))
+                if key in seen:
+                    continue
+                seen.add(key)
+                # np.array (copy), NOT np.asarray: on the CPU backend
+                # device_get is zero-copy, and the fused step DONATES these
+                # buffers — a view would dangle the moment the next step
+                # runs, corrupting (or segfaulting) the background write
+                pieces.append((key, np.array(jax.device_get(s.data))))
+            return {"shape": shape, "dtype": str(arr.dtype),
+                    "pieces": pieces}
+
+        return {
+            "params": {n: entry(a)
+                       for n, a in zip(self._param_names, self._arrays)},
+            "states": [[entry(leaf)
+                        for leaf in jax.tree_util.tree_leaves(st)]
+                       for st in self._states],
+            "step": self._step_count,
+            "num_update": self._optimizer.num_update,
+        }
+
+    def _install_shard_payloads(self, payloads, header):
+        """`CheckpointManager.restore_sharded` loader: install parameters,
+        optimizer state and the step/num_update cursors from shard
+        payloads. Fast path gets only this rank's payload and places each
+        piece verbatim; the elastic path gets EVERY saved shard,
+        reassembles each global array (erroring on coverage holes) and
+        reshards it onto the current mesh via make_array_from_callback —
+        each process materializes only its addressable indices."""
+        import jax
+        import jax.numpy as jnp
+
+        def materialize(entries, sharding, what):
+            shape = tuple(entries[0]["shape"])
+            dtype = entries[0]["dtype"]
+            pieces = {}
+            for e in entries:
+                if tuple(e["shape"]) != shape or e["dtype"] != dtype:
+                    raise MXNetError(
+                        "sharded checkpoint: %s changed shape/dtype "
+                        "(saved %r/%s, shard disagrees with %r/%s)"
+                        % (what, tuple(e["shape"]), e["dtype"], shape,
+                           dtype))
+                for key, data in e["pieces"]:
+                    pieces[tuple(tuple(p) for p in key)] = data
+            cache = {}
+
+            def full():
+                if "a" not in cache:
+                    out = np.zeros(shape, dtype)
+                    cover = np.zeros(shape, bool)
+                    for key, data in pieces.items():
+                        slc = tuple(slice(a, b) for a, b in key)
+                        out[slc] = data
+                        cover[slc] = True
+                    if not cover.all():
+                        raise MXNetError(
+                            "sharded checkpoint: the shard set does not "
+                            "cover %s — an elastic resume needs every "
+                            "saved rank's shard (a solo emergency "
+                            "checkpoint only covers fully-replicated "
+                            "state)" % what)
+                    cache["a"] = out
+                return cache["a"]
+
+            def cb(index):
+                key = tuple(sl.indices(dim)[:2]
+                            for sl, dim in zip(index, shape))
+                hit = pieces.get(key)
+                piece = hit if hit is not None else full()[index]
+                # hand jax an XLA-OWNED device array, never the raw
+                # pickle-loaded numpy buffer: the CPU client zero-copies
+                # 64-byte-aligned host memory, and these arrays feed the
+                # DONATING fused step — donating a buffer numpy still owns
+                # corrupts the heap (flaky SIGSEGV in whatever allocates
+                # next, only in resumed generations)
+                return jnp.array(piece, copy=True)
+
+            return jax.make_array_from_callback(shape, sharding, cb)
+
+        plist = list(payloads.values())
+        new_arrays = []
+        for name, sh in zip(self._param_names, self._shardings):
+            entries = [p["params"].get(name) for p in plist]
+            if any(e is None for e in entries):
+                raise MXNetError(
+                    "sharded checkpoint: parameter %r missing from a "
+                    "shard — the checkpoint was saved for a different "
+                    "model" % name)
+            new_arrays.append(materialize(entries, sh, "param %r" % name))
+        new_states = []
+        for k, (st, shs) in enumerate(zip(self._states,
+                                          self._state_shardings)):
+            per_payload = [p["states"][k] for p in plist]
+            sh_leaves = jax.tree_util.tree_leaves(shs)
+            n = len(sh_leaves)
+            if any(len(pp) != n for pp in per_payload):
+                raise MXNetError(
+                    "sharded checkpoint: optimizer state %d leaf count "
+                    "mismatch — saved with a different optimizer" % k)
+            leaves = [materialize([pp[j] for pp in per_payload],
+                                  sh_leaves[j], "state[%d][%d]" % (k, j))
+                      for j in range(n)]
+            new_states.append(jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(st), leaves))
+        self._arrays = new_arrays
+        self._states = new_states
+        self._step_count = int(plist[0]["step"])
+        self._optimizer.num_update = int(plist[0]["num_update"])
+
+    def _shard_identity(self):
+        import jax
+
+        from .mesh import mesh_fingerprint
+
+        return (jax.process_index(), jax.process_count(),
+                mesh_fingerprint(self._mesh))
+
+    def save_sharded_checkpoint(self, manager, step=None, meta=None):
+        """Write this rank's shard of a sharded checkpoint through
+        `manager` (parallel.resilience.CheckpointManager): snapshot on the
+        calling thread, serialize+fsync+manifest-publish on the manager's
+        background writer (MXTPU_CKPT_ASYNC). Every rank must call this at
+        the same step boundary."""
+        rank, world, topology = self._shard_identity()
+        return manager.save_sharded_async(
+            self._step_count if step is None else step,
+            self.shard_snapshot(), rank=rank, world_size=world,
+            topology=topology, meta=meta)
+
+    def emergency_sharded_checkpoint(self, manager, meta=None):
+        """SOLO synchronous checkpoint for the preemption path: flush any
+        in-flight async save, then publish this rank's snapshot as a
+        1-shard manifest (rank 0 of world 1) with no peer cooperation —
+        the preempting agent only notified THIS rank, and the others may
+        be wedged in a collective. Restoring it at any world size goes
+        through the elastic path; it covers the full model whenever this
+        process's shards do (pure data-parallel / single-host — a
+        cross-process-partitioned model needs a group-wide `preempt`
+        instead, and restore errors honestly on the coverage hole)."""
+        _, _, topology = self._shard_identity()
+        manager.flush()
+        m = dict(meta or {})
+        m.setdefault("preempt", True)
+        return manager.save_sharded(
+            self._step_count, self.shard_snapshot(), rank=0, world_size=1,
+            topology=topology, meta=m)
+
+    def restore_sharded_checkpoint(self, manager, step=None):
+        """Restore the newest complete sharded checkpoint (or `step`) onto
+        the CURRENT mesh; reshards when the saved topology/world size
+        differs (the compile key's topology fingerprint then honestly
+        misses once). Returns the manifest header, or None when there is
+        nothing to restore."""
+        rank, world, topology = self._shard_identity()
+        return manager.restore_sharded(
+            self._install_shard_payloads, step=step, rank=rank,
+            world_size=world, topology=topology)

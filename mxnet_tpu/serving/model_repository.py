@@ -870,7 +870,7 @@ class ModelRepository:
                                version=model.version)
         # chaos hook: a `load_surge@` MXTPU_FAULT_INJECT entry arms a
         # synthetic open-loop burst against this model's admission queue
-        # (docs/fault_tolerance.md §4 — the autoscaler test vector)
+        # (docs/fault_tolerance.md §5 — the autoscaler test vector)
         _resilience.maybe_inject_load_surge(model)
         return model
 

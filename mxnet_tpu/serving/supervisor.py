@@ -615,7 +615,7 @@ def worker_main(argv=None):
         deadline = None if msg.get("remaining") is None \
             else t_batch + float(msg["remaining"])
         # fault hook at the batch boundary (kill_replica / wedge_replica /
-        # slow_reply — docs/fault_tolerance.md §4)
+        # slow_reply — docs/fault_tolerance.md §5)
         maybe_inject_serving_fault(seq, args.replica)
         # deadline propagation: a replica that wakes up past the batch
         # budget (slow_reply, GC pause, CPU contention) cancels instead of

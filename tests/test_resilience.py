@@ -198,7 +198,14 @@ def test_fault_spec_parsing(monkeypatch):
     assert fault_spec("kill@step=7,rank=1") == [
         {"action": "kill", "step": 7, "rank": 1, "gen": 0, "code": 42,
          "dir": None, "batch": None, "replica": None, "ms": 1000,
-         "after": None, "rps": 100, "duration": 2}]
+         "after": None, "rps": 100, "duration": 2, "grace": None}]
+    # the preemption / mid-checkpoint actions ride the same grammar
+    pe, kc = fault_spec("preempt@step=7,rank=1,grace=30 "
+                        "kill_during_ckpt@step=4,rank=0")
+    assert (pe["action"], pe["step"], pe["rank"], pe["grace"]) == \
+        ("preempt", 7, 1, 30)
+    assert (kc["action"], kc["step"], kc["rank"], kc["grace"]) == \
+        ("kill_during_ckpt", 4, 0, None)
     assert fault_spec("exc@step=3 corrupt_ckpt@step=5,dir=/tmp/x")[1]["dir"] \
         == "/tmp/x"
     # serving actions key on batch=/replica= instead of step=/rank=

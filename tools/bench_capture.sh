@@ -264,6 +264,17 @@ if ls "$TRB_TDIR"/train_restart_bench_*/telemetry_*/*.jsonl >/dev/null 2>&1; the
 fi
 rm -rf "$TRB_TDIR"
 
+# preemption row: sync-vs-async checkpoint stall A/B + measured
+# steps-lost contrast (docs/fault_tolerance.md §Preemption) — the async
+# writer's per-save trainer stall must stay an order of magnitude under
+# the synchronous serialize+fsync, and a graceful preemption must lose
+# zero steps where a hard kill loses up to a save period
+echo "[bench_capture] train preempt (checkpoint stall A/B)" >&2
+env PYTHONPATH=".:${PYTHONPATH:-}" \
+  timeout 900 python tools/train_restart_bench.py --mode preempt \
+  > "BENCH_${TAG}_preempt.json" 2> "BENCH_${TAG}_preempt.log"
+echo "[bench_capture] train preempt rc=$?" >&2
+
 # memory row: the serving memory budget's evidence (docs/observability.md
 # §Memory) — per-bucket memory_analysis footprint, over-budget load
 # rejected / within-budget accepted / warn-mode canary, and the donation

@@ -132,6 +132,26 @@ def _extract(doc):
             detail.append("STALE")
         return (metric, doc.get("value"), doc.get("unit") or "",
                 ", ".join(detail))
+    if metric == "train_preempt_ckpt_stall" and "value" in doc:
+        # the async-vs-sync checkpoint stall A/B (train_restart_bench.py
+        # --mode preempt): per-save trainer stall plus the measured
+        # steps-lost contrast between a hard kill and a graceful preempt
+        sy, asy = doc.get("sync") or {}, doc.get("async") or {}
+        lost = doc.get("steps_lost") or {}
+        detail = ["sync %sms -> async %sms/save" % (
+            _fmt((sy.get("per_save_stall_s") or 0) * 1e3, 0),
+            _fmt((asy.get("per_save_stall_s") or 0) * 1e3, 0))]
+        if lost:
+            detail.append("lost kill=%s preempt=%s" % (
+                _fmt(lost.get("steps_lost_hard_kill"), 0),
+                _fmt(lost.get("steps_lost_graceful_preempt"), 0)))
+        if doc.get("payload_bytes"):
+            detail.append("%sMB payload"
+                          % _fmt(doc["payload_bytes"] / (1 << 20), 0))
+        if doc.get("stale"):
+            detail.append("STALE")
+        return (metric, doc.get("value"), doc.get("unit") or "x",
+                ", ".join(detail))
     if metric and "value" in doc:
         detail = []
         if doc.get("mfu") is not None:
@@ -232,6 +252,7 @@ _CHECK_METRICS = {
     # (includes coldstart_train_*: fused-restart time-to-step-1)
     "autoscale_scale_up_s": "lower",  # surge -> grown pool serving
     "train_sharded": "higher",      # promotion A/B imgs/sec, per impl+bs
+    "train_preempt_ckpt_stall": "higher",  # sync/async stall reduction, x
 }
 
 

@@ -42,6 +42,13 @@ per rank so multi-rank post-mortems stay readable. This restores, in
 TPU-native form, the node-failure semantics ps-lite's scheduler provided
 the reference (PAPER §1 layer map).
 
+Preemption (MXTPU_PREEMPT_EXIT_CODE, default 83): a worker that exits
+with the graceful-preemption rc checkpointed on its way out (SIGTERM +
+grace window, parallel.resilience.maybe_preempt_exit), so the launcher
+restarts the group WITHOUT consuming the --max-restarts budget and with
+the backoff reset to its initial value — preemptions are scheduler
+events, not crash loops. A `preempt` launcher event records each one.
+
 Usage:
   python tools/launch.py -n 4 python train.py ...
   python tools/launch.py -n 4 --max-restarts 3 python train.py ...
@@ -273,7 +280,17 @@ def _teardown(procs, grace=None):
             pass
 
 
-def _run_generation(cmds):
+def _preempt_exit_code():
+    """The graceful-preemption rc contract (parallel/resilience.py
+    maybe_preempt_exit), read import-free from the env like the rest of
+    the launcher."""
+    try:
+        return int(os.environ.get("MXTPU_PREEMPT_EXIT_CODE", "83"))
+    except ValueError:
+        return 83
+
+
+def _run_generation(cmds, preempt_rc=None):
     """Spawn every (argv, env, label) and supervise by polling: the FIRST
     failure — a spawn error partway through the list, or any worker exiting
     nonzero — tears the survivors down (escalating SIGTERM→SIGKILL on the
@@ -281,8 +298,17 @@ def _run_generation(cmds):
     the rendezvous waiting for it. Workers that exit 0 simply leave the
     others to finish. (ssh mode: the teardown hits the local ssh client;
     sshd tears the remote command down with the connection.) Labeled
-    workers get their output line-prefixed via a pump thread."""
+    workers get their output line-prefixed via a pump thread.
+
+    Returns (rc, preempted). `preempted` is True when ANY worker's final
+    rc equals `preempt_rc` — checked after teardown, because the
+    first-OBSERVED exit may be a peer's collective error while the
+    actually-preempted rank (which DID land an emergency checkpoint
+    before exiting) finished an instant earlier. Also counts a worker
+    that preempt-exits gracefully under the teardown SIGTERM itself:
+    either way a fresh checkpoint exists, so the restart makes progress."""
     procs, pumps = [], []
+    rc = 0
     try:
         for argv, env, label in cmds:
             p = subprocess.Popen(
@@ -296,7 +322,6 @@ def _run_generation(cmds):
                 t.start()
                 pumps.append(t)
         pending = list(procs)
-        rc = 0
         while pending and not rc:
             for p in list(pending):
                 r = p.poll()
@@ -305,11 +330,13 @@ def _run_generation(cmds):
                     rc = rc or r
             if pending and not rc:
                 time.sleep(0.1)
-        return rc  # nonzero -> finally tears down the stragglers
     finally:
-        _teardown(procs)
+        _teardown(procs)  # nonzero rc -> tears down the stragglers
         for t in pumps:
             t.join(timeout=5)
+    preempted = preempt_rc is not None and any(
+        p.returncode == preempt_rc for p in procs)
+    return rc, preempted
 
 
 def _spawn_and_wait(make_cmds, max_restarts=0, backoff=1.0):
@@ -320,20 +347,46 @@ def _spawn_and_wait(make_cmds, max_restarts=0, backoff=1.0):
     rendezvous port (the dead coordinator's port may sit in TIME_WAIT) and
     workers see MXTPU_RESTART_GENERATION. On group failure: escalating
     teardown, exponential-backoff wait, respawn — up to `max_restarts`
-    times, after which the last exit code propagates."""
+    times, after which the last exit code propagates.
+
+    Two exits are NOT ordinary failures: a generation where some worker
+    exited with the graceful-preemption rc (MXTPU_PREEMPT_EXIT_CODE,
+    default 83) restarts for FREE — the preempted rank checkpointed on
+    its way out, so the retry makes forward progress and should not
+    burn the crash budget — and the backoff ramp resets to its initial
+    value, since exponential backoff exists to damp crash loops, not to
+    punish schedulers for reclaiming capacity."""
     generation = 0
-    delay = max(backoff, 0.0)
+    restarts_used = 0
+    initial_delay = max(backoff, 0.0)
+    delay = initial_delay
     while True:
         if generation:
             _log("spawning generation %d" % generation)
         _emit_event("launcher_generation_start", generation=generation,
                     max_restarts=max_restarts)
-        rc = _run_generation(make_cmds(generation))
-        _emit_event("launcher_generation_exit", generation=generation, rc=rc)
+        rc, preempted = _run_generation(make_cmds(generation),
+                                        _preempt_exit_code())
+        _emit_event("launcher_generation_exit", generation=generation, rc=rc,
+                    preempted=preempted)
         _emit_generation_span(generation, rc)
         if rc == 0:
             return 0
-        if generation >= max_restarts:
+        if preempted and max_restarts > 0:
+            # free restart: the preempted rank landed an emergency
+            # checkpoint before exiting, so the next generation resumes
+            # with fresh progress — budget untouched, backoff reset
+            generation += 1
+            delay = initial_delay
+            _log("group preempted (rc=%d); free restart as generation %d in "
+                 "%.1fs (restart budget untouched: %d/%d used)"
+                 % (rc, generation, delay, restarts_used, max_restarts))
+            _emit_event("preempt", generation=generation, rc=rc,
+                        restarts_used=restarts_used, backoff_s=delay)
+            if delay:
+                time.sleep(delay)
+            continue
+        if restarts_used >= max_restarts:
             if max_restarts:
                 _log("group failed (rc=%d); %d restart(s) exhausted, giving "
                      "up" % (rc, max_restarts))
@@ -341,8 +394,9 @@ def _spawn_and_wait(make_cmds, max_restarts=0, backoff=1.0):
                         rc=rc)
             return rc
         generation += 1
+        restarts_used += 1
         _log("group failed (rc=%d); restarting (%d/%d) in %.1fs on a fresh "
-             "rendezvous port" % (rc, generation, max_restarts, delay))
+             "rendezvous port" % (rc, restarts_used, max_restarts, delay))
         _emit_event("launcher_restart", generation=generation, rc=rc,
                     backoff_s=delay)
         if delay:
@@ -509,11 +563,15 @@ def main(argv=None):
                              "backoff and a fresh rendezvous port; workers "
                              "see MXTPU_RESTART_GENERATION and auto-resume "
                              "from the last complete checkpoint "
-                             "(parallel/resilience.py). Default 0 = fail "
-                             "fast, the pre-elastic behavior")
+                             "(parallel/resilience.py). Graceful preemptions "
+                             "(exit rc MXTPU_PREEMPT_EXIT_CODE, default 83) "
+                             "restart for free — they do not consume this "
+                             "budget. Default 0 = fail fast, the pre-elastic "
+                             "behavior")
     parser.add_argument("--restart-backoff", type=float, default=1.0,
                         help="initial seconds between generations (doubles "
-                             "each restart, capped at 60)")
+                             "each restart, capped at 60; resets to the "
+                             "initial value after a graceful preemption)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     if args.command and args.command[0] == "--":
