@@ -34,6 +34,11 @@ MXTPU_BENCH_MODE=lstm runs the word-LM 2x650 LSTM (reference
 example/rnn/word_lm defaults, PTB-shaped synthetic data) and reports
 tokens/sec + MFU under the same stance as the bert mode.
 
+MXTPU_BENCH_MODE=goodput runs the goodput-attribution A/B: a tiny
+module.fit whose legacy data-wait split must agree with the
+telemetry/goodput.py phase accountant within 10% (docs/observability.md
+§Goodput) — the `train_goodput` row.
+
 MXTPU_BENCH_MODE=train_sharded runs the hot-path promotion A/B
 (docs/sharded_training.md): op-by-op gluon.Trainer loop vs the fused
 ShardedTrainer whole-step executable on a dispatch-bound MLP, reporting
@@ -91,6 +96,40 @@ def _percentiles(ms):
         "step_ms_p10": round(ms[max(0, int(0.1 * n))], 2),
         "step_ms_p90": round(ms[min(n - 1, int(0.9 * n))], 2),
     }
+
+
+def _goodput_mark():
+    """Snapshot the goodput accountant's cumulative totals — pair with
+    _goodput_breakdown() to decompose a timed region into phases."""
+    from mxnet_tpu.telemetry import goodput
+
+    t = goodput.totals()
+    return dict(t["phases"]), t["wall"]
+
+
+def _goodput_breakdown(mark):
+    """Per-phase seconds + fractions of the step wall accumulated since
+    ``mark`` (telemetry/goodput.py attribution — the CPU-side mirror of
+    tools/step_profile.py's on-device xplane rollup, so the two rows line
+    up). None when the accountant saw no steps (telemetry disabled)."""
+    from mxnet_tpu.telemetry import goodput
+
+    ph0, wall0 = mark
+    t = goodput.totals()
+    wall = t["wall"] - wall0
+    if wall <= 0.0:
+        return None
+    secs, fracs = {}, {}
+    for p, v in t["phases"].items():
+        if p == "between_steps":  # loop idle — not part of any step's wall
+            continue
+        d = v - ph0.get(p, 0.0)
+        if d > 1e-9:
+            secs[p] = round(d, 4)
+            fracs[p] = round(d / wall, 4)
+    return {"phase_seconds": secs, "phase_fractions": fracs,
+            "goodput_fraction": fracs.get("compute", 0.0),
+            "step_wall_s": round(wall, 4)}
 
 
 def _build(ctx, factory="resnet50_v1", hw=224):
@@ -166,6 +205,7 @@ def bench_train():
         for _ in range(WARMUP):
             trainer.step(xb, yb)
         trainer.step(xb, yb).asnumpy()  # drain dispatch before timed region
+        gp_mark = _goodput_mark() if split is not None else None
         batches = ((xb, yb) for _ in range(ITERS))
         wait = 0.0
         t0 = time.perf_counter()
@@ -183,6 +223,9 @@ def bench_train():
             split.update(data_wait_s=round(wait, 4),
                          compute_s=round(total - wait, 4),
                          data_wait_fraction=round(wait / total, 4))
+            gp = _goodput_breakdown(gp_mark)
+            if gp is not None:
+                split["goodput"] = gp
         return batch * ITERS / total
 
     split = {}
@@ -313,6 +356,7 @@ def bench_train_sharded():
             step()
         drain(step())
         d0 = dispatches()
+        gp_mark = _goodput_mark()
         batches = (None for _ in range(ITERS))
         wait = 0.0
         t0 = time.perf_counter()
@@ -326,11 +370,15 @@ def bench_train_sharded():
             out = step()
         drain(out)
         total = time.perf_counter() - t0
-        return {"imgs_per_sec": round(BATCH * ITERS / total, 2),
-                "dispatch_per_step": round((dispatches() - d0) / ITERS, 1),
-                "data_wait_s": round(wait, 4),
-                "compute_s": round(total - wait, 4),
-                "data_wait_fraction": round(wait / total, 4)}
+        res = {"imgs_per_sec": round(BATCH * ITERS / total, 2),
+               "dispatch_per_step": round((dispatches() - d0) / ITERS, 1),
+               "data_wait_s": round(wait, 4),
+               "compute_s": round(total - wait, 4),
+               "data_wait_fraction": round(wait / total, 4)}
+        gp = _goodput_breakdown(gp_mark)
+        if gp is not None:
+            res["goodput"] = gp
+        return res
 
     def run_opbyop():
         net = build("ab_op_")
@@ -388,6 +436,83 @@ def bench_train_sharded():
         if peak:
             out["mfu"] = round(out["value"] * flops_per_img
                                / (peak * 1e12), 4)
+    print(json.dumps(out))
+
+
+def bench_train_goodput():
+    """Goodput-attribution A/B over module.fit (MXTPU_BENCH_MODE=goodput):
+    run a tiny MLP fit and compare the legacy two-phase split the fit loop
+    has always published (mxtpu_data_{wait,compute}_seconds_total{src=fit})
+    against the goodput accountant's phase decomposition of the SAME run
+    (telemetry/goodput.py). The two account the iterator wait through
+    independent code paths, so their data-wait seconds must agree within
+    10% — `ab_agree_within_10pct` is the row's self-check. The headline
+    value is the attributed goodput fraction (compute ÷ step wall). This
+    row prices the attribution machinery, not a device: it is meaningful
+    on CPU and is labeled with whatever platform actually ran it."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    rng = np.random.RandomState(0)
+    n, in_dim, classes = 4096, 64, 8
+    X = rng.uniform(-1, 1, (n, in_dim)).astype(np.float32)
+    Y = rng.randint(0, classes, (n,)).astype(np.float32)
+
+    data = mx.sym.var("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    sym = mx.sym.Activation(sym, act_type="relu")
+    sym = mx.sym.FullyConnected(sym, num_hidden=classes, name="fc2")
+    sym = mx.sym.SoftmaxOutput(sym, name="softmax")
+
+    def fit_split():
+        s = telemetry.snapshot()
+
+        def val(name):
+            return float((s.get('%s{src="fit"}' % name) or {})
+                         .get("value") or 0.0)
+
+        return (val("mxtpu_data_wait_seconds_total"),
+                val("mxtpu_data_compute_seconds_total"))
+
+    train = mx.io.NDArrayIter(X, Y, batch_size=BATCH, shuffle=True,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    epochs = max(2, ITERS // 4)
+    w0, c0 = fit_split()
+    gp_mark = _goodput_mark()
+    t0 = time.perf_counter()
+    mod.fit(train, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    total = time.perf_counter() - t0
+    w1, c1 = fit_split()
+    legacy_wait, legacy_compute = w1 - w0, c1 - c0
+    gp = _goodput_breakdown(gp_mark) or {
+        "phase_seconds": {}, "phase_fractions": {},
+        "goodput_fraction": None, "step_wall_s": 0.0}
+    gp_wait = gp["phase_seconds"].get("data_wait", 0.0)
+    ratio = (gp_wait / legacy_wait) if legacy_wait > 0 else None
+    out = {
+        "metric": "train_goodput",
+        "value": gp["goodput_fraction"],
+        "unit": "fraction",
+        "vs_baseline": None,
+        "device": getattr(jax.devices()[0], "device_kind",
+                          jax.devices()[0].platform),
+        "platform": jax.devices()[0].platform,
+        "batch": BATCH,
+        "epochs": epochs,
+        "steps": epochs * (n // BATCH),
+        "fit_wall_s": round(total, 4),
+        "goodput": gp,
+        "legacy_fit_split": {"data_wait_s": round(legacy_wait, 4),
+                             "compute_s": round(legacy_compute, 4)},
+        "ab_data_wait_ratio": round(ratio, 4) if ratio is not None
+        else None,
+        "ab_agree_within_10pct": bool(ratio is not None
+                                      and 0.9 <= ratio <= 1.1),
+    }
     print(json.dumps(out))
 
 
@@ -1097,6 +1222,8 @@ def main():
         bench_lstm()
     elif MODE == "train_sharded":
         bench_train_sharded()
+    elif MODE == "goodput":
+        bench_train_goodput()
     else:
         bench_train()
 

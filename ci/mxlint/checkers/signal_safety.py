@@ -48,6 +48,7 @@ _SCOPE_FILES = (
     "mxnet_tpu/telemetry/core.py",
     "mxnet_tpu/telemetry/memory.py",
     "mxnet_tpu/telemetry/slo.py",
+    "mxnet_tpu/telemetry/goodput.py",
     "mxnet_tpu/telemetry/__init__.py",
     "mxnet_tpu/env.py",
     "mxnet_tpu/serving/supervisor.py",

@@ -257,15 +257,27 @@ class Registry:
         value = self.lookup(key)
         if value is not None:
             return value
-        label = label or key.fingerprint
-        if callable(example_args):
-            example_args = example_args()
-        if key.concrete and example_args is not None:
-            value = self._fill_concrete(key, build, example_args, label,
-                                        on_fill, event_fields)
-        else:
-            value = self._fill_lazy(key, build, label, on_fill, event_fields)
-        return self._insert(key, value)
+        # the whole miss path — persistent-tier loads and true fills alike —
+        # is compile time the training step did not spend on the device;
+        # the goodput accountant attributes it whether or not a step
+        # bracket is open (warmup compiles land on the cumulative counter)
+        from ..telemetry import goodput as _goodput
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            label = label or key.fingerprint
+            if callable(example_args):
+                example_args = example_args()
+            if key.concrete and example_args is not None:
+                value = self._fill_concrete(key, build, example_args, label,
+                                            on_fill, event_fields)
+            else:
+                value = self._fill_lazy(key, build, label, on_fill,
+                                        event_fields)
+            return self._insert(key, value)
+        finally:
+            _goodput.add("compile", _time.perf_counter() - t0)
 
     def _insert(self, key, value):
         with self._lock:

@@ -610,6 +610,18 @@ _var("MXTPU_STEP_FLOPS", "float", None,
      "× local device count (API spelling: `telemetry.set_step_flops`). "
      "Overrides the automatic cost-analysis accounting "
      "(`MXTPU_TRACE_FLOPS`).")
+_var("MXTPU_GOODPUT", "bool", True,
+     "per-step goodput attribution (docs/observability.md §Goodput): "
+     "every training step decomposes into exhaustive, non-overlapping "
+     "phases (`data_wait`/`host_dispatch`/`compile`/`compute`/"
+     "`checkpoint_stall`/`collective`/`other`) published as "
+     "`mxtpu_step_phase_seconds{phase=}` plus the rolling "
+     "`mxtpu_goodput_fraction` gauge. `0` turns the accountant into a "
+     "no-op (the legacy `module.fit` data-wait split keeps working).")
+_var("MXTPU_GOODPUT_WINDOW_STEPS", "int", 128,
+     "steps in the rolling window behind `mxtpu_goodput_fraction` and the "
+     "`/statusz` `training` block (windowed compute ÷ wall, top stall "
+     "phase).")
 
 # -- SLO engine -------------------------------------------------------------
 _var("MXTPU_SLO", "bool", True,
@@ -686,6 +698,12 @@ _var("MXTPU_SLO_MFU_FLOOR", "float", None,
      "when set): `mxtpu_step_mfu` floor, 0..1 — pages when achieved MFU "
      "drops below it (input starvation, a de-optimized step, a sick "
      "chip).")
+_var("MXTPU_SLO_GOODPUT_FLOOR", "float", None,
+     "optional training objective (registered at the first `observe_step` "
+     "when set): `mxtpu_goodput_fraction` floor, 0..1 — pages when the "
+     "windowed compute ÷ wall fraction drops below it (input stalls, "
+     "checkpoint stalls, recompile storms; docs/observability.md "
+     "§Goodput).")
 _var("MXTPU_SLO_STEP_STALENESS_S", "float", None,
      "optional training staleness objective (registered at the first "
      "`observe_step` when set): seconds `mxtpu_steps_total` may sit "

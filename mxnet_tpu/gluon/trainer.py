@@ -192,6 +192,7 @@ class Trainer:
                 "instead of record()/backward()/step() "
                 "(docs/sharded_training.md)")
         t0 = time.perf_counter()
+        telemetry.goodput.step_start(kind="train", t0=t0)
         # distributed tracing: a sampled step records allreduce/optimizer
         # phase spans (no-op span when tracing is unarmed)
         with telemetry.tracing.root("train.step", component="train",
@@ -199,9 +200,12 @@ class Trainer:
             if not self._kv_initialized:
                 self._init_kvstore()
             self._optimizer.rescale_grad = self._scale / batch_size
-            with telemetry.tracing.span("train.allreduce"):
+            with telemetry.tracing.span("train.allreduce"), \
+                    telemetry.goodput.phase("collective"):
                 self._allreduce_grads()
-            with telemetry.tracing.span("train.optimizer"):
+            telemetry.goodput.mark_launch()
+            with telemetry.tracing.span("train.optimizer"), \
+                    telemetry.goodput.phase("compute"):
                 self._update(ignore_stale_grad)
             self._step_count += 1
             # always-on telemetry: step wall time, examples/sec, MFU (auto
@@ -210,6 +214,7 @@ class Trainer:
             telemetry.observe_step(time.perf_counter() - t0,
                                    examples=batch_size,
                                    step=self._step_count)
+            telemetry.goodput.step_end(step=self._step_count)
         # step-boundary fault hook; the env guard keeps the hot path free
         # of even the import lookup when injection is unarmed
         if _env.is_set("MXTPU_FAULT_INJECT"):

@@ -264,6 +264,11 @@ class BaseModule(object):
                     break
                 t_step = time.perf_counter()
                 tm_wait.inc(t_step - t_wait)
+                # goodput bracket opens back-dated to t_wait (the iterator
+                # wait belongs to the step) but only after a successful
+                # next() — StopIteration must not leave a dangling bracket
+                telemetry.goodput.step_start(kind="fit", t0=t_wait)
+                telemetry.goodput.add("data_wait", t_step - t_wait)
                 if monitor is not None:
                     monitor.tic()
                 # distributed tracing: one root span per fit step; the
@@ -277,13 +282,17 @@ class BaseModule(object):
                         "train.data_wait",
                         time.time() - (t_step - t_wait), t_step - t_wait,
                         t_span, component="train")
+                    telemetry.goodput.mark_launch()
                     if use_fused:
-                        with telemetry.tracing.span("train.fused_step"):
+                        with telemetry.tracing.span("train.fused_step"), \
+                                telemetry.goodput.phase("compute"):
                             self.fused_step(data_batch)
                     else:
-                        with telemetry.tracing.span("train.fwd_bwd"):
+                        with telemetry.tracing.span("train.fwd_bwd"), \
+                                telemetry.goodput.phase("compute"):
                             self.forward_backward(data_batch)
-                        with telemetry.tracing.span("train.optimizer"):
+                        with telemetry.tracing.span("train.optimizer"), \
+                                telemetry.goodput.phase("compute"):
                             self.update()
                     fit_updates += 1
                     examples = None
@@ -294,6 +303,7 @@ class BaseModule(object):
                     telemetry.observe_step(time.perf_counter() - t_step,
                                            examples=examples,
                                            step=fit_updates, kind="fit")
+                    telemetry.goodput.step_end(step=fit_updates)
                 # step-boundary fault hook: counts updates since THIS
                 # process started (no-op unless MXTPU_FAULT_INJECT is set)
                 maybe_inject_fault(fit_updates)

@@ -73,7 +73,13 @@ def _x64_arming(arrays=(), shapes=(), dtypes=()):
     if armed:
         import jax
 
-        return jax.enable_x64(True), True
+        # jax removed the top-level alias; the context manager lives in
+        # jax.experimental on current releases. Probe both so the policy
+        # survives either spelling.
+        x64 = getattr(jax, "enable_x64", None)
+        if x64 is None:
+            from jax.experimental import enable_x64 as x64
+        return x64(True), True
     return contextlib.nullcontext(), False
 
 
@@ -653,8 +659,14 @@ def invoke(op_name, inputs, attrs, out=None):
                                                 _np.floating))
                    and not isinstance(attrs.get(k), bool)
                    and _math.isfinite(attrs[k]))
-    with _x64_if_large(attr_shape, *bounds,
-                       *(a.shape for a in in_arrays if hasattr(a, "shape"))):
+    # dtype-triggered arm as well: a float64 operand (argmax index past
+    # int32-max) silently narrows at trace time if only shapes are
+    # consulted. jax.jit keys on avals, so armed/unarmed traces of the
+    # same op never collide.
+    with _x64_arming(arrays=in_arrays,
+                     shapes=(attr_shape, *bounds,
+                             *(a.shape for a in in_arrays
+                               if hasattr(a, "shape"))))[0]:
         results = _profiler.timed_call(op_name, _ops.invoke_jax,
                                        (op_name, call_arrays, attrs))
     multi = isinstance(results, (tuple, list))

@@ -434,6 +434,9 @@ class PipelineTrainer:
         import time as _time
 
         t0 = _time.perf_counter()
+        from .. import telemetry as _telemetry
+
+        _telemetry.goodput.step_start(kind="pipeline", t0=t0)
         if self._loss is not None and len(batch) < 2:
             raise MXNetError("step(*inputs, label) needs a label for the "
                              "configured loss")
@@ -469,23 +472,28 @@ class PipelineTrainer:
 
         import jax
 
-        arrs = [jax.device_put(a, named_sharding(
-            self._mesh, batch_spec(self._mesh, a.ndim))) for a in arrs]
+        with _telemetry.goodput.phase("data_wait"):
+            arrs = [jax.device_put(a, named_sharding(
+                self._mesh, batch_spec(self._mesh, a.ndim))) for a in arrs]
         self._step_count += 1
         o = self._optimizer
         o.num_update = max(self._step_count + o.begin_num_update,
                            o.num_update)
         lr = self._host_lr()
         t = jnp.asarray(self._step_count, dtype=jnp.float32)
-        loss_val, self._outer_arrays, self._cell_leaves, self._states = fn(
-            key, t, jnp.asarray(lr, dtype=jnp.float32),
-            self._outer_arrays, self._cell_leaves, self._states, *arrs)
+        _telemetry.goodput.mark_launch()
+        with _telemetry.goodput.phase("compute"):
+            loss_val, self._outer_arrays, self._cell_leaves, self._states = \
+                fn(key, t, jnp.asarray(lr, dtype=jnp.float32),
+                   self._outer_arrays, self._cell_leaves, self._states,
+                   *arrs)
         from .. import telemetry
 
         examples = int(arrs[0].shape[0]) if getattr(arrs[0], "ndim", 0) \
             else None
         telemetry.observe_step(_time.perf_counter() - t0, examples=examples,
                                step=self._step_count, kind="pipeline")
+        _telemetry.goodput.step_end(step=self._step_count)
         return NDArray(loss_val, ctx=self._ctx)
 
     def forward(self, *batch, is_train=False):

@@ -367,6 +367,7 @@ class CheckpointManager:
         if threading.current_thread().name != _WRITER_THREAD:
             telemetry.histogram("mxtpu_checkpoint_stall_seconds",
                                 {"mode": "sync"}).observe(seconds)
+            telemetry.goodput.add("checkpoint_stall", seconds)
 
     def _fsync_and_crc(self, path):
         crc = 0
@@ -573,6 +574,7 @@ class CheckpointManager:
         stall = time.perf_counter() - t0
         telemetry.histogram("mxtpu_checkpoint_stall_seconds",
                             {"mode": "async"}).observe(stall)
+        telemetry.goodput.add("checkpoint_stall", stall)
         telemetry.record_event("ckpt_async_submit", step=int(step),
                                stall_s=round(stall, 5))
         return None
@@ -700,6 +702,7 @@ class CheckpointManager:
         stall = time.perf_counter() - t0
         telemetry.histogram("mxtpu_checkpoint_stall_seconds",
                             {"mode": "async"}).observe(stall)
+        telemetry.goodput.add("checkpoint_stall", stall)
         telemetry.record_event("ckpt_async_submit", step=int(step),
                                stall_s=round(stall, 5), sharded=True)
         return None

@@ -352,6 +352,9 @@ class DistributedTrainer:
         import time as _time
 
         t0 = _time.perf_counter()
+        from .. import telemetry as _telemetry
+
+        _telemetry.goodput.step_start(kind="dist", t0=t0)
         if self._loss is not None and label is None:
             raise MXNetError("this trainer was built with a loss that takes "
                              "(pred, label); step() needs a label argument")
@@ -395,7 +398,8 @@ class DistributedTrainer:
                 "mxtpu_executor_build_total", {"what": "dist_step"}).inc(),
             event_fields={"batch_sig": str(sig)})
 
-        batch = [self._shard_batch(b) for b in batch]
+        with _telemetry.goodput.phase("data_wait"):
+            batch = [self._shard_batch(b) for b in batch]
         # host-side schedule: the real step count advances here (only after
         # the batch sharded successfully, so a failed step doesn't skew the
         # update schedule); the traced update consumes it (and the scheduled
@@ -410,7 +414,9 @@ class DistributedTrainer:
         with telemetry.tracing.root("train.step", component="train",
                                     attrs={"step": self._step_count,
                                            "kind": "dist"}):
-            with telemetry.tracing.span("train.fused_step"):
+            telemetry.goodput.mark_launch()
+            with telemetry.tracing.span("train.fused_step"), \
+                    telemetry.goodput.phase("compute"):
                 loss_val, self._arrays, self._states = fn(
                     key, t, jnp.asarray(lr, dtype=jnp.float32),
                     self._arrays, self._states, *batch)
@@ -423,6 +429,7 @@ class DistributedTrainer:
             telemetry.observe_step(_time.perf_counter() - t0,
                                    examples=examples,
                                    step=self._step_count, kind="dist")
+            telemetry.goodput.step_end(step=self._step_count)
         from . import resilience
 
         # step-boundary fault hook (no-op unless MXTPU_FAULT_INJECT is set)

@@ -31,6 +31,7 @@ from .recorder import (  # noqa: F401
 )
 from . import core as _core
 from . import flops  # noqa: F401  (automatic FLOP accounting)
+from . import goodput  # noqa: F401  (per-step stall attribution)
 from . import memory  # noqa: F401  (HBM/RSS attribution + live gauges)
 from . import slo  # noqa: F401  (windowed SLO engine + /statusz)
 from . import tracing  # noqa: F401  (distributed request/step spans)
@@ -41,7 +42,7 @@ __all__ = [
     "record_event", "record_step", "events", "dump", "dump_path",
     "last_step", "install_signal_handler", "observe_step", "set_step_flops",
     "rank", "restart_generation", "telemetry_dir", "tracing", "flops",
-    "memory", "slo", "LATENCY_BOUNDS", "BYTE_BOUNDS",
+    "goodput", "memory", "slo", "LATENCY_BOUNDS", "BYTE_BOUNDS",
 ]
 
 

@@ -41,8 +41,9 @@ import traceback
 
 from .. import env as _env
 from . import core
-from . import memory  # imported HERE, not inside dump(): an import in a
-from . import tracing  # signal handler could deadlock on the import lock
+from . import goodput  # imported HERE, not inside dump(): an import in a
+from . import memory  # signal handler could deadlock on the import lock
+from . import tracing
 
 __all__ = ["record_event", "record_step", "events", "dump", "dump_path",
            "last_step", "install_signal_handler", "drain_pending_events",
@@ -216,6 +217,10 @@ def dump(reason, path=None):
             # device stats, NDArray live counts, top executables by temp
             # bytes — every hang/OOM dump says where the memory went
             "memory": memory.snapshot(),
+            # where the training wall-clock went: windowed goodput
+            # fraction + cumulative per-phase totals (docs §Goodput;
+            # lock-free value reads — signal-safe)
+            "goodput": goodput.snapshot(),
             # which objective was burning when the process hung: the
             # bounded slo_breach/slo_recovered ring (docs §SLOs)
             "alerts": alerts(),

@@ -56,6 +56,7 @@ import time
 
 from .. import env as _env
 from . import core
+from . import goodput
 from . import memory
 from . import recorder
 
@@ -95,6 +96,9 @@ _SPEC_METRICS = frozenset((
     "mxtpu_data_wait_seconds_total", "mxtpu_collective_seconds",
     "mxtpu_checkpoint_seconds", "mxtpu_device_bytes_in_use",
     "mxtpu_process_rss_bytes", "mxtpu_ndarray_live_bytes",
+    "mxtpu_step_phase_seconds", "mxtpu_goodput_fraction",
+    "mxtpu_goodput_phase_seconds_total", "mxtpu_goodput_wall_seconds_total",
+    "mxtpu_checkpoint_stall_seconds",
 ))
 
 
@@ -989,6 +993,17 @@ def wire_training(kind):
             metric="mxtpu_steps_total", labels=labels, threshold=stale_s,
             description="seconds without a completed step (SLO-shaped "
                         "watchdog)"), replace=False)
+    goodput_floor = _env.get("MXTPU_SLO_GOODPUT_FLOOR")
+    if goodput_floor:
+        # one unlabeled gauge per process (the goodput accountant is
+        # trainer-agnostic), so the objective registers once — the first
+        # trainer kind to step wins the race harmlessly
+        register(Objective(
+            "train-goodput-floor", "gauge_floor",
+            metric="mxtpu_goodput_fraction", threshold=goodput_floor,
+            description="windowed goodput floor: compute ÷ wall over the "
+                        "last MXTPU_GOODPUT_WINDOW_STEPS steps "
+                        "(docs/observability.md §Goodput)"), replace=False)
 
 
 # ---------------------------------------------------------------------------
@@ -1148,6 +1163,7 @@ def statusz_payload(extra=None):
         "pools": _pool_health(),
         "compile_cache": _compile_stats(),
         "memory": memory.snapshot(),
+        "training": goodput.statusz_block(),
         "slowest_exemplars": _slowest_exemplars(),
     }
     if extra:
@@ -1193,6 +1209,12 @@ def _render_text(payload):
         lines.append("  decode %s: %s" % (name, fields))
     for kind, fields in sorted(payload["rates"]["training"].items()):
         lines.append("  train %s: %s" % (kind, fields))
+    tr = payload.get("training") or {}
+    if tr.get("window_steps"):
+        lines.append("goodput: frac=%s over %d steps top_stall=%s (%.4gs)"
+                     % (tr.get("goodput_fraction"), tr["window_steps"],
+                        tr.get("top_stall_phase"),
+                        tr.get("top_stall_seconds", 0.0)))
     for name, pool in sorted(payload["pools"].items()):
         lines.append("  pool %s: %s" % (name, pool))
     if payload["compile_cache"]:

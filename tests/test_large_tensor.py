@@ -174,14 +174,14 @@ def test_backward_preserves_float64_operand():
     2^31+6 down to float32 (which rounds to 2^31), so the gradient value
     silently shifts. Allocation-free: the magnitude lives in the VALUE, not
     the shape (ADVICE r3 medium)."""
-    import jax
     import jax.numpy as jnp
+    from jax.experimental import enable_x64
 
     from mxnet_tpu import autograd
     from mxnet_tpu.ndarray import NDArray
 
     hi = 2**31 + 6
-    with jax.enable_x64(True):
+    with enable_x64(True):
         vj = jnp.full((1,), float(hi), jnp.float64)
         ones = jnp.ones((1,), jnp.float64)
     v = NDArray(vj)

@@ -132,6 +132,32 @@ def _extract(doc):
             detail.append("STALE")
         return (metric, doc.get("value"), doc.get("unit") or "",
                 ", ".join(detail))
+    if metric == "train_goodput" and "value" in doc:
+        # the goodput-attribution A/B row (bench.py bench_train_goodput):
+        # headline is the attributed goodput fraction; detail surfaces the
+        # stall mix and whether the legacy fit split and the attributor
+        # still agree on data-wait (the row's self-check)
+        gp = doc.get("goodput") or {}
+        fr = gp.get("phase_fractions") or {}
+        detail = []
+        if fr.get("data_wait") is not None:
+            detail.append("wait %s%%" % _fmt(100 * fr["data_wait"], 1))
+        stalls = {p: v for p, v in fr.items()
+                  if p not in ("compute", "data_wait")}
+        if stalls:
+            top = max(stalls.items(), key=lambda kv: kv[1])
+            detail.append("top stall %s %s%%" % (top[0],
+                                                 _fmt(100 * top[1], 1)))
+        if doc.get("ab_data_wait_ratio") is not None:
+            detail.append("A/B x%s%s" % (
+                _fmt(doc["ab_data_wait_ratio"]),
+                "" if doc.get("ab_agree_within_10pct") else " DISAGREE"))
+        if doc.get("platform"):
+            detail.append(str(doc["platform"]))
+        if doc.get("stale"):
+            detail.append("STALE")
+        return (metric, doc.get("value"), doc.get("unit") or "fraction",
+                ", ".join(detail))
     if metric == "train_preempt_ckpt_stall" and "value" in doc:
         # the async-vs-sync checkpoint stall A/B (train_restart_bench.py
         # --mode preempt): per-save trainer stall plus the measured
@@ -253,6 +279,7 @@ _CHECK_METRICS = {
     "autoscale_scale_up_s": "lower",  # surge -> grown pool serving
     "train_sharded": "higher",      # promotion A/B imgs/sec, per impl+bs
     "train_preempt_ckpt_stall": "higher",  # sync/async stall reduction, x
+    "train_goodput": "higher",      # attributed goodput fraction of wall
 }
 
 

@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""Whole-job goodput report: join the launcher's generation/downtime ledger
+(`launcher-events.jsonl`) with each rank's per-phase goodput totals (the
+final `telemetry-rank*-pid*.jsonl` metrics snapshot per process) into a
+per-generation, per-phase decomposition of where a multi-restart training
+job's wall-clock went (docs/observability.md §Goodput).
+
+Stdlib-only (like tools/launch.py): the report must run on a machine with
+nothing but the JSONL artifacts.
+
+For every generation the launcher supervised:
+
+  * wall        — launcher_generation_start → launcher_generation_exit
+  * spawn       — generation start → worker process import (per rank)
+  * startup     — import → first training step start (rendezvous, restore,
+                  warmup; from the worker's `goodput_first_step` event)
+  * phases      — the worker's cumulative `mxtpu_goodput_phase_seconds_total`
+                  counters (data_wait / host_dispatch / compile / compute /
+                  checkpoint_stall / collective / other / between_steps) —
+                  a contiguous attribution of first-step-start → last-step-end
+  * shutdown    — final telemetry flush → teardown start (or generation
+                  exit when the generation ended cleanly without a
+                  launcher teardown): interpreter epilogue per rank
+  * teardown    — `launcher_teardown` → generation exit, generation-wide:
+                  the SIGTERM→SIGKILL escalation window where survivors may
+                  be wedged (e.g. an allreduce on a dead peer) and can no
+                  longer account for themselves
+  * trailer     — attributed window end → final telemetry flush (epilogue
+                  inside the worker) — reported but NOT counted toward
+                  coverage, so a broken attributor (attributed collapses,
+                  trailer balloons) still fails `--check`
+
+plus the labeled `launcher_downtime` gap BEFORE the generation
+(teardown → respawn, cause preempt|crash from the rc-83 contract).
+
+Coverage per rank = (spawn + startup + attributed + shutdown + teardown)
+/ wall, capped at 1. `--check` fails (exit 1) unless every generation's
+coverage is at least `--min-coverage` (default 0.9) and every restart that
+followed a preemption carries a preempt-labeled downtime event.
+
+Usage:
+  python tools/goodput_report.py --dir /path/to/telemetry [--json] \
+      [--check] [--min-coverage 0.9]
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+PHASES = ("data_wait", "host_dispatch", "compile", "compute",
+          "checkpoint_stall", "collective", "other", "between_steps")
+
+_PHASE_RE = re.compile(
+    r'^mxtpu_goodput_phase_seconds_total\{phase="([a-z_]+)"\}$')
+_RANK_RE = re.compile(r"telemetry-rank(\d+)-pid(\d+)\.jsonl$")
+
+
+def _read_jsonl(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue  # torn final line of a killed process
+    except OSError:
+        pass
+    return out
+
+
+def load_launcher(directory):
+    """Generation ledger from launcher-events.jsonl:
+    {gen: {start, exit, rc, preempted, downtime: {cause, down_s, rc}}}."""
+    gens = {}
+    for rec in _read_jsonl(os.path.join(directory, "launcher-events.jsonl")):
+        if rec.get("kind") != "event":
+            continue
+        ev, ts = rec.get("event"), rec.get("ts")
+        f = rec.get("fields") or {}
+        g = f.get("generation")
+        if g is None:
+            continue
+        entry = gens.setdefault(g, {})
+        if ev == "launcher_generation_start":
+            entry["start"] = ts
+        elif ev == "launcher_generation_exit":
+            entry["exit"] = ts
+            entry["rc"] = f.get("rc")
+            entry["preempted"] = bool(f.get("preempted"))
+        elif ev == "launcher_teardown":
+            # clean generations emit no teardown event — missing means 0
+            entry["teardown"] = ts
+        elif ev == "launcher_downtime":
+            entry["downtime"] = {"cause": f.get("cause"),
+                                 "down_s": f.get("down_s"),
+                                 "rc": f.get("rc")}
+    return gens
+
+
+def load_ranks(directory):
+    """Per-(generation, rank) goodput totals from each worker's telemetry
+    JSONL. One process == one generation, so the LAST metrics snapshot in
+    a file is that generation's cumulative total."""
+    out = {}  # (gen, rank) -> record
+    for path in sorted(glob.glob(
+            os.path.join(directory, "telemetry-rank*-pid*.jsonl"))):
+        m = _RANK_RE.search(path)
+        if not m:
+            continue
+        rank = int(m.group(1))
+        last_metrics = None
+        first_step = None
+        for rec in _read_jsonl(path):
+            if rec.get("kind") == "metrics":
+                last_metrics = rec
+            elif rec.get("kind") == "event" and \
+                    rec.get("event") == "goodput_first_step":
+                first_step = rec
+        if last_metrics is None:
+            continue
+        gen = last_metrics.get("generation") or 0
+        phases = {}
+        wall_steps = 0.0
+        for key, snap in (last_metrics.get("metrics") or {}).items():
+            pm = _PHASE_RE.match(key)
+            if pm:
+                phases[pm.group(1)] = float(snap.get("value") or 0.0)
+            elif key == "mxtpu_goodput_wall_seconds_total":
+                wall_steps = float(snap.get("value") or 0.0)
+        rec = {"rank": rank, "generation": gen, "path": path,
+               "phases": phases, "step_wall_s": wall_steps,
+               "final_flush_ts": last_metrics.get("ts")}
+        if first_step is not None:
+            f = first_step.get("fields") or {}
+            rec["startup_s"] = float(f.get("startup_s") or 0.0)
+            # attributed window starts at first step start
+            rec["attr_start_ts"] = (first_step.get("ts") or 0.0) \
+                - float(f.get("step_wall_s") or 0.0)
+        prev = out.get((gen, rank))
+        # a rank restarted within one launcher generation keeps the
+        # freshest file (later final flush wins)
+        if prev is None or (rec["final_flush_ts"] or 0) >= \
+                (prev["final_flush_ts"] or 0):
+            out[(gen, rank)] = rec
+    return out
+
+
+def build_report(directory, min_coverage=0.9):
+    gens = load_launcher(directory)
+    ranks = load_ranks(directory)
+    report = {"directory": directory, "generations": [], "problems": []}
+    if not gens:
+        report["problems"].append("no launcher-events.jsonl generations "
+                                  "found in %s" % directory)
+        return report
+
+    job_start = min(e["start"] for e in gens.values() if "start" in e)
+    job_end = max(e.get("exit", e.get("start", 0)) for e in gens.values())
+    total_compute = total_wall = total_down = 0.0
+
+    for g in sorted(gens):
+        entry = gens[g]
+        start, end = entry.get("start"), entry.get("exit")
+        wall = (end - start) if (start is not None and end is not None) \
+            else None
+        teardown_ts = entry.get("teardown")
+        teardown_s = max(0.0, end - teardown_ts) \
+            if (teardown_ts is not None and end is not None) else 0.0
+        gen_ranks = sorted((rec for (gg, _), rec in ranks.items()
+                            if gg == g), key=lambda r: r["rank"])
+        agg = {p: 0.0 for p in PHASES}
+        rank_rows = []
+        coverages = []
+        for rec in gen_ranks:
+            attributed = sum(rec["phases"].values())
+            row = {"rank": rec["rank"],
+                   "phases": {p: round(v, 4)
+                              for p, v in sorted(rec["phases"].items())},
+                   "attributed_s": round(attributed, 4)}
+            for p, v in rec["phases"].items():
+                if p in agg:
+                    agg[p] += v
+            segments = attributed
+            if "startup_s" in rec:
+                row["startup_s"] = round(rec["startup_s"], 3)
+                segments += rec["startup_s"]
+            if "attr_start_ts" in rec and start is not None:
+                spawn = max(0.0, (rec["attr_start_ts"]
+                                  - rec.get("startup_s", 0.0)) - start)
+                row["spawn_s"] = round(spawn, 3)
+                segments += spawn
+            if rec.get("final_flush_ts") and "attr_start_ts" in rec:
+                trailer = max(0.0, (rec["final_flush_ts"]
+                                    - rec["attr_start_ts"]) - attributed)
+                row["trailer_s"] = round(trailer, 3)
+            if rec.get("final_flush_ts"):
+                # final flush -> teardown start (or clean exit): the
+                # interpreter epilogue the worker can't see; a rank whose
+                # final flush came DURING teardown clamps to 0 (that span
+                # is already priced in teardown_s)
+                shut_end = teardown_ts if teardown_ts is not None else end
+                if shut_end is not None:
+                    shutdown = max(0.0, shut_end - rec["final_flush_ts"])
+                    row["shutdown_s"] = round(shutdown, 3)
+                    segments += shutdown
+            segments += teardown_s
+            if wall:
+                cov = min(1.0, segments / wall)
+                row["coverage"] = round(cov, 4)
+                coverages.append(cov)
+            rank_rows.append(row)
+
+        n = max(1, len(gen_ranks))
+        compute = agg.get("compute", 0.0) / n
+        mean_phases = {p: round(v / n, 4) for p, v in agg.items() if v}
+        gen_row = {
+            "generation": g,
+            "wall_s": round(wall, 3) if wall is not None else None,
+            "rc": entry.get("rc"),
+            "preempted": entry.get("preempted", False),
+            "ranks": rank_rows,
+            "mean_phases_s": mean_phases,
+            "mean_compute_s": round(compute, 4),
+            "goodput_fraction": round(compute / wall, 4)
+            if wall else None,
+            "coverage": round(min(coverages), 4) if coverages else None,
+        }
+        if teardown_s:
+            gen_row["teardown_s"] = round(teardown_s, 3)
+        if "downtime" in entry:
+            gen_row["downtime_before"] = entry["downtime"]
+            total_down += entry["downtime"].get("down_s") or 0.0
+        report["generations"].append(gen_row)
+        if wall:
+            total_wall += wall
+            total_compute += compute
+
+        # -- checks -------------------------------------------------------
+        if wall is None:
+            report["problems"].append(
+                "generation %d has no start/exit pair (run still live, or "
+                "a torn ledger)" % g)
+        elif not gen_ranks:
+            report["problems"].append(
+                "generation %d: no rank telemetry found" % g)
+        elif coverages and min(coverages) < min_coverage:
+            report["problems"].append(
+                "generation %d: attributed coverage %.1f%% < %.0f%% of "
+                "wall" % (g, 100 * min(coverages), 100 * min_coverage))
+        if g > 0:
+            prev = gens.get(g - 1, {})
+            dt = entry.get("downtime")
+            if dt is None:
+                report["problems"].append(
+                    "generation %d: restart without a launcher_downtime "
+                    "event" % g)
+            elif prev.get("preempted") and dt.get("cause") != "preempt":
+                report["problems"].append(
+                    "generation %d followed a preemption but downtime is "
+                    "labeled %r" % (g, dt.get("cause")))
+
+    job_wall = job_end - job_start if job_end and job_start else None
+    report["job"] = {
+        "generations": len(gens),
+        "wall_s": round(job_wall, 3) if job_wall else None,
+        "generation_wall_s": round(total_wall, 3),
+        "downtime_s": round(total_down, 3),
+        "mean_compute_s": round(total_compute, 4),
+        "goodput_fraction": round(total_compute / job_wall, 4)
+        if job_wall else None,
+    }
+    return report
+
+
+def render_text(report):
+    lines = ["goodput report: %s" % report["directory"]]
+    for g in report["generations"]:
+        head = ("gen %d  wall=%ss rc=%s%s  goodput=%s coverage=%s"
+                % (g["generation"], g["wall_s"], g["rc"],
+                   " PREEMPTED" if g["preempted"] else "",
+                   g["goodput_fraction"], g["coverage"]))
+        if "teardown_s" in g:
+            head += " teardown=%.3fs" % g["teardown_s"]
+        lines.append(head)
+        if "downtime_before" in g:
+            d = g["downtime_before"]
+            lines.append("  downtime before: %.3fs cause=%s rc=%s"
+                         % (d.get("down_s") or 0.0, d.get("cause"),
+                            d.get("rc")))
+        if g["mean_phases_s"]:
+            lines.append("  phases (mean/rank): " + "  ".join(
+                "%s=%.3fs" % (p, v)
+                for p, v in sorted(g["mean_phases_s"].items(),
+                                   key=lambda kv: -kv[1])))
+        for r in g["ranks"]:
+            seg = ["rank %d:" % r["rank"]]
+            for k in ("spawn_s", "startup_s", "attributed_s", "shutdown_s",
+                      "trailer_s"):
+                if k in r:
+                    seg.append("%s=%.3f" % (k[:-2], r[k]))
+            if "coverage" in r:
+                seg.append("coverage=%.1f%%" % (100 * r["coverage"]))
+            lines.append("  " + " ".join(seg))
+    j = report.get("job") or {}
+    lines.append("job: %d generation(s) wall=%ss downtime=%ss goodput=%s"
+                 % (j.get("generations", 0), j.get("wall_s"),
+                    j.get("downtime_s"), j.get("goodput_fraction")))
+    for p in report["problems"]:
+        lines.append("PROBLEM: %s" % p)
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.environ.get("MXTPU_TELEMETRY_DIR"),
+                    help="telemetry directory (default: "
+                         "$MXTPU_TELEMETRY_DIR)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON instead of text")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless every generation decomposes to "
+                         ">= --min-coverage of wall and preempt downtime "
+                         "is labeled")
+    ap.add_argument("--min-coverage", type=float, default=0.9,
+                    help="minimum attributed fraction of generation wall "
+                         "(default 0.9)")
+    args = ap.parse_args(argv)
+    if not args.dir:
+        ap.error("--dir (or MXTPU_TELEMETRY_DIR) is required")
+    report = build_report(args.dir, min_coverage=args.min_coverage)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(render_text(report))
+    if args.check and report["problems"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

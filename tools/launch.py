@@ -228,7 +228,7 @@ def _signal_group(procs, sig):
                     pass
 
 
-def _teardown(procs, grace=None):
+def _teardown(procs, grace=None, generation=None):
     """Escalating group teardown: when MXTPU_TELEMETRY_DIR is configured,
     SIGUSR1 first (flight-recorder dump — every survivor writes thread
     stacks + recent telemetry events before dying, so a hung worker's
@@ -256,7 +256,7 @@ def _teardown(procs, grace=None):
             "SIGUSR1 (flight-recorder dump), then " if dump_first else "",
             grace))
     _emit_event("launcher_teardown", live=len(survivors), grace_s=grace,
-                dump_first=dump_first)
+                dump_first=dump_first, generation=generation)
     if dump_first:
         _signal_group(procs, signal.SIGUSR1)
         # let handlers write their dump files before SIGTERM lands
@@ -290,7 +290,7 @@ def _preempt_exit_code():
         return 83
 
 
-def _run_generation(cmds, preempt_rc=None):
+def _run_generation(cmds, preempt_rc=None, generation=None):
     """Spawn every (argv, env, label) and supervise by polling: the FIRST
     failure — a spawn error partway through the list, or any worker exiting
     nonzero — tears the survivors down (escalating SIGTERM→SIGKILL on the
@@ -331,7 +331,7 @@ def _run_generation(cmds, preempt_rc=None):
             if pending and not rc:
                 time.sleep(0.1)
     finally:
-        _teardown(procs)  # nonzero rc -> tears down the stragglers
+        _teardown(procs, generation=generation)  # nonzero rc -> stragglers
         for t in pumps:
             t.join(timeout=5)
     preempted = preempt_rc is not None and any(
@@ -360,13 +360,27 @@ def _spawn_and_wait(make_cmds, max_restarts=0, backoff=1.0):
     restarts_used = 0
     initial_delay = max(backoff, 0.0)
     delay = initial_delay
+    prev_exit = None  # (ts, rc, preempted) of the previous generation
     while True:
         if generation:
             _log("spawning generation %d" % generation)
+        if prev_exit is not None:
+            # goodput job ledger (docs/observability.md §Goodput): the gap
+            # between the previous generation's teardown and this spawn is
+            # categorized downtime — labeled preempt vs crash from the
+            # rc-83 contract. tools/goodput_report.py joins it (plus each
+            # rank's goodput_first_step event for the restore→first-step
+            # tail) against per-rank phase totals.
+            _emit_event("launcher_downtime", generation=generation,
+                        cause="preempt" if prev_exit[2] else "crash",
+                        rc=prev_exit[1],
+                        down_s=round(time.time() - prev_exit[0], 3))
         _emit_event("launcher_generation_start", generation=generation,
                     max_restarts=max_restarts)
         rc, preempted = _run_generation(make_cmds(generation),
-                                        _preempt_exit_code())
+                                        _preempt_exit_code(),
+                                        generation=generation)
+        prev_exit = (time.time(), rc, preempted)
         _emit_event("launcher_generation_exit", generation=generation, rc=rc,
                     preempted=preempted)
         _emit_generation_span(generation, rc)

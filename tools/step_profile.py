@@ -8,18 +8,20 @@ contradiction leaves open: per-shape conv kernels run near peak
 (conv_probe), yet the model's backward runs at ~1/4 of forward
 efficiency — so the time must be in ops the per-shape probe doesn't see.
 
-Usage: python tools/step_profile.py [batch] (default 256)
-Writes step_trace/ and prints a JSON summary per op category.
+Usage: python tools/step_profile.py [--net resnet50_v1] [--batch 256]
+Writes step_trace/ and prints a JSON summary per op category, plus a
+rollup onto the goodput phase vocabulary (telemetry/goodput.py) so these
+on-silicon xplane rows line up with tools/goodput_report.py's CPU-side
+attribution rows: device collectives land in `collective`, everything
+else the device executes is `compute`.
 """
+import argparse
 import glob
 import json
 import os
-import sys
-
-BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 256
 
 
-def capture(trace_dir):
+def capture(trace_dir, net_name, batch):
     import jax
     import numpy as np
 
@@ -30,12 +32,16 @@ def capture(trace_dir):
 
     ctx = mx.tpu()
     with ctx:
-        net = vision.resnet50_v1()
+        factory = getattr(vision, net_name, None)
+        if factory is None:
+            raise SystemExit("--net %r: no such model_zoo.vision model"
+                             % net_name)
+        net = factory()
         net.initialize(ctx=ctx)
         rng = np.random.RandomState(0)
-        x = mx.nd.array(rng.uniform(-1, 1, (BATCH, 3, 224, 224))
+        x = mx.nd.array(rng.uniform(-1, 1, (batch, 3, 224, 224))
                         .astype(np.float32), ctx=ctx)
-        y = mx.nd.array(rng.randint(0, 1000, (BATCH,)).astype(np.float32),
+        y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32),
                         ctx=ctx)
         net(x)
     mesh = make_mesh([("dp", 1)], devices=[jax.devices()[0]])
@@ -88,11 +94,18 @@ def summarize(trace_dir):
         total_us += dur
         cats[classify(nm)] = cats.get(classify(nm), 0.0) + dur
         ops[nm] = ops.get(nm, 0.0) + dur
+    phases = {}
+    for cat, us in cats.items():
+        p = goodput_phase(cat)
+        phases[p] = phases.get(p, 0.0) + us
     out = {
         "device_tracks": sorted(procs[p] for p in dev_pids),
         "trace_total_ms": round(total_us / 1e3, 2),
         "by_category_ms": {k: round(v / 1e3, 2) for k, v in
                            sorted(cats.items(), key=lambda kv: -kv[1])},
+        "by_goodput_phase_ms": {k: round(v / 1e3, 2) for k, v in
+                                sorted(phases.items(),
+                                       key=lambda kv: -kv[1])},
         "top_ops_ms": {k: round(v / 1e3, 2) for k, v in
                        sorted(ops.items(), key=lambda kv: -kv[1])[:40]},
     }
@@ -120,7 +133,27 @@ def classify(nm):
     return "other"
 
 
-if __name__ == "__main__":
+def goodput_phase(category):
+    """Map a device-op category onto the goodput phase vocabulary
+    (telemetry/goodput.py PHASES). On the device track only two phases
+    exist: cross-replica communication is `collective`, all other
+    executed HLO is `compute` — host phases (data_wait, host_dispatch,
+    compile, checkpoint_stall) never appear on a device track."""
+    return "collective" if category == "collective" else "compute"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--net", default="resnet50_v1",
+                    help="gluon.model_zoo.vision factory name "
+                         "(default resnet50_v1)")
+    ap.add_argument("--batch", type=int, default=256,
+                    help="global batch size (default 256)")
+    args = ap.parse_args(argv)
     d = os.environ.get("MXTPU_STEP_TRACE_DIR", "step_trace")
-    capture(d)
+    capture(d, args.net, args.batch)
     summarize(d)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,41 @@ def _jsonl_events(tdir):
     return counts
 
 
+def _jsonl_goodput(tdir):
+    """Goodput phase breakdown from the life's final telemetry flush (the
+    same counters tools/goodput_report.py joins): per-phase seconds +
+    fractions of step wall and the attributed goodput fraction. None when
+    the life published no goodput counters (telemetry disabled)."""
+    prefix = 'mxtpu_goodput_phase_seconds_total{phase="'
+    phases, wall = {}, 0.0
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") != "metrics":
+                    continue
+                for key, snap in (rec.get("metrics") or {}).items():
+                    if key.startswith(prefix):
+                        phase = key[len(prefix):].rstrip('"}')
+                        phases[phase] = float(snap.get("value") or 0.0)
+                    elif key == "mxtpu_goodput_wall_seconds_total":
+                        wall = float(snap.get("value") or 0.0)
+    if wall <= 0.0:
+        return None
+    phases.pop("between_steps", None)  # loop idle — not part of step wall
+    phases = {p: v for p, v in phases.items() if v > 0.0}
+    return {"phase_seconds": {p: round(v, 4) for p, v in phases.items()},
+            "phase_fractions": {p: round(v / wall, 4)
+                                for p, v in phases.items()},
+            "goodput_fraction": round(phases.get("compute", 0.0) / wall, 4),
+            "step_wall_s": round(wall, 4)}
+
+
 def _worker(steps):
     """One training life: build the promoted trainer, time to the first
     completed fused step (trace + compile or persist-load + run), then a
@@ -125,6 +160,9 @@ def _spawn_run(tag, steps, cache_dir, workdir, timeout_s):
     row["persist_hits"] = events.get("compile_persist_hit", 0)
     row["persist_bad"] = events.get("compile_persist_bad", 0)
     row["manifest_prefetches"] = events.get("sharded_manifest_prefetch", 0)
+    gp = _jsonl_goodput(tdir)
+    if gp is not None:
+        row["goodput"] = gp
     return row
 
 
