@@ -25,22 +25,45 @@ invariants no general-purpose linter knows about:
     locks are never re-acquired down a call chain).
   * ``thread-hygiene``  — every library ``threading.Thread`` passes
     ``name=`` and is daemon or provably joined.
+  * ``tracer-leak``     — jit-traced code never stores trace-time state
+    (``self.*`` / global / closed-over mutable writes, RNG-chain mutator
+    calls — the PR-9 bug class) unless annotated ``trace-pure``.
+  * ``trace-purity``    — no trace-time capture of mutable environment
+    (env/``os.environ`` reads, clocks, telemetry counters, logging) inside
+    traced functions: the value freezes at trace time and goes stale.
+  * ``retrace-hazard``  — every ``jax.jit``/``pjit`` call site routes
+    through the ``mxnet_tpu.compile`` registry (or is a deliberate
+    module-level singleton); no non-literal static args; no trace-time
+    ``self.*`` reads or Python branching on traced arguments.
+  * ``donation-discipline`` — ``donate_argnums`` sites: no read of a
+    donated binding after the call, argnums within the wrapped fn's
+    signature, and donating builders' ExecutableKeys declare ``donation=``
+    so the fill-hook donation verifier covers them.
 
 Checker API (see ``checkers/``): a checker is an object with ``rule``,
 ``description`` and ``run(repo) -> iterable[Finding]``; per-file AST
-visitors and whole-repo cross-file passes both fit. Suppression:
+visitors and whole-repo cross-file passes both fit. The ``Repo`` object
+parses each file once and memoizes shared analyses (``Repo.memo`` —
+per-file ``ModuleIndex``, traced-scope discovery), so adding rules costs
+walk time, not re-parse/re-index time. Suppression:
 
   * pragma — append ``# mxlint: disable=<rule>[,<rule>...]`` to the flagged
     line (grep-able, justification comment expected next to it);
   * semantic annotation — ``# mxlint: gil-atomic — <why>`` marks
-    deliberately lock-free state for the lock-discipline rule
-    (docs/static_analysis.md §Annotating intentional lock-free state);
+    deliberately lock-free state for the lock-discipline rule, and
+    ``# mxlint: trace-pure — <why>`` marks deliberate trace-time effects
+    for the tracer-leak/trace-purity rules (docs/static_analysis.md
+    §Annotating intentional lock-free state, §Trace-discipline audit);
   * baseline — ``ci/mxlint/baseline.txt`` grandfathers pre-existing
     findings (``--update-baseline`` regenerates; the committed file is kept
     EMPTY — fix, don't baseline, is the default posture).
 
-Runner: ``python -m ci.mxlint [--rule R] [--list-rules]
-[--update-baseline]`` — exit 0 clean, 1 findings, 2 usage/internal error.
+Runner: ``python -m ci.mxlint [--rule R] [--list-rules] [--format json]
+[--changed-only] [--update-baseline]`` — exit 0 clean, 1 findings, 2
+usage/internal error. ``--changed-only`` restricts per-file rules to files
+changed vs git HEAD (fast pre-commit loop; whole-repo parity rules always
+see the full tree, so registry ↔ docs diffing stays sound). ``--format
+json`` emits machine-readable findings for CI tooling (ci/run_checks.sh).
 Enforced in-suite by ``tests/test_infra.py::test_mxlint_clean``.
 Zero dependencies beyond the stdlib; never imports mxnet_tpu (all analysis
 is on source text/ASTs, so the lint runs without jax installed).
@@ -49,7 +72,9 @@ from __future__ import annotations
 
 import argparse
 import ast
+import json
 import os
+import subprocess
 import sys
 
 __all__ = ["Finding", "Repo", "all_checkers", "run_checkers", "main"]
@@ -83,11 +108,23 @@ class Finding:
 
 
 class Repo:
-    """Parsed view of the checkout: file discovery + cached ASTs."""
+    """Parsed view of the checkout: file discovery + cached ASTs.
 
-    def __init__(self, root):
+    One Repo instance is shared by every checker in a run; anything a
+    checker computes per file that another checker could reuse belongs in
+    ``memo()`` (the per-file ``ModuleIndex`` and traced-scope discovery
+    live there), so the whole 14-rule run parses and indexes each file
+    exactly once.
+    """
+
+    def __init__(self, root, changed=None):
         self.root = os.path.abspath(root)
         self._cache = {}
+        self._memo = {}
+        self._files = {}
+        #: None, or a frozenset of repo-relative paths (``--changed-only``)
+        #: that per-file rules restrict themselves to via scoped_files().
+        self.changed = changed
 
     def abspath(self, rel):
         return os.path.join(self.root, rel.replace("/", os.sep))
@@ -95,9 +132,20 @@ class Repo:
     def exists(self, rel):
         return os.path.exists(self.abspath(rel))
 
+    def memo(self, key, build):
+        """Run-scoped cache for shared per-file analyses. The first caller
+        pays ``build()``; every later checker asking for the same ``key``
+        gets the cached value."""
+        if key not in self._memo:
+            self._memo[key] = build()
+        return self._memo[key]
+
     def py_files(self, *tops):
         """Repo-relative paths of .py files under the given top-level dirs
-        (or single files), sorted, ``__pycache__`` skipped."""
+        (or single files), sorted, ``__pycache__`` skipped. Cached per
+        ``tops`` tuple (several checkers walk the same package)."""
+        if tops in self._files:
+            return self._files[tops]
         out = []
         for top in tops:
             path = self.abspath(top)
@@ -111,7 +159,20 @@ class Repo:
                         rel = os.path.relpath(os.path.join(dirpath, name),
                                               self.root)
                         out.append(rel.replace(os.sep, "/"))
-        return sorted(set(out))
+        self._files[tops] = sorted(set(out))
+        return self._files[tops]
+
+    def scoped_files(self, *tops):
+        """py_files() narrowed to the ``--changed-only`` set when one is
+        active. ONLY for per-file rules (host-sync, the trace-discipline
+        suite, lock-discipline, ...); whole-repo parity rules must keep
+        calling py_files() — diffing a registry against docs with half the
+        tree hidden would manufacture false 'documented but absent'
+        findings."""
+        files = self.py_files(*tops)
+        if self.changed is None:
+            return files
+        return [f for f in files if f in self.changed]
 
     def read(self, rel):
         try:
@@ -195,6 +256,26 @@ def run_checkers(repo, checkers, baseline=None):
     return kept, by_pragma, by_baseline
 
 
+def changed_files(root):
+    """Repo-relative .py paths changed vs git HEAD (staged + unstaged +
+    untracked) for ``--changed-only``. Returns None — meaning 'no
+    restriction' — when git is unavailable or the root is not a checkout,
+    so the flag degrades to a full run rather than a silent skip."""
+    rels = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD", "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0:
+            return None
+        rels.update(line.strip() for line in out.stdout.splitlines()
+                    if line.strip().endswith(".py"))
+    return frozenset(r.replace(os.sep, "/") for r in rels)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m ci.mxlint",
@@ -211,12 +292,21 @@ def main(argv=None):
     parser.add_argument("--update-baseline", action="store_true",
                         help="rewrite the baseline to grandfather every "
                              "current finding, then exit 0")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="output format (json: machine-readable "
+                             "findings for CI tooling)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="restrict per-file rules to files changed vs "
+                             "git HEAD (fast pre-commit loop; whole-repo "
+                             "parity rules still see the full tree)")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
     root = args.root or os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
-    repo = Repo(root)
+    changed = changed_files(root) if args.changed_only else None
+    repo = Repo(root, changed=changed)
     checkers = all_checkers()
     if args.list_rules:
         for c in checkers:
@@ -254,6 +344,17 @@ def main(argv=None):
         sys.stdout.write("mxlint: baseline updated (%d entries) at %s\n"
                          % (len(entries), baseline_path))
         return 0
+
+    if args.format == "json":
+        payload = {
+            "rules": len(checkers),
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message} for f in kept],
+            "pragma_suppressed": len(by_pragma),
+            "baselined": len(by_baseline),
+        }
+        sys.stdout.write(json.dumps(payload, indent=2) + "\n")
+        return 1 if kept else 0
 
     for finding in kept:
         sys.stdout.write(finding.render() + "\n")

@@ -104,6 +104,35 @@ def names_in(node):
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
 
+def local_names(func):
+    """Names bound in the function's own scope: parameters, assignment /
+    loop / with / walrus / comprehension targets, except-handler names,
+    local imports, nested def/class names. A bare Name a function reads
+    that is NOT in this set is closed-over or global — the distinction the
+    tracer-leak rule turns on (mutating a local temp at trace time is
+    fine; mutating captured state leaks the trace)."""
+    out = set()
+    a = func.args
+    for arg in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+        out.add(arg.arg)
+    if a.vararg is not None:
+        out.add(a.vararg.arg)
+    if a.kwarg is not None:
+        out.add(a.kwarg.arg)
+    for node in body_walk(func):
+        if isinstance(node, FUNC_DEFS) or isinstance(node, ast.ClassDef):
+            out.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            out.add(node.name)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # module/class symbol graph (shared by the concurrency checkers)
 # ---------------------------------------------------------------------------
@@ -229,6 +258,14 @@ class ModuleIndex:
                 if self._contains(near, cand):
                     return cand
         return candidates[0]
+
+
+def shared_index(repo, rel):
+    """The (memoized) ModuleIndex for a file — one parse+index shared by
+    every checker in a run (the runner's shared-parse contract; the
+    concurrency rules alone used to build this three times per file)."""
+    return repo.memo(("module-index", rel),
+                     lambda: ModuleIndex(rel, repo.tree(rel)))
 
 
 class ThreadRoot:

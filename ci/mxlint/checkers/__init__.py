@@ -10,11 +10,15 @@ from .bare_print import BarePrintChecker
 from .compile_registry import CompileRegistryChecker
 from .concurrency import (LockDisciplineChecker, LockOrderChecker,
                           ThreadHygieneChecker)
+from .donation_discipline import DonationDisciplineChecker
 from .env_registry import EnvRegistryChecker
 from .host_sync import HostSyncChecker
 from .metric_registry import MetricRegistryChecker
 from .registry_parity import RegistryParityChecker
+from .retrace_hazard import RetraceHazardChecker
 from .signal_safety import SignalSafetyChecker
+from .trace_purity import TracePurityChecker
+from .tracer_leak import TracerLeakChecker
 
 CHECKERS = (
     HostSyncChecker(),
@@ -27,4 +31,8 @@ CHECKERS = (
     LockDisciplineChecker(),
     LockOrderChecker(),
     ThreadHygieneChecker(),
+    TracerLeakChecker(),
+    TracePurityChecker(),
+    RetraceHazardChecker(),
+    DonationDisciplineChecker(),
 )

@@ -1,9 +1,13 @@
 """bare-print: no bare ``print(`` in library code.
 
 The ported ``ci/lint_print.py`` rule (PR 3) as an mxlint checker, sharing
-the original's tokenizer and allowlist semantics verbatim by importing
-them — one implementation, two frontends (the old standalone CLI keeps
-working; ``tests/test_mxlint.py`` pins that with a regression test).
+the original's per-file tokenizer and allowlist constants verbatim by
+importing them — one implementation, two frontends (the old standalone
+CLI keeps working; ``tests/test_mxlint.py`` pins that with a regression
+test). File iteration is the runner's (cached + ``--changed-only``
+aware), with a cheap substring prefilter: a file without the word
+``print`` anywhere skips the tokenizer entirely, which is most of the
+tree.
 
 Allowlist (from ci/lint_print.py): ``mxnet_tpu/test_utils.py``,
 ``mxnet_tpu/notebook/``, and lines marked ``# allow-print``. The mxlint
@@ -11,6 +15,8 @@ pragma ``# mxlint: disable=bare-print`` also works, but prefer
 ``# allow-print`` so both frontends agree.
 """
 from __future__ import annotations
+
+import os
 
 from .. import Finding
 
@@ -23,9 +29,21 @@ class BarePrintChecker:
     def run(self, repo):
         from ci import lint_print
 
-        for rel, line, text in lint_print.iter_violations(repo.root):
-            yield Finding(
-                self.rule, rel, line,
-                "bare print( in library code — route through "
-                "mxnet_tpu.log (+ telemetry for numbers) or mark "
-                "an explicit display surface with `# allow-print`")
+        allow_files = {f.replace(os.sep, "/")
+                       for f in lint_print.ALLOW_FILES}
+        allow_dirs = {d.replace(os.sep, "/")
+                      for d in lint_print.ALLOW_DIRS}
+        for rel in repo.scoped_files("mxnet_tpu"):
+            if rel in allow_files or any(
+                    rel.startswith(d + "/") for d in allow_dirs):
+                continue
+            lines = repo.lines(rel)
+            if not lines or not any("print" in ln for ln in lines):
+                continue
+            for line, text in lint_print.find_bare_prints(
+                    repo.abspath(rel), rel) or ():
+                yield Finding(
+                    self.rule, rel, line,
+                    "bare print( in library code — route through "
+                    "mxnet_tpu.log (+ telemetry for numbers) or mark "
+                    "an explicit display surface with `# allow-print`")

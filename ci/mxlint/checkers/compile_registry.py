@@ -110,7 +110,7 @@ class CompileRegistryChecker:
                    "mxnet_tpu/compile")
 
     def run(self, repo):
-        for rel in repo.py_files("mxnet_tpu"):
+        for rel in repo.scoped_files("mxnet_tpu"):
             if rel.startswith("mxnet_tpu/compile/"):
                 continue
             tree = repo.tree(rel)

@@ -57,6 +57,7 @@ import re
 
 from .. import Finding
 from ..astutil import (FUNC_DEFS, ModuleIndex, dotted, keyword_value,
+                       shared_index,
                        thread_roots)
 
 GIL_ATOMIC = "mxlint: gil-atomic"
@@ -152,8 +153,11 @@ class _FileConcurrency:
     per-root reachability with held-lock propagation, producing per
     (class, attr) write/read site tables."""
 
-    def __init__(self, rel, tree):
-        self.idx = ModuleIndex(rel, tree)
+    def __init__(self, rel, tree, idx=None):
+        # the runner passes the memoized per-file index (shared with
+        # lock-order, thread-hygiene and the trace-discipline rules);
+        # building one here is the standalone/test path
+        self.idx = idx if idx is not None else ModuleIndex(rel, tree)
         self.facts = {name: _ClassFacts(info)
                       for name, info in self.idx.classes.items()}
         self.roots = thread_roots(self.idx)
@@ -425,12 +429,13 @@ class LockDisciplineChecker:
 
     def run(self, repo):
         findings = []
-        for rel in repo.py_files("mxnet_tpu"):
+        for rel in repo.scoped_files("mxnet_tpu"):
             tree = repo.tree(rel)
             if tree is None:
                 continue
             try:
-                analysis = _FileConcurrency(rel, tree)
+                analysis = _FileConcurrency(rel, tree,
+                                            shared_index(repo, rel))
             except RecursionError:   # pathological tree: skip, don't crash
                 continue
             findings.extend(analysis.findings(self.rule, repo))
@@ -461,7 +466,7 @@ class _LockGraph:
             tree = repo.tree(rel)
             if tree is None:
                 continue
-            idx = ModuleIndex(rel, tree)
+            idx = shared_index(repo, rel)
             facts = {n: _ClassFacts(i) for n, i in idx.classes.items()}
             self.files[rel] = (idx, facts)
             for info in idx.classes.values():
@@ -695,11 +700,11 @@ class ThreadHygieneChecker:
 
     def run(self, repo):
         findings = []
-        for rel in repo.py_files("mxnet_tpu"):
+        for rel in repo.scoped_files("mxnet_tpu"):
             tree = repo.tree(rel)
             if tree is None:
                 continue
-            idx = ModuleIndex(rel, tree)
+            idx = shared_index(repo, rel)
             src = "\n".join(repo.lines(rel) or [])
             for node in ast.walk(tree):
                 if not isinstance(node, ast.Call):

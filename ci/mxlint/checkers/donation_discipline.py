@@ -1,0 +1,355 @@
+"""donation-discipline: donated buffers are dead after the call.
+
+``donate_argnums`` tells XLA it may alias an input's buffer into an
+output. The trainers and the decode engine lean on this hard (params,
+optimizer state, and the KV pool are donated every step) — and it is
+entirely unchecked at the Python level: reading a donated array after
+the call returns garbage or crashes with a deleted-buffer error,
+depending on backend and timing; a donated argnum that drifts out of
+position after a signature edit silently donates the WRONG argument; and
+a donating executable whose ExecutableKey omits ``donation=`` is
+invisible to the fill-hook donation verifier
+(``telemetry.memory.verify_donation``), so a donation XLA silently
+declined is never reported.
+
+At every ``donate_argnums=`` jit site, and at every compile-registry
+resolve call linked to one (the builder argument of ``get_or_build`` /
+``_resolve`` / ``_resolve_persistent``, directly or via ``lambda:
+self._build(...)`` / ``self._build_prefill(lp)`` builder factories):
+
+  * D0 — ``donate_argnums`` must be a literal int / tuple of ints (a
+    computed spec can drift without any diff touching the jit line);
+  * D1 — every donated argnum must fall inside the wrapped function's
+    positional signature (vararg-aware);
+  * D2 — use-after-donate: resolve the executable's invocations (a local
+    ``fn = self._resolve(...)`` binding, or the direct
+    ``self._decode_exe(n)(...)`` shape for methods that return the
+    resolve call) and flag any read of a donated binding — a bare name
+    or ``self.<attr>`` chain — after the call in the same function,
+    before it is re-stored. A binding re-assigned by the call statement
+    itself (``tok, self._kv = exe(params, self._kv, ...)``) is the
+    canonical safe shape;
+  * D3 — verifier coverage: the resolve call's key (inline
+    ``ExecutableKey(...)``, a local key variable, or a ``self._key(...)``
+    key-builder method) must declare ``donation=`` matching the jit's
+    ``donate_argnums``, so ``verify_donation`` actually audits the site.
+
+Suppress a deliberate exception with ``# mxlint:
+disable=donation-discipline`` and a justifying comment.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from ..astutil import FUNC_DEFS, body_walk, dotted
+from ..trace_scope import traced_scope
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_RESOLVE_TAILS = {"get_or_build", "_resolve", "_resolve_persistent"}
+
+
+def _donation_spec(node):
+    """(spec tuple, value node) for a jit call's donate_argnums keyword;
+    spec is None when the keyword is absent or non-literal."""
+    for kw in node.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,), v
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            return tuple(e.value for e in v.elts), v
+        return None, v
+    return None, None
+
+
+def _spec_literal(node):
+    """A literal int/tuple-of-ints value as a tuple, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+def _positional_arity(fn):
+    """(number of named positional params, has_vararg)."""
+    a = fn.args
+    return len(a.posonlyargs) + len(a.args), a.vararg is not None
+
+
+def _nearest(parents, node, kinds):
+    cur = parents.get(node)
+    while cur is not None and not isinstance(cur, kinds):
+        cur = parents.get(cur)
+    return cur
+
+
+def _binding_of(arg):
+    """A stable spelling for a donated argument expression: a bare name
+    (``train``) or a ``self.<attr>`` chain (``self._states``); None for
+    anything temporary (a ``jnp.asarray(lr)`` expression cannot be read
+    again, so it cannot be misused)."""
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Attribute):
+        name = dotted(arg)
+        if name and name.startswith("self."):
+            return name
+    return None
+
+
+class DonationDisciplineChecker:
+    rule = "donation-discipline"
+    description = ("donate_argnums sites: literal in-signature argnums, "
+                   "no read of a donated binding after the call, keys "
+                   "declare donation= for the fill-hook verifier")
+
+    def run(self, repo):
+        for rel in repo.scoped_files("mxnet_tpu"):
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            yield from self._check_file(repo, rel, tree)
+
+    def _check_file(self, repo, rel, tree):
+        scope = traced_scope(repo, rel, tree)
+        parents = scope.parents
+
+        # -- donating jit sites: D0/D1, and builder -> spec map -----------
+        builder_specs = {}  # FUNC_DEFS node -> set of spec tuples
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or \
+                    dotted(node.func) not in _JIT_NAMES:
+                continue
+            spec, value = _donation_spec(node)
+            if value is None:
+                continue
+            if spec is None:
+                yield Finding(
+                    self.rule, rel, value.lineno,
+                    "non-literal `donate_argnums` on `%s(...)` — a "
+                    "computed spec can drift out of position without any "
+                    "diff touching this line" % dotted(node.func))
+                continue
+            builder = _nearest(parents, node, FUNC_DEFS)
+            if builder is not None:
+                builder_specs.setdefault(builder, set()).add(spec)
+            if node.args and isinstance(node.args[0], ast.Name):
+                for fd in scope.resolve(node.args[0].id, node):
+                    npos, vararg = _positional_arity(fd)
+                    bad = [i for i in spec
+                           if i < 0 or (not vararg and i >= npos)]
+                    if bad:
+                        yield Finding(
+                            self.rule, rel, node.lineno,
+                            "donate_argnums %s outside `%s`'s positional "
+                            "signature (%d positional param(s)%s) — the "
+                            "spec drifted from the wrapped fn"
+                            % (tuple(bad), fd.name, npos,
+                               "" if not vararg else " + *%s"
+                               % fd.args.vararg.arg))
+
+        # -- resolve calls linked to donating builders: D2/D3 -------------
+        seen_keys = set()  # prefill + decode share one _key method: one
+        # ExecutableKey node, one finding
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = dotted(node.func) or ""
+            if cname.rpartition(".")[2] not in _RESOLVE_TAILS or \
+                    not node.args:
+                continue
+            specs = set()
+            for arg in node.args[1:]:
+                for fd in self._linked_builders(scope, arg, node):
+                    specs |= builder_specs.get(fd, set())
+            if len(specs) != 1:
+                continue  # not donating, or ambiguous — nothing to check
+            spec = next(iter(specs))
+            yield from self._check_key(rel, scope, node, spec, seen_keys)
+            yield from self._check_use_after_donate(
+                rel, tree, scope, node, spec)
+
+    # -- builder linking ---------------------------------------------------
+    def _linked_builders(self, scope, arg, at):
+        """Builder function defs a resolve-call argument leads to: a bare
+        name, a ``self.method(...)``/``name(...)`` factory call, or a
+        lambda whose body calls either."""
+        if isinstance(arg, ast.Name):
+            return scope.resolve(arg.id, at)
+        if isinstance(arg, ast.Lambda):
+            out = []
+            for n in ast.walk(arg):
+                if isinstance(n, ast.Call):
+                    out.extend(self._linked_builders(scope, n.func, at))
+            return out
+        if isinstance(arg, ast.Call):
+            return self._linked_builders(scope, arg.func, at)
+        if isinstance(arg, ast.Attribute) and \
+                isinstance(arg.value, ast.Name) and \
+                arg.value.id in ("self", "cls"):
+            cls = _nearest(scope.parents, at, ast.ClassDef)
+            if cls is not None:
+                return scope.methods.get(cls, {}).get(arg.attr, ())
+        return ()
+
+    # -- D3: key coverage --------------------------------------------------
+    def _check_key(self, rel, scope, resolve_call, spec, seen_keys):
+        key_calls = self._key_exprs(scope, resolve_call)
+        for kc in key_calls:
+            if (id(kc), spec) in seen_keys:
+                continue
+            seen_keys.add((id(kc), spec))
+            donation = None
+            for kw in kc.keywords:
+                if kw.arg == "donation":
+                    donation = kw.value
+            if donation is None:
+                yield Finding(
+                    self.rule, rel, kc.lineno,
+                    "donating executable (donate_argnums=%s) resolved "
+                    "with an ExecutableKey that omits `donation=` — the "
+                    "fill-hook donation verifier "
+                    "(telemetry.memory.verify_donation) never covers this "
+                    "site" % (spec,))
+                continue
+            lit = _spec_literal(donation)
+            if lit is not None and lit != spec:
+                yield Finding(
+                    self.rule, rel, donation.lineno,
+                    "ExecutableKey declares donation=%s but the jit "
+                    "donates %s — the donation verifier audits the wrong "
+                    "argnums" % (lit, spec))
+
+    def _key_exprs(self, scope, resolve_call):
+        """ExecutableKey(...) Call nodes the resolve call's key argument
+        leads to (inline, via a local variable, or via a same-class
+        key-builder method). Empty when unresolvable — no finding is
+        better than a guessed one."""
+        key = resolve_call.args[0]
+        if isinstance(key, ast.Call):
+            if (dotted(key.func) or "").rpartition(".")[2] == \
+                    "ExecutableKey":
+                return [key]
+            builders = self._linked_builders(scope, key.func, resolve_call)
+            out = []
+            for fd in builders:
+                for n in ast.walk(fd):
+                    if isinstance(n, ast.Call) and \
+                            (dotted(n.func) or "").rpartition(".")[2] == \
+                            "ExecutableKey":
+                        out.append(n)
+            return out
+        if isinstance(key, ast.Name):
+            fn = _nearest(scope.parents, resolve_call, FUNC_DEFS)
+            if fn is None:
+                return []
+            out = []
+            for n in body_walk(fn):
+                if isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == key.id
+                        for t in n.targets) and \
+                        isinstance(n.value, ast.Call) and \
+                        (dotted(n.value.func) or "").rpartition(".")[2] \
+                        == "ExecutableKey":
+                    out.append(n.value)
+            return out
+        return []
+
+    # -- D2: use-after-donate ----------------------------------------------
+    def _check_use_after_donate(self, rel, tree, scope, resolve_call,
+                                spec):
+        parents = scope.parents
+        invocations = []
+        stmt = _nearest(parents, resolve_call, ast.stmt)
+
+        # shape A: fn = self._resolve(...); ... fn(args)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            bound = stmt.targets[0].id
+            encl = _nearest(parents, resolve_call, FUNC_DEFS)
+            if encl is not None:
+                for n in body_walk(encl):
+                    if isinstance(n, ast.Call) and \
+                            isinstance(n.func, ast.Name) and \
+                            n.func.id == bound and n is not resolve_call:
+                        invocations.append(n)
+
+        # shape B: def _exe(self, ...): return self._resolve(...)
+        # invoked as self._exe(...)(args)
+        if isinstance(stmt, ast.Return):
+            method = _nearest(parents, resolve_call, FUNC_DEFS)
+            if method is not None:
+                for n in ast.walk(tree):
+                    if isinstance(n, ast.Call) and \
+                            isinstance(n.func, ast.Call) and \
+                            isinstance(n.func.func, ast.Attribute) and \
+                            isinstance(n.func.func.value, ast.Name) and \
+                            n.func.func.value.id == "self" and \
+                            n.func.func.attr == method.name:
+                        invocations.append(n)
+
+        for inv in invocations:
+            yield from self._check_invocation(rel, scope, inv, spec)
+
+    def _check_invocation(self, rel, scope, inv, spec):
+        parents = scope.parents
+        fn = _nearest(parents, inv, FUNC_DEFS)
+        stmt = _nearest(parents, inv, ast.stmt)
+        if fn is None or stmt is None:
+            return
+        star = next((i for i, a in enumerate(inv.args)
+                     if isinstance(a, ast.Starred)), None)
+        restored = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                for el in ([t] if not isinstance(t, (ast.Tuple, ast.List))
+                           else t.elts):
+                    b = _binding_of(el)
+                    if b:
+                        restored.add(b)
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        for i in spec:
+            if i >= len(inv.args) or (star is not None and i >= star):
+                continue
+            binding = _binding_of(inv.args[i])
+            if binding is None or binding in restored:
+                continue
+            leak = self._first_read_after(fn, parents, binding, end)
+            if leak is not None:
+                yield Finding(
+                    self.rule, rel, leak,
+                    "`%s` read after being donated (argnum %d) to the "
+                    "step executable at line %d — the buffer may be "
+                    "aliased into the outputs; reread returns garbage or "
+                    "crashes. Re-store the new value first" %
+                    (binding, i, inv.lineno))
+
+    def _first_read_after(self, fn, parents, binding, after_line):
+        """Line of the first Load of ``binding`` after ``after_line`` in
+        ``fn``, unless a Store happens first (None when safe)."""
+        events = []
+        for n in body_walk(fn):
+            if isinstance(n, ast.Name) and n.id == binding:
+                node = n
+            elif isinstance(n, ast.Attribute) and dotted(n) == binding:
+                node = n
+            else:
+                continue
+            if node.lineno <= after_line:
+                continue
+            store = isinstance(node.ctx, (ast.Store, ast.Del))
+            if store and isinstance(parents.get(node), ast.AugAssign):
+                store = False  # x += v reads the donated value
+            events.append((node.lineno, node.col_offset, store))
+        for lineno, _, store in sorted(events):
+            if store:
+                return None
+            return lineno
+        return None

@@ -7,24 +7,11 @@ test happens to compile) or, worse, silently runs on a concrete value at
 trace time and bakes a constant into the executable. This checker finds the
 construct statically, on every path.
 
-What counts as traced (the roots), per file:
-
-  * functions decorated with ``jax.jit`` / ``pjit`` (bare or via
-    ``functools.partial(jax.jit, ...)``) or ``jax.custom_vjp``;
-  * functions passed by name to ``jax.jit`` / ``jax.vjp`` / ``jax.grad`` /
-    ``jax.eval_shape`` / ``pl.pallas_call`` (kernel bodies) or to a
-    ``*.defvjp(fwd, bwd)`` backward-wiring call — this covers the
-    ``ops._jitted`` / ``autograd._bwd_jitted`` cache builders and the
-    Executor's jit closures, whose inner functions are built for tracing;
-  * op functions registered via ``@register(...)`` in ``mxnet_tpu/ops/``
-    (every registered op is eager-jitted and inlined into outer traces)
-    unless registered ``host=True`` (the dgl-style host ops).
-
-Tracedness then propagates to a fixpoint through same-file bare-name calls
-AND same-class ``self.<method>(...)`` calls (a helper called from a traced
-function is traced) — the class propagation covers step-builder methods
-like ``parallel.sharded_trainer``'s, whose jitted inner functions call
-``self._trace_forward`` / ``self._traced_update``.
+What counts as traced is the shared per-file discovery in
+``ci/mxlint/trace_scope.py`` (jit decorators, fns passed by name to
+tracing entry points, registered op functions, same-file and same-class
+call-graph propagation) — one computation shared with the trace-discipline
+suite (tracer-leak / trace-purity / retrace-hazard).
 
 Inside traced functions the checker flags:
 
@@ -48,32 +35,11 @@ from __future__ import annotations
 import ast
 
 from .. import Finding
-from ..astutil import (arrayish_params, body_walk, build_parents,
-                       called_names, dotted, iter_functions, keyword_value,
-                       names_in, self_method_calls)
+from ..astutil import arrayish_params, body_walk, dotted, names_in
+from ..trace_scope import traced_scope
 
-# callables whose first positional argument is traced
-_TRACE_TAKING = {
-    "jax.jit", "jit", "jax.pjit", "pjit", "jax.vjp", "jax.grad",
-    "jax.value_and_grad", "jax.eval_shape", "jax.custom_vjp", "custom_vjp",
-    "pl.pallas_call", "pallas_call", "jax.checkpoint", "jax.remat",
-}
-_JIT_DECOS = {
-    "jax.jit", "jit", "jax.pjit", "pjit", "jax.custom_vjp", "custom_vjp",
-}
-_PARTIALS = {"functools.partial", "partial"}
 _SYNC_CASTS = {"float", "int", "bool"}
 _NP_ROOTS = {"np", "_np", "onp", "numpy"}
-
-
-def _register_deco(deco):
-    """The Call node of an op-registering decorator (@register(...) /
-    @_ops.register(...)), else None."""
-    if isinstance(deco, ast.Call):
-        name = dotted(deco.func)
-        if name == "register" or (name or "").endswith(".register"):
-            return deco
-    return None
 
 
 class HostSyncChecker:
@@ -82,100 +48,14 @@ class HostSyncChecker:
                    "values reachable from jit-traced code")
 
     def run(self, repo):
-        for rel in repo.py_files("mxnet_tpu"):
+        for rel in repo.scoped_files("mxnet_tpu"):
             tree = repo.tree(rel)
             if tree is None:
                 continue
-            yield from self._check_file(rel, tree)
-
-    # -- per file ----------------------------------------------------------
-    def _check_file(self, rel, tree):
-        funcs = list(iter_functions(tree))
-        by_name = {}
-        for fn in funcs:
-            by_name.setdefault(fn.name, []).append(fn)
-
-        traced = {}  # func node -> reason
-        is_ops_file = rel.startswith("mxnet_tpu/ops/")
-
-        for fn in funcs:
-            for deco in fn.decorator_list:
-                name = dotted(deco)
-                if name in _JIT_DECOS:
-                    traced.setdefault(fn, "decorated @%s" % name)
-                elif isinstance(deco, ast.Call):
-                    cname = dotted(deco.func)
-                    if cname in _JIT_DECOS:
-                        traced.setdefault(fn, "decorated @%s(...)" % cname)
-                    elif cname in _PARTIALS and deco.args and \
-                            dotted(deco.args[0]) in _JIT_DECOS:
-                        traced.setdefault(
-                            fn, "decorated @partial(%s, ...)"
-                            % dotted(deco.args[0]))
-                    elif is_ops_file:
-                        reg = _register_deco(deco)
-                        if reg is not None:
-                            host = keyword_value(reg, "host")
-                            if not (isinstance(host, ast.Constant)
-                                    and host.value is True):
-                                traced.setdefault(
-                                    fn, "registered op function")
-
-        # functions passed by name to tracing entry points
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            cname = dotted(node.func)
-            targets = ()
-            if cname in _TRACE_TAKING and node.args:
-                targets = (node.args[0],)
-            elif isinstance(node.func, ast.Attribute) and \
-                    node.func.attr == "defvjp":
-                targets = tuple(node.args)
-            for t in targets:
-                if isinstance(t, ast.Name):
-                    for fn in by_name.get(t.id, ()):
-                        traced.setdefault(
-                            fn, "passed to %s" % (cname or "defvjp"))
-
-        # class scope: enclosing ClassDef per function (nested defs — a
-        # step builder's jitted closure — inherit the builder's class), so
-        # `self.helper(...)` resolves against the right method table
-        parents = build_parents(tree)
-        owner = {}
-        methods = {}  # ClassDef -> name -> [method nodes]
-        for fn in funcs:
-            node = parents.get(fn)
-            while node is not None and not isinstance(node, ast.ClassDef):
-                node = parents.get(node)
-            if node is not None:
-                owner[fn] = node
-                table = methods.setdefault(node, {})
-                table.setdefault(fn.name, []).append(fn)
-
-        # propagate through same-file bare-name calls and same-class
-        # self-method calls to a fixpoint
-        calls = {fn: called_names(fn) for fn in funcs}
-        self_calls = {fn: self_method_calls(fn) for fn in funcs}
-        roots = set(traced)
-        changed = True
-        while changed:
-            changed = False
-            for fn, reason in list(traced.items()):
-                callees = [by_name.get(n, ()) for n in calls[fn]]
-                if fn in owner:
-                    table = methods[owner[fn]]
-                    callees += [table.get(n, ()) for n in self_calls[fn]]
-                for group in callees:
-                    for callee in group:
-                        if callee not in traced:
-                            traced[callee] = "called from traced `%s`" \
-                                % fn.name
-                            changed = True
-
-        for fn, reason in traced.items():
-            yield from self._check_traced_fn(rel, fn, reason,
-                                             is_root=fn in roots)
+            scope = traced_scope(repo, rel, tree)
+            for fn, reason in scope.traced.items():
+                yield from self._check_traced_fn(rel, fn, reason,
+                                                 is_root=scope.is_root(fn))
 
     # -- per traced function ----------------------------------------------
     def _check_traced_fn(self, rel, fn, reason, is_root):

@@ -1,0 +1,304 @@
+"""retrace-hazard: jit call sites that can silently recompile per call.
+
+``jax.jit`` caches by (fn identity, static args, avals). Each of these is
+a way to lose the cache without an error message — you find out from the
+goodput accountant's compile-stall column, long after the fact:
+
+  * an UNROUTED jit — built at call time instead of through the
+    ``mxnet_tpu.compile`` registry — makes a fresh fn identity per call
+    (or per instance, for jits built in ``__init__``): every invocation
+    retraces. Rule R1: every ``jax.jit``/``pjit`` call must be reachable
+    from a registry builder (an argument of ``get_or_build`` /
+    ``_resolve`` / ``_resolve_persistent``, directly or through the
+    builder's call graph), or be a module-level / ``global``-declared
+    singleton (the ``collectives._BARRIER_JIT`` shape), which caches by
+    construction.
+  * non-literal ``static_argnums``/``static_argnames`` (R2) hide which
+    args gate the cache — and a live-object static arg hashes by
+    identity, so every fresh instance recompiles.
+  * a traced function reading ``self.<attr>`` (R3) closes over whatever
+    the attribute holds at trace time: a captured array becomes a baked
+    constant and a new instance silently retraces; mutated state goes
+    stale (this is the read-side twin of tracer-leak's store rule).
+  * Python ``if``/``while`` on a traced argument (R4) either aborts the
+    trace (ConcretizationTypeError) or — under ``static_argnums`` —
+    forks the cache per value.
+
+R3/R4 honor the shared ``# mxlint: trace-pure — <why>`` annotation (a
+deliberate trace-time specialization); R1/R2 sites justify themselves
+with ``# mxlint: disable=retrace-hazard`` plus a comment (a one-shot
+export trace, a fixture). The compile registry itself is exempt — it is
+the thing jits are supposed to route through.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from ..astutil import FUNC_DEFS, body_walk, dotted
+from ..trace_scope import is_trace_pure, traced_scope
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_RESOLVE_TAILS = {"get_or_build", "_resolve", "_resolve_persistent"}
+
+
+def _is_literal(node):
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literal(e) for e in node.elts)
+    return False
+
+
+class RetraceHazardChecker:
+    rule = "retrace-hazard"
+    description = ("jax.jit/pjit sites route through the compile registry "
+                   "or are module-level singletons; literal static args; "
+                   "no self.* reads or Python branches on traced values")
+
+    def run(self, repo):
+        for rel in repo.scoped_files("mxnet_tpu"):
+            if rel.startswith("mxnet_tpu/compile/"):
+                continue
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            scope = traced_scope(repo, rel, tree)
+            lines = repo.lines(rel)
+            yield from self._check_jit_sites(rel, tree, scope)
+            for fn in scope.roots:
+                yield from self._check_root_fn(rel, fn, scope, lines)
+
+    # -- R1/R2: jit call sites ---------------------------------------------
+    def _check_jit_sites(self, rel, tree, scope):
+        routed = _routed_callables(tree, scope)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or \
+                    dotted(node.func) not in _JIT_NAMES:
+                continue
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") and \
+                        not _is_literal(kw.value):
+                    yield Finding(
+                        self.rule, rel, kw.value.lineno,
+                        "non-literal `%s` on `%s(...)` — a live/computed "
+                        "static arg gates the jit cache invisibly (and "
+                        "hashes by identity)" % (kw.arg,
+                                                 dotted(node.func)))
+            if self._site_allowed(node, scope, routed):
+                continue
+            yield Finding(
+                self.rule, rel, node.lineno,
+                "`%s(...)` built outside the mxnet_tpu.compile registry — "
+                "a per-call/per-instance jit retraces silently; route it "
+                "through get_or_build (or make it a module-level "
+                "singleton)" % dotted(node.func))
+
+    def _site_allowed(self, node, scope, routed):
+        """Is this jit call a registry-builder site or a cached
+        singleton?"""
+        globals_here = set()
+        cur = scope.parents.get(node)
+        enclosing_fn = None
+        assign = None
+        while cur is not None:
+            if assign is None and isinstance(cur, (ast.Assign,
+                                                   ast.AnnAssign)):
+                assign = cur
+            if isinstance(cur, (ast.Lambda,) + FUNC_DEFS):
+                if enclosing_fn is None:
+                    enclosing_fn = cur
+                if cur in routed:
+                    return True
+                if isinstance(cur, FUNC_DEFS):
+                    for n in body_walk(cur):
+                        if isinstance(n, (ast.Global, ast.Nonlocal)):
+                            globals_here.update(n.names)
+            cur = scope.parents.get(cur)
+        if enclosing_fn is None:
+            return True  # module-level singleton: traced once per import
+        if assign is not None:
+            targets = assign.targets if isinstance(assign, ast.Assign) \
+                else [assign.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in globals_here:
+                    return True  # the lazy global-singleton shape
+        return False
+
+    # -- R3/R4: trace-time capture in root traced fns ----------------------
+    def _check_root_fn(self, rel, fn, scope, lines):
+        # every Attribute link of a call's func chain is a read-for-
+        # dispatch (`self._symbol._interpret(...)`), not a data capture
+        call_funcs = set()
+        for n in body_walk(fn):
+            if isinstance(n, ast.Call):
+                link = n.func
+                while isinstance(link, ast.Attribute):
+                    call_funcs.add(id(link))
+                    link = link.value
+        # R4 uses a stricter array set than host-sync: only no-default
+        # positionals (a None default marks an OPTIONAL attr — `layout=
+        # None` is a string, and branching on it is static), vararg
+        # excluded (*feeds is a python tuple; branching on its length is
+        # static)
+        arrays = _required_positionals(fn)
+        for node in body_walk(fn):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in ("self", "cls") and \
+                    id(node) not in call_funcs:
+                stmt = scope.parents.get(node)
+                while stmt is not None and not isinstance(stmt, ast.stmt):
+                    stmt = scope.parents.get(stmt)
+                if is_trace_pure(lines, fn, node.lineno,
+                                 stmt.lineno if stmt else None):
+                    continue
+                yield Finding(
+                    self.rule, rel, node.lineno,
+                    "`self.%s` read inside jit-traced `%s` — captured at "
+                    "trace time: an array here is a baked constant (new "
+                    "instance ⇒ silent retrace), mutable state goes "
+                    "stale; pass it as an argument or annotate "
+                    "`# mxlint: trace-pure — <why>`"
+                    % (node.attr, fn.name))
+            elif isinstance(node, (ast.If, ast.While)):
+                hit = _traced_names_in_test(node.test, arrays)
+                if hit and not is_trace_pure(lines, fn, node.lineno):
+                    yield Finding(
+                        self.rule, rel, node.lineno,
+                        "Python `%s` on traced argument%s %s inside "
+                        "jit-traced `%s` — aborts the trace or forks the "
+                        "jit cache per value; use lax.cond/jnp.where"
+                        % ("if" if isinstance(node, ast.If) else "while",
+                           "s" if len(hit) > 1 else "",
+                           ", ".join(sorted(hit)), fn.name))
+
+
+def _required_positionals(fn):
+    """Positional params with NO default (the arrays-first head of an op
+    signature). Stricter than host-sync's arrayish set on purpose: R4
+    flags *branching*, and branching on an optional ``layout=None`` /
+    ``axes=None`` attr is static and idiomatic."""
+    a = fn.args
+    pos = list(a.posonlyargs) + list(a.args)
+    required = pos[:len(pos) - len(a.defaults)]
+    return {p.arg for p in required if p.arg not in ("self", "cls")}
+
+
+# branching on trace-time METADATA is static and fine; these subtrees are
+# pruned before looking for traced names in a test
+_STATIC_ATTRS = {"ndim", "shape", "dtype", "size"}
+_STATIC_CALLS = {"len", "isinstance", "issubdtype", "isdtype", "iinfo",
+                 "finfo", "result_type"}
+
+
+def _traced_names_in_test(test, arrays):
+    """Traced-argument names a branch test actually branches on the VALUE
+    of. Pruned as static: ``x is (not) None`` guards, ``x.ndim``/
+    ``x.shape``/``x.dtype``/``x.size`` metadata, ``len()``/
+    ``isinstance()``/``jnp.issubdtype()``-style introspection, and a bare
+    ``if flag:`` truthiness test (under the arrays-first heuristic a
+    required positional can still be a static bool attr — a genuinely
+    traced truthiness aborts loudly at first compile, so the silent-hazard
+    rule stays out of it)."""
+    if isinstance(test, ast.Name):
+        return set()
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _traced_names_in_test(test.operand, arrays)
+    if isinstance(test, ast.BoolOp):
+        out = set()
+        for v in test.values:
+            out |= _traced_names_in_test(v, arrays)
+        return out
+    if isinstance(test, ast.Compare) and \
+            all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return set()
+    out = set()
+    stack = [test]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(node, ast.Call) and (
+                dotted(node.func) or "").rpartition(".")[2] in \
+                _STATIC_CALLS:
+            continue
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops):
+            continue
+        if isinstance(node, ast.Name) and node.id in arrays:
+            out.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _routed_callables(tree, scope):
+    """Function/lambda nodes reachable from a compile-registry resolve
+    call's builder arguments — the set whose jit calls are 'routed'.
+
+    Seeds: every non-key argument of a ``get_or_build`` / ``_resolve`` /
+    ``_resolve_persistent`` call that is a lambda, a bare name, or a
+    ``self.method(...)``/``name(...)`` builder-factory call. Tracedness
+    then propagates through same-file bare-name calls and same-class
+    self-method calls to a fixpoint, so ``lambda: self._build(n)`` routes
+    ``_build`` and the jit inside it."""
+    routed = set()
+    work = []
+
+    def add_defs(defs):
+        for fd in defs:
+            if fd not in routed:
+                routed.add(fd)
+                work.append(fd)
+
+    def seed(arg, at):
+        if isinstance(arg, ast.Lambda):
+            if arg not in routed:
+                routed.add(arg)
+                work.append(arg)
+        elif isinstance(arg, ast.Name):
+            add_defs(scope.resolve(arg.id, at))
+        elif isinstance(arg, ast.Call):
+            seed(arg.func, at)
+        elif isinstance(arg, ast.Attribute) and \
+                isinstance(arg.value, ast.Name) and \
+                arg.value.id in ("self", "cls"):
+            add_defs(_class_methods(scope, at, arg.attr))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = dotted(node.func) or ""
+        if cname.rpartition(".")[2] not in _RESOLVE_TAILS:
+            continue
+        for arg in node.args[1:]:  # args[0] is the key
+            seed(arg, node)
+        for kw in node.keywords:
+            if kw.arg in ("build", "builder"):
+                seed(kw.value, node)
+
+    while work:
+        cal = work.pop()
+        for n in ast.walk(cal):
+            if not isinstance(n, ast.Call):
+                continue
+            if isinstance(n.func, ast.Name):
+                add_defs(scope.resolve(n.func.id, n))
+            elif isinstance(n.func, ast.Attribute) and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id in ("self", "cls"):
+                add_defs(_class_methods(scope, cal, n.func.attr))
+    return routed
+
+
+def _class_methods(scope, at, name):
+    """Same-class methods named ``name``, for a self-call at/inside node
+    ``at``."""
+    cur = at
+    while cur is not None and not isinstance(cur, ast.ClassDef):
+        cur = scope.parents.get(cur)
+    if cur is None:
+        return ()
+    return scope.methods.get(cur, {}).get(name, ())

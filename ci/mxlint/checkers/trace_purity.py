@@ -1,0 +1,123 @@
+"""trace-purity: no trace-time capture of mutable environment.
+
+host-sync catches host *syncs* (forcing an array to the host); this rule
+catches host *effects*. A traced function's body runs exactly once, at
+trace time — an ``env.get`` / ``os.environ`` read freezes whatever the
+variable held when the executable was built and silently goes stale; a
+``time.*`` read bakes the build-time clock into every step; a telemetry
+counter increments once per compile instead of once per step; a log line
+fires at trace time and then never again (or worse, looks alive because
+retraces keep re-emitting it).
+
+Inside every traced function (shared discovery: ``ci/mxlint/
+trace_scope.py``) the checker flags calls that read or touch mutable
+environment:
+
+  * config reads — ``env.get`` / ``env.raw`` / ``env.is_set`` (the typed
+    ``mxnet_tpu.env`` registry), ``os.getenv``, ``os.environ`` access;
+  * clocks — ``time.time`` / ``monotonic`` / ``perf_counter`` /
+    ``process_time`` (+ ``_ns`` variants), ``time.sleep``,
+    ``datetime.now`` / ``utcnow`` / ``today``;
+  * telemetry — ``*.counter`` / ``gauge`` / ``histogram`` metric calls,
+    ``*.span`` / ``emit_span`` tracing, goodput ``record_event`` /
+    ``observe_step`` / ``record_step``;
+  * logging — anything ``logging.``-rooted, and logger-method calls
+    (``*.info`` / ``warning`` / ``error`` / ...; ``.log`` itself is
+    deliberately excluded so ``jnp.log`` never fires).
+
+A deliberately frozen capture (a trace-time config read that is MEANT to
+specialize the executable) carries ``# mxlint: trace-pure — <why>`` on
+the line (or on the traced fn's ``def`` line); the annotation is shared
+with tracer-leak. ``# mxlint: disable=trace-purity`` also works.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from ..astutil import body_walk, dotted, local_names
+from ..trace_scope import is_trace_pure, traced_scope
+
+_ENV_ROOTS = {"env", "_env"}
+_ENV_ATTRS = {"get", "raw", "is_set"}
+_TIME_ROOTS = {"time", "_time"}
+_TIME_ATTRS = {"time", "monotonic", "perf_counter", "process_time",
+               "time_ns", "monotonic_ns", "perf_counter_ns",
+               "process_time_ns", "sleep"}
+_DATETIME_ATTRS = {"now", "utcnow", "today"}
+_TELEMETRY_ATTRS = {"counter", "gauge", "histogram", "span", "emit_span",
+                    "record_event", "observe_step", "record_step"}
+_LOGGER_ATTRS = {"debug", "info", "warning", "warn", "error", "exception",
+                 "critical"}
+
+
+class TracePurityChecker:
+    rule = "trace-purity"
+    description = ("no trace-time capture of mutable environment inside "
+                   "traced fns: env/os.environ reads, clocks, telemetry, "
+                   "logging")
+
+    def run(self, repo):
+        for rel in repo.scoped_files("mxnet_tpu"):
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            scope = traced_scope(repo, rel, tree)
+            if not scope.traced:
+                continue
+            lines = repo.lines(rel)
+            for fn, reason in scope.traced.items():
+                yield from self._check_fn(rel, fn, reason, lines)
+
+    def _check_fn(self, rel, fn, reason, lines):
+        # a LOCAL name shadowing a module root is not the module: autograd's
+        # scalar_fn builds a plain dict named `env`, and its .get() is not
+        # a config read
+        local = local_names(fn)
+
+        def emit(lineno, what):
+            if is_trace_pure(lines, fn, lineno):
+                return None
+            return Finding(
+                self.rule, rel, lineno,
+                "%s inside jit-traced `%s` (%s) — the value/effect "
+                "freezes at trace time; annotate `# mxlint: trace-pure — "
+                "<why>` if the specialization is deliberate"
+                % (what, fn.name, reason))
+
+        for node in body_walk(fn):
+            f = None
+            if isinstance(node, ast.Call):
+                f = self._check_call(node, local, emit)
+            elif isinstance(node, ast.Subscript) and \
+                    dotted(node.value) == "os.environ":
+                f = emit(node.lineno, "`os.environ[...]` read")
+            if f is not None:
+                yield f
+
+    def _check_call(self, node, local, emit):
+        cname = dotted(node.func)
+        if cname:
+            root, _, attr = cname.rpartition(".")
+            if root in _ENV_ROOTS and attr in _ENV_ATTRS and \
+                    root not in local:
+                return emit(node.lineno, "config read `%s(...)`" % cname)
+            if cname == "os.getenv" or root == "os.environ":
+                return emit(node.lineno, "environment read `%s(...)`"
+                            % cname)
+            if root in _TIME_ROOTS and attr in _TIME_ATTRS and \
+                    root not in local:
+                return emit(node.lineno, "clock read `%s(...)`" % cname)
+            if attr in _DATETIME_ATTRS and \
+                    root.rpartition(".")[2] in ("datetime", "date"):
+                return emit(node.lineno, "clock read `%s(...)`" % cname)
+            if root.split(".", 1)[0] == "logging" or cname == "getLogger":
+                return emit(node.lineno, "logging call `%s(...)`" % cname)
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _TELEMETRY_ATTRS:
+                return emit(node.lineno,
+                            "telemetry call `.%s(...)`" % attr)
+            if attr in _LOGGER_ATTRS:
+                return emit(node.lineno, "logger call `.%s(...)`" % attr)
+        return None

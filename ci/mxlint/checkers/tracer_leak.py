@@ -1,0 +1,176 @@
+"""tracer-leak: jit-traced code must not store trace-time state.
+
+The PR-9 bug class. A traced function runs ONCE, at trace time, on
+abstract tracer values; anything it stores outlives the trace. Storing a
+tracer into ``self``, a module global, or a closed-over container leaks
+it — the next consumer gets ``UnexpectedTracerError`` (or, for non-array
+state, a value silently frozen at trace time). PR 9 shipped exactly this:
+a lazy ``_get()`` inside an AOT trace minted a key and stored it into the
+global threefry RNG chain; the leak was found by hand, two layers away
+from the store. This rule finds the store itself, statically.
+
+Inside every traced function (shared discovery: ``ci/mxlint/
+trace_scope.py``) the checker flags:
+
+  * ``self.X = ...`` / ``cls.X = ...`` (and augmented) — instance/class
+    state written at trace time;
+  * attribute or subscript stores whose base name is closed-over or
+    global (``_state.key = ...``, ``entry.single = n``, ``cache[k] = v``
+    — the registry-fill shape);
+  * assignment to a ``global`` / ``nonlocal``-declared name;
+  * mutator-method calls (``append``/``update``/``clear``/...) on
+    ``self.*`` or on closed-over/global receivers — import aliases and
+    locally-bound names are exempt, so ``jnp.add(x, y)`` and a local
+    ``parts.append(...)`` never fire;
+  * calls into the RNG-chain mutators (``random.seed`` / ``next_key`` /
+    ``get_state`` / ``set_state`` / ``push_trace_key`` /
+    ``pop_trace_key`` / ``_get``) — the stateful singleton PR 9 leaked
+    into. The fix convention stands: mint keys eagerly, BEFORE the fill.
+
+Deliberate trace-time bookkeeping (gluon's cache builder populating its
+cache entry during the trace) carries ``# mxlint: trace-pure — <why>``
+on the flagged line, or on the traced function's ``def`` line to bless
+the whole body. ``# mxlint: disable=tracer-leak`` also works; trace-pure
+is preferred because trace-purity shares it (one annotation, both
+rules).
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from ..astutil import body_walk, dotted, local_names, shared_index
+from ..trace_scope import is_trace_pure, traced_scope
+
+_MUTATORS = {
+    "append", "extend", "insert", "clear", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "add", "appendleft", "popleft",
+    "extendleft", "sort", "reverse",
+}
+_RNG_MUTATORS = {"seed", "next_key", "get_state", "set_state",
+                 "push_trace_key", "pop_trace_key", "_get"}
+_RANDOM_ROOTS = {"random", "_random", "_rng"}
+
+
+def _base_name(node):
+    """The root Name of an Attribute/Subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node if isinstance(node, ast.Name) else None
+
+
+def _store_targets(node):
+    """Flattened store-target expressions of an assignment statement."""
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    else:
+        return
+    while targets:
+        t = targets.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            targets.extend(t.elts)
+        else:
+            yield t
+
+
+class TracerLeakChecker:
+    rule = "tracer-leak"
+    description = ("jit-traced code stores no trace-time state: no "
+                   "self/global/closed-over writes, no RNG-chain mutator "
+                   "calls (the PR-9 leak shape)")
+
+    def run(self, repo):
+        for rel in repo.scoped_files("mxnet_tpu"):
+            tree = repo.tree(rel)
+            if tree is None:
+                continue
+            scope = traced_scope(repo, rel, tree)
+            if not scope.traced:
+                continue
+            idx = shared_index(repo, rel)
+            lines = repo.lines(rel)
+            for fn, reason in scope.traced.items():
+                yield from self._check_fn(rel, fn, reason, idx, lines)
+
+    def _check_fn(self, rel, fn, reason, idx, lines):
+        local = local_names(fn)
+        declared = set()  # global/nonlocal names: stores are leaks
+        for node in body_walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared.update(node.names)
+
+        def emit(lineno, what):
+            if is_trace_pure(lines, fn, lineno):
+                return None
+            return Finding(
+                self.rule, rel, lineno,
+                "%s inside jit-traced `%s` (%s) — traced code runs once, "
+                "at trace time; annotate `# mxlint: trace-pure — <why>` "
+                "if deliberate" % (what, fn.name, reason))
+
+        for node in body_walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for t in _store_targets(node):
+                    f = self._check_store(t, local, declared, emit)
+                    if f is not None:
+                        yield f
+            elif isinstance(node, ast.Call):
+                f = self._check_call(node, local, declared, idx, emit)
+                if f is not None:
+                    yield f
+
+    def _check_store(self, target, local, declared, emit):
+        if isinstance(target, ast.Name):
+            if target.id in declared:
+                return emit(target.lineno,
+                            "assignment to global/nonlocal `%s`" % target.id)
+            return None
+        base = _base_name(target)
+        if base is None:
+            return None
+        kind = "attribute" if isinstance(target, ast.Attribute) \
+            else "subscript"
+        spelled = dotted(target) if isinstance(target, ast.Attribute) \
+            else "%s[...]" % (dotted(target.value) or base.id)
+        if base.id in ("self", "cls"):
+            return emit(target.lineno,
+                        "%s store `%s` onto the instance" % (kind, spelled))
+        if base.id not in local:
+            return emit(target.lineno,
+                        "%s store `%s` on closed-over/global `%s`"
+                        % (kind, spelled, base.id))
+        return None
+
+    def _check_call(self, node, local, declared, idx, emit):
+        cname = dotted(node.func)
+        if cname and "." in cname:
+            root, _, attr = cname.rpartition(".")
+            base = root.split(".", 1)[0]
+            if attr in _RNG_MUTATORS and (
+                    base in _RANDOM_ROOTS or root.endswith("random")):
+                return emit(node.lineno,
+                            "RNG-chain mutator `%s(...)`" % cname)
+        if not isinstance(node.func, ast.Attribute) or \
+                node.func.attr not in _MUTATORS:
+            return None
+        base = _base_name(node.func.value)
+        if base is None:
+            return None
+        recv = dotted(node.func.value) or base.id
+        if base.id in ("self", "cls"):
+            return emit(node.lineno,
+                        "mutator `%s.%s(...)` on instance state"
+                        % (recv, node.func.attr))
+        if base.id in local or base.id in declared:
+            # a local temp is trace-scratch (fine); a global-declared name
+            # already fires on its assignment, and mutating it without
+            # assignment is the closed-over case below
+            if base.id in local:
+                return None
+        if base.id in idx.mod_aliases or base.id in idx.classes:
+            return None  # jnp.add / np.append / classmethod-style calls
+        return emit(node.lineno,
+                    "mutator `%s.%s(...)` on closed-over/global state"
+                    % (recv, node.func.attr))

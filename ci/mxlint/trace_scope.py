@@ -1,0 +1,239 @@
+"""Shared traced-scope discovery for the trace-discipline rules.
+
+"Which functions in this file does jax trace?" was first answered inside
+the host-sync checker; the trace-discipline suite (tracer-leak,
+trace-purity, retrace-hazard) asks the exact same question, so the
+discovery lives here and is computed ONCE per file per run
+(``traced_scope()`` memoizes through ``Repo.memo``) — four rules, one
+walk.
+
+Roots (directly handed to the tracer):
+
+  * functions decorated with ``jax.jit`` / ``pjit`` (bare, called, or via
+    ``functools.partial(jax.jit, ...)``) or ``jax.custom_vjp``;
+  * functions passed by name to ``jax.jit`` / ``jax.vjp`` / ``jax.grad`` /
+    ``jax.value_and_grad`` / ``jax.eval_shape`` / ``pl.pallas_call`` /
+    ``jax.checkpoint`` or to a ``*.defvjp(fwd, bwd)`` backward-wiring
+    call;
+  * op functions registered via ``@register(...)`` in ``mxnet_tpu/ops/``
+    (every registered op is eager-jitted and inlined into outer traces)
+    unless registered ``host=True``.
+
+Passed-by-name targets resolve in the NEAREST enclosing scope of the call
+site first, then module level, then anywhere in the file. This matters:
+``parallel/trainer.py`` has a jitted inner ``step`` built inside
+``_build_step`` AND a public eager ``step`` method on the same class —
+resolving by bare name across the whole file would mark the eager method
+traced and drown the purity rules in false positives on its telemetry
+calls.
+
+Tracedness then propagates to a fixpoint through same-file bare-name
+calls and same-class ``self.<method>(...)`` calls (nested defs inherit
+the enclosing method's class, so a step builder's jitted closure resolves
+``self._traced_update`` against the right method table). ``roots`` is
+kept distinct from the propagated set: signature-convention checks
+(arrayish params) are only sound on roots.
+"""
+from __future__ import annotations
+
+import ast
+
+from .astutil import (FUNC_DEFS, build_parents, called_names, dotted,
+                      iter_functions, keyword_value, self_method_calls)
+
+# callables whose first positional argument is traced
+TRACE_TAKING = {
+    "jax.jit", "jit", "jax.pjit", "pjit", "jax.vjp", "jax.grad",
+    "jax.value_and_grad", "jax.eval_shape", "jax.custom_vjp", "custom_vjp",
+    "pl.pallas_call", "pallas_call", "jax.checkpoint", "jax.remat",
+}
+JIT_DECOS = {
+    "jax.jit", "jit", "jax.pjit", "pjit", "jax.custom_vjp", "custom_vjp",
+}
+_PARTIALS = {"functools.partial", "partial"}
+
+
+def _register_deco(deco):
+    """The Call node of an op-registering decorator (@register(...) /
+    @_ops.register(...)), else None."""
+    if isinstance(deco, ast.Call):
+        name = dotted(deco.func)
+        if name == "register" or (name or "").endswith(".register"):
+            return deco
+    return None
+
+
+class TracedScope:
+    """The traced functions of one file.
+
+    ``traced`` maps function node -> human-readable reason; ``roots`` is
+    the subset handed directly to the tracer (vs reached by call-graph
+    propagation). ``owner`` maps a function to its enclosing ClassDef
+    (transitively — nested defs belong to the method's class).
+    """
+
+    def __init__(self, rel, tree):
+        self.rel = rel
+        self.tree = tree
+        self.funcs = list(iter_functions(tree))
+        self.by_name = {}
+        for fn in self.funcs:
+            self.by_name.setdefault(fn.name, []).append(fn)
+        self.parents = build_parents(tree)
+        self._encl_func = {fn: self._nearest_func(fn) for fn in self.funcs}
+
+        self.traced = {}  # func node -> reason
+        is_ops_file = rel.startswith("mxnet_tpu/ops/")
+
+        for fn in self.funcs:
+            for deco in fn.decorator_list:
+                name = dotted(deco)
+                if name in JIT_DECOS:
+                    self.traced.setdefault(fn, "decorated @%s" % name)
+                elif isinstance(deco, ast.Call):
+                    cname = dotted(deco.func)
+                    if cname in JIT_DECOS:
+                        self.traced.setdefault(
+                            fn, "decorated @%s(...)" % cname)
+                    elif cname in _PARTIALS and deco.args and \
+                            dotted(deco.args[0]) in JIT_DECOS:
+                        self.traced.setdefault(
+                            fn, "decorated @partial(%s, ...)"
+                            % dotted(deco.args[0]))
+                    elif is_ops_file:
+                        reg = _register_deco(deco)
+                        if reg is not None:
+                            host = keyword_value(reg, "host")
+                            if not (isinstance(host, ast.Constant)
+                                    and host.value is True):
+                                self.traced.setdefault(
+                                    fn, "registered op function")
+
+        # functions passed by name to tracing entry points
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = dotted(node.func)
+            targets = ()
+            if cname in TRACE_TAKING and node.args:
+                targets = (node.args[0],)
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "defvjp":
+                targets = tuple(node.args)
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    for fn in self.resolve(t.id, node):
+                        self.traced.setdefault(
+                            fn, "passed to %s" % (cname or "defvjp"))
+
+        self.roots = set(self.traced)
+
+        # class scope: enclosing ClassDef per function, so `self.helper()`
+        # resolves against the right method table
+        self.owner = {}
+        self.methods = {}  # ClassDef -> name -> [method nodes]
+        for fn in self.funcs:
+            node = self.parents.get(fn)
+            while node is not None and not isinstance(node, ast.ClassDef):
+                node = self.parents.get(node)
+            if node is not None:
+                self.owner[fn] = node
+                table = self.methods.setdefault(node, {})
+                table.setdefault(fn.name, []).append(fn)
+
+        # propagate through same-file bare-name calls and same-class
+        # self-method calls to a fixpoint
+        calls = {fn: called_names(fn) for fn in self.funcs}
+        self_calls = {fn: self_method_calls(fn) for fn in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.traced):
+                callees = [self.by_name.get(n, ()) for n in calls[fn]]
+                if fn in self.owner:
+                    table = self.methods[self.owner[fn]]
+                    callees += [table.get(n, ()) for n in self_calls[fn]]
+                for group in callees:
+                    for callee in group:
+                        if callee not in self.traced:
+                            self.traced[callee] = \
+                                "called from traced `%s`" % fn.name
+                            changed = True
+
+    # -- name resolution ---------------------------------------------------
+    def _nearest_func(self, node):
+        """The nearest enclosing function def of ``node`` (None = module
+        scope; ClassDefs are transparent — a method's scope is wherever
+        its class sits)."""
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, FUNC_DEFS):
+            cur = self.parents.get(cur)
+        return cur
+
+    def resolve(self, name, at):
+        """Defs a bare ``name`` referenced at node ``at`` could mean,
+        preferring the nearest enclosing scope: walk outward from ``at``
+        and return the defs living directly in the first scope that has
+        any; fall back to every same-named def (conservative — a name fed
+        to the tracer that we cannot place is still traced)."""
+        candidates = self.by_name.get(name, ())
+        if not candidates:
+            return ()
+        scope = self._nearest_func(at)
+        while True:
+            here = [fn for fn in candidates
+                    if self._encl_func.get(fn) is scope]
+            if here:
+                return here
+            if scope is None:
+                return candidates
+            scope = self._encl_func.get(scope) \
+                if scope in self._encl_func else self._nearest_func(scope)
+
+    def is_root(self, fn):
+        return fn in self.roots
+
+
+TRACE_PURE = "mxlint: trace-pure"
+
+
+def is_trace_pure(lines, fn, lineno, stmt_lineno=None):
+    """Is a trace-time effect at ``lineno`` inside traced fn ``fn``
+    blessed by a ``# mxlint: trace-pure — <why>`` annotation? The marker
+    goes on the flagged line, or blesses the whole body from the traced
+    function's ``def`` line / the comment block directly above it (for
+    builders like gluon's ``traced`` whose trace-time bookkeeping is the
+    design and deserves a multi-line why). ``stmt_lineno`` (optional) is
+    the first line of the enclosing statement, for flagged nodes that sit
+    on a continuation line of a multi-line call."""
+    if not lines:
+        return False
+    if _marked(lines, lineno) or _marked(lines, fn.lineno) or (
+            stmt_lineno is not None and _marked(lines, stmt_lineno)):
+        return True
+    # decorated fns: the justification block naturally sits ABOVE the
+    # decorators, not squeezed between `@jax.jit` and `def`
+    decos = getattr(fn, "decorator_list", None)
+    return bool(decos) and _marked(lines, decos[0].lineno)
+
+
+def _marked(lines, lineno):
+    """Marker on the line itself, or in the contiguous comment block
+    directly above it (where a justification that deserves full sentences
+    goes)."""
+    if 0 < lineno <= len(lines) and TRACE_PURE in lines[lineno - 1]:
+        return True
+    ln = lineno - 1
+    while 0 < ln <= len(lines) and lines[ln - 1].lstrip().startswith("#"):
+        if TRACE_PURE in lines[ln - 1]:
+            return True
+        ln -= 1
+    return False
+
+
+def traced_scope(repo, rel, tree=None):
+    """The (memoized) TracedScope for a file — every trace-discipline
+    checker in a run shares one instance per file."""
+    if tree is None:
+        tree = repo.tree(rel)
+    return repo.memo(("traced-scope", rel), lambda: TracedScope(rel, tree))

@@ -2,17 +2,24 @@
 # Single static-analysis entry point (SURVEY §5.2 — the reference's lint +
 # sanitizer CI layer): mxlint (AST checks: host-sync, signal-safety,
 # env-registry, registry-parity, metric-registry, compile-registry,
-# bare-print, and the concurrency suite: lock-discipline, lock-order,
-# thread-hygiene — docs/static_analysis.md) followed by the
-# native-runtime sanitizers (ASan/UBSan + TSan).
+# bare-print, the concurrency suite: lock-discipline, lock-order,
+# thread-hygiene, and the trace-discipline suite: tracer-leak,
+# trace-purity, retrace-hazard, donation-discipline —
+# docs/static_analysis.md) followed by the native-runtime sanitizers
+# (ASan/UBSan + TSan).
 #
 # Usage: ci/run_checks.sh [--lint-only]
+#   MXLINT_FORMAT=json   emit machine-readable mxlint findings (for CI
+#                        annotation tooling) instead of the text report
+#   MXLINT_ARGS="..."    extra mxlint flags (e.g. --changed-only for a
+#                        fast pre-commit loop)
 # Exit nonzero on the first failing layer.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== mxlint =="
-python -m ci.mxlint
+# shellcheck disable=SC2086
+python -m ci.mxlint --format "${MXLINT_FORMAT:-text}" ${MXLINT_ARGS:-}
 
 if [[ "${1:-}" != "--lint-only" ]]; then
     ./ci/sanitize.sh

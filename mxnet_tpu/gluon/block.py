@@ -431,6 +431,10 @@ class HybridBlock(Block):
         static_args = [_slot if isinstance(a, NDArray) else a for a in args]
         block = self
 
+        # mxlint: trace-pure — the whole body is cache-entry bookkeeping
+        # that MUST run at trace time (entry.single/n_outputs/aux_params
+        # describe the trace; push/pop routes the traced key through the
+        # RNG chain for the trace's duration and restores it in finally)
         def traced(key, arg_arrays, param_arrays):
             prev_key = _random.push_trace_key(key)
             saved = [(p, p._data, p._version) for p in param_nds]

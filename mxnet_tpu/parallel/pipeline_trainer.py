@@ -316,6 +316,8 @@ class PipelineTrainer:
         from ..gluon import block as block_mod
         from ..ndarray import NDArray
 
+        # mxlint: trace-pure — routes the traced step key through the
+        # RNG chain for the trace's duration; restored in finally
         prev_key = _random.push_trace_key(key)
         saved = self._swap_all(outer_full)
         block_mod._TRACING.flag = True
@@ -347,7 +349,7 @@ class PipelineTrainer:
         finally:
             self._restore(saved)
             block_mod._TRACING.flag = False
-            _random.pop_trace_key(prev_key)
+            _random.pop_trace_key(prev_key)  # mxlint: trace-pure — see push
 
     def _build_step(self, batch_shapes):
         import jax
@@ -383,8 +385,10 @@ class PipelineTrainer:
                 from ..ndarray import NDArray
 
                 if loss_blk is not None:
+                    # mxlint: trace-pure — self._ctx is frozen per-trainer
+                    # config; a rebuilt trainer resolves a fresh executable
                     pred_nd = NDArray(pred_arr, ctx=self._ctx)
-                    label_nd = NDArray(batch[-1], ctx=self._ctx)
+                    label_nd = NDArray(batch[-1], ctx=self._ctx)  # mxlint: trace-pure — ditto
                     l = loss_blk(pred_nd, label_nd)
                     lval = jnp.mean(l._data.astype(jnp.float32))
                 else:

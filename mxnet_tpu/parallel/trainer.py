@@ -198,6 +198,8 @@ class DistributedTrainer:
         from ..gluon import block as block_mod
 
         ctx = self._params[0].list_ctx()[0]
+        # mxlint: trace-pure — routes the traced step key through the
+        # RNG chain for the trace's duration; restored in finally
         prev_key = _random.push_trace_key(key)
         saved = [(nd_, nd_._data, nd_._version) for nd_ in self._param_nds]
         block_mod._TRACING.flag = True
@@ -221,7 +223,7 @@ class DistributedTrainer:
                 nd_._data = old
                 nd_._version = ver
             block_mod._TRACING.flag = False
-            _random.pop_trace_key(prev_key)
+            _random.pop_trace_key(prev_key)  # mxlint: trace-pure — see push
 
     def _traced_update(self, weights, grads, states, t, lr):
         return _traced_update(self._optimizer, self._params[0].list_ctx()[0],
@@ -286,9 +288,12 @@ class DistributedTrainer:
                 aux_up = {i: u.astype(arrays[i].dtype)
                           for i, u in aux_up.items()}
                 if loss_blk is not None:
+                    # mxlint: trace-pure — per-trainer statics: the params'
+                    # ctx and the loss-input mode deliberately specialize
+                    # this executable (fixed for the trainer's lifetime)
                     label_nd = pred.__class__(batch[-1],
                                               ctx=self._params[0].list_ctx()[0])
-                    mode = self._loss_inputs
+                    mode = self._loss_inputs  # mxlint: trace-pure — see above
                     if mode is None:
                         # default: gluon loss Blocks keep the (pred, label)
                         # contract; plain callables see the whole output so
@@ -465,8 +470,11 @@ class DistributedTrainer:
                     out, aux_up = self._trace_forward((batch,), arrays, key,
                                                       is_train)
                     pred = out[0] if isinstance(out, (list, tuple)) else out
+                    # mxlint: trace-pure — aux_order is the trace's own
+                    # output-ordering record (see decl above): filled once at
+                    # trace time, read eagerly after resolve, stable after
                     aux_order.clear()
-                    aux_order.extend(sorted(aux_up))
+                    aux_order.extend(sorted(aux_up))  # mxlint: trace-pure
                     return pred._data, [aux_up[i] for i in aux_order]
 
                 from jax.sharding import PartitionSpec

@@ -758,8 +758,12 @@ class TransformerLMEngine:
         # no_persist: plain memory-tier entries (the decode loop's hit
         # path is a dict get; serializing pallas/jnp decode graphs buys
         # little and the artifact trust story nothing)
+        # donation=(1,): every executable minted through this key (prefill
+        # AND per-bucket decode) donates the KV pool at argnum 1, and the
+        # fill-hook donation verifier (telemetry.memory.verify_donation)
+        # only audits keys that declare it
         return _compile.ExecutableKey(
-            kind, self._fingerprint, shapes=shape_sig,
+            kind, self._fingerprint, shapes=shape_sig, donation=(1,),
             static=(("pages", self.num_pages),
                     ("page_size", self.page_size),
                     ("maxp", self.max_pages_per_seq),
@@ -845,15 +849,20 @@ class TransformerLMEngine:
         # kv donated: the per-step update must alias, not copy, the pool
         return lambda: jax.jit(fn, donate_argnums=(1,))
 
-    def _prefill_exe(self, lp):
+    def _prefill_exe(self, lp, example_args=None):
+        # example_args routes a miss through the registry's AOT fill, so
+        # the donation verifier actually audits the declared KV-pool
+        # donation at fill time (misses only; hits never evaluate it)
         return _compile.get_or_build(
             self._key("lm_prefill", ("prompt", lp)),
-            self._build_prefill(lp), label="lm_prefill:l%d" % lp)
+            self._build_prefill(lp), label="lm_prefill:l%d" % lp,
+            example_args=example_args)
 
-    def _decode_exe(self, bucket):
+    def _decode_exe(self, bucket, example_args=None):
         return _compile.get_or_build(
             self._key("lm_decode", ("batch", bucket)),
-            self._build_decode(bucket), label="lm_decode:b%d" % bucket)
+            self._build_decode(bucket), label="lm_decode:b%d" % bucket,
+            example_args=example_args)
 
     # -- driving -----------------------------------------------------------
     def prefill(self, tokens, page_row, sampling, key):
@@ -868,11 +877,11 @@ class TransformerLMEngine:
         padded = _np.zeros(lp, _np.int32)
         padded[:len(tokens)] = tokens
         temp, top_k, top_p = sampling
-        tok, self._kv = self._prefill_exe(lp)(
-            self._params, self._kv, padded,
-            _np.int32(len(tokens)), _np.asarray(page_row, _np.int32),
-            _np.float32([temp]), _np.int32([top_k]), _np.float32([top_p]),
-            key)
+        args = (self._params, self._kv, padded,
+                _np.int32(len(tokens)), _np.asarray(page_row, _np.int32),
+                _np.float32([temp]), _np.int32([top_k]),
+                _np.float32([top_p]), key)
+        tok, self._kv = self._prefill_exe(lp, lambda: args)(*args)
         return int(tok)
 
     def decode_step(self, tokens, positions, dest_pages, dest_slots,
@@ -880,9 +889,9 @@ class TransformerLMEngine:
         """One token for every row (rows with length 0 are inert padding:
         their K/V writes drop and their sampled token is discarded).
         Returns an int32 numpy array of next tokens."""
-        out, self._kv = self._decode_exe(len(tokens))(
-            self._params, self._kv, tokens, positions, dest_pages,
-            dest_slots, tables, lengths, temps, top_ks, top_ps, key)
+        args = (self._params, self._kv, tokens, positions, dest_pages,
+                dest_slots, tables, lengths, temps, top_ks, top_ps, key)
+        out, self._kv = self._decode_exe(len(tokens), lambda: args)(*args)
         return _np.asarray(out)
 
     def warm(self):

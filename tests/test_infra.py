@@ -452,10 +452,11 @@ def test_conftest_leaked_thread_report(tmp_path, monkeypatch):
 
 def test_mxlint_clean():
     """CI static analysis (ci/mxlint, docs/static_analysis.md): the tree has
-    ZERO findings across all ten checkers (host-sync, signal-safety,
+    ZERO findings across all fourteen checkers (host-sync, signal-safety,
     env-registry, registry-parity, metric-registry, compile-registry,
-    bare-print, lock-discipline, lock-order, thread-hygiene) modulo the
-    committed
+    bare-print, the concurrency suite: lock-discipline, lock-order,
+    thread-hygiene, and the trace-discipline suite: tracer-leak,
+    trace-purity, retrace-hazard, donation-discipline) modulo the committed
     baseline — enforced in-suite so a new violation fails tier-1, not just
     a side CI job. Checker efficacy (each rule still catches a planted
     violation) is proven separately in test_mxlint.py's fixture tests."""
@@ -467,4 +468,4 @@ def test_mxlint_clean():
     r = subprocess.run([sys.executable, "-m", "ci.mxlint"], cwd=root,
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "0 finding(s) across 10 rule(s)" in r.stdout, r.stdout
+    assert "0 finding(s) across 14 rule(s)" in r.stdout, r.stdout

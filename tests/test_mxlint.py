@@ -6,6 +6,7 @@ regression that the pre-mxlint ``ci/lint_print.py`` CLI still works
 standalone. The real-tree cleanliness gate lives in
 ``test_infra.py::test_mxlint_clean`` (tier-1).
 """
+import json
 import os
 import subprocess
 import sys
@@ -29,6 +30,11 @@ from ci.mxlint.checkers.registry_parity import RegistryParityChecker  # noqa: E4
 from ci.mxlint.checkers.signal_safety import SignalSafetyChecker  # noqa: E402
 from ci.mxlint.checkers.bare_print import BarePrintChecker  # noqa: E402
 from ci.mxlint.checkers.compile_registry import CompileRegistryChecker  # noqa: E402
+from ci.mxlint.checkers.tracer_leak import TracerLeakChecker  # noqa: E402
+from ci.mxlint.checkers.trace_purity import TracePurityChecker  # noqa: E402
+from ci.mxlint.checkers.retrace_hazard import RetraceHazardChecker  # noqa: E402
+from ci.mxlint.checkers.donation_discipline import (  # noqa: E402
+    DonationDisciplineChecker)
 
 
 def _tree(tmp_path, files):
@@ -1133,7 +1139,9 @@ def test_cli_modes(args, expect_rc):
     if expect_rc == 0:
         for rule in ("host-sync", "signal-safety", "env-registry",
                      "registry-parity", "compile-registry", "bare-print",
-                     "lock-discipline", "lock-order", "thread-hygiene"):
+                     "lock-discipline", "lock-order", "thread-hygiene",
+                     "tracer-leak", "trace-purity", "retrace-hazard",
+                     "donation-discipline"):
             assert rule in r.stdout
 
 
@@ -1190,9 +1198,471 @@ def test_env_module_typed_accessors(monkeypatch):
     assert all("| `MXTPU_" in line for line in table.splitlines()[2:])
 
 
+# ---------------------------------------------------------------------------
+# trace-discipline suite: tracer-leak / trace-purity / retrace-hazard /
+# donation-discipline
+# ---------------------------------------------------------------------------
+
+def test_tracer_leak_pr9_rng_chain_shape(tmp_path):
+    """The PR-9 bug class verbatim: a lazy key mint inside an AOT trace
+    calls into the global threefry chain and stores the resulting tracer
+    into closed-over state — both halves must be flagged."""
+    repo = _tree(tmp_path, {"mxnet_tpu/aot.py": """\
+        import jax
+        from mxnet_tpu import random as _random
+
+        _CHAIN = {}
+
+        @jax.jit
+        def fill(params):
+            key = _random.next_key()     # line 8: RNG-chain mutator
+            _CHAIN["key"] = key          # line 9: closed-over store
+            return params
+        """})
+    got = _lines(_findings(TracerLeakChecker(), repo))
+    assert got == [("mxnet_tpu/aot.py", 8), ("mxnet_tpu/aot.py", 9)]
+
+
+def test_tracer_leak_instance_state_and_propagation(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/cachey.py": """\
+        import jax
+
+        class Builder:
+            @jax.jit
+            def traced(self, x):
+                self._cached = x          # line 6: instance store
+                self._log.append(x)       # line 7: mutator on self
+                return self._store(x)
+
+            def _store(self, x):
+                self._entries[0] = x      # line 11: traced via self-call
+                return x
+
+        @jax.jit
+        def g(x):
+            global _K
+            _K = x                        # line 17: global store
+            return x
+        """})
+    got = _lines(_findings(TracerLeakChecker(), repo))
+    assert got == [("mxnet_tpu/cachey.py", n) for n in (6, 7, 11, 17)]
+
+
+def test_tracer_leak_negative_locals_and_aliases(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/scratch.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fine(x):
+            parts = []
+            parts.append(x)            # local temp: trace scratch
+            acc = {}
+            acc["x"] = x               # local subscript
+            y = jnp.append(x, x)       # module-alias call, not a mutator
+            return y
+
+        def eager(state):
+            state.key = 1              # never traced: no jit reaches it
+        """})
+    assert _findings(TracerLeakChecker(), repo) == []
+
+
+def test_tracer_leak_trace_pure_annotation_placements(tmp_path):
+    """All three blessed placements: on the flagged line, in the comment
+    block above a passed-by-name traced fn's def, and in the block above
+    a decorated fn's decorators. An unannotated store still fires."""
+    repo = _tree(tmp_path, {"mxnet_tpu/bless.py": """\
+        import jax
+
+        _CACHE = {}
+
+        @jax.jit
+        def inline(x):
+            _CACHE["a"] = x  # mxlint: trace-pure — deliberate fill
+            _CACHE["b"] = x              # line 8: NOT blessed
+            return x
+
+        # The builder populates its cache entry during the trace by
+        # design. mxlint: trace-pure — trace-time bookkeeping.
+        def blessed(x):
+            _CACHE["c"] = x
+            return x
+
+        _exe = jax.jit(blessed)
+
+        # mxlint: trace-pure — whole-body bookkeeping, above decorator
+        @jax.jit
+        def blessed_deco(x):
+            _CACHE["d"] = x
+            return x
+        """})
+    got = _lines(_findings(TracerLeakChecker(), repo))
+    assert got == [("mxnet_tpu/bless.py", 8)]
+
+
+def test_tracer_leak_pragma_suppression(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/prag.py": """\
+        import jax
+
+        _S = {}
+
+        @jax.jit
+        def f(x):
+            _S["k"] = x  # mxlint: disable=tracer-leak
+            return x
+        """})
+    kept, by_pragma, _ = run_checkers(repo, [TracerLeakChecker()])
+    assert kept == [] and len(by_pragma) == 1
+
+
+def test_trace_purity_positive(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/pure.py": """\
+        import logging
+        import os
+        import time
+
+        import jax
+
+        from mxnet_tpu import env
+        from mxnet_tpu.telemetry import metrics
+
+        log = logging.getLogger(__name__)
+
+        @jax.jit
+        def step(params):
+            flat = env.get("MXTPU_FLATTEN")        # line 14: config read
+            raw = os.environ["MXTPU_RAW"]          # line 15: environ read
+            t0 = time.monotonic()                  # line 16: clock
+            metrics.counter("steps")               # line 17: telemetry
+            log.info("tracing step")               # line 18: logging
+            return params
+        """})
+    got = _lines(_findings(TracePurityChecker(), repo))
+    assert got == [("mxnet_tpu/pure.py", n) for n in (14, 15, 16, 17, 18)]
+
+
+def test_trace_purity_negative_shadow_and_jnp_log(tmp_path):
+    """A LOCAL `env` dict is not the config registry (autograd's
+    scalar_fn shape), `jnp.log` is not a logger, and untraced code may
+    read whatever it wants."""
+    repo = _tree(tmp_path, {"mxnet_tpu/pureok.py": """\
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        from mxnet_tpu import env
+
+        @jax.jit
+        def scalar_fn(x):
+            env = {"x": x}
+            return env.get("x") + jnp.log(x)
+
+        def eager():
+            return env.get("MXTPU_FLATTEN"), time.time()
+        """})
+    assert _findings(TracePurityChecker(), repo) == []
+
+
+def test_trace_purity_deliberate_specialization_annotated(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/spec.py": """\
+        import jax
+
+        from mxnet_tpu import env
+
+        @jax.jit
+        def step(x):
+            # the mode deliberately specializes the executable; changing
+            # it requires a rebuild. mxlint: trace-pure — deliberate.
+            mode = env.get("MXTPU_FUSION_MODE")
+            return x + 1 if mode else x
+        """})
+    assert _findings(TracePurityChecker(), repo) == []
+
+
+def test_retrace_hazard_unrouted_jit_and_nonliteral_static(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/rh.py": """\
+        import jax
+
+        class Runner:
+            def __init__(self, fwd, axes):
+                self._exe = jax.jit(fwd)                   # line 5: unrouted
+                self._axes = axes
+
+            def call(self, fwd, axes):
+                return jax.jit(fwd, static_argnums=axes)   # line 9: both
+        """})
+    got = _lines(_findings(RetraceHazardChecker(), repo))
+    assert got.count(("mxnet_tpu/rh.py", 5)) == 1
+    assert got.count(("mxnet_tpu/rh.py", 9)) == 2  # unrouted + non-literal
+
+
+def test_retrace_hazard_routed_and_singletons_allowed(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/rhok.py": """\
+        import jax
+
+        def _fwd(x):
+            return x
+
+        _SINGLETON = jax.jit(_fwd)        # module level: traced per import
+
+        _LAZY = None
+
+        def barrier():
+            global _LAZY
+            if _LAZY is None:
+                _LAZY = jax.jit(_fwd)     # global-declared lazy singleton
+            return _LAZY
+
+        class Engine:
+            def _build(self, n):
+                return jax.jit(_fwd, static_argnums=(0,))
+
+            def step(self, registry, key, n):
+                return registry.get_or_build(key, lambda: self._build(n))
+        """})
+    assert _findings(RetraceHazardChecker(), repo) == []
+
+
+def test_retrace_hazard_trace_time_capture_and_branching(tmp_path):
+    """R3/R4 inside a traced root: a value branch and a self.* data read
+    fire; metadata branches (`.ndim`), `is None` guards on optional
+    attrs, and a trace-pure-annotated capture stay quiet."""
+    repo = _tree(tmp_path, {"mxnet_tpu/rh3.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        class Model:
+            @jax.jit
+            def fwd(self, data, layout=None):
+                if data > 0:                      # line 7: value branch
+                    data = data + self._bias      # line 8: self read
+                if data.ndim == 3:                # metadata: static
+                    data = data[0]
+                if layout is None:                # optional attr: static
+                    layout = "NCHW"
+                # the head is a per-instance static by design
+                # mxlint: trace-pure — baked head is deliberate
+                return jnp.dot(data, self._head)
+        """})
+    got = _lines(_findings(RetraceHazardChecker(), repo))
+    assert got == [("mxnet_tpu/rh3.py", 7), ("mxnet_tpu/rh3.py", 8)]
+
+
+def test_donation_literal_and_signature_drift(tmp_path):
+    repo = _tree(tmp_path, {"mxnet_tpu/don.py": """\
+        import jax
+
+        SPEC = (1,)
+
+        def _step(params, state):
+            return params, state
+
+        def _vstep(*bufs):
+            return bufs
+
+        bad_spec = jax.jit(_step, donate_argnums=SPEC)       # line 11: D0
+        bad_pos = jax.jit(_step, donate_argnums=(5,))        # line 12: D1
+        ok = jax.jit(_step, donate_argnums=(1,))
+        ok_vararg = jax.jit(_vstep, donate_argnums=(3,))
+        """})
+    got = _lines(_findings(DonationDisciplineChecker(), repo))
+    assert got == [("mxnet_tpu/don.py", 11), ("mxnet_tpu/don.py", 12)]
+
+
+def test_donation_use_after_donate_fixture(tmp_path):
+    """THE use-after-donate shape: a step executable donating params and
+    optimizer state; the canonical re-store is safe, reading the donated
+    binding afterwards is flagged."""
+    repo = _tree(tmp_path, {"mxnet_tpu/uad.py": """\
+        import jax
+
+        from mxnet_tpu.compile import ExecutableKey
+
+        class Trainer:
+            def _build(self):
+                def step(params, states, batch):
+                    return params, states
+                return jax.jit(step, donate_argnums=(0, 1))
+
+            def train_step(self, batch):
+                fn = self._resolve(
+                    ExecutableKey("step", donation=(0, 1)),
+                    lambda: self._build())
+                self._params, new_states = fn(
+                    self._params, self._states, batch)
+                self._states = new_states
+                return self._states
+
+            def broken_step(self, batch):
+                fn = self._resolve(
+                    ExecutableKey("step2", donation=(0, 1)),
+                    lambda: self._build())
+                out = fn(self._params, self._states, batch)
+                return self._states       # line 25: read-after-donate
+        """})
+    got = _lines(_findings(DonationDisciplineChecker(), repo))
+    assert got == [("mxnet_tpu/uad.py", 25)]
+
+
+def test_donation_key_coverage_and_shape_b_invocation(tmp_path):
+    """D3: a donating builder's ExecutableKey must declare a matching
+    donation= (the fill-hook verifier's coverage contract); D2 shape B:
+    `self._decode_exe(n)(...)` invocations of a method that returns the
+    resolve call."""
+    repo = _tree(tmp_path, {"mxnet_tpu/kv.py": """\
+        import jax
+
+        from mxnet_tpu.compile import ExecutableKey
+
+        class Engine:
+            def _build_decode(self, n):
+                def step(params, pool, tok):
+                    return tok, pool
+                return jax.jit(step, donate_argnums=(1,))
+
+            def _decode_exe(self, n):
+                key = ExecutableKey("decode", bucket=n)     # 12: no donation=
+                return self._resolve(key, lambda: self._build_decode(n))
+
+            def _prefill_exe(self, n):
+                key = ExecutableKey("prefill", bucket=n,
+                                    donation=(2,))          # 17: mismatch
+                return self._resolve(key, lambda: self._build_decode(n))
+
+            def decode(self, tok):
+                new_tok, pool = self._decode_exe(3)(
+                    self._params, self._pool, tok)
+                self._pool = pool               # re-stored first: safe
+                return new_tok
+
+            def peek(self, tok):
+                out = self._decode_exe(3)(self._params, self._pool, tok)
+                return self._pool.mean()        # line 28: read-after-donate
+        """})
+    got = _lines(_findings(DonationDisciplineChecker(), repo))
+    assert got == [("mxnet_tpu/kv.py", n) for n in (12, 17, 28)]
+
+
+def test_trace_discipline_real_tree_clean():
+    """The live tree is clean under all four trace-discipline rules —
+    the triage acceptance criterion: every real finding fixed (the
+    serving KV-pool key now declares donation=), deliberate trace-time
+    effects trace-pure-annotated, the one one-shot export trace
+    pragma'd, nothing baselined."""
+    repo = Repo(ROOT)
+    assert _lines(_findings(TracerLeakChecker(), repo)) == []
+    assert _lines(_findings(TracePurityChecker(), repo)) == []
+    assert _lines(_findings(DonationDisciplineChecker(), repo)) == []
+    kept, by_pragma, _ = run_checkers(repo, [RetraceHazardChecker()])
+    assert _lines(kept) == []
+    assert len(by_pragma) == 1  # predict.py's one-shot export trace
+
+
+def test_trace_pure_real_tree_annotations_load_bearing():
+    """The committed trace-pure annotations are LOAD-BEARING: stripping
+    them from gluon/block.py re-surfaces tracer-leak findings (an
+    annotation on dead code would rot silently)."""
+    import ast as _ast
+    import re
+
+    repo = Repo(ROOT)
+    rel = "mxnet_tpu/gluon/block.py"
+    src = repo.read(rel)
+    assert "mxlint: trace-pure" in src
+    stripped = re.sub(r"mxlint: trace-pure[^\n]*", "", src)
+    repo._cache[rel] = (_ast.parse(stripped, filename=rel),
+                        stripped.splitlines())
+    got = [f for f in TracerLeakChecker().run(repo) if f.path == rel]
+    assert got, "stripping block.py annotations surfaces nothing — the " \
+        "checker no longer sees the cache-entry fills"
+
+
+# ---------------------------------------------------------------------------
+# runner: --format json and --changed-only
+# ---------------------------------------------------------------------------
+
+_LEAKY = """\
+    import jax
+
+    _S = {}
+
+    @jax.jit
+    def f(x):
+        _S["k"] = x
+        return x
+"""
+
+
+def test_cli_json_format(tmp_path):
+    _tree(tmp_path, {"mxnet_tpu/leak.py": _LEAKY})
+    cmd = [sys.executable, "-m", "ci.mxlint", "--root", str(tmp_path),
+           "--rule", "tracer-leak", "--format", "json"]
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=ROOT,
+                       timeout=240)
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["rules"] == 1
+    assert [(f["rule"], f["path"], f["line"]) for f in payload["findings"]] \
+        == [("tracer-leak", "mxnet_tpu/leak.py", 7)]
+    assert payload["pragma_suppressed"] == 0
+    (tmp_path / "mxnet_tpu" / "leak.py").write_text("def f(x):\n"
+                                                    "    return x\n")
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=ROOT,
+                       timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["findings"] == []
+
+
+def test_changed_only_scoping_and_degrade(tmp_path):
+    """Repo.scoped_files honors the changed set for per-file rules while
+    py_files (whole-repo parity rules) still sees everything; outside a
+    git checkout changed_files() degrades to 'no restriction'."""
+    from ci.mxlint import changed_files
+
+    repo = _tree(tmp_path, {"mxnet_tpu/a.py": "A = 1\n",
+                            "mxnet_tpu/b.py": "B = 1\n"})
+    assert repo.scoped_files("mxnet_tpu") == ["mxnet_tpu/a.py",
+                                              "mxnet_tpu/b.py"]
+    scoped = Repo(str(tmp_path), changed=frozenset({"mxnet_tpu/b.py"}))
+    assert scoped.scoped_files("mxnet_tpu") == ["mxnet_tpu/b.py"]
+    assert scoped.py_files("mxnet_tpu") == ["mxnet_tpu/a.py",
+                                            "mxnet_tpu/b.py"]
+    assert changed_files(str(tmp_path)) is None  # not a checkout
+
+
+def test_cli_changed_only_end_to_end(tmp_path):
+    """--changed-only catches a violation introduced in the working tree
+    (here: an untracked file) after a clean pass on the committed seed."""
+    _tree(tmp_path, {"mxnet_tpu/clean.py": "X = 1\n"})
+
+    def git(*a):
+        return subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t"] + list(a),
+            cwd=str(tmp_path), capture_output=True, text=True, timeout=60)
+
+    assert git("init", "-q").returncode == 0
+    git("add", "-A")
+    assert git("commit", "-q", "-m", "seed").returncode == 0
+    cmd = [sys.executable, "-m", "ci.mxlint", "--root", str(tmp_path),
+           "--rule", "tracer-leak", "--changed-only"]
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=ROOT,
+                       timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    (tmp_path / "mxnet_tpu" / "leak.py").write_text(
+        textwrap.dedent(_LEAKY))
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=ROOT,
+                       timeout=240)
+    assert r.returncode == 1 and "leak.py:7" in r.stdout, \
+        r.stdout + r.stderr
+
+
 def test_env_registry_covers_every_checker_rule():
-    """Meta: the shipped checker set is exactly the documented ten."""
+    """Meta: the shipped checker set is exactly the documented
+    fourteen."""
     assert sorted(c.rule for c in CHECKERS) == [
-        "bare-print", "compile-registry", "env-registry", "host-sync",
-        "lock-discipline", "lock-order", "metric-registry",
-        "registry-parity", "signal-safety", "thread-hygiene"]
+        "bare-print", "compile-registry", "donation-discipline",
+        "env-registry", "host-sync", "lock-discipline", "lock-order",
+        "metric-registry", "registry-parity", "retrace-hazard",
+        "signal-safety", "thread-hygiene", "trace-purity", "tracer-leak"]
