@@ -45,6 +45,15 @@ ShardedTrainer whole-step executable on a dispatch-bound MLP, reporting
 the speedup, per-step dispatch-count delta, donation aliased_fraction
 and the data-wait/compute split (MXTPU_BENCH_SHARDED_IMPL selects the
 headline implementation).
+
+MXTPU_BENCH_MODE=train_input runs the input-pipeline A/B
+(docs/data_pipeline.md): the same fused step_batch loop fed by the same
+deliberately stalled iterator (MXTPU_BENCH_INPUT_STALL_MS per batch),
+synchronously vs wrapped in trainer.prefetch(...) — the
+data.DevicePrefetcher double buffer. Reports the data_wait_fraction of
+both arms, the imgs/sec speedup, whether the two loss trajectories
+match bit-for-bit, post-warm jit_compile counts, and the goodput
+attributor's coverage of the prefetched run — the `train_input` row.
 """
 from __future__ import annotations
 
@@ -512,6 +521,172 @@ def bench_train_goodput():
         else None,
         "ab_agree_within_10pct": bool(ratio is not None
                                       and 0.9 <= ratio <= 1.1),
+    }
+    print(json.dumps(out))
+
+
+def bench_train_input():
+    """Input-pipeline A/B (MXTPU_BENCH_MODE=train_input): one fused
+    step_batch loop, one deliberately stalled source iterator
+    (MXTPU_BENCH_INPUT_STALL_MS of producer work per batch, modeling
+    decode/augment/IO), two feeding disciplines:
+
+      sync       — the loop blocks on every next(): the stall lands in
+                   the step gap and shows up as data_wait.
+      prefetched — the same iterator wrapped in trainer.prefetch(...)
+                   (data.DevicePrefetcher): a producer thread absorbs
+                   the stall and lands batches on device, already laid
+                   out to the step's batch_spec sharding, while the
+                   previous step computes.
+
+    Both arms run the identical weight init and batch sequence, so the
+    loss trajectories must match — `loss_trajectory_match` is the row's
+    self-check, alongside zero post-warm jit_compile events per arm and
+    the goodput attributor covering >=0.9 of the prefetched arm's step
+    wall. The headline value is the prefetched imgs/sec; the acceptance
+    figure is `data_wait_reduction` (sync / prefetched fraction). The
+    stall only hides behind compute, so the MLP is sized compute-heavy;
+    meaningful on CPU and labeled with whatever platform ran it."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, random as _mxrandom
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.telemetry import recorder as _rec
+
+    ctx = mx.tpu()
+    dev = jax.devices()[0]
+    stall_ms = int(os.environ.get("MXTPU_BENCH_INPUT_STALL_MS", 20))
+    # compute-heavy on purpose: prefetch can only hide a stall behind
+    # compute, so the step must cost more than the stall it absorbs
+    in_dim, hidden, classes = 1024, 2048, 10
+    fwd_flops = 2 * (in_dim * hidden + hidden * hidden + hidden * classes)
+    flops_per_img = 3 * fwd_flops
+
+    rng = np.random.RandomState(0)
+    nsteps = WARMUP + ITERS
+    X = rng.uniform(-1, 1, (nsteps * BATCH, in_dim)).astype(np.float32)
+    Y = rng.randint(0, classes, (nsteps * BATCH,)).astype(np.float32)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    class _StalledIter:
+        """NDArrayIter plus a fixed per-batch producer stall — the
+        synthetic stand-in for decode/augment/IO cost."""
+
+        def __init__(self):
+            self._it = mx.io.NDArrayIter(X, Y, batch_size=BATCH,
+                                         shuffle=False,
+                                         label_name="softmax_label")
+            self.batch_size = BATCH
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            batch = self._it.next()  # raises StopIteration at the end
+            time.sleep(stall_ms / 1e3)
+            return batch
+
+        next = __next__
+
+        def reset(self):
+            self._it.reset()
+
+    def build_trainer():
+        # both seeds: initializers draw from NumPy's global RNG, the
+        # per-step keys from the mx chain — identical weights and
+        # identical step RNG are what make the A/B trajectories equal
+        np.random.seed(1234)
+        _mxrandom.seed(1234)
+        with ctx:
+            net = nn.HybridSequential(prefix="inp_")
+            with net.name_scope():
+                net.add(nn.Dense(hidden, activation="relu", prefix="fc1_"))
+                net.add(nn.Dense(hidden, activation="relu", prefix="fc2_"))
+                net.add(nn.Dense(classes, prefix="fc3_"))
+            net.initialize(ctx=ctx)
+        net(mx.nd.zeros((BATCH, in_dim), ctx=ctx))
+        return gluon.Trainer(net.collect_params(), "sgd",
+                             {"learning_rate": 0.05, "momentum": 0.9},
+                             sharded=True, block=net, loss=loss_fn)
+
+    def jit_compiles():
+        return sum(1 for e in _rec.events() if e["event"] == "jit_compile")
+
+    def run_arm(prefetched):
+        tr = build_trainer()
+        src = _StalledIter()
+        it = tr.prefetch(src) if prefetched else src
+        losses = []
+        # warm: first batches compile the fused step; the timed region
+        # below must then run compile-free (jit_compiles_after_warm)
+        for _ in range(WARMUP):
+            b = next(it)
+            losses.append(tr.step_batch(b.data[0], b.label[0]))
+        losses[-1].asnumpy()  # drain before opening the timed region
+        j0 = jit_compiles()
+        gp_mark = _goodput_mark()
+        wait = 0.0
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            tw = time.perf_counter()
+            b = next(it)
+            wait += time.perf_counter() - tw
+            losses.append(tr.step_batch(b.data[0], b.label[0]))
+        losses[-1].asnumpy()
+        total = time.perf_counter() - t0
+        jits = jit_compiles() - j0
+        if prefetched:
+            it.close()
+        res = {"imgs_per_sec": round(BATCH * ITERS / total, 2),
+               "data_wait_s": round(wait, 4),
+               "compute_s": round(total - wait, 4),
+               "data_wait_fraction": round(wait / total, 4),
+               "jit_compiles_after_warm": jits}
+        gp = _goodput_breakdown(gp_mark)
+        if gp is not None:
+            res["goodput"] = gp
+            # attributor coverage: share of the step wall landing in a
+            # NAMED phase (everything step_end couldn't attribute is
+            # "other" — telemetry/goodput.py)
+            res["goodput_coverage"] = round(
+                1.0 - gp["phase_fractions"].get("other", 0.0), 4)
+        return res, np.array([float(v.asnumpy()) for v in losses])
+
+    sync, loss_sync = run_arm(prefetched=False)
+    pre, loss_pre = run_arm(prefetched=True)
+    reduction = (sync["data_wait_fraction"] / pre["data_wait_fraction"]
+                 if pre["data_wait_fraction"] > 0 else None)
+    traj_delta = float(np.max(np.abs(loss_sync - loss_pre)))
+    speedup = (pre["imgs_per_sec"] / sync["imgs_per_sec"]
+               if sync["imgs_per_sec"] else None)
+    out = {
+        "metric": "mlp_train_input_prefetch_bs%d_imgs_per_sec" % BATCH,
+        "value": pre["imgs_per_sec"],
+        "unit": "imgs/sec",
+        # in-row baseline: the sync loop under identical init and data
+        "vs_baseline": round(speedup, 3) if speedup else None,
+        "baseline": {"value": sync["imgs_per_sec"], "hw": "sync next()",
+                     "batch": BATCH},
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+        "batch": BATCH,
+        "steps": ITERS,
+        "stall_ms": stall_ms,
+        "flops_per_img": flops_per_img,
+        "sync": sync,
+        "prefetched": pre,
+        "speedup_prefetched_vs_sync": round(speedup, 3) if speedup
+        else None,
+        "data_wait_fraction_sync": sync["data_wait_fraction"],
+        "data_wait_fraction_prefetched": pre["data_wait_fraction"],
+        "data_wait_reduction": round(reduction, 2) if reduction is not None
+        else None,
+        "loss_trajectory_max_delta": traj_delta,
+        "loss_trajectory_match": bool(traj_delta == 0.0),
+        "jit_compiles_after_warm": (sync["jit_compiles_after_warm"]
+                                    + pre["jit_compiles_after_warm"]),
+        "goodput_coverage_prefetched": pre.get("goodput_coverage"),
     }
     print(json.dumps(out))
 
@@ -1224,6 +1399,8 @@ def main():
         bench_train_sharded()
     elif MODE == "goodput":
         bench_train_goodput()
+    elif MODE == "train_input":
+        bench_train_input()
     else:
         bench_train()
 

@@ -37,7 +37,7 @@ from . import autograd
 _SUBMODULES = [
     "telemetry",
     "optimizer", "initializer", "lr_scheduler", "metric", "symbol", "executor",
-    "module", "io", "recordio", "image", "kvstore", "gluon", "callback",
+    "module", "io", "data", "recordio", "image", "kvstore", "gluon", "callback",
     "model", "profiler", "runtime", "test_utils", "visualization", "monitor",
     "parallel", "attribute", "name", "operator", "contrib", "rtc",
     "torch_bridge", "registry", "log", "libinfo", "util",

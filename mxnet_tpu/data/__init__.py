@@ -1,0 +1,12 @@
+"""mxnet_tpu.data — the asynchronous input pipeline.
+
+The shared core (``PrefetchBuffer``/``DecodePool``) behind every
+prefetching surface in the library, the NamedSharding-aware device
+prefetcher, and sharded RecordIO streaming with a checkpointable cursor.
+Architecture and sizing math: docs/data_pipeline.md."""
+from .core import DecodePool, PrefetchBuffer
+from .device_prefetch import DevicePrefetcher, place_batch
+from .sharded_stream import ShardedRecordStream, StreamDataIter
+
+__all__ = ["PrefetchBuffer", "DecodePool", "DevicePrefetcher",
+           "place_batch", "ShardedRecordStream", "StreamDataIter"]

@@ -1,0 +1,163 @@
+"""Device prefetcher: double-buffered async host->device staging.
+
+The missing half of the prefetch story: ``PrefetchBuffer`` overlaps host
+decode with compute, but the step still paid the host->device copy
+synchronously. ``DevicePrefetcher`` moves that copy onto the producer
+thread as an *async* ``jax.device_put`` — PJRT starts the transfer and
+returns immediately, so batch N+1's copy (and the decode behind it)
+overlaps batch N's compute, and the consumer receives device arrays that
+are already (or nearly) resident when the step launches.
+
+With a ``mesh``, placement is ``NamedSharding``-aware: every batch leaf
+is put with ``batch_spec(mesh, ndim)`` — the exact in_sharding the
+ShardedTrainer fused step compiles against — so ``step_batch`` consumes
+already-sharded arrays and ``executor._place_inputs`` is a no-op (no
+second copy, no resharding at dispatch).
+
+Cursor semantics: each staged batch carries the inner iterator's
+``state()`` snapshot taken right after it was produced; ``state()`` here
+returns the snapshot of the last batch the CONSUMER received, so a
+checkpoint taken between steps describes exactly the batches the model
+has seen — not the batches the pipeline read ahead.
+"""
+from __future__ import annotations
+
+from .. import env as _env
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .core import PrefetchBuffer
+
+__all__ = ["DevicePrefetcher", "place_batch"]
+
+
+def _batch_sharding(mesh, ndim):
+    import jax.sharding as jsh
+
+    from ..parallel.sharding import batch_spec, named_sharding
+
+    if ndim == 0:
+        return named_sharding(mesh, jsh.PartitionSpec())  # replicate scalars
+    return named_sharding(mesh, batch_spec(mesh, ndim))
+
+
+def _place_leaf(x, mesh):
+    import jax
+
+    if isinstance(x, NDArray):
+        return NDArray(_place_leaf(x._data, mesh))
+    arr = x
+    if mesh is None:
+        return jax.device_put(arr)
+    ndim = getattr(arr, "ndim", None)
+    if ndim is None:
+        return jax.device_put(arr)
+    return jax.device_put(arr, _batch_sharding(mesh, ndim))
+
+
+def place_batch(batch, mesh=None):
+    """Start async device transfers for every array leaf of a batch.
+
+    Handles ``DataBatch`` (data/label lists), NDArray, numpy/jax arrays,
+    and (possibly nested) lists/tuples/dicts of those; anything else
+    passes through untouched. Returns the same structure with every array
+    leaf replaced by its device-resident (sharded, when ``mesh`` is
+    given) counterpart."""
+    from ..io import DataBatch
+
+    if isinstance(batch, DataBatch):
+        return DataBatch(
+            data=place_batch(batch.data, mesh),
+            label=place_batch(batch.label, mesh),
+            pad=batch.pad, index=batch.index, bucket_key=batch.bucket_key,
+            provide_data=batch.provide_data,
+            provide_label=batch.provide_label)
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(place_batch(b, mesh) for b in batch)
+    if isinstance(batch, dict):
+        return {k: place_batch(v, mesh) for k, v in batch.items()}
+    if isinstance(batch, NDArray) or hasattr(batch, "ndim"):
+        return _place_leaf(batch, mesh)
+    return batch
+
+
+class DevicePrefetcher:
+    """Bounded double-buffered queue of async device transfers over any
+    iterator/DataIter of batches.
+
+    depth (default ``MXTPU_DATA_PREFETCH_DEPTH``) batches are staged
+    ahead; the producer thread pulls the inner iterator, starts the
+    device_put, and queues the placed batch. Iterator protocol plus the
+    DataIter surface the training loops use (``next``/``reset``/
+    ``provide_data``/``provide_label``), plus the checkpointable cursor
+    passthrough (``state``/``set_state``) when the inner iterator has
+    one."""
+
+    def __init__(self, it, depth=None, mesh=None, src="fit"):
+        if depth is None:
+            depth = _env.get("MXTPU_DATA_PREFETCH_DEPTH")
+        self._it = it
+        self._depth = max(1, int(depth))
+        self._mesh = mesh
+        self._src = src
+        self.batch_size = getattr(it, "batch_size", 0)
+        self._buf = None
+        self._last_state = None
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+    def _produce(self):
+        batch = next(self._it)
+        placed = place_batch(batch, self._mesh)
+        st = self._it.state() if hasattr(self._it, "state") else None
+        return (st, placed)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self._buf is None:
+            self._buf = PrefetchBuffer(
+                self._produce, depth=self._depth,
+                name="mxtpu-data-device-prefetch",
+                owner="DevicePrefetcher", src=self._src)
+        st, batch = self._buf.get()
+        if st is not None:
+            # the cursor the checkpoint should record: batches DELIVERED,
+            # not batches the pipeline read ahead
+            self._last_state = st
+        return batch
+
+    def reset(self):
+        self.close()
+        self._it.reset()
+
+    def close(self):
+        """Stop + join the producer (clean shutdown / preemption path)."""
+        if self._buf is not None:
+            self._buf.close()
+            self._buf = None
+
+    def state(self):
+        if self._last_state is not None:
+            return self._last_state
+        if hasattr(self._it, "state"):
+            return self._it.state()
+        raise MXNetError("DevicePrefetcher: inner iterator %r has no "
+                         "state()" % (type(self._it).__name__,))
+
+    def set_state(self, st):
+        if not hasattr(self._it, "set_state"):
+            raise MXNetError("DevicePrefetcher: inner iterator %r has no "
+                             "set_state()" % (type(self._it).__name__,))
+        self.close()
+        self._it.set_state(st)
+        self._last_state = None

@@ -238,7 +238,9 @@ _var("MXTPU_BENCH_MODE", "str", "train",
      "analogue), `score_int8` (quantize_model int8 deployment path), "
      "`bert` (BERT-base tokens/sec + MFU), `lstm` (word-LM), "
      "`train_sharded` (ShardedTrainer fused-step vs op-by-op A/B, "
-     "docs/sharded_training.md).")
+     "docs/sharded_training.md), `goodput` (attribution self-check A/B), "
+     "`train_input` (sync vs prefetched input-pipeline A/B, "
+     "docs/data_pipeline.md).")
 _var("MXTPU_BENCH_SHARDED_IMPL", "str", "fused",
      "train_sharded mode implementation under test: `fused` times BOTH "
      "the op-by-op baseline and the promoted fused step (the A/B row); "
@@ -278,6 +280,9 @@ _var("MXTPU_BENCH_PROFILE", "bool", False,
 _var("MXTPU_BENCH_PROFILE_DIR", "str", None,
      "Output directory for the `MXTPU_BENCH_PROFILE` trace (default "
      "`bench_trace_<mode>`).")
+_var("MXTPU_BENCH_INPUT_STALL_MS", "int", 20,
+     "train_input mode: per-batch producer stall (ms) of the deliberately "
+     "input-bound workload the sync-vs-prefetched A/B runs against.")
 
 # -- data loading -----------------------------------------------------------
 _var("MXTPU_DATALOADER_CTX", "str", "fork",
@@ -291,6 +296,18 @@ _var("MXTPU_DATALOADER_PROBE_TIMEOUT", "float", 20.0,
      "tripped through a real worker process) may take before the loader "
      "falls back to in-process loading; the legit probe path touches no "
      "jax and returns in well under a second.")
+_var("MXTPU_DATA_PREFETCH", "bool", False,
+     "`1` wraps the `module.fit` batch iterator in the mxnet_tpu.data "
+     "DevicePrefetcher: batch N+1's host decode + async host->device copy "
+     "overlap batch N's compute (docs/data_pipeline.md).")
+_var("MXTPU_DATA_PREFETCH_DEPTH", "int", 2,
+     "batches the DevicePrefetcher stages ahead (double-buffering). Depth "
+     "d absorbs producer jitter up to d x step-time; sizing math in "
+     "docs/data_pipeline.md.")
+_var("MXTPU_DATA_JOIN_TIMEOUT_S", "float", 30.0,
+     "seconds the data pipeline's close()/reset() wait for producer and "
+     "decode-worker threads to stop before raising (rewinding reader "
+     "state under a live reader would corrupt the next epoch).")
 
 # -- test suite -------------------------------------------------------------
 _var("MXTPU_TEST_TPU", "bool", False,

@@ -27,8 +27,6 @@ from __future__ import annotations
 
 import os
 import pickle
-import queue
-import threading
 
 import numpy as _np
 
@@ -428,27 +426,27 @@ class DataLoader:
                                     self._prefetch)
 
     def _iter_threaded(self):
-        # threaded prefetch pipeline (round-1 behavior, thread_pool=True)
-        q = queue.Queue(maxsize=self._prefetch or 2)
-        sentinel = object()
+        # threaded prefetch pipeline on the shared mxnet_tpu.data core
+        # (thread_pool=True, and the fallback when worker processes are
+        # unviable); bounded put + capture-as-local generation semantics
+        # live in data/core.PrefetchBuffer
+        from ...data.core import PrefetchBuffer
 
-        def producer():
-            try:
-                for batch in self._batch_sampler:
-                    q.put(self._load(batch))
-            except Exception as e:  # propagate worker errors
-                q.put(e)
-            finally:
-                q.put(sentinel)
+        batches = iter(self._batch_sampler)
 
-        t = threading.Thread(target=producer, daemon=True,
-                             name="mxtpu-dataloader-prefetch")
-        t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            if isinstance(item, Exception):
-                raise item
-            yield item
-        t.join()
+        def produce():
+            return self._load(next(batches))
+
+        buf = PrefetchBuffer(produce, depth=self._prefetch or 2,
+                             name="mxtpu-dataloader-prefetch",
+                             owner="DataLoader", src="dataloader")
+        try:
+            while True:
+                try:
+                    yield buf.get()
+                except StopIteration:
+                    return
+        finally:
+            # abandoned iterator (break mid-epoch) or natural end: stop +
+            # join the producer either way
+            buf.close()

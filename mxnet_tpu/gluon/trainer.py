@@ -173,6 +173,15 @@ class Trainer:
                 "sharded=True, block= and loss= (docs/sharded_training.md)")
         return self._sharded.step(data, label)
 
+    def prefetch(self, it, depth=None):
+        """Wrap `it` in a mesh-aware `data.DevicePrefetcher` so step_batch
+        consumes already-sharded device batches (promoted path only)."""
+        if self._sharded is None:
+            raise MXNetError(
+                "prefetch() needs a promoted trainer: construct with "
+                "sharded=True, block= and loss= (docs/sharded_training.md)")
+        return self._sharded.prefetch(it, depth=depth)
+
     def sync_params(self):
         """Copy mesh-trained values back into the block's Parameters (the
         promoted path keeps ONE sharded copy per param; call this before

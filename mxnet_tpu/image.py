@@ -9,8 +9,6 @@ mean/std normalize."""
 from __future__ import annotations
 
 import io as _io
-import queue
-import threading
 
 import numpy as _np
 
@@ -527,65 +525,101 @@ class ImageRecordIterPy(ImageIter):
                          aug_list=aug_list)
         self._threads = max(1, preprocess_threads)
         self._buffer = max(1, prefetch_buffer)
-        self._queue = None
-        self._worker = None
+        self._pool = None
+        self._buf = None
+
+    def _next_raw(self):
+        """Sequential source stage (record readers are not thread-safe):
+        one raw payload per sample, decode deferred to the pool."""
+        if self.seq is None:  # sequential (un-indexed) record stream
+            s = self.record.read()
+            if s is None:
+                raise StopIteration
+            return ("rec", s)
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.record is not None:
+            return ("rec", self.record.read_idx(idx))
+        return ("img", self.imglist[idx])
+
+    def _decode_raw(self, raw):
+        """Parallel decode/augment stage — runs on the mxtpu-data-worker
+        pool, preprocess_threads wide."""
+        kind, payload = raw
+        if kind == "rec":
+            from . import recordio
+
+            header, buf = recordio.unpack(payload)
+            label, img = header.label, imdecode(buf)
+        else:
+            import os
+
+            fname, label = payload
+            label = _np.asarray(label)
+            img = imread(os.path.join(self.path_root, fname))
+        for aug in self.auglist:
+            img = aug(img)
+        arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
+        data = _np.transpose(arr.astype(_np.float32), (2, 0, 1))
+        lab = _np.asarray(label, dtype=_np.float32).reshape(-1)
+        return data, (lab[0] if self.label_width == 1 else
+                      lab[: self.label_width])
+
+    def _assemble(self):
+        """Batch-assembly stage (PrefetchBuffer producer): collects
+        pool-decoded samples — source order — into one DataBatch,
+        pad-cycling the tail exactly like ImageIter.next."""
+        batch_data = []
+        batch_label = []
+        pad = 0
+        for _ in range(self.batch_size):
+            try:
+                data, lab = self._pool.get()
+            except StopIteration:
+                if not batch_data:
+                    raise
+                pad = self.batch_size - len(batch_data)
+                k = 0
+                while len(batch_data) < self.batch_size:
+                    batch_data.append(batch_data[k])
+                    batch_label.append(batch_label[k])
+                    k += 1
+                break
+            batch_data.append(data)
+            batch_label.append(lab)
+        return DataBatch(data=[nd.array(_np.stack(batch_data))],
+                         label=[nd.array(_np.stack(batch_label))], pad=pad)
 
     def _start(self):
-        self._queue = queue.Queue(maxsize=self._buffer)
-        self._stop = threading.Event()
-        stop, q = self._stop, self._queue
+        from .data.core import DecodePool, PrefetchBuffer
 
-        def run():
-            try:
-                while not stop.is_set():
-                    item = ImageIter.next(self)
-                    while not stop.is_set():
-                        try:  # bounded put that honors the stop signal
-                            q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-            except StopIteration:
-                q.put(None)
-            except Exception as e:  # surfaced to the consumer in next()
-                q.put(e)
-
-        self._worker = threading.Thread(target=run, daemon=True,
-                                        name="mxtpu-image-prefetch")
-        self._worker.start()
+        self._pool = DecodePool(self._next_raw, self._decode_raw,
+                                workers=self._threads,
+                                depth=max(2, 2 * self._threads),
+                                owner="ImageRecordIter.reset")
+        self._buf = PrefetchBuffer(self._assemble, depth=self._buffer,
+                                   name="mxtpu-image-prefetch",
+                                   owner="ImageRecordIter.reset",
+                                   src="image")
 
     def reset(self):
-        if self._worker is not None:
-            # stop + join the producer BEFORE touching reader state: a live
-            # worker races super().reset()'s stream rewind (sequential mode
-            # closes/reopens the file) and would feed stale samples into
-            # the next epoch
-            self._stop.set()
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
-            self._worker.join(timeout=30)
-            if self._worker.is_alive():
-                # proceeding would rewind the stream under a live reader
-                # (sequential mode closes/reopens the file) — fail loudly
-                raise MXNetError(
-                    "ImageRecordIter.reset: prefetch worker did not stop "
-                    "within 30s (stalled read?); cannot safely rewind")
+        if self._buf is not None:
+            # stop + join the whole pipeline BEFORE touching reader state:
+            # a live worker races super().reset()'s stream rewind
+            # (sequential mode closes/reopens the file) and would feed
+            # stale samples into the next epoch
+            self._buf.close()
+            self._pool.close()
+            self._buf = None
+            self._pool = None
         super().reset()
-        self._worker = None
 
     def next(self):
-        if self._worker is None:
+        if self._buf is None:
             self._start()
-        item = self._queue.get()
-        if item is None:
-            self._worker = None
-            raise StopIteration
-        if isinstance(item, Exception):
-            raise item
-        return item
+        return self._buf.get()
 
 
 # --------------------------------------------------------------------------

@@ -11,8 +11,6 @@ iter_prefetcher.h chain."""
 from __future__ import annotations
 
 import collections
-import queue
-import threading
 
 import numpy as _np
 
@@ -232,8 +230,7 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = getattr(iters[0], "batch_size", 0)
-        self._queue = queue.Queue(maxsize=2)
-        self._start()   # sets self._stop + self._thread for THIS worker
+        self._start()   # arms THIS generation's PrefetchBuffer
 
     @property
     def provide_data(self):
@@ -257,62 +254,33 @@ class PrefetchingIter(DataIter):
             out.extend(descs)
         return out
 
+    def _produce(self):
+        # runs on the PrefetchBuffer producer thread (which captures its
+        # queue/stop as locals — the stale-worker epoch-bleed fix lives in
+        # data/core, shared by every prefetching surface)
+        batches = [it.next() for it in self.iters]
+        data = sum([b.data for b in batches], [])
+        label = sum([(b.label or []) for b in batches], [])
+        return DataBatch(data=data, label=label, pad=batches[0].pad,
+                         index=batches[0].index)
+
     def _start(self):
-        # the worker must capture THIS generation's queue + stop event as
-        # locals: `self._queue`/`self._stop` read live from the loop would
-        # let a worker that outlived a timed-out reset feed stale batches
-        # into the NEXT epoch's queue (and a cleared live Event would
-        # resurrect its loop) — the lock-discipline checker flags the
-        # reassign-under-use shape this guards against
-        self._stop = stop = threading.Event()
-        q = self._queue
+        from .data.core import PrefetchBuffer
 
-        def run():
-            while not stop.is_set():
-                try:
-                    batches = [it.next() for it in self.iters]
-                except StopIteration:
-                    q.put(None)
-                    return
-                except Exception as e:
-                    q.put(e)
-                    return
-                data = sum([b.data for b in batches], [])
-                label = sum([(b.label or []) for b in batches], [])
-                q.put(DataBatch(data=data, label=label,
-                                pad=batches[0].pad,
-                                index=batches[0].index))
-
-        self._thread = threading.Thread(target=run, daemon=True,
-                                        name="mxtpu-io-prefetch")
-        self._thread.start()
+        self._buf = PrefetchBuffer(self._produce, depth=2,
+                                   name="mxtpu-io-prefetch",
+                                   owner="PrefetchingIter.reset", src="io")
 
     def reset(self):
         # stop + join the producer BEFORE rewinding: resetting the wrapped
         # iterators under a live reader corrupts the next epoch
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=30)
-        if self._thread.is_alive():
-            raise MXNetError(
-                "PrefetchingIter.reset: prefetch worker did not stop "
-                "within 30s (stalled read?); cannot safely rewind")
+        self._buf.close()
         for it in self.iters:
             it.reset()
-        self._queue = queue.Queue(maxsize=2)
         self._start()
 
     def next(self):
-        item = self._queue.get()
-        if item is None:
-            raise StopIteration
-        if isinstance(item, Exception):
-            raise item
-        return item
+        return self._buf.get()
 
     def iter_next(self):
         raise MXNetError("use next() with PrefetchingIter")

@@ -189,6 +189,7 @@ class BaseModule(object):
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
         resume_skip = 0
+        data_restored = False
         if mgr is not None and resume is not None:
             header = mgr.restore(
                 load_params=self.load_params,
@@ -205,10 +206,21 @@ class BaseModule(object):
                 # interrupted epoch, so the resumed epoch fast-forwards
                 # the iterator past them instead of re-applying them
                 resume_skip = int(header["meta"].get("batches_done", 0))
+                # a checkpointable iterator (mxnet_tpu.data StreamDataIter
+                # and friends) restores its exact mid-epoch cursor instead
+                # of blind fast-forwarding: set_state() arms a one-shot
+                # reset skip so the epoch-top reset below keeps it
+                data_state = header["meta"].get("data_state")
+                if data_state is not None and \
+                        hasattr(train_data, "set_state"):
+                    train_data.set_state(data_state)
+                    data_restored = True
                 self.logger.info(
                     "resumed from checkpoint step %d (%s); continuing at "
-                    "epoch %d%s", header["step"], mgr.directory, begin_epoch,
-                    " batch %d" % resume_skip if resume_skip else "")
+                    "epoch %d%s%s", header["step"], mgr.directory,
+                    begin_epoch,
+                    " batch %d" % resume_skip if resume_skip else "",
+                    " (exact data cursor)" if data_restored else "")
         if validation_metric is None:
             validation_metric = eval_metric
         eval_metric = _as_metric(eval_metric)
@@ -242,6 +254,12 @@ class BaseModule(object):
         use_fused = (monitor is None and _env.get("MXTPU_SHARDED_STEP")
                      and self.supports_fused_step())
 
+        # MXTPU_DATA_PREFETCH: overlap batch N+1's host decode + async
+        # host->device copy with batch N's compute (docs/data_pipeline.md).
+        # The fused path places with the trainer's mesh so step_batch
+        # consumes already-sharded arrays (executor._place_inputs no-ops).
+        use_prefetch = _env.get("MXTPU_DATA_PREFETCH")
+
         fit_updates = 0
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -250,12 +268,24 @@ class BaseModule(object):
             train_data.reset()
             batch_iter = iter(train_data)
             if epoch == begin_epoch and resume_skip:
-                for _ in range(resume_skip):
-                    try:
-                        next(batch_iter)
-                    except StopIteration:
-                        break
-                    nbatch += 1
+                if data_restored:
+                    # the restored cursor already sits past these batches;
+                    # only the batch numbering needs to catch up
+                    nbatch = resume_skip
+                else:
+                    for _ in range(resume_skip):
+                        try:
+                            next(batch_iter)
+                        except StopIteration:
+                            break
+                        nbatch += 1
+            prefetcher = None
+            if use_prefetch:
+                from ..data import DevicePrefetcher
+
+                batch_iter = prefetcher = DevicePrefetcher(
+                    batch_iter, mesh=getattr(self, "_mesh", None),
+                    src="fit")
             while True:
                 t_wait = time.perf_counter()
                 try:
@@ -308,17 +338,33 @@ class BaseModule(object):
                 # process started (no-op unless MXTPU_FAULT_INJECT is set)
                 maybe_inject_fault(fit_updates)
                 if mgr is not None and resilience.preemption_requested():
-                    def _emergency_save(_epoch=epoch, _done=nbatch + 1):
+                    if prefetcher is not None:
+                        # freeze the pipeline first: producer threads are
+                        # joined and the delivered-batch cursor is final
+                        # before it lands in the checkpoint meta
+                        prefetcher.close()
+
+                    def _emergency_save(_epoch=epoch, _done=nbatch + 1,
+                                        _cursor=prefetcher or train_data):
                         arg_p, aux_p = self.get_params()
                         self.set_params(arg_p, aux_p)  # sync exec copies
                         # meta epoch = _epoch - 1 + batches_done: resume
                         # re-enters the interrupted epoch but fast-forwards
                         # past the batches whose updates these weights
                         # already carry (exact resume-equivalence)
+                        meta = {"epoch": _epoch - 1, "preempt": True,
+                                "batches_done": _done}
+                        if hasattr(_cursor, "state"):
+                            try:
+                                # exact mid-epoch cursor: resume restores
+                                # it via set_state instead of blind
+                                # fast-forwarding (data/sharded_stream.py)
+                                meta["data_state"] = _cursor.state()
+                            except MXNetError:
+                                pass  # inner iterator has no cursor
                         mgr.save(_epoch, save_params=self.save_params,
                                  save_states=self.save_optimizer_states,
-                                 meta={"epoch": _epoch - 1, "preempt": True,
-                                       "batches_done": _done})
+                                 meta=meta)
                     resilience.maybe_preempt_exit(
                         emergency_save=_emergency_save)
                 self.update_metric(eval_metric, data_batch.label)
@@ -332,6 +378,8 @@ class BaseModule(object):
                 nbatch += 1
                 tm_compute.inc(time.perf_counter() - t_step)
 
+            if prefetcher is not None:
+                prefetcher.close()  # join the producer between epochs
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,

@@ -834,6 +834,19 @@ class CheckpointManager:
 #                                                   (deadline-propagation
 #                                                   test vector)
 #
+# Data-pipeline action (fired by the input pipeline's producer thread —
+# mxnet_tpu/data/core.PrefetchBuffer — with the ordinal of the batch it
+# just produced; `step=` is that producer-side batch ordinal):
+#
+#   MXTPU_FAULT_INJECT="slow_batch@step=3,ms=200"   stall PRODUCTION of
+#                                                   batch 3 by ms= (the
+#                                                   input-jitter chaos
+#                                                   vector: a prefetcher
+#                                                   with depth*step-time
+#                                                   of slack must absorb
+#                                                   it without moving
+#                                                   step latency)
+#
 # Server-side surge action (armed per published model by the repository —
 # `maybe_inject_load_surge`; `after=` seconds into serving replaces the
 # when-condition):
@@ -867,6 +880,7 @@ _TRAIN_ACTIONS = ("kill", "exc", "hang", "corrupt_ckpt", "preempt")
 _CKPT_ACTIONS = ("kill_during_ckpt",)
 _SERVE_ACTIONS = ("kill_replica", "wedge_replica", "slow_reply")
 _SURGE_ACTIONS = ("load_surge",)
+_DATA_ACTIONS = ("slow_batch",)
 _UNPARSED = object()
 _fault_cache = _UNPARSED
 
@@ -878,7 +892,8 @@ def fault_spec(env=None):
     invalidate the test using it."""
     raw = (_env.raw("MXTPU_FAULT_INJECT") or "") if env is None else env
     entries = []
-    known = _TRAIN_ACTIONS + _CKPT_ACTIONS + _SERVE_ACTIONS + _SURGE_ACTIONS
+    known = (_TRAIN_ACTIONS + _CKPT_ACTIONS + _SERVE_ACTIONS +
+             _SURGE_ACTIONS + _DATA_ACTIONS)
     for part in raw.replace(";", " ").split():
         action, _, conds = part.partition("@")
         if action not in known:
@@ -949,6 +964,31 @@ def maybe_inject_fault(step):
         if e["rank"] is not None and e["rank"] != rank:
             continue
         _fire(e, step, rank)
+
+
+def maybe_inject_data_stall(batch):
+    """Producer-side input-stall hook (`slow_batch@step=,ms=`): called by
+    the data pipeline's producer thread (data/core.PrefetchBuffer) with
+    the ordinal of the batch it just produced; sleeps ms= on a match. A
+    correctly-sized prefetcher absorbs the stall (the consumer keeps
+    draining staged batches); an undersized one surfaces it as data_wait
+    — which is exactly what the chaos e2e measures. No-op (one
+    cached-empty check) unless MXTPU_FAULT_INJECT is set."""
+    if not _entries():
+        return
+    gen = restart_generation()
+    rank = _current_rank()
+    for e in _entries():
+        if e["action"] not in _DATA_ACTIONS:
+            continue
+        if e["step"] != batch or e["gen"] != gen:
+            continue
+        if e["rank"] is not None and e["rank"] != rank:
+            continue
+        _LOG.warning("MXTPU_FAULT_INJECT firing: slow_batch at batch=%d "
+                     "rank=%d gen=%d (%dms producer stall)", batch, rank,
+                     gen, e["ms"])
+        time.sleep(e["ms"] / 1e3)
 
 
 def maybe_inject_serving_fault(batch, replica):

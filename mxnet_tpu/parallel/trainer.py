@@ -447,6 +447,17 @@ class DistributedTrainer:
         return jax.device_put(arr, named_sharding(
             self._mesh, batch_spec(self._mesh, arr.ndim)))
 
+    def prefetch(self, it, depth=None):
+        """Wrap a data iterator in a `data.DevicePrefetcher` bound to this
+        trainer's mesh: batches arrive on-device already laid out as
+        `batch_spec` shardings, so step()'s `_shard_batch` is a no-op and
+        the host→device copy overlaps the previous step's compute
+        (docs/data_pipeline.md)."""
+        from ..data import DevicePrefetcher
+
+        return DevicePrefetcher(it, depth=depth, mesh=self._mesh,
+                                src="sharded")
+
     def forward(self, data, is_train=False):
         """Compiled sharded inference over the mesh."""
         import jax

@@ -124,6 +124,12 @@ run_one train_sharded_fused  MXTPU_BENCH_MODE=train_sharded \
                              MXTPU_BENCH_SHARDED_IMPL=fused \
                              MXTPU_BENCH_BATCH=256
 
+# input-pipeline A/B (docs/data_pipeline.md): sync next() vs the
+# DevicePrefetcher double buffer over a deliberately stalled iterator —
+# data_wait_fraction both arms, loss-trajectory equality self-check
+run_one input           MXTPU_BENCH_MODE=train_input \
+                        MXTPU_BENCH_BATCH=256
+
 echo "[bench_capture] step profile" >&2
 rm -rf step_trace
 PYTHONPATH=".:${PYTHONPATH:-}" timeout 1200 python tools/step_profile.py 256 \

@@ -132,6 +132,36 @@ def _extract(doc):
             detail.append("STALE")
         return (metric, doc.get("value"), doc.get("unit") or "",
                 ", ".join(detail))
+    if "train_input" in metric and "value" in doc:
+        # the input-pipeline A/B row (bench.py bench_train_input):
+        # headline is the prefetched imgs/sec; detail surfaces the
+        # data-wait contrast and the row's self-checks (loss-trajectory
+        # equality, post-warm compiles, attributor coverage)
+        detail = []
+        if doc.get("speedup_prefetched_vs_sync") is not None:
+            detail.append("x%s vs sync"
+                          % _fmt(doc["speedup_prefetched_vs_sync"]))
+        if doc.get("data_wait_fraction_sync") is not None:
+            detail.append("wait %s%%->%s%%" % (
+                _fmt(100 * doc["data_wait_fraction_sync"], 1),
+                _fmt(100 * (doc.get("data_wait_fraction_prefetched")
+                            or 0.0), 1)))
+        if doc.get("data_wait_reduction") is not None:
+            detail.append("wait /%s" % _fmt(doc["data_wait_reduction"], 1))
+        if doc.get("loss_trajectory_match") is False:
+            detail.append("TRAJECTORY DIVERGED")
+        if doc.get("jit_compiles_after_warm"):
+            detail.append("%s jit after warm"
+                          % _fmt(doc["jit_compiles_after_warm"], 0))
+        if doc.get("goodput_coverage_prefetched") is not None:
+            detail.append("coverage %s"
+                          % _fmt(doc["goodput_coverage_prefetched"]))
+        if doc.get("platform"):
+            detail.append(str(doc["platform"]))
+        if doc.get("stale"):
+            detail.append("STALE")
+        return (metric, doc.get("value"), doc.get("unit") or "",
+                ", ".join(detail))
     if metric == "train_goodput" and "value" in doc:
         # the goodput-attribution A/B row (bench.py bench_train_goodput):
         # headline is the attributed goodput fraction; detail surfaces the
@@ -278,6 +308,7 @@ _CHECK_METRICS = {
     # (includes coldstart_train_*: fused-restart time-to-step-1)
     "autoscale_scale_up_s": "lower",  # surge -> grown pool serving
     "train_sharded": "higher",      # promotion A/B imgs/sec, per impl+bs
+    "train_input": "higher",        # prefetch A/B imgs/sec, per batch
     "train_preempt_ckpt_stall": "higher",  # sync/async stall reduction, x
     "train_goodput": "higher",      # attributed goodput fraction of wall
 }
@@ -340,13 +371,13 @@ def check(rows, tolerance=0.15):
                 gate(name, [r for r in usable if r["metric"] == name],
                      lambda r: r["value"], direction)
             continue
-        if metric == "train_sharded":
+        if metric in ("train_sharded", "train_input"):
             # per-impl-per-batch families (mlp_train_sharded_fused_bs256_
-            # imgs_per_sec, ...): fused and op-by-op each gate on their
-            # own history — racing them would mask a fused regression
-            # behind an op-by-op improvement
+            # imgs_per_sec, mlp_train_input_prefetch_bs256_..., ...):
+            # each name gates on its own history — racing configs would
+            # mask one family's regression behind another's improvement
             names = sorted({str(r["metric"]) for r in usable
-                            if "train_sharded" in str(r["metric"])})
+                            if metric in str(r["metric"])})
             for name in names:
                 gate(name, [r for r in usable if r["metric"] == name],
                      lambda r: r["value"], direction)
